@@ -1,0 +1,38 @@
+"""hetu_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of Hetu
+(PKU DAIR's dataflow DL system, see SURVEY.md): define-then-run graph API,
+executor, distributed strategies (DP/TP/PP/EP/CP) over ``jax.sharding`` device
+meshes, MoE, host-resident embedding store with bounded-staleness cache,
+auto-parallel search, tokenizers/ONNX/metrics tooling.
+
+Typical use (identical shape to reference examples)::
+
+    import hetu_tpu as ht
+    x = ht.placeholder_op('x')
+    w = ht.init.xavier_uniform((784, 10), name='w')
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    executor = ht.Executor({'train': [loss, train_op]})
+    executor.run('train', feed_dict={...})
+"""
+
+from . import initializers as init
+from . import optim
+from . import context as _context_mod
+from .context import (cpu, gpu, tpu, rcpu, rgpu, DLContext, DeviceGroup,
+                      context, DistConfig, make_mesh)
+from .ndarray import NDArray, array, empty, IndexedSlices, is_gpu_ctx
+from .graph import (Op, PlaceholderOp, Variable, placeholder_op, gradients,
+                    GradientOp, Executor, topo_sort,
+                    worker_init, worker_finish, server_init, server_finish,
+                    scheduler_init, scheduler_finish)
+from .ops import *  # noqa: F401,F403 — full op surface (ht.matmul_op, ...)
+from .data import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
+from . import data
+from . import parallel
+from . import parallel as dist  # reference alias: ht.dist.DataParallel
+from . import layers
+from . import metrics
+
+__version__ = "0.1.0"

@@ -1,0 +1,230 @@
+"""Device placement DSL and cluster config.
+
+TPU-native re-design of the reference ``python/hetu/context.py`` (DeviceGroup:19,
+ContextStack/ht.context:153-181, DistConfig:284).  On TPU, placement is not
+"which CUDA device runs this op's kernel" but "how is this op's data sharded
+over a named mesh".  We keep the user-facing surface (``ht.context(...)``,
+``DeviceGroup``, ``ht.gpu(i)``/``ht.cpu(i)``) and map it onto
+``jax.sharding.Mesh`` + ``PartitionSpec``.
+
+Standard mesh axes (SURVEY.md §7 design mapping):
+    ``dp``  – data parallel        ``tp`` – tensor parallel
+    ``pp``  – pipeline stages      ``ep`` – expert parallel
+    ``cp``  – context/sequence parallel
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+
+import numpy as np
+
+
+class DLContext:
+    """A single logical device. Parity shim for ``ht.gpu(i)`` / ``ht.cpu(i)``.
+
+    On TPU we interpret device indices as positions in the flat device list;
+    'cpu' marks host-resident placement (embedding tables, dataloaders).
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0, hostname: str = "localhost"):
+        self.device_type = device_type  # 'cpu' | 'gpu' | 'tpu'
+        self.device_id = device_id
+        self.hostname = hostname
+
+    @property
+    def is_host(self):
+        return self.device_type == "cpu"
+
+    def __eq__(self, other):
+        return (isinstance(other, DLContext)
+                and (self.device_type, self.device_id, self.hostname)
+                == (other.device_type, other.device_id, other.hostname))
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id, self.hostname))
+
+    def __repr__(self):
+        return f"{self.hostname}:{self.device_type}:{self.device_id}"
+
+
+def cpu(device_id: int = 0):
+    return DLContext("cpu", device_id)
+
+
+def gpu(device_id: int = 0):
+    # On this framework "gpu" means "accelerator chip" — kept for API parity
+    # with reference model scripts; maps to TPU device index.
+    return DLContext("tpu", device_id)
+
+
+def tpu(device_id: int = 0):
+    return DLContext("tpu", device_id)
+
+
+def rcpu(hostname, device_id=0):
+    return DLContext("cpu", device_id, hostname)
+
+
+def rgpu(hostname, device_id=0):
+    return DLContext("tpu", device_id, hostname)
+
+
+class DeviceGroup:
+    """An ordered group of devices an op (or stage) is placed on.
+
+    Reference: ``context.py:19``. Accepts contexts, strings like
+    ``'gpu:0'``/``'cpu:0'``/``'node1:gpu:3'``, and tuples (a tuple = one
+    model-parallel unit spanning several devices).
+    """
+
+    def __init__(self, ctxs):
+        if not isinstance(ctxs, (list, tuple)):
+            ctxs = [ctxs]
+        self._contexts = [self._parse(c) for c in ctxs]
+
+    @staticmethod
+    def _parse(c):
+        if isinstance(c, DLContext):
+            return c
+        if isinstance(c, tuple):
+            return tuple(DeviceGroup._parse(x) for x in c)
+        if isinstance(c, str):
+            parts = c.split(":")
+            if len(parts) == 2:
+                dtype, idx = parts
+                host = "localhost"
+            elif len(parts) == 3:
+                host, dtype, idx = parts
+            else:
+                raise ValueError(f"cannot parse device string {c!r}")
+            dtype = "tpu" if dtype == "gpu" else dtype
+            return DLContext(dtype, int(idx), host)
+        raise TypeError(f"bad context spec: {c!r}")
+
+    @property
+    def contexts(self):
+        return self._contexts
+
+    @property
+    def worker_num(self):
+        return len(self._contexts)
+
+    def flat_device_ids(self):
+        out = []
+        for c in self._contexts:
+            if isinstance(c, tuple):
+                out.extend(x.device_id for x in c)
+            elif not c.is_host:
+                out.append(c.device_id)
+        return out
+
+    def __len__(self):
+        return len(self._contexts)
+
+    def __iter__(self):
+        return iter(self._contexts)
+
+    def __getitem__(self, i):
+        return self._contexts[i]
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceGroup) and self._contexts == other._contexts
+
+    def __hash__(self):
+        return hash(tuple(self._contexts))
+
+    def __repr__(self):
+        return f"DeviceGroup({self._contexts})"
+
+
+class _ContextStack:
+    def __init__(self):
+        self._stack = []
+
+    def peek(self):
+        return self._stack[-1] if self._stack else None
+
+    def push(self, ctx):
+        self._stack.append(ctx)
+
+    def pop(self):
+        self._stack.pop()
+
+
+_ctx_stack = _ContextStack()
+
+
+def current_context():
+    return _ctx_stack.peek()
+
+
+@contextlib.contextmanager
+def context(ctx):
+    """``with ht.context(ht.gpu(0)):`` placement scope (reference context.py:174)."""
+    if not isinstance(ctx, DeviceGroup):
+        ctx = DeviceGroup(ctx)
+    _ctx_stack.push(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# Mesh helpers
+# ---------------------------------------------------------------------------
+
+MESH_AXES = ("dp", "pp", "tp", "ep", "cp")
+
+
+def make_mesh(axis_sizes=None, devices=None):
+    """Build a ``jax.sharding.Mesh`` with named axes.
+
+    ``axis_sizes``: dict like {'dp': 4, 'tp': 2}; unmentioned axes get size 1
+    and are dropped. If None, all devices go on 'dp'.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {"dp": n}
+    names, sizes = [], []
+    for ax in MESH_AXES:
+        s = int(axis_sizes.get(ax, 1))
+        if s > 1 or (ax in axis_sizes and s == 1):
+            names.append(ax)
+            sizes.append(s)
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} "
+                         f"devices, got {n}")
+    dev_array = np.asarray(devices).reshape(sizes if sizes else (1,))
+    return Mesh(dev_array, tuple(names) if names else ("dp",))
+
+
+class DistConfig:
+    """Cluster spec loaded from yaml (reference ``context.py:284``).
+
+    On TPU pods the runtime discovers topology itself
+    (``jax.distributed.initialize``); the yaml is kept for launcher parity and
+    for multi-slice (DCN) descriptions.
+    """
+
+    def __init__(self, file=None, num_hosts=1, hosts=None):
+        self.hosts = hosts or ["localhost"]
+        self.num_hosts = num_hosts
+        if file is not None:
+            import yaml
+            with open(file) as f:
+                spec = yaml.safe_load(f)
+            nodes = spec.get("nodes", [])
+            self.hosts = [n.get("host", "localhost") for n in nodes] or self.hosts
+            self.num_hosts = len(self.hosts)
+        self.chief = self.hosts[0]
+
+    def __repr__(self):
+        return f"DistConfig(hosts={self.hosts})"

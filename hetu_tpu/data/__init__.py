@@ -1,0 +1,2 @@
+from .dataloader import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
+from .datasets import mnist, cifar10, cifar100, normalize_cifar

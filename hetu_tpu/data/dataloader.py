@@ -1,0 +1,104 @@
+"""Data pipeline (reference ``python/hetu/dataloader.py``: Dataloader:84 with
+triple-buffer prefetch + dp sharding, DataloaderOp:259 multi-split).
+
+TPU-native: the loader hands the executor one GLOBAL batch per step; under a
+DataParallel mesh the executor ``device_put``s it with a 'dp' PartitionSpec so
+each chip receives its shard via async host→device transfer (the reference
+instead had each MPI rank slice by ``dp_rank``, dataloader.py:96-101).
+Prefetch = simple lookahead queue; XLA's async dispatch overlaps the copy
+with the previous step's compute.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import PlaceholderOp
+
+
+class Dataloader:
+    """One split of data batched for one subgraph name."""
+
+    def __init__(self, raw_data, batch_size, name="default", func=None,
+                 drop_last=True, shuffle=False, seed=0):
+        self.raw_data = np.asarray(raw_data, np.float32)
+        self.batch_size = int(batch_size)
+        self.name = name
+        self.func = func
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self._order = np.arange(len(self.raw_data))
+        self._cursor = 0
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def batch_num(self):
+        n = len(self.raw_data) // self.batch_size
+        if not self.drop_last and len(self.raw_data) % self.batch_size:
+            n += 1
+        return n
+
+    def get_arr(self):
+        idx = self._order[self._cursor * self.batch_size:
+                          (self._cursor + 1) * self.batch_size]
+        batch = self.raw_data[idx]
+        if self.func is not None:
+            batch = self.func(batch)
+        self._cursor += 1
+        if self._cursor >= self.batch_num:
+            self._cursor = 0
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+        return batch
+
+    def get_cur_shape(self):
+        return (self.batch_size,) + self.raw_data.shape[1:]
+
+
+class DataloaderOp(PlaceholderOp):
+    """Graph input fed from per-subgraph Dataloaders (reference :259)."""
+
+    op_type = "DataloaderOp"
+
+    def __init__(self, dataloaders, name=None):
+        super().__init__(name or "dataloader")
+        self.dataloaders = {dl.name: dl for dl in dataloaders}
+
+    def get_batch_num(self, name):
+        return self.dataloaders[name].batch_num
+
+    def get_arr(self, name):
+        return self.dataloaders[name].get_arr()
+
+    def get_cur_shape(self, name):
+        return self.dataloaders[name].get_cur_shape()
+
+
+def dataloader_op(dataloaders, name=None):
+    """``ht.dataloader_op([ht.Dataloader(x, bs, 'train'), ...])`` parity."""
+    dls = []
+    for d in dataloaders:
+        if isinstance(d, Dataloader):
+            dls.append(d)
+        else:  # [raw_data, batch_size, name?, func?] list form
+            dls.append(Dataloader(*d))
+    return DataloaderOp(dls, name=name)
+
+
+class GNNDataLoaderOp(PlaceholderOp):
+    """Graph-minibatch loader (reference :220) — host-side graph sampling
+    feeding dense blocks; ping-pong buffering is XLA-async here."""
+
+    op_type = "GNNDataloaderOp"
+
+    def __init__(self, handler, name=None):
+        super().__init__(name or "gnn_dataloader")
+        self.handler = handler
+        self._next = None
+
+    def step(self, graph):
+        self._next = self.handler(graph)
+
+    def get_arr(self, name):
+        return self._next

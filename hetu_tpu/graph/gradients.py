@@ -1,0 +1,40 @@
+"""Reverse-mode autodiff over the symbolic graph.
+
+The reference implements graph-transform autodiff with one hand-written
+``gradient()`` rule per op (``gpu_ops/executor.py:1071-1189``).  TPU-native
+redesign: gradients are *symbolic markers* resolved by the executor with
+``jax.grad`` over the lowered forward function — one fused backward XLA
+computation, correct for every op that has a JAX lowering, no per-op rules.
+The user-facing contract is identical: ``ht.gradients(loss, [w1, w2])``
+returns graph nodes that can be fetched or fed to an optimizer.
+"""
+from __future__ import annotations
+
+from .node import Op
+
+
+class GradientOp(Op):
+    """Marker node: d(loss)/d(wrt). Resolved inside the executor's jitted step."""
+
+    op_type = "Gradient"
+
+    def __init__(self, loss, wrt, name=None):
+        super().__init__([loss, wrt], name=name or f"grad_{wrt.name}")
+        self.loss = loss
+        self.wrt = wrt
+
+    def lower(self, ctx, *vals):  # resolved specially by the executor
+        raise RuntimeError("GradientOp must be resolved by the executor")
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+def gradients(loss, node_list, insert_grad=None):
+    """Return gradient nodes of ``loss`` w.r.t. each node in ``node_list``.
+
+    Parity with reference ``ht.gradients`` (executor.py:1071). ``insert_grad``
+    (initial output cotangent) is accepted for API parity.
+    """
+    del insert_grad
+    return [GradientOp(loss, n) for n in node_list]

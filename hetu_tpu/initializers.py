@@ -1,0 +1,259 @@
+"""Initializers (reference ``python/hetu/initializers.py`` — nine init classes,
+``zeros``/``ones``/``xavier_*``/``he_*``/``lecun_*`` Variable factories and
+``Gen*`` closures).  TPU-native: inits are pure functions of a
+``jax.random`` key — fully deterministic per-variable (vs curand global
+state); the executor folds a per-variable index into the master seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .graph.node import Variable
+
+
+class BaseInit:
+    def __call__(self, shape, name=None, trainable=True, ctx=None, is_embed=False):
+        """Variable factory — layers call ``initializer(shape=..., name=...)``
+        (reference layers/linear.py:26); returns a Variable node."""
+        return Variable(name or "var", initializer=self, trainable=trainable,
+                        shape=shape, is_embed=is_embed)
+
+    def materialize(self, shape, key):
+        """Pure init used by the executor: deterministic in ``key``."""
+        import jax
+        if key is None:
+            key = jax.random.key(np.random.randint(0, 2**31 - 1))
+        return np.asarray(self.init(shape, key), np.float32)
+
+    def init(self, shape, key):
+        raise NotImplementedError
+
+
+class ConstantInit(BaseInit):
+    def __init__(self, constant=0.0):
+        self.constant = constant
+
+    def init(self, shape, key):
+        return np.full(shape, self.constant, np.float32)
+
+
+class ZerosInit(ConstantInit):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class OnesInit(ConstantInit):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+class UniformInit(BaseInit):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def init(self, shape, key):
+        import jax
+        return jax.random.uniform(key, shape, minval=self.low, maxval=self.high)
+
+
+class NormalInit(BaseInit):
+    def __init__(self, mean=0.0, stddev=1.0):
+        self.mean, self.stddev = mean, stddev
+
+    def init(self, shape, key):
+        import jax
+        return self.mean + self.stddev * jax.random.normal(key, shape)
+
+
+class TruncatedNormalInit(BaseInit):
+    def __init__(self, mean=0.0, stddev=1.0):
+        self.mean, self.stddev = mean, stddev
+
+    def init(self, shape, key):
+        import jax
+        return self.mean + self.stddev * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape)
+
+
+def _fans(shape, mode):
+    shape = tuple(shape)
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:  # conv OIHW
+        rf = int(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    else:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    return {"fan_in": fan_in, "fan_out": fan_out,
+            "avg": (fan_in + fan_out) / 2.0}[mode]
+
+
+class GeneralXavierUniformInit(UniformInit):
+    def __init__(self, gain=1.0, mode="avg"):
+        super().__init__()
+        self.gain, self.mode = gain, mode
+
+    def init(self, shape, key):
+        limit = float(np.sqrt(3.0 * self.gain / _fans(shape, self.mode)))
+        self.low, self.high = -limit, limit
+        return super().init(shape, key)
+
+
+class XavierUniformInit(GeneralXavierUniformInit):
+    def __init__(self):
+        super().__init__(1.0, "avg")
+
+
+class HeUniformInit(GeneralXavierUniformInit):
+    def __init__(self):
+        super().__init__(2.0, "fan_in")
+
+
+class LecunUniformInit(GeneralXavierUniformInit):
+    def __init__(self):
+        super().__init__(1.0, "fan_in")
+
+
+class GeneralXavierNormalInit(NormalInit):
+    def __init__(self, gain=1.0, mode="avg"):
+        super().__init__()
+        self.gain, self.mode = gain, mode
+
+    def init(self, shape, key):
+        self.stddev = float(np.sqrt(self.gain / _fans(shape, self.mode)))
+        return super().init(shape, key)
+
+
+class XavierNormalInit(GeneralXavierNormalInit):
+    def __init__(self):
+        super().__init__(1.0, "avg")
+
+
+class HeNormalInit(GeneralXavierNormalInit):
+    def __init__(self):
+        super().__init__(2.0, "fan_in")
+
+
+class LecunNormalInit(GeneralXavierNormalInit):
+    def __init__(self):
+        super().__init__(1.0, "fan_in")
+
+
+# -- Variable factories (reference initializers.py:214-311) -----------------
+
+def _make(init, shape, name, trainable, is_embed=False):
+    return init(shape, name=name, trainable=trainable, is_embed=is_embed)
+
+
+def zeros(shape, name=None, trainable=True, ctx=None):
+    return _make(ZerosInit(), shape, name, trainable)
+
+
+def ones(shape, name=None, trainable=True, ctx=None):
+    return _make(OnesInit(), shape, name, trainable)
+
+
+def constant(shape, fill_value=0.0, name=None, trainable=True, ctx=None):
+    return _make(ConstantInit(fill_value), shape, name, trainable)
+
+
+def truncated_normal(shape, mean=0.0, stddev=1.0, name=None, trainable=True, ctx=None):
+    return _make(TruncatedNormalInit(mean, stddev), shape, name, trainable)
+
+
+def random_normal(shape, mean=0.0, stddev=1.0, name=None, trainable=True, ctx=None):
+    return _make(NormalInit(mean, stddev), shape, name, trainable)
+
+
+def random_uniform(shape, minval=-1.0, maxval=1.0, name=None, trainable=True, ctx=None):
+    return _make(UniformInit(minval, maxval), shape, name, trainable)
+
+
+def general_xavier_normal(shape, gain, mode, name=None, trainable=True, ctx=None):
+    return _make(GeneralXavierNormalInit(gain, mode), shape, name, trainable)
+
+
+def general_xavier_uniform(shape, gain, mode, name=None, trainable=True, ctx=None):
+    return _make(GeneralXavierUniformInit(gain, mode), shape, name, trainable)
+
+
+def xavier_normal(shape, name=None, trainable=True, ctx=None):
+    return _make(XavierNormalInit(), shape, name, trainable)
+
+
+def xavier_uniform(shape, name=None, trainable=True, ctx=None):
+    return _make(XavierUniformInit(), shape, name, trainable)
+
+
+def he_normal(shape, name=None, trainable=True, ctx=None):
+    return _make(HeNormalInit(), shape, name, trainable)
+
+
+def he_uniform(shape, name=None, trainable=True, ctx=None):
+    return _make(HeUniformInit(), shape, name, trainable)
+
+
+def lecun_normal(shape, name=None, trainable=True, ctx=None):
+    return _make(LecunNormalInit(), shape, name, trainable)
+
+
+def lecun_uniform(shape, name=None, trainable=True, ctx=None):
+    return _make(LecunUniformInit(), shape, name, trainable)
+
+
+# -- Gen* closures (reference initializers.py:314-360) ----------------------
+
+def GenZeros():
+    return ZerosInit()
+
+
+def GenOnes():
+    return OnesInit()
+
+
+def GenConstant(fill_value=0.0):
+    return ConstantInit(fill_value)
+
+
+def GenTruncatedNormal(mean=0.0, stddev=1.0):
+    return TruncatedNormalInit(mean, stddev)
+
+
+def GenNormal(mean=0.0, stddev=1.0):
+    return NormalInit(mean, stddev)
+
+
+def GenUniform(minval=-1.0, maxval=1.0):
+    return UniformInit(minval, maxval)
+
+
+def GenGeneralXavierNormal(gain, mode):
+    return GeneralXavierNormalInit(gain, mode)
+
+
+def GenGeneralXavierUniform(gain, mode):
+    return GeneralXavierUniformInit(gain, mode)
+
+
+def GenXavierNormal():
+    return XavierNormalInit()
+
+
+def GenXavierUniform():
+    return XavierUniformInit()
+
+
+def GenHeNormal():
+    return HeNormalInit()
+
+
+def GenHeUniform():
+    return HeUniformInit()
+
+
+def GenLecunNormal():
+    return LecunNormalInit()
+
+
+def GenLecunUniform():
+    return LecunUniformInit()

@@ -1,0 +1,40 @@
+"""Multi-head attention layer (new capability; the reference composes this
+per-model in ``examples/transformers/*/hetu_bert.py``)."""
+from __future__ import annotations
+
+from .base import BaseLayer
+from .core import Linear, DropOut
+from .. import ops
+from ..ops.attention import sdpa_op
+
+
+class MultiHeadAttention(BaseLayer):
+    def __init__(self, hidden_size, num_heads, dropout=0.0, causal=False,
+                 name="mha"):
+        assert hidden_size % num_heads == 0
+        self.h = num_heads
+        self.dk = hidden_size // num_heads
+        self.hidden = hidden_size
+        self.causal = causal
+        self.q = Linear(hidden_size, hidden_size, name=name + ".q")
+        self.k = Linear(hidden_size, hidden_size, name=name + ".k")
+        self.v = Linear(hidden_size, hidden_size, name=name + ".v")
+        self.o = Linear(hidden_size, hidden_size, name=name + ".o")
+        self.drop = DropOut(dropout) if dropout else None
+
+    def _split(self, x, batch, seq):
+        x = ops.array_reshape_op(x, output_shape=(batch, seq, self.h, self.dk))
+        return ops.transpose_op(x, perm=(0, 2, 1, 3))
+
+    def __call__(self, x, batch, seq):
+        """x: (batch*seq, hidden) (reference models flatten); returns same."""
+        q = self._split(self.q(x), batch, seq)
+        k = self._split(self.k(x), batch, seq)
+        v = self._split(self.v(x), batch, seq)
+        o = sdpa_op(q, k, v, causal=self.causal)
+        o = ops.transpose_op(o, perm=(0, 2, 1, 3))
+        o = ops.array_reshape_op(o, output_shape=(batch * seq, self.hidden))
+        o = self.o(o)
+        if self.drop is not None:
+            o = self.drop(o)
+        return o

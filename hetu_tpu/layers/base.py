@@ -1,0 +1,9 @@
+"""Layer base (reference ``layers/base.py``)."""
+
+
+class BaseLayer:
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"

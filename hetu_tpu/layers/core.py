@@ -1,0 +1,218 @@
+"""Core layers (reference ``layers/``: linear.py, conv.py, normalization.py,
+pooling.py, dropout.py, embedding.py, sequence.py, reshape.py, identity.py,
+concatenate.py, slice.py, sum.py)."""
+from __future__ import annotations
+
+from .base import BaseLayer
+from ..graph.node import Op
+from .. import initializers as init
+from .. import ops
+
+
+class _TransposedInit:
+    """Initialize with the transposed (logical) shape, store transposed —
+    keeps fan_in/fan_out semantics for weight_transpose layers."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __call__(self, shape, name=None, trainable=True, ctx=None,
+                 is_embed=False):
+        from ..graph.node import Variable
+        return Variable(name or "var", initializer=self, trainable=trainable,
+                        shape=shape, is_embed=is_embed)
+
+    def materialize(self, shape, key):
+        return self.inner.materialize(tuple(shape)[::-1], key).T
+
+
+def _resolve_activation(activation):
+    if isinstance(activation, str):
+        table = {"relu": ops.relu_op, "gelu": ops.gelu_op,
+                 "tanh": ops.tanh_op, "sigmoid": ops.sigmoid_op}
+        if activation not in table:
+            raise NotImplementedError(activation)
+        return table[activation]
+    return activation
+
+
+class Linear(BaseLayer):
+    def __init__(self, in_features, out_features, initializer=None, bias=True,
+                 activation=None, weight_transpose=False, name="linear"):
+        initializer = initializer or init.GenXavierUniform()
+        self.in_features, self.out_features = in_features, out_features
+        self.bias = bias
+        self.activation = _resolve_activation(activation)
+        self.weight_transpose = weight_transpose
+        self.name = name
+        if isinstance(initializer, Op):
+            self.weight_var = initializer  # user-supplied weight node
+        else:
+            if weight_transpose:
+                # materialize with logical (in, out) shape so fan_in/fan_out
+                # mode initializers (He/Lecun) see the true fans, then store
+                # transposed
+                initializer = _TransposedInit(initializer)
+                wshape = (out_features, in_features)
+            else:
+                wshape = (in_features, out_features)
+            self.weight_var = initializer(shape=wshape, name=name + ".weight")
+        if bias:
+            self.bias_var = init.zeros(shape=(out_features,), name=name + ".bias")
+
+    def __call__(self, x):
+        if self.bias:
+            x = ops.linear_op(x, self.weight_var, self.bias_var,
+                              trans_B=self.weight_transpose)
+        else:
+            x = ops.matmul_op(x, self.weight_var, trans_B=self.weight_transpose)
+        if self.activation is not None:
+            x = self.activation(x)
+        return x
+
+
+class Conv2d(BaseLayer):
+    def __init__(self, in_channel, out_channel, kernel_size, stride=1,
+                 padding=0, initializer=None, bias=True, activation=None,
+                 name="conv2d"):
+        initializer = initializer or init.GenXavierUniform()
+        ksize = kernel_size if isinstance(kernel_size, tuple) \
+            else (kernel_size, kernel_size)
+        self.stride, self.padding = stride, padding
+        self.bias = bias
+        self.activation = _resolve_activation(activation)
+        self.weight_var = initializer(
+            shape=(out_channel, in_channel) + ksize, name=name + ".weight")
+        if bias:
+            self.bias_var = init.zeros(shape=(out_channel,), name=name + ".bias")
+
+    def __call__(self, x):
+        if self.bias:
+            x = ops.conv2d_add_bias_op(x, self.weight_var, self.bias_var,
+                                       padding=self.padding, stride=self.stride)
+        else:
+            x = ops.conv2d_op(x, self.weight_var,
+                              padding=self.padding, stride=self.stride)
+        if self.activation is not None:
+            x = self.activation(x)
+        return x
+
+
+class BatchNorm(BaseLayer):
+    def __init__(self, num_channels, momentum=0.1, eps=1e-5, name="batchnorm"):
+        self.scale_var = init.ones(shape=(num_channels,), name=name + ".scale")
+        self.bias_var = init.zeros(shape=(num_channels,), name=name + ".bias")
+        self.momentum, self.eps, self.name = momentum, eps, name
+
+    def __call__(self, x):
+        return ops.batch_normalization_op(x, self.scale_var, self.bias_var,
+                                          momentum=self.momentum, eps=self.eps,
+                                          name=self.name)
+
+
+class LayerNorm(BaseLayer):
+    def __init__(self, num_channels, eps=1e-5, name="layernorm"):
+        self.scale_var = init.ones(shape=(num_channels,), name=name + ".scale")
+        self.bias_var = init.zeros(shape=(num_channels,), name=name + ".bias")
+        self.eps = eps
+
+    def __call__(self, x):
+        return ops.layer_normalization_op(x, self.scale_var, self.bias_var,
+                                          eps=self.eps)
+
+
+class Embedding(BaseLayer):
+    def __init__(self, num_embeddings, embedding_dim, initializer=None,
+                 name="embedding", ctx=None):
+        initializer = initializer or init.GenXavierNormal()
+        self.embedding_table = initializer(
+            shape=(num_embeddings, embedding_dim), name=name + ".weight",
+            is_embed=True)
+
+    def __call__(self, x):
+        return ops.embedding_lookup_op(self.embedding_table, x)
+
+
+class DropOut(BaseLayer):
+    def __init__(self, p=0.5):
+        self.keep_prob = 1.0 - p
+
+    def __call__(self, x):
+        return ops.dropout_op(x, self.keep_prob)
+
+
+class MaxPool2d(BaseLayer):
+    def __init__(self, kernel_size, stride=1, padding=0):
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return ops.max_pool2d_op(x, self.k, self.k, self.p, self.s)
+
+
+class AvgPool2d(BaseLayer):
+    def __init__(self, kernel_size, stride=1, padding=0):
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return ops.avg_pool2d_op(x, self.k, self.k, self.p, self.s)
+
+
+class Relu(BaseLayer):
+    def __call__(self, x):
+        return ops.relu_op(x)
+
+
+class Reshape(BaseLayer):
+    def __init__(self, shape):
+        self.shape = shape
+
+    def __call__(self, x):
+        return ops.array_reshape_op(x, output_shape=self.shape)
+
+
+class Identity(BaseLayer):
+    def __call__(self, x):
+        return x
+
+
+class Sequence(BaseLayer):
+    def __init__(self, *layers):
+        self.layers = layers
+
+    def __call__(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class Concatenate(BaseLayer):
+    def __init__(self, axis=0):
+        self.axis = axis
+
+    def __call__(self, xs):
+        return ops.concatenate_op(list(xs), axis=self.axis)
+
+
+class ConcatenateLayers(BaseLayer):
+    def __init__(self, layers, axis=0):
+        self.layers, self.axis = layers, axis
+
+    def __call__(self, x):
+        return ops.concatenate_op([l(x) for l in self.layers], axis=self.axis)
+
+
+class SumLayers(BaseLayer):
+    def __init__(self, layers):
+        self.layers = layers
+
+    def __call__(self, x):
+        outs = [l(x) for l in self.layers]
+        return outs[0] if len(outs) == 1 else ops.sum_op(outs)
+
+
+class Slice(BaseLayer):
+    def __init__(self, begin, size):
+        self.begin, self.size = begin, size
+
+    def __call__(self, x):
+        return ops.slice_op(x, begin=self.begin, size=self.size)

@@ -1,0 +1,62 @@
+"""Numpy-side metrics (reference ``python/hetu/metrics.py``: AUC:120,
+accuracy:154, precision/recall/F1:220-315)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def accuracy(y_pred, y_true):
+    """Row-wise argmax accuracy; accepts one-hot or class-index y_true."""
+    y_pred = _np(y_pred)
+    y_true = _np(y_true)
+    pred = np.argmax(y_pred, axis=-1)
+    true = np.argmax(y_true, axis=-1) if y_true.ndim == y_pred.ndim else y_true
+    return float((pred == true).mean())
+
+
+def auc(y_pred, y_true):
+    """Binary ROC-AUC via rank statistic (ties averaged)."""
+    score = _np(y_pred).reshape(-1)
+    label = _np(y_true).reshape(-1)
+    # average ranks with ties, vectorized: rank of a tied group = mean of its
+    # positions = start + (count-1)/2
+    uniq, inv, counts = np.unique(score, return_inverse=True,
+                                  return_counts=True)
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    ranks = (starts + (counts - 1) / 2.0 + 1.0)[inv]
+    pos = label > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def confusion_matrix(y_pred, y_true, num_classes=None):
+    pred = np.argmax(_np(y_pred), axis=-1) if _np(y_pred).ndim > 1 else _np(y_pred)
+    true = np.argmax(_np(y_true), axis=-1) if _np(y_true).ndim > 1 else _np(y_true)
+    n = num_classes or int(max(pred.max(), true.max())) + 1
+    cm = np.zeros((n, n), np.int64)
+    np.add.at(cm, (true.astype(int), pred.astype(int)), 1)
+    return cm
+
+
+def precision(y_pred, y_true, cls=1):
+    cm = confusion_matrix(y_pred, y_true)
+    denom = cm[:, cls].sum()
+    return float(cm[cls, cls] / denom) if denom else 0.0
+
+
+def recall(y_pred, y_true, cls=1):
+    cm = confusion_matrix(y_pred, y_true)
+    denom = cm[cls, :].sum()
+    return float(cm[cls, cls] / denom) if denom else 0.0
+
+
+def f1_score(y_pred, y_true, cls=1):
+    p = precision(y_pred, y_true, cls)
+    r = recall(y_pred, y_true, cls)
+    return 2 * p * r / (p + r) if (p + r) else 0.0

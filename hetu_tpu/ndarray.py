@@ -1,0 +1,110 @@
+"""NDArray façade over ``jax.Array`` (reference ``python/hetu/ndarray.py``:
+NDArray:140, ND_Sparse_Array:460, IndexedSlices:507, ``array``:405).
+
+The reference NDArray owns raw device memory via the ctypes DLArray ABI; here
+it is a thin veneer: jax.Array already provides device residence, async
+transfer and buffer lifetime.  Kept so model/example code using
+``ht.array(...)``, ``.asnumpy()``, ``ht.empty`` ports unchanged.  Streams and
+events (``stream.py``) have no TPU analogue under XLA's async runtime —
+``wait()`` maps to ``block_until_ready``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import DLContext, cpu, gpu
+
+
+class NDArray:
+    __slots__ = ("_arr", "ctx")
+
+    def __init__(self, arr, ctx=None):
+        import jax.numpy as jnp
+        if isinstance(arr, NDArray):
+            arr = arr._arr
+        if not hasattr(arr, "devices"):  # numpy / list → device array
+            arr = jnp.asarray(np.asarray(arr))
+        self._arr = arr
+        self.ctx = ctx or gpu(0)
+
+    @property
+    def shape(self):
+        return tuple(self._arr.shape)
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def asnumpy(self):
+        return np.asarray(self._arr)
+
+    def numpy(self):
+        return self.asnumpy()
+
+    def jax(self):
+        return self._arr
+
+    def wait(self):
+        self._arr.block_until_ready()
+        return self
+
+    def copyto(self, target):
+        if isinstance(target, NDArray):
+            target._arr = self._arr
+            return target
+        raise TypeError(target)
+
+    def __getitem__(self, idx):
+        return NDArray(self._arr[idx], self.ctx)
+
+    def __array__(self, dtype=None):
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
+    def __float__(self):
+        return float(self._arr)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return f"NDArray(shape={self.shape}, dtype={self.dtype}, ctx={self.ctx})"
+
+
+def array(arr, ctx=None, data_type=np.float32):
+    """``ht.array(np_arr, ctx=ht.gpu(0))`` parity (reference ndarray.py:405)."""
+    return NDArray(np.asarray(arr, dtype=data_type), ctx)
+
+
+def empty(shape, ctx=None, dtype=np.float32):
+    import jax.numpy as jnp
+    return NDArray(jnp.zeros(shape, dtype), ctx)
+
+
+def is_gpu_ctx(ctx):
+    return isinstance(ctx, DLContext) and not ctx.is_host
+
+
+class IndexedSlices:
+    """Sparse gradient rows (reference ndarray.py:507).  Under jit, XLA's
+    scatter-add covers the dense path; this host-side type serves the
+    host-embedding store (:mod:`hetu_tpu.embedding`)."""
+
+    def __init__(self, indices=None, values=None, dense_shape=None):
+        self.indices = indices
+        self.values = values
+        self.dense_shape = dense_shape
+
+    def to_dense(self):
+        out = np.zeros(self.dense_shape, np.float32)
+        np.add.at(out, np.asarray(self.indices).astype(np.int64).reshape(-1),
+                  np.asarray(self.values).reshape(-1, self.dense_shape[-1]))
+        return out
+
+    def cpu_deduplicate(self):
+        idx = np.asarray(self.indices).reshape(-1)
+        vals = np.asarray(self.values).reshape(-1, np.asarray(self.values).shape[-1])
+        uniq, inv = np.unique(idx, return_inverse=True)
+        out = np.zeros((len(uniq), vals.shape[-1]), vals.dtype)
+        np.add.at(out, inv, vals)
+        return uniq, out

@@ -1,0 +1,84 @@
+"""Elementwise / scalar ops.
+
+Parity targets (reference ``src/ops``): Abs, AddConst, AddElewise, Bool, Clamp,
+ConstPow, Division, Exp, Floor, Fmod, Log, MinusByConst/Elewise,
+MultiplyConst/Elewise, Ne, Opposite, Pow, Sigmoid, Sin, Sqrt, Tanh, Gelu(act),
+LeakyRelu(act), Where, Eye, Arange, Full, OnesLike, ZerosLike, Rand.
+All lower to jnp; XLA fuses chains of these into single kernels (vs one CUDA
+launch per op in the reference, SURVEY.md §3.1).
+"""
+import jax
+import jax.numpy as jnp
+
+from .base import def_op
+
+_same = lambda a, **k: a  # shape rule: unary elementwise
+
+
+def _bcast(a, b, **k):
+    import numpy as np
+    return np.broadcast_shapes(tuple(a), tuple(b))
+
+
+# binary elementwise
+add_op = def_op("AddElewise", lambda c, a, b: a + b, _bcast)
+minus_op = def_op("MinusElewise", lambda c, a, b: a - b, _bcast)
+mul_op = def_op("MultiplyElewise", lambda c, a, b: a * b, _bcast)
+div_op = def_op("Division", lambda c, a, b: a / b, _bcast)
+div_handle_zero_op = def_op(
+    "DivisionHandleZero",
+    lambda c, a, b: jnp.where(b == 0, jnp.zeros_like(a), a / jnp.where(b == 0, jnp.ones_like(b), b)),
+    _bcast)
+fmod_op = def_op("Fmod", lambda c, a, b: jnp.fmod(a, b), _bcast)
+ne_op = def_op("Ne", lambda c, a, b: (a != b).astype(a.dtype), _bcast)
+outer_op = def_op("Outer", lambda c, a, b: jnp.outer(a, b),
+                  lambda a, b: (int(jnp.prod(jnp.array(a))), int(jnp.prod(jnp.array(b)))))
+
+# const variants
+addbyconst_op = def_op("AddConst", lambda c, a, const_attr=0.0: a + const_attr, _same)
+minusbyconst_op = def_op("MinusByConst", lambda c, a, const_attr=0.0: a - const_attr, _same)
+mulbyconst_op = def_op("MultiplyConst", lambda c, a, const_attr=1.0: a * const_attr, _same)
+div_const_op = def_op("DivConst", lambda c, a, const_attr=1.0: a * const_attr, _same)
+const_div_op = def_op("ConstDiv", lambda c, a, const_attr=1.0: const_attr / a, _same)
+const_pow_op = def_op("ConstPow", lambda c, a, const_attr=1.0: jnp.power(const_attr, a), _same)
+
+# reference-compat aliases
+minus_byconst_op = minusbyconst_op
+mul_byconst_op = mulbyconst_op
+
+# unary elementwise
+abs_op = def_op("Abs", lambda c, a: jnp.abs(a), _same)
+opposite_op = def_op("Opposite", lambda c, a: -a, _same)
+exp_op = def_op("Exp", lambda c, a: jnp.exp(a), _same)
+log_op = def_op("Log", lambda c, a: jnp.log(a), _same)
+sqrt_op = def_op("Sqrt", lambda c, a: jnp.sqrt(a), _same)
+rsqrt_op = def_op("ReciprocalSqrt", lambda c, a: jax.lax.rsqrt(a), _same)
+sigmoid_op = def_op("Sigmoid", lambda c, a: jax.nn.sigmoid(a), _same)
+tanh_op = def_op("Tanh", lambda c, a: jnp.tanh(a), _same)
+sin_op = def_op("Sin", lambda c, a: jnp.sin(a), _same)
+cos_op = def_op("Cos", lambda c, a: jnp.cos(a), _same)
+floor_op = def_op("Floor", lambda c, a: jnp.floor(a), _same)
+bool_op = def_op("Bool", lambda c, a: (a != 0).astype(jnp.float32), _same)
+pow_op = def_op("Pow", lambda c, a, p=2.0: jnp.power(a, p), _same)
+clamp_op = def_op("Clamp",
+                  lambda c, a, mmin=None, mmax=None: jnp.clip(a, mmin, mmax), _same)
+oneslike_op = def_op("OnesLike", lambda c, a: jnp.ones_like(a), _same)
+zeroslike_op = def_op("ZerosLike", lambda c, a: jnp.zeros_like(a), _same)
+
+# where
+where_op = def_op("Where", lambda c, cond, a, b: jnp.where(cond.astype(bool), a, b),
+                  lambda cond, a, b: _bcast(a, b))
+where_const_op = def_op(
+    "WhereConst",
+    lambda c, cond, a, const_attr=0.0: jnp.where(cond.astype(bool), a, const_attr),
+    lambda cond, a: tuple(a))
+
+# generators (no tensor inputs)
+full_op = def_op("Full", lambda c, shape=(), fill_value=0.0, dtype=jnp.float32:
+                 jnp.full(shape, fill_value, dtype))
+full_like_op = def_op("FullLike", lambda c, a, fill_value=0.0: jnp.full_like(a, fill_value), _same)
+eye_op = def_op("Eye", lambda c, n=1, m=None, dtype=jnp.float32: jnp.eye(n, m, dtype=dtype))
+arange_op = def_op("Arange", lambda c, start=0, end=None, step=1, dtype=jnp.float32:
+                   jnp.arange(start, end, step, dtype=dtype))
+rand_op = def_op("Rand", lambda c, shape=(), low=0.0, high=1.0:
+                 jax.random.uniform(c.rng(), shape, minval=low, maxval=high))

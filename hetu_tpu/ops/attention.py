@@ -1,0 +1,53 @@
+"""Attention ops.
+
+The reference has NO attention kernel — its transformer examples compose
+batch_matmul + softmax ops (SURVEY.md §5.7).  Here scaled-dot-product
+attention is a first-class fused op so the hot path can lower to the Pallas
+flash-attention kernel (:mod:`hetu_tpu.ops.pallas.flash_attention`) on TPU,
+with a reference jnp lowering for CPU tests; ring/blockwise variants live in
+:mod:`hetu_tpu.parallel.ring_attention`.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .base import def_op
+
+_FLASH_MIN_LEN = 256  # below this, XLA's fused softmax-matmul is fine
+
+
+def sdpa_reference(q, k, v, causal=False, scale=None, mask=None):
+    """(B, H, S, D) reference attention in plain jnp."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2:]
+        cmask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _sdpa(c, q, k, v, causal=False, scale=None):
+    seq = q.shape[-2]
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and seq >= _FLASH_MIN_LEN and seq % 128 == 0:
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return sdpa_reference(q, k, v, causal=causal, scale=scale)
+
+
+sdpa_op = def_op("ScaledDotProductAttention", _sdpa)
+
+
+def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
+    return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
+
+
+sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)

@@ -1,0 +1,90 @@
+"""Op factory: concise definition of symbolic ops with JAX lowering rules.
+
+Replaces the reference's one-CUDA-file-per-op scheme (``src/ops/*.cu``, 108
+files + ``python/hetu/gpu_ops/*.py`` wrappers) with a registry of lowering
+rules onto ``jax.numpy``/``lax``.  XLA fuses these into large kernels; the few
+ops that need hand-tuning get Pallas kernels (see :mod:`hetu_tpu.ops.pallas`).
+"""
+from __future__ import annotations
+
+from ..graph.node import Op
+
+OP_REGISTRY = {}
+
+
+class SimpleOp(Op):
+    """A node whose semantics are fully captured by a pure lowering function."""
+
+    def __init__(self, op_type, inputs, lower_fn, shape_fn=None, name=None,
+                 **attrs):
+        self.op_type = op_type
+        self._lower_fn = lower_fn
+        self._shape_fn = shape_fn
+        super().__init__(inputs, name=name, **attrs)
+
+    def lower(self, ctx, *vals):
+        return self._lower_fn(ctx, *vals, **self.attrs)
+
+    def infer_shape(self, input_shapes):
+        if self._shape_fn is None:
+            return None
+        return self._shape_fn(*input_shapes, **self.attrs)
+
+
+class ItemOp(Op):
+    """Extract one output of a multi-output op (tuple-valued lowering)."""
+
+    op_type = "Item"
+
+    def __init__(self, src, index, name=None):
+        super().__init__([src], name=name)
+        self.index = index
+
+    def lower(self, ctx, val):
+        return val[self.index]
+
+
+def tuple_outputs(node, n):
+    """Split a tuple-valued node into n single-output nodes."""
+    return tuple(ItemOp(node, i, name=f"{node.name}.{i}") for i in range(n))
+
+
+def def_op(op_type, lower_fn, shape_fn=None):
+    """Register an op kind; returns its constructor.
+
+    The constructor accepts the graph-node inputs positionally and attributes
+    as keywords; a trailing ``ctx=`` kwarg is accepted for reference-API
+    compatibility (placement is handled by ``ht.context`` scopes instead).
+    """
+
+    import inspect
+    try:
+        lower_params = [p for p in inspect.signature(lower_fn).parameters
+                        if p != "c" and not p.startswith("*")]
+    except (TypeError, ValueError):  # builtins / C funcs
+        lower_params = []
+
+    def ctor(*args, ctx=None, name=None, **attrs):
+        del ctx  # placement comes from the ht.context scope
+        # split positional args: leading Ops are graph inputs; the rest are
+        # attributes matched to the lowering fn's parameter names in order
+        # (reference signatures pass attrs positionally, e.g.
+        # ``reduce_mean_op(node, axes, keepdims)``)
+        inputs = []
+        i = 0
+        while i < len(args) and isinstance(args[i], Op):
+            inputs.append(args[i])
+            i += 1
+        extra = args[i:]
+        if extra:
+            attr_names = lower_params[len(inputs):]
+            if len(extra) > len(attr_names):
+                raise TypeError(
+                    f"{op_type}: too many positional args {extra}")
+            for pname, val in zip(attr_names, extra):
+                attrs[pname] = val
+        return SimpleOp(op_type, inputs, lower_fn, shape_fn, name=name, **attrs)
+
+    ctor.__name__ = op_type
+    OP_REGISTRY[op_type] = ctor
+    return ctor

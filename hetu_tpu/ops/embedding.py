@@ -1,0 +1,17 @@
+"""Embedding lookup (reference ``gpu_ops/EmbeddingLookUp.py:10`` +
+``src/ops/EmbeddingLookup.cu``).
+
+Dense path: ``jnp.take`` — XLA lowers the backward to a scatter-add, which is
+the TPU-native equivalent of the reference's IndexedSlices machinery
+(``ndarray.py:507``); no explicit sparse-gradient type is needed under jit.
+Huge (HBM-exceeding) tables go through the host-resident embedding store in
+:mod:`hetu_tpu.embedding` instead (HET cache semantics, SURVEY.md §5.8).
+"""
+import jax.numpy as jnp
+
+from .base import def_op
+
+embedding_lookup_op = def_op(
+    "EmbeddingLookup",
+    lambda c, table, idx: jnp.take(table, idx.astype(jnp.int32), axis=0),
+    lambda table, idx: tuple(idx) + (table[1],))

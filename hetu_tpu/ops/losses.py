@@ -1,0 +1,55 @@
+"""Loss ops (reference: SoftmaxCrossEntropy(.Sparse).cu, CrossEntropy(Sparse).cu,
+BinaryCrossEntropy.cu, NllLoss.cu).
+
+Reference semantics: per-example losses are returned unreduced (shape (N,))
+and the model applies reduce_mean — we keep that contract.
+"""
+import jax
+import jax.numpy as jnp
+
+from .base import def_op
+
+
+def _softmax_ce(c, logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(labels * logp, axis=-1)
+
+
+softmaxcrossentropy_op = def_op("SoftmaxCrossEntropy", _softmax_ce,
+                                lambda a, b: tuple(a[:-1]))
+
+
+def _softmax_ce_sparse(c, logits, labels, ignored_index=-1):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lbl = labels.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, jnp.maximum(lbl, 0)[..., None], axis=-1)[..., 0]
+    return jnp.where(lbl == ignored_index, 0.0, -picked)
+
+
+softmaxcrossentropy_sparse_op = def_op("SoftmaxCrossEntropySparse",
+                                       _softmax_ce_sparse,
+                                       lambda a, b, ignored_index=-1: tuple(a[:-1]))
+
+crossentropy_op = def_op(
+    "CrossEntropy",
+    lambda c, pred, labels, eps=1e-12: -jnp.sum(labels * jnp.log(pred + eps), axis=-1))
+
+crossentropy_sparse_op = def_op(
+    "CrossEntropySparse",
+    lambda c, pred, labels, ignored_index=-1, eps=1e-12: jnp.where(
+        labels.astype(jnp.int32) == ignored_index, 0.0,
+        -jnp.log(jnp.take_along_axis(
+            pred, jnp.maximum(labels.astype(jnp.int32), 0)[..., None], axis=-1)[..., 0] + eps)))
+
+binarycrossentropy_op = def_op(
+    "BinaryCrossEntropy",
+    lambda c, pred, labels, eps=1e-12:
+        -(labels * jnp.log(pred + eps) + (1 - labels) * jnp.log(1 - pred + eps)))
+
+
+def _nll(c, logp, target):
+    t = target.astype(jnp.int32)
+    return -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+
+
+nll_loss_op = def_op("NllLoss", _nll)

@@ -1,0 +1,68 @@
+"""Matrix ops — the MXU path.
+
+Reference: ``src/ops/MatrixMult.cu`` (cublasSgemm), ``BatchMatrixMult.cu``,
+``Linear.cu``, ``Addmm.cu``, ``Baddbmm.cu``, ``Dot.cu``.  Here they lower to
+``jnp.matmul``/``lax.dot_general`` which XLA tiles onto the 128x128 systolic
+array; ``preferred_element_type=f32`` keeps bf16 inputs accumulating in f32.
+"""
+import jax.numpy as jnp
+
+from .base import def_op
+
+
+def _mm(c, a, b, trans_A=False, trans_B=False):
+    if trans_A:
+        a = a.T
+    if trans_B:
+        b = b.T
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+def _mm_shape(a, b, trans_A=False, trans_B=False):
+    m = a[1] if trans_A else a[0]
+    n = b[0] if trans_B else b[1]
+    return (m, n)
+
+
+matmul_op = def_op("MatrixMult", _mm, _mm_shape)
+
+
+def _linear(c, a, b, bias, trans_A=False, trans_B=False):
+    return _mm(c, a, b, trans_A, trans_B) + bias
+
+
+linear_op = def_op("Linear", _linear,
+                   lambda a, b, bias, trans_A=False, trans_B=False:
+                   _mm_shape(a, b, trans_A, trans_B))
+
+
+def _bmm(c, a, b, trans_A=False, trans_B=False):
+    if trans_A:
+        a = jnp.swapaxes(a, -1, -2)
+    if trans_B:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+batch_matmul_op = def_op("BatchMatrixMult", _bmm)
+
+addmm_op = def_op(
+    "Addmm",
+    lambda c, inp, a, b, alpha=1.0, beta=1.0: beta * inp + alpha * _mm(c, a, b))
+
+baddbmm_op = def_op(
+    "Baddbmm",
+    lambda c, inp, a, b, alpha=1.0, beta=1.0: beta * inp + alpha * _bmm(c, a, b))
+
+matrix_dot_op = def_op("MatrixDot", lambda c, a, b: jnp.sum(a * b))
+
+
+def einsum_op(subscripts, *nodes, name=None):
+    """General einsum node (new; subsumes the reference's special-case batched
+    contractions and feeds the MXU directly)."""
+    from .base import SimpleOp
+    return SimpleOp("Einsum", list(nodes),
+                    lambda c, *vals, subscripts=None: jnp.einsum(
+                        subscripts, *vals,
+                        preferred_element_type=jnp.float32).astype(vals[0].dtype),
+                    name=name, subscripts=subscripts)

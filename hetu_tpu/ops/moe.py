@@ -1,0 +1,306 @@
+"""MoE ops — gating, dispatch, expert-parallel collectives.
+
+Reference machinery (SURVEY.md §2.6): LayoutTransform.cu (Tutel-style token
+dispatch), ReverseLayoutTransform, AllToAll.cu / HAllToAll (hierarchical),
+TopKIdx/TopKVal, Cumsum, OneHot, BalanceAssignment (BASE layer auction).
+
+TPU-native redesign: dispatch/combine are *dense einsums* against one-hot
+capacity masks (the GShard formulation) — MXU-friendly, static shapes, no
+scatter; expert parallelism is expressed by sharding the expert axis over the
+'ep' mesh axis, letting XLA emit all_to_all over ICI (the explicit
+``lax.all_to_all`` path lives in :mod:`hetu_tpu.parallel.collectives` for
+shard_map users).  Capacity overflow drops tokens exactly like the
+reference's fixed-capacity LayoutTransform.
+"""
+import jax
+import jax.numpy as jnp
+
+from .base import def_op, SimpleOp, tuple_outputs
+
+
+def _one_hot_f(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _top1_gating(logits, capacity):
+    """Returns (dispatch (s,e,c), combine (s,e,c), aux_loss) — GShard top-1."""
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot_f(idx1, e)                       # (s, e)
+    # position of each token within its expert queue
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # (s, e), 0-based
+    keep1 = mask1 * (pos1 < capacity)
+    gate1 = jnp.sum(gates * keep1, axis=-1)           # (s,)
+    # aux load-balance loss (reference TopGate.py balance_loss:6)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = jnp.sum(me * ce) * e
+    pos_in_e = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)  # (s,)
+    dispatch = keep1[:, :, None] * _one_hot_f(pos_in_e, capacity)[:, None, :]
+    combine = gate1[:, None, None] * dispatch
+    return dispatch, combine, aux
+
+
+def _top2_gating(logits, capacity):
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot_f(idx1, e)
+    gates2 = gates * (1 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = _one_hot_f(idx2, e)
+
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    # expert-2 queue positions come after all expert-1 tokens of that expert
+    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) \
+        + jnp.sum(mask1, axis=0, keepdims=True)
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = jnp.sum(me * ce) * e
+
+    p1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)
+    p2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
+    d1 = keep1[:, :, None] * _one_hot_f(p1, capacity)[:, None, :]
+    d2 = keep2[:, :, None] * _one_hot_f(p2, capacity)[:, None, :]
+    dispatch = jnp.maximum(d1, d2)
+    combine = g1[:, None, None] * d1 + g2[:, None, None] * d2
+    return dispatch, combine, aux
+
+
+def _dispatch_from(keep, pos, capacity, gate_w=None):
+    """Build (s,e,c) dispatch / combine tensors from a keep mask (s,e) and
+    per-token queue positions (s,)."""
+    d = keep[:, :, None] * _one_hot_f(pos, capacity)[:, None, :]
+    if gate_w is None:
+        return d
+    return d, gate_w[:, None, None] * d
+
+
+def _ktop1_gating(logits, k, capacity):
+    """KTop1 (reference ``layers/KTop1Gate.py`` ktop1gating:14): experts are
+    split into k prototype groups of e/k; each token routes top-1 within
+    EVERY group (so k experts per token, one per group); balance loss summed
+    per group."""
+    s, e = logits.shape
+    g = e // k
+    dis_parts, com_parts = [], []
+    aux = 0.0
+    for i in range(k):
+        gates = jax.nn.softmax(logits[:, i * g:(i + 1) * g], axis=-1)
+        idx = jnp.argmax(gates, axis=-1)
+        mask = _one_hot_f(idx, g)
+        posm = jnp.cumsum(mask, axis=0) * mask - mask
+        keep = mask * (posm < capacity)
+        gate_w = jnp.sum(gates * keep, axis=-1)
+        aux = aux + jnp.sum(jnp.mean(gates, 0) * jnp.mean(mask, 0)) * g
+        p = jnp.sum(posm * keep, axis=-1).astype(jnp.int32)
+        d, c = _dispatch_from(keep, p, capacity, gate_w)
+        dis_parts.append(d)
+        com_parts.append(c)
+    dispatch = jnp.concatenate(dis_parts, axis=1)   # (s, e, c)
+    combine = jnp.concatenate(com_parts, axis=1)
+    return dispatch, combine, aux
+
+
+def _sam_gating(logits, k, capacity, group_size):
+    """SAM gate (reference ``layers/SAMGate.py`` samgating:22 + SamMax.cu,
+    SamGroupSum.cu, GroupTopKIdx.cu): softmax over all experts; pick the
+    group (node) with the largest summed prob; route top-k within that group;
+    alignment loss = hinge on out-group probs exceeding the selected k-th
+    expert's prob."""
+    s, e = logits.shape
+    ngroups = e // group_size
+    gates = jax.nn.softmax(logits, axis=-1)
+    gsum = gates.reshape(s, ngroups, group_size).sum(-1)
+    top_group = jnp.argmax(gsum, axis=-1)                       # (s,)
+    in_group = _one_hot_f(top_group, ngroups)                   # (s, ngroups)
+    in_group_e = jnp.repeat(in_group, group_size, axis=1)       # (s, e)
+    masked_gates = jnp.where(in_group_e > 0, gates, -jnp.inf)
+
+    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    aux = 0.0
+    used = jnp.zeros((s, e), jnp.float32)  # masks already routed experts
+    kth_prob = None
+    for i in range(k):
+        idx = jnp.argmax(jnp.where(used > 0, -jnp.inf, masked_gates), axis=-1)
+        mask = _one_hot_f(idx, e)
+        used = used + mask
+        # queue positions account for earlier-k selections (acc_base)
+        posm = jnp.cumsum(mask, axis=0) * mask - mask \
+            + jnp.sum(used - mask, axis=0, keepdims=True) * mask
+        keep = mask * (posm < capacity)
+        gate_w = jnp.sum(gates * keep, axis=-1)
+        aux = aux + jnp.sum(jnp.mean(gates, 0) * jnp.mean(mask, 0)) * e
+        p = jnp.sum(posm * keep, axis=-1).astype(jnp.int32)
+        d, c = _dispatch_from(keep, p, capacity, gate_w)
+        dispatch = dispatch + d
+        combine = combine + c
+        kth_prob = jnp.sum(gates * mask, axis=-1)               # (s,)
+    # SamMax hinge: out-group probs exceeding the k-th selected prob
+    out_group = 1.0 - in_group_e
+    align = jnp.sum(jnp.maximum(gates - kth_prob[:, None], 0.0) * out_group)
+    return dispatch, combine, aux, align
+
+
+def ktop1_gate_op(logits_node, k, capacity, name=None):
+    """Fused KTop1 gating node → (dispatch, combine, aux_loss)."""
+    node = SimpleOp("KTop1Gate", [logits_node],
+                    lambda c, logits, k=1, capacity=None:
+                        _ktop1_gating(logits, k, capacity),
+                    name=name, k=k, capacity=capacity)
+    return tuple_outputs(node, 3)
+
+
+def sam_gate_op(logits_node, k, capacity, group_size, name=None):
+    """Fused SAM gating node → (dispatch, combine, aux_loss, align_loss)."""
+    node = SimpleOp("SAMGate", [logits_node],
+                    lambda c, logits, k=1, capacity=None, group_size=1:
+                        _sam_gating(logits, k, capacity, group_size),
+                    name=name, k=k, capacity=capacity, group_size=group_size)
+    return tuple_outputs(node, 4)
+
+
+def topk_gate_op(logits_node, k=1, capacity=None, name=None):
+    """Fused GShard gating: returns (dispatch, combine, aux_loss) nodes."""
+    assert k in (1, 2)
+
+    def lower(c, logits, k=1, capacity=None):
+        fn = _top1_gating if k == 1 else _top2_gating
+        return fn(logits, capacity)
+
+    node = SimpleOp("TopKGate", [logits_node], lower, name=name,
+                    k=k, capacity=capacity)
+    return tuple_outputs(node, 3)
+
+
+# dense dispatch/combine einsums (the reference's layout_transform /
+# reverse_layout_transform, ``LayoutTransform.py:12``)
+layout_transform_op = def_op(
+    "LayoutTransform",
+    lambda c, dispatch, tokens: jnp.einsum(
+        "sec,sm->ecm", dispatch, tokens,
+        preferred_element_type=jnp.float32).astype(tokens.dtype))
+
+reverse_layout_transform_op = def_op(
+    "ReverseLayoutTransform",
+    lambda c, combine, expert_out: jnp.einsum(
+        "sec,ecm->sm", combine, expert_out,
+        preferred_element_type=jnp.float32).astype(expert_out.dtype))
+
+
+def _hash_dispatch(c, idx, num_experts=1, capacity=None):
+    """Hash gating (reference HashGate.py): expert = token_id % E."""
+    e = num_experts
+    expert_of = (idx.astype(jnp.int32) % e)
+    mask = _one_hot_f(expert_of, e)
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    keep = mask * (pos < capacity)
+    p = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+    dispatch = keep[:, :, None] * _one_hot_f(p, capacity)[:, None, :]
+    return dispatch
+
+
+def hash_dispatch_op(idx_node, num_experts, capacity, name=None):
+    return SimpleOp("HashDispatch", [idx_node], _hash_dispatch, name=name,
+                    num_experts=num_experts, capacity=capacity)
+
+
+def _balanced_assignment(scores, rounds=4):
+    """Balanced token→expert assignment: every expert gets exactly
+    tokens/experts tokens and every token is assigned exactly once.
+
+    TPU-native replacement for the reference's auction kernel
+    (``BalanceAssignment.cu``): a fixed number of dense greedy rounds —
+    each round, unassigned tokens bid for their best expert with remaining
+    capacity and the top bidders win — then a deterministic fill matches any
+    leftovers to the remaining slots.  All static shapes, no data-dependent
+    loops (rounds is a compile-time constant).
+
+    Returns slot→token ids, shape (s,), grouped by expert: slot q*cap+i holds
+    the i-th token assigned to expert q — a true permutation of arange(s).
+    """
+    s, e = scores.shape
+    cap = s // e
+    # Sinkhorn normalization evens out scale differences between experts
+    p = scores
+    for _ in range(4):
+        p = p - jax.nn.logsumexp(p, axis=1, keepdims=True)
+        p = p - jax.nn.logsumexp(p, axis=0, keepdims=True)
+
+    assigned = jnp.full((s,), -1, jnp.int32)      # token -> expert
+    pos = jnp.zeros((s,), jnp.int32)              # token -> queue pos in expert
+    used = jnp.zeros((e,), jnp.int32)             # expert -> #tokens taken
+    NEG = jnp.asarray(-1e30, p.dtype)
+    for _ in range(rounds):
+        open_e = used < cap                       # (e,)
+        unas = assigned < 0                       # (s,)
+        masked = jnp.where(open_e[None, :] & unas[:, None], p, NEG)
+        choice = jnp.argmax(masked, axis=1)       # (s,)
+        bid = jnp.where(unas & jnp.take(open_e, choice),
+                        jnp.take_along_axis(masked, choice[:, None], 1)[:, 0],
+                        NEG)
+        cmask = _one_hot_f(choice, e) * (bid > NEG / 2)[:, None]  # (s, e)
+        score_col = jnp.where(cmask > 0, bid[:, None], NEG)
+        # rank tokens per chosen expert by bid (descending, stable)
+        order = jnp.argsort(-score_col, axis=0)
+        rank = jnp.argsort(order, axis=0)         # (s, e) rank within column
+        accept = (cmask > 0) & (rank < (cap - used)[None, :])
+        tok_rank = jnp.sum(jnp.where(accept, rank, 0), axis=1)
+        acc_any = jnp.any(accept, axis=1)
+        new_pos = jnp.sum(jnp.where(accept, used[None, :], 0), axis=1) + tok_rank
+        assigned = jnp.where(acc_any, choice.astype(jnp.int32), assigned)
+        pos = jnp.where(acc_any, new_pos.astype(jnp.int32), pos)
+        used = used + jnp.sum(accept, axis=0).astype(jnp.int32)
+
+    # deterministic fill: k-th leftover token -> k-th free slot
+    unas = assigned < 0
+    token_rank = jnp.cumsum(unas.astype(jnp.int32)) - 1          # (s,)
+    slot_expert = jnp.repeat(jnp.arange(e), cap)                 # (s,)
+    slot_idx = jnp.tile(jnp.arange(cap), e)                      # pos within expert
+    free = slot_idx >= jnp.take(used, slot_expert)               # (s,) slot free?
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    # token with rank r takes the slot with rank r
+    fill_expert = jnp.zeros((s,), jnp.int32).at[
+        jnp.where(free, free_rank, s)].set(slot_expert.astype(jnp.int32),
+                                           mode="drop")
+    fill_pos = jnp.zeros((s,), jnp.int32).at[
+        jnp.where(free, free_rank, s)].set(slot_idx.astype(jnp.int32),
+                                           mode="drop")
+    assigned = jnp.where(unas, jnp.take(fill_expert, token_rank), assigned)
+    pos = jnp.where(unas, jnp.take(fill_pos, token_rank), pos)
+
+    slot_of_token = assigned * cap + pos                          # (s,)
+    token_of_slot = jnp.zeros((s,), jnp.int32).at[slot_of_token].set(
+        jnp.arange(s, dtype=jnp.int32))
+    return token_of_slot
+
+
+def balance_assignment_op(scores_node, name=None):
+    """BASE-layer balanced assignment node: scores (tokens, experts) →
+    slot→token permutation (see :func:`_balanced_assignment`)."""
+    return SimpleOp("BalanceAssignment", [scores_node],
+                    lambda c, scores: _balanced_assignment(scores), name=name)
+
+
+# explicit graph-level alltoall (EP over mesh): identity + sharding constraint;
+# real lax.all_to_all lives in parallel.collectives for shard_map programs
+def _alltoall(c, x):
+    if c.mesh is not None and "ep" in c.mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(c.mesh, PartitionSpec("ep", *([None] * (x.ndim - 1)))))
+    return x
+
+
+alltoall_op = def_op("AllToAll", _alltoall)
+halltoall_op = def_op("HAllToAll", _alltoall)  # 2-level mesh handled by XLA

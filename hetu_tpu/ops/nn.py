@@ -1,0 +1,202 @@
+"""Neural-net ops: conv/pool/norm/dropout/activations/softmax.
+
+Reference kernels: ``src/ops/CudnnConv2d.cu``, ``CudnnBn.cu``, ``LayerNorm.cu``,
+``InstanceNorm2d.cu``, ``CudnnDropout.cu``, ``MaxPool.cu``, ``AvgPool.cu``,
+``Relu/Gelu/LeakyRelu.cu``, ``CudnnSoftmax.cu``.  Layout follows the reference
+API (NCHW / OIHW); XLA:TPU re-lays-out internally so the user-visible layout
+costs nothing.
+
+Stateful ops (BatchNorm running stats, Dropout RNG) are functional here:
+BN writes its new running stats into the ``LowerCtx.state_updates``
+side-channel and the executor commits them after the step — no mutation
+inside the traced computation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import def_op
+from ..graph.node import Op, PlaceholderOp
+
+# -- activations ------------------------------------------------------------
+relu_op = def_op("Relu", lambda c, a: jax.nn.relu(a), lambda a: tuple(a))
+leaky_relu_op = def_op("LeakyRelu",
+                       lambda c, a, alpha=0.01: jax.nn.leaky_relu(a, alpha),
+                       lambda a, alpha=0.01: tuple(a))
+gelu_op = def_op("Gelu", lambda c, a: jax.nn.gelu(a, approximate=True),
+                 lambda a: tuple(a))
+softmax_op = def_op("Softmax", lambda c, a: jax.nn.softmax(a, axis=-1),
+                    lambda a: tuple(a))
+log_softmax_op = def_op("LogSoftmax", lambda c, a: jax.nn.log_softmax(a, axis=-1))
+
+
+def softmax_func(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+# -- dropout ----------------------------------------------------------------
+
+
+def _dropout(c, a, keep_prob=0.9):
+    if not c.training or keep_prob >= 1.0:
+        return a
+    mask = jax.random.bernoulli(c.rng(), keep_prob, a.shape)
+    return jnp.where(mask, a / keep_prob, jnp.zeros_like(a))
+
+
+dropout_op = def_op("Dropout", _dropout, lambda a, keep_prob=0.9: tuple(a))
+
+
+def _dropout2d(c, a, keep_prob=0.9):
+    """Channel dropout: zero whole (N, C) feature maps (reference Dropout2d.cu)."""
+    if not c.training or keep_prob >= 1.0:
+        return a
+    mask = jax.random.bernoulli(c.rng(), keep_prob, a.shape[:2] + (1,) * (a.ndim - 2))
+    return jnp.where(mask, a / keep_prob, jnp.zeros_like(a))
+
+
+dropout2d_op = def_op("Dropout2d", _dropout2d)
+
+# -- conv / pool ------------------------------------------------------------
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv2d(c, x, w, padding=0, stride=1):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _conv2d_shape(x, w, padding=0, stride=1):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    n, _, h, ww = x
+    o, _, kh, kw = w
+    return (n, o, (h + 2 * ph - kh) // sh + 1, (ww + 2 * pw - kw) // sw + 1)
+
+
+conv2d_op = def_op("Conv2d", _conv2d, _conv2d_shape)
+
+conv2d_add_bias_op = def_op(
+    "Conv2dAddBias",
+    lambda c, x, w, b, padding=0, stride=1:
+        _conv2d(c, x, w, padding, stride) + b.reshape(1, -1, 1, 1),
+    lambda x, w, b, padding=0, stride=1: _conv2d_shape(x, w, padding, stride))
+
+
+def _pool(c, x, kernel_H, kernel_W, padding, stride, kind):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    window = (1, 1, kernel_H, kernel_W)
+    strides = (1, 1, sh, sw)
+    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if kind == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+        out = out / (kernel_H * kernel_W)
+    return out
+
+
+def _pool_shape(x, kernel_H, kernel_W, padding, stride):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    n, ch, h, w = x
+    return (n, ch, (h + 2 * ph - kernel_H) // sh + 1, (w + 2 * pw - kernel_W) // sw + 1)
+
+
+def max_pool2d_op(node, kernel_H, kernel_W, padding=0, stride=1, ctx=None, name=None):
+    from .base import SimpleOp
+    return SimpleOp("MaxPool2d", [node],
+                    lambda c, x, **kw: _pool(c, x, kind="max", **kw),
+                    lambda x, **kw: _pool_shape(x, **kw), name=name,
+                    kernel_H=kernel_H, kernel_W=kernel_W, padding=padding, stride=stride)
+
+
+def avg_pool2d_op(node, kernel_H, kernel_W, padding=0, stride=1, ctx=None, name=None):
+    from .base import SimpleOp
+    return SimpleOp("AvgPool2d", [node],
+                    lambda c, x, **kw: _pool(c, x, kind="avg", **kw),
+                    lambda x, **kw: _pool_shape(x, **kw), name=name,
+                    kernel_H=kernel_H, kernel_W=kernel_W, padding=padding, stride=stride)
+
+
+# -- normalization ----------------------------------------------------------
+
+
+class BatchNormOp(Op):
+    """BatchNorm2d over NCHW with functional running stats.
+
+    Reference: ``gpu_ops/BatchNorm.py`` / ``src/ops/CudnnBn.cu``. Running
+    mean/var live as internal non-trainable Variables whose updates flow
+    through ``ctx.state_updates`` (committed by the executor after the step).
+    """
+
+    op_type = "BatchNorm"
+
+    def __init__(self, node_in, bn_scale, bn_bias, momentum=0.1, eps=1e-5, name=None):
+        self.running_mean = PlaceholderOp(
+            f"{name or 'bn'}_running_mean", trainable=False,
+            initializer=lambda shape, key: np.zeros(shape, np.float32))
+        self.running_var = PlaceholderOp(
+            f"{name or 'bn'}_running_var", trainable=False,
+            initializer=lambda shape, key: np.ones(shape, np.float32))
+        # running-stat shapes follow the scale param's shape
+        self.running_mean.shape_from = bn_scale
+        self.running_var.shape_from = bn_scale
+        super().__init__([node_in, bn_scale, bn_bias,
+                          self.running_mean, self.running_var], name=name,
+                         momentum=momentum, eps=eps)
+
+    def lower(self, ctx, x, scale, bias, rmean, rvar):
+        momentum = self.attrs["momentum"]
+        eps = self.attrs["eps"]
+        axes = (0,) + tuple(range(2, x.ndim))
+        cshape = (1, -1) + (1,) * (x.ndim - 2)
+        if ctx.training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            ctx.state_updates[self.running_mean] = \
+                (1 - momentum) * rmean.reshape(-1) + momentum * mean
+            ctx.state_updates[self.running_var] = \
+                (1 - momentum) * rvar.reshape(-1) + momentum * var
+        else:
+            mean = rmean.reshape(-1)
+            var = rvar.reshape(-1)
+        inv = jax.lax.rsqrt(var.reshape(cshape) + eps)
+        return (x - mean.reshape(cshape)) * inv * scale.reshape(cshape) \
+            + bias.reshape(cshape)
+
+    def infer_shape(self, input_shapes):
+        return tuple(input_shapes[0])
+
+
+def batch_normalization_op(node_in, bn_scale, bn_bias, momentum=0.1, eps=1e-5,
+                           ctx=None, name=None):
+    return BatchNormOp(node_in, bn_scale, bn_bias, momentum, eps, name=name)
+
+
+def _layer_norm(c, x, scale, bias, eps=0.01):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+layer_normalization_op = def_op("LayerNorm", _layer_norm,
+                                lambda x, s, b, eps=0.01: tuple(x))
+
+
+def _instance_norm2d(c, x, eps=1e-7):
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps)
+
+
+instance_normalization2d_op = def_op("InstanceNorm2d", _instance_norm2d)

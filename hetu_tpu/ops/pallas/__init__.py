@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for ops where XLA fusion is insufficient
+(SURVEY.md §7: fused attention, MoE dispatch, embedding scatter-add)."""

@@ -1,0 +1,10 @@
+"""Flash attention — Pallas TPU kernel (placeholder lowering for now).
+
+Falls back to the fused-XLA reference attention until the blockwise kernel
+lands; the call signature is stable so callers don't change.
+"""
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    from ..attention import sdpa_reference
+    return sdpa_reference(q, k, v, causal=causal, scale=scale)

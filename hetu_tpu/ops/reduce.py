@@ -1,0 +1,40 @@
+"""Reduction ops (reference: ReduceSum.cu, ReduceMean.cu, ReduceSumAxisZero.cu,
+Sum op ``gpu_ops/Sum.py``)."""
+import jax.numpy as jnp
+import numpy as np
+
+from .base import def_op, SimpleOp
+
+
+def _reduce_shape(fn):
+    def shape(a, axes=None, keepdims=False):
+        return tuple(fn(np.empty(a), axis=tuple(axes) if isinstance(axes, (list, tuple)) else axes,
+                        keepdims=keepdims).shape)
+    return shape
+
+
+def _norm_axes(axes):
+    if isinstance(axes, (list, tuple)):
+        return tuple(axes)
+    return axes
+
+
+reduce_sum_op = def_op(
+    "ReduceSum",
+    lambda c, a, axes=None, keepdims=False: jnp.sum(a, axis=_norm_axes(axes), keepdims=keepdims),
+    _reduce_shape(np.sum))
+
+reduce_mean_op = def_op(
+    "ReduceMean",
+    lambda c, a, axes=None, keepdims=False: jnp.mean(a, axis=_norm_axes(axes), keepdims=keepdims),
+    _reduce_shape(np.mean))
+
+reducesumaxiszero_op = def_op(
+    "ReduceSumAxisZero", lambda c, a: jnp.sum(a, axis=0),
+    lambda a: tuple(a[1:]))
+
+
+def sum_op(node_list, ctx=None, name=None):
+    """Elementwise sum of a list of nodes (reference ``gpu_ops/Sum.py``)."""
+    return SimpleOp("Sum", list(node_list),
+                    lambda c, *vals: sum(vals[1:], vals[0]), name=name)

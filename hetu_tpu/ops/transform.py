@@ -1,0 +1,211 @@
+"""Shape/layout/indexing ops.
+
+Reference: Reshape, Transpose, Concat(enate), Split, Slice(Assign/ByMatrix),
+Pad, Broadcast(Shape), Repeat, Roll, Flip, Unsqueeze, Gather, Scatter,
+IndexSelect, AsStrided, Argmax, Argsort, OneHot, CumSum, Triu, MaskedFill,
+Interpolate, Max, Min, TopK* (``src/ops/*.cu``).  On TPU these are pure
+data-movement; XLA folds most of them into surrounding fusions.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import def_op
+
+# -- reshape family ---------------------------------------------------------
+array_reshape_op = def_op(
+    "ArrayReshape",
+    lambda c, a, output_shape=None: jnp.reshape(a, output_shape),
+    lambda a, output_shape=None: tuple(np.empty(a).reshape(output_shape).shape))
+
+
+def _flatten(c, a, start_dim=0, end_dim=-1):
+    shape = list(a.shape)
+    nd = len(shape)
+    s = start_dim % nd
+    e = end_dim % nd
+    new = shape[:s] + [int(np.prod(shape[s:e + 1] or [1]))] + shape[e + 1:]
+    return jnp.reshape(a, new)
+
+
+flatten_op = def_op("Flatten", _flatten)
+
+transpose_op = def_op(
+    "Transpose", lambda c, a, perm=None: jnp.transpose(a, perm),
+    lambda a, perm=None: tuple(np.empty(a).transpose(perm).shape))
+
+unsqueeze_op = def_op("Unsqueeze", lambda c, a, axis=0: jnp.expand_dims(a, axis))
+squeeze_op = def_op("Squeeze", lambda c, a, axis=None: jnp.squeeze(a, axis))
+
+# -- concat / split ---------------------------------------------------------
+concat_op = def_op("Concat", lambda c, a, b, axis=0: jnp.concatenate([a, b], axis))
+
+
+def concatenate_op(node_list, axis=0, ctx=None, name=None):
+    from .base import SimpleOp
+    return SimpleOp("Concatenate", list(node_list),
+                    lambda c, *vals, axis=0: jnp.concatenate(vals, axis),
+                    name=name, axis=axis)
+
+
+def _split(c, a, axes=None, indices=None, splits=None):
+    """Reference Split.py semantics: cut dim ``axes[i]`` into ``splits[i]``
+    equal parts and keep part ``indices[i]`` (used for model-parallel demos)."""
+    axes = axes if isinstance(axes, (list, tuple)) else [axes]
+    indices = indices if isinstance(indices, (list, tuple)) else [indices]
+    splits = splits if isinstance(splits, (list, tuple)) else [splits]
+    for ax, idx, sp in zip(axes, indices, splits):
+        size = a.shape[ax] // sp
+        a = jax.lax.slice_in_dim(a, idx * size, (idx + 1) * size, axis=ax)
+    return a
+
+
+split_op = def_op("Split", _split)
+
+# -- slice family -----------------------------------------------------------
+
+
+def _slice(c, a, begin=None, size=None, end=None):
+    begin = list(begin)
+    if size is not None:
+        end = [b + s if s >= 0 else dim for b, s, dim in zip(begin, size, a.shape)]
+    return a[tuple(slice(b, e) for b, e in zip(begin, end))]
+
+
+slice_op = def_op("Slice", _slice)
+
+
+def _slice_assign(c, a, begin=None, end=None, val=0.0):
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return a.at[idx].set(val)
+
+
+slice_assign_op = def_op("SliceAssign", _slice_assign)
+
+
+def _slice_assign_matrix(c, a, b, begin=None, end=None, begin2=None, end2=None):
+    dst = tuple(slice(x, y) for x, y in zip(begin, end))
+    src = tuple(slice(x, y) for x, y in zip(begin2, end2))
+    return a.at[dst].set(b[src])
+
+
+slice_assign_matrix_op = def_op("SliceAssignMatrix", _slice_assign_matrix)
+
+
+def _slice_by_matrix(c, a, idx1, idx2):
+    return a[idx1.astype(jnp.int32), idx2.astype(jnp.int32)]
+
+
+slice_by_matrix_op = def_op("SliceByMatrix", _slice_by_matrix)
+
+# -- pad / broadcast / repeat ----------------------------------------------
+
+
+def _pad(c, a, paddings=None, mode="CONSTANT", constant_values=0):
+    return jnp.pad(a, paddings, mode=mode.lower(),
+                   **({"constant_values": constant_values}
+                      if mode.upper() == "CONSTANT" else {}))
+
+
+pad_op = def_op("Pad", _pad)
+
+broadcastto_op = def_op("BroadcastTo",
+                        lambda c, a, b: jnp.broadcast_to(a, b.shape),
+                        lambda a, b: tuple(b))
+
+
+def _broadcast_shape(c, a, shape=None, add_axes=None):
+    if add_axes:
+        for ax in sorted(add_axes):
+            a = jnp.expand_dims(a, ax)
+    return jnp.broadcast_to(a, shape)
+
+
+broadcast_shape_op = def_op("BroadcastShape", _broadcast_shape)
+
+repeat_op = def_op("Repeat", lambda c, a, reps=None: jnp.tile(a, reps))
+roll_op = def_op("Roll", lambda c, a, shift=None, axis=None: jnp.roll(a, shift, axis))
+flip_op = def_op("Flip", lambda c, a, dims=None: jnp.flip(a, dims))
+
+# -- gather / scatter / indexing -------------------------------------------
+gather_op = def_op(
+    "Gather",
+    lambda c, a, idx, dim=0: jnp.take_along_axis(a, idx.astype(jnp.int32), axis=dim))
+
+index_select_op = def_op(
+    "IndexSelect",
+    lambda c, a, idx, dim=0: jnp.take(a, idx.astype(jnp.int32), axis=dim))
+
+
+def _scatter(c, a, idx, src, dim=0):
+    return a.at[tuple(
+        idx.astype(jnp.int32) if d == dim else
+        jnp.arange(a.shape[d]).reshape([-1 if dd == d else 1 for dd in range(a.ndim)])
+        for d in range(a.ndim))].set(src)
+
+
+scatter_op = def_op("Scatter", _scatter)
+
+scatter1d_op = def_op(
+    "Scatter1D", lambda c, a, idx: a[idx.astype(jnp.int32)])
+scatter1d_grad_op = def_op(
+    "Scatter1DGrad",
+    lambda c, g, idx, size=None: jnp.zeros((size,) + g.shape[1:], g.dtype)
+    .at[idx.astype(jnp.int32)].set(g))
+
+indexing_op = def_op(
+    "Indexing", lambda c, a, idx: a[idx.astype(jnp.int32)])
+
+
+def _as_strided(c, a, shape=None, stride=None, storage_offset=0):
+    flat = jnp.ravel(a)
+    idx = np.zeros(shape, dtype=np.int64) + storage_offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ix = np.arange(s) * st
+        idx += ix.reshape([-1 if dd == d else 1 for dd in range(len(shape))])
+    return flat[idx]
+
+
+as_strided_op = def_op("AsStrided", _as_strided)
+
+# -- arg / topk / sort ------------------------------------------------------
+argmax_op = def_op("Argmax", lambda c, a, dim=0: jnp.argmax(a, axis=dim).astype(jnp.float32))
+argsort_op = def_op("Argsort", lambda c, a, dim=-1, descending=False:
+                    jnp.argsort(-a if descending else a, axis=dim).astype(jnp.float32))
+
+max_op = def_op("Max", lambda c, a, dim=0, keepdim=False: jnp.max(a, axis=dim, keepdims=keepdim))
+min_op = def_op("Min", lambda c, a, dim=0, keepdim=False: jnp.min(a, axis=dim, keepdims=keepdim))
+
+topk_val_op = def_op("TopKVal",
+                     lambda c, a, k=1: jax.lax.top_k(a, k)[0])
+topk_idx_op = def_op("TopKIdx",
+                     lambda c, a, k=1: jax.lax.top_k(a, k)[1].astype(jnp.int32))
+
+# -- misc -------------------------------------------------------------------
+one_hot_op = def_op("OneHot",
+                    lambda c, a, num_classes=2: jax.nn.one_hot(a.astype(jnp.int32), num_classes))
+
+cumsum_with_bias_op = def_op(
+    "CumsumWithBias",
+    lambda c, a, bias=0.0, dim=0: jnp.cumsum(a, axis=dim) + bias)
+
+triu_op = def_op("Triu", lambda c, a, diagonal=0: jnp.triu(a, diagonal))
+tril_op = def_op("Tril", lambda c, a, diagonal=0: jnp.tril(a, diagonal))
+
+masked_fill_op = def_op(
+    "MaskedFill",
+    lambda c, a, mask, val=0.0: jnp.where(mask.astype(bool), jnp.asarray(val, a.dtype), a))
+
+
+def _interpolate(c, a, scale_factor=None, size=None, mode="bilinear", align_corners=False):
+    n, ch, h, w = a.shape
+    if size is None:
+        size = (int(h * scale_factor), int(w * scale_factor))
+    method = {"bilinear": "bilinear", "nearest": "nearest"}[mode]
+    return jax.image.resize(a, (n, ch) + tuple(size), method=method)
+
+
+interpolate_op = def_op("Interpolate", _interpolate)
+
+norm_op = def_op("Norm", lambda c, a, axis=None, p=2:
+                 jnp.sum(jnp.abs(a) ** p, axis=axis) ** (1.0 / p))

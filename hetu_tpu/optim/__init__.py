@@ -1,0 +1,6 @@
+from .optimizer import (Optimizer, OptimizerOp, SGDOptimizer, MomentumOptimizer,
+                        AdaGradOptimizer, AdamOptimizer, AdamWOptimizer,
+                        LambOptimizer)
+from .lr_scheduler import (LRScheduler, FixedScheduler, StepScheduler,
+                           MultiStepScheduler, ExponentialScheduler,
+                           ReduceOnPlateauScheduler, CosineScheduler)

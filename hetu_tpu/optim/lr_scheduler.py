@@ -1,0 +1,111 @@
+"""LR schedulers (reference ``python/hetu/lr_scheduler.py``: FixedScheduler:2,
+StepScheduler:13, MultiStepScheduler:39, ExponentialScheduler:59,
+ReduceOnPlateauScheduler:83).  Schedulers are host-side — the executor feeds
+the scalar lr into the jitted step each call, so schedule changes never
+retrace.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LRScheduler:
+    def get(self, step: int) -> float:
+        raise NotImplementedError
+
+    def on_step(self, step: int):
+        pass
+
+
+class FixedScheduler(LRScheduler):
+    def __init__(self, learning_rate):
+        self.lr = learning_rate
+
+    def get(self, step):
+        return self.lr
+
+
+class StepScheduler(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1):
+        assert step_size > 0
+        self.lr, self.step_size, self.gamma = learning_rate, step_size, gamma
+
+    def get(self, step):
+        return self.lr * self.gamma ** (step // self.step_size)
+
+
+class MultiStepScheduler(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1):
+        self.lr = learning_rate
+        self.milestones = sorted(milestones)
+        self.gamma = gamma
+
+    def get(self, step):
+        k = int(np.searchsorted(self.milestones, step, side="right"))
+        return self.lr * self.gamma ** k
+
+
+class ExponentialScheduler(LRScheduler):
+    def __init__(self, learning_rate, gamma=0.99):
+        self.lr, self.gamma = learning_rate, gamma
+
+    def get(self, step):
+        return self.lr * self.gamma ** step
+
+
+class ReduceOnPlateauScheduler(LRScheduler):
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0.0):
+        self.lr = learning_rate
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.threshold_mode = threshold, threshold_mode
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_left = 0
+
+    def _better(self, metric):
+        if self.best is None:
+            return True
+        t = self.threshold
+        if self.threshold_mode == "rel":
+            bound = self.best * (1 - t) if self.mode == "min" else self.best * (1 + t)
+        else:
+            bound = self.best - t if self.mode == "min" else self.best + t
+        return metric < bound if self.mode == "min" else metric > bound
+
+    def step(self, metric):
+        """User calls this with the monitored metric (e.g. val loss)."""
+        metric = float(metric)
+        if self._better(metric):
+            self.best = metric
+            self.num_bad = 0
+        elif self.cooldown_left > 0:
+            self.cooldown_left -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.lr = max(self.lr * self.factor, self.min_lr)
+                self.cooldown_left = self.cooldown
+                self.num_bad = 0
+
+    def get(self, step):
+        return self.lr
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine decay with linear warmup — the standard LLM-pretrain schedule
+    (new; not in the reference, needed by the BERT MFU target)."""
+
+    def __init__(self, learning_rate, warmup_steps, total_steps, min_ratio=0.0):
+        self.lr = learning_rate
+        self.warmup = max(1, warmup_steps)
+        self.total = total_steps
+        self.min_ratio = min_ratio
+
+    def get(self, step):
+        if step < self.warmup:
+            return self.lr * (step + 1) / self.warmup
+        p = min(1.0, (step - self.warmup) / max(1, self.total - self.warmup))
+        cos = 0.5 * (1 + np.cos(np.pi * p))
+        return self.lr * (self.min_ratio + (1 - self.min_ratio) * cos)

@@ -1,0 +1,1 @@
+from .strategies import Strategy, DataParallel, ModelParallel

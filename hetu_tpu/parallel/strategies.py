@@ -1,0 +1,75 @@
+"""Distributed strategies (reference ``python/hetu/distributed_strategies/``:
+Strategy base.py:11, DataParallel simple.py:6).
+
+TPU-native: a strategy owns a named ``jax.sharding.Mesh`` and answers "how is
+this tensor sharded" (PartitionSpec) instead of inserting NCCL comm ops into
+the graph.  Under ``jax.jit`` the XLA SPMD partitioner then emits the
+collectives (psum for DP grads, all_to_all for EP, ...) over ICI — the role
+the reference's OptimizerOp.backward_hook + mpirun launch played
+(``optimizer.py:145-164``, SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..context import make_mesh
+
+
+class Strategy:
+    def make_mesh(self):
+        raise NotImplementedError
+
+    def feed_spec(self, node, ndim):
+        """PartitionSpec for a fed placeholder value."""
+        from jax.sharding import PartitionSpec
+        return PartitionSpec()
+
+    def param_spec(self, node, ndim):
+        from jax.sharding import PartitionSpec
+        return PartitionSpec()
+
+
+class DataParallel(Strategy):
+    """Pure data parallelism: batch dim sharded over the 'dp' axis, params
+    replicated; grad allreduce is emitted by XLA from the mean-loss psum.
+
+    ``aggregate`` ∈ {allreduce, ps, hybrid} kept for reference API parity
+    (simple.py:6); on TPU all three map to ICI collectives for dense params,
+    while embeddings marked ``is_embed`` can live in the host store
+    (:mod:`hetu_tpu.embedding`) — the hybrid path's equivalent.
+    """
+
+    def __init__(self, aggregate="allreduce", num_devices=None):
+        aggregate = (aggregate or "allreduce").lower()
+        assert aggregate in ("allreduce", "ps", "hybrid")
+        self.aggregate = aggregate
+        self.num_devices = num_devices
+
+    def make_mesh(self):
+        import jax
+        n = self.num_devices or len(jax.devices())
+        return make_mesh({"dp": n}, jax.devices()[:n])
+
+    def feed_spec(self, node, ndim):
+        from jax.sharding import PartitionSpec
+        if ndim == 0:
+            return PartitionSpec()
+        return PartitionSpec("dp", *([None] * (ndim - 1)))
+
+
+class ModelParallel(Strategy):
+    """Generic mesh strategy: explicit axis sizes, per-node shardings come
+    from ``ht.dispatch``/layer annotations (realized as GSPMD constraints —
+    the reference's vestigial Dispatch API made real, SURVEY.md §2.3)."""
+
+    def __init__(self, axis_sizes):
+        self.axis_sizes = dict(axis_sizes)
+
+    def make_mesh(self):
+        return make_mesh(self.axis_sizes)
+
+    def feed_spec(self, node, ndim):
+        from jax.sharding import PartitionSpec
+        if ndim and "dp" in self.axis_sizes:
+            return PartitionSpec("dp", *([None] * (ndim - 1)))
+        return PartitionSpec()

@@ -1,0 +1,19 @@
+"""Test config: run on a simulated 8-device CPU mesh so every parallelism
+test (dp/tp/ep/pp/cp) executes real XLA collectives without TPU hardware
+(SURVEY.md §4 — replaces the reference's mpirun-based distributed tests).
+
+Note: jax may already be imported by site customization with a TPU platform
+pinned in the environment, so we must force the platform via jax.config (env
+vars alone are read too early to override here).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

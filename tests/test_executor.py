@@ -1,0 +1,189 @@
+"""Executor + training-step tests (reference pattern: tests/test_ops.py dual
+executors + examples/runner/parallel/validate_results.py single-vs-parallel
+numerical parity)."""
+import numpy as np
+
+import hetu_tpu as ht
+
+
+def _mlp_graph(seed=0):
+    rng = np.random.RandomState(seed)
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y_")
+    w1 = ht.Variable("w1", value=rng.randn(8, 16).astype(np.float32) * 0.1)
+    b1 = ht.Variable("b1", value=np.zeros(16, np.float32))
+    w2 = ht.Variable("w2", value=rng.randn(16, 4).astype(np.float32) * 0.1)
+    h = ht.relu_op(ht.linear_op(x, w1, b1))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    return x, y_, loss, logits, [w1, b1, w2]
+
+
+def _data(seed=1, n=32):
+    rng = np.random.RandomState(seed)
+    xv = rng.randn(n, 8).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, n)]
+    return xv, yv
+
+
+def test_sgd_training_decreases_loss():
+    x, y_, loss, logits, _ = _mlp_graph()
+    opt = ht.optim.SGDOptimizer(learning_rate=0.5)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]})
+    xv, yv = _data()
+    losses = [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_sgd_matches_numpy():
+    """One SGD step == manual numpy gradient step for a linear regression."""
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    yv = np.array([[1.0], [0.0]], np.float32)
+    w0 = np.array([[0.5], [-0.5]], np.float32)
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    w = ht.Variable("w", value=w0.copy())
+    pred = ht.matmul_op(x, w)
+    diff = pred - y_
+    loss = ht.reduce_mean_op(diff * diff, [0, 1])
+    train_op = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]})
+    ex.run("train", feed_dict={x: xv, y_: yv})
+    # manual: dL/dw = 2/N * x^T (xw - y)
+    grad = 2.0 / 2 * xv.T @ (xv @ w0 - yv)
+    np.testing.assert_allclose(np.asarray(ex.var_values[w]), w0 - 0.1 * grad,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_gradients_fetch():
+    x, y_, loss, logits, (w1, b1, w2) = _mlp_graph()
+    gw1, gw2 = ht.gradients(loss, [w1, w2])
+    ex = ht.Executor([loss, gw1, gw2])
+    xv, yv = _data()
+    lv, g1, g2 = ex.run(feed_dict={x: xv, y_: yv},
+                        convert_to_numpy_ret_vals=True)
+    assert g1.shape == (8, 16) and g2.shape == (16, 4)
+    assert np.abs(g2).sum() > 0
+
+
+def _run_optimizer(opt, steps=3):
+    xv, yv = _data(3)
+    x, y_, loss, logits, params = _mlp_graph(2)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]})
+    for _ in range(steps):
+        out = ex.run("train", feed_dict={x: xv, y_: yv})
+    return float(out[0].asnumpy())
+
+
+def test_all_optimizers_step():
+    for opt in [ht.optim.SGDOptimizer(0.1),
+                ht.optim.MomentumOptimizer(0.1, momentum=0.9),
+                ht.optim.MomentumOptimizer(0.1, momentum=0.9, nesterov=True),
+                ht.optim.AdaGradOptimizer(0.1, initial_accumulator_value=0.1),
+                ht.optim.AdamOptimizer(0.01),
+                ht.optim.AdamWOptimizer(0.01, weight_decay=0.01),
+                ht.optim.LambOptimizer(0.01, weight_decay=0.01)]:
+        final = _run_optimizer(opt)
+        assert np.isfinite(final)
+
+
+def test_adam_matches_numpy():
+    w0 = np.array([[1.0, 2.0]], np.float32)
+    x = ht.placeholder_op("x")
+    w = ht.Variable("w", value=w0.copy())
+    loss = ht.reduce_mean_op(ht.mul_op(w, x), [0, 1])  # dL/dw = x/2
+    opt = ht.optim.AdamOptimizer(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                                 epsilon=1e-7)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+    xv = np.array([[2.0, 4.0]], np.float32)
+    ex.run("train", feed_dict={x: xv})
+    g = xv / 2
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = w0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-7)
+    np.testing.assert_allclose(np.asarray(ex.var_values[w]), ref, rtol=1e-5)
+
+
+def test_batchnorm_updates_running_stats():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 3, 4, 4).astype(np.float32) * 2 + 1
+    x = ht.placeholder_op("x")
+    scale = ht.init.ones((3,), name="scale")
+    bias = ht.init.zeros((3,), name="bias")
+    bn = ht.batch_normalization_op(x, scale, bias, momentum=0.5)
+    loss = ht.reduce_mean_op(bn, [0, 1, 2, 3])
+    train_op = ht.optim.SGDOptimizer(0.01).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op], "eval": [bn]})
+    ex.run("train", feed_dict={x: xv})
+    rm = np.asarray(ex.var_values[bn.running_mean])
+    batch_mean = xv.mean((0, 2, 3))
+    np.testing.assert_allclose(rm, 0.5 * batch_mean, rtol=1e-4)
+    # eval path uses running stats (not batch stats)
+    out = ex.run("eval", feed_dict={x: xv}, convert_to_numpy_ret_vals=True)[0]
+    assert np.isfinite(out).all()
+
+
+def test_dropout_train_vs_eval():
+    xv = np.ones((64, 64), np.float32)
+    x = ht.placeholder_op("x")
+    d = ht.dropout_op(x, 0.5)
+    s = ht.reduce_mean_op(d, [0, 1])
+    w = ht.Variable("w", value=np.ones((1,), np.float32))
+    loss = s * ht.reduce_mean_op(w, [0])
+    ex = ht.Executor({"train": [loss, ht.optim.SGDOptimizer(0.0).minimize(loss)],
+                      "eval": [d]}, seed=7)
+    lv = float(ex.run("train", feed_dict={x: xv})[0].asnumpy())
+    assert 0.8 < lv < 1.2 and lv != 1.0  # masked+rescaled mean ≈ 1
+    ev = ex.run("eval", feed_dict={x: xv}, convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(ev, xv)  # identity at inference
+
+
+def test_save_load_roundtrip(tmp_path):
+    x, y_, loss, logits, params = _mlp_graph()
+    opt = ht.optim.AdamOptimizer(0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+    xv, yv = _data()
+    for _ in range(3):
+        ex.run("train", feed_dict={x: xv, y_: yv})
+    ckpt = str(tmp_path / "ck.bin")
+    ex.save(ckpt)
+    w_after = {n.name: np.asarray(v) for n, v in ex.var_values.items()}
+    for _ in range(2):
+        ex.run("train", feed_dict={x: xv, y_: yv})
+    ex.load(ckpt)
+    for n, v in ex.var_values.items():
+        np.testing.assert_allclose(np.asarray(v), w_after[n.name], rtol=1e-6)
+    assert ex.step_counter == 3
+
+
+def test_lr_scheduler_effective():
+    sched = ht.optim.StepScheduler(1.0, step_size=2, gamma=0.1)
+    assert sched.get(0) == 1.0 and np.isclose(sched.get(2), 0.1) \
+        and np.isclose(sched.get(4), 0.01)
+    ms = ht.optim.MultiStepScheduler(1.0, [2, 4], 0.5)
+    assert ms.get(1) == 1.0 and np.isclose(ms.get(3), 0.5) and np.isclose(ms.get(5), 0.25)
+    ex = ht.optim.ExponentialScheduler(1.0, 0.9)
+    np.testing.assert_allclose(ex.get(3), 0.9 ** 3)
+    pl = ht.optim.ReduceOnPlateauScheduler(1.0, patience=1, factor=0.1)
+    for m in [1.0, 1.0, 1.0, 1.0]:
+        pl.step(m)
+    assert pl.get(0) < 1.0
+
+
+def test_dataloader_and_batch_num():
+    xv, yv = _data(5, 40)
+    x = ht.dataloader_op([ht.Dataloader(xv, 8, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(yv, 8, "train")])
+    w = ht.Variable("w", value=np.zeros((8, 4), np.float32))
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_),
+                             [0])
+    ex = ht.Executor({"train": [loss, ht.optim.SGDOptimizer(0.1).minimize(loss)]})
+    assert ex.get_batch_num("train") == 5
+    for _ in range(5):
+        out = ex.run("train")
+    assert np.isfinite(float(out[0].asnumpy()))

@@ -1,0 +1,133 @@
+"""MoE op + layer tests (reference tests/test_moe_op.py — run under mpirun
+there; here single-program with expert sharding tested in test_parallel)."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.layers import TopKGate, KTop1Gate, SAMGate, Expert, MoELayer
+
+
+def _tokens(s=64, d=16, seed=0):
+    return np.random.RandomState(seed).randn(s, d).astype(np.float32)
+
+
+def _run(fetches, feeds):
+    ex = ht.Executor(fetches)
+    return ex.run(feed_dict=feeds, convert_to_numpy_ret_vals=True)
+
+
+def test_top1_gate_dispatch_properties():
+    xv = _tokens()
+    x = ht.placeholder_op("x")
+    gate = TopKGate(16, 64, num_experts=4, k=1, capacity_factor=1.0)
+    dispatch, combine, aux = gate(x)
+    d, c, a = _run([dispatch, combine, aux], {x: xv})
+    s, e, cap = d.shape
+    assert (s, e) == (64, 4) and cap == 16
+    # each token dispatched at most once; each (expert, slot) holds <= 1 token
+    assert d.sum(axis=(1, 2)).max() <= 1.0 + 1e-6
+    assert d.sum(axis=0).max() <= 1.0 + 1e-6
+    # combine weights are gate probabilities in (0, 1]
+    assert (c.sum(axis=(1, 2)) <= 1.0 + 1e-5).all()
+    assert np.isfinite(a)
+
+
+def test_top2_gate_two_experts_per_token():
+    xv = _tokens(32, 8, 1)
+    x = ht.placeholder_op("x")
+    gate = TopKGate(8, 32, num_experts=4, k=2, capacity_factor=2.0)
+    dispatch, combine, aux = gate(x)
+    d, c = _run([dispatch, combine], {x: xv})
+    counts = d.sum(axis=(1, 2))
+    assert counts.max() <= 2.0 + 1e-6
+    assert counts.mean() > 1.5  # generous capacity → most tokens keep 2 slots
+    # combine weights normalized over the two experts
+    np.testing.assert_allclose(c.sum(axis=(1, 2))[counts == 2], 1.0, rtol=1e-4)
+
+
+def test_ktop1_gate_one_expert_per_group():
+    xv = _tokens(32, 8, 2)
+    x = ht.placeholder_op("x")
+    gate = KTop1Gate(8, 32, num_experts=4, k=2, capacity_factor=2.0)
+    dispatch, combine, aux = gate(x)
+    d, = _run([dispatch], {x: xv})
+    s, e, cap = d.shape
+    assert e == 4
+    # with ample capacity every token lands exactly once in each of the 2
+    # prototype groups (experts 0-1 and 2-3)
+    g1 = d[:, :2, :].sum(axis=(1, 2))
+    g2 = d[:, 2:, :].sum(axis=(1, 2))
+    assert g1.max() <= 1 + 1e-6 and g2.max() <= 1 + 1e-6
+    assert g1.mean() > 0.9 and g2.mean() > 0.9
+
+
+def test_sam_gate_routes_within_one_group():
+    xv = _tokens(32, 8, 3)
+    x = ht.placeholder_op("x")
+    gate = SAMGate(8, 32, num_experts=4, k=1, capacity_factor=4.0,
+                   num_local_devices=2)
+    dispatch, combine, aux = gate(x)
+    d, a = _run([dispatch, aux], {x: xv})
+    # each token's expert must lie inside a single group of size 2
+    for t in range(32):
+        experts = np.nonzero(d[t].sum(-1))[0]
+        if len(experts):
+            assert (experts < 2).all() or (experts >= 2).all()
+    assert np.isfinite(a)
+
+
+def test_balanced_assignment_is_permutation():
+    from hetu_tpu.ops.moe import _balanced_assignment
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    for s, e in [(16, 4), (64, 8), (32, 2)]:
+        scores = jnp.asarray(rng.randn(s, e).astype(np.float32))
+        slot_tokens = np.asarray(_balanced_assignment(scores))
+        # exact permutation: every token appears exactly once
+        assert sorted(slot_tokens.tolist()) == list(range(s)), (s, e)
+
+
+def test_balanced_assignment_prefers_high_scores():
+    from hetu_tpu.ops.moe import _balanced_assignment
+    import jax.numpy as jnp
+    # tokens 0..3 strongly prefer expert 0, 4..7 expert 1 — assignment should
+    # respect that (capacity 4 per expert, 8 tokens, 2 experts)
+    scores = np.full((8, 2), -5.0, np.float32)
+    scores[:4, 0] = 5.0
+    scores[4:, 1] = 5.0
+    slots = np.asarray(_balanced_assignment(jnp.asarray(scores)))
+    assert set(slots[:4].tolist()) == {0, 1, 2, 3}
+    assert set(slots[4:].tolist()) == {4, 5, 6, 7}
+
+
+def test_moe_layer_end_to_end_trains():
+    s, d, e = 64, 16, 4
+    xv = _tokens(s, d, 4)
+    yv = _tokens(s, d, 5)
+    x, y_ = ht.placeholder_op("x"), ht.placeholder_op("y")
+    gate = TopKGate(d, s, num_experts=e, k=2, capacity_factor=2.0)
+    moe = MoELayer(gate, Expert(e, d, 32))
+    out, aux = moe(x)
+    diff = out - y_
+    loss = ht.reduce_mean_op(diff * diff, [0, 1]) + aux * 0.01
+    ex = ht.Executor({"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]})
+    losses = [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+              for _ in range(30)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_balanced_moe_layer_no_drops():
+    from hetu_tpu.layers.moe_layer import BalancedMoELayer
+    from hetu_tpu.layers.gates import BalanceAssignmentGate
+    s, d, e = 32, 8, 4
+    xv = _tokens(s, d, 6)
+    x = ht.placeholder_op("x")
+    gate = BalanceAssignmentGate(d, s, e)
+    moe = BalancedMoELayer(gate, Expert(e, d, 16), e, s, d)
+    out, _ = moe(x)
+    o, = _run([out], {x: xv})
+    assert o.shape == (s, d)
+    assert np.isfinite(o).all()
+    # no token row is zero (every token processed — permutation, no drops)
+    assert (np.abs(o).sum(-1) > 0).all()

@@ -1,0 +1,57 @@
+"""Parallelism tests on the simulated 8-device CPU mesh (SURVEY.md §4:
+N-device sharded runs must match single-device runs on the same seed —
+the TPU-native replacement for the reference's mpirun validate_results.py)."""
+import numpy as np
+
+import hetu_tpu as ht
+
+
+def _graph(seed=0):
+    rng = np.random.RandomState(seed)
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y_")
+    w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32) * 0.1)
+    w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32) * 0.1)
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+    return x, y_, loss
+
+
+def _run(dist_strategy, steps=6):
+    x, y_, loss = _graph()
+    opt = ht.optim.MomentumOptimizer(0.1, momentum=0.9)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     dist_strategy=dist_strategy)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(64, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+    return [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+            for _ in range(steps)]
+
+
+def test_dp8_matches_single_device():
+    import jax
+    assert len(jax.devices()) == 8
+    single = _run(None)
+    dp8 = _run(ht.dist.DataParallel())
+    np.testing.assert_allclose(single, dp8, rtol=2e-5)
+
+
+def test_dp8_adam_matches_single_device():
+    def run(strategy):
+        x, y_, loss = _graph(3)
+        ex = ht.Executor({"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+                         dist_strategy=strategy)
+        rng = np.random.RandomState(2)
+        xv = rng.randn(32, 16).astype(np.float32)
+        yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+        return [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+                for _ in range(4)]
+    np.testing.assert_allclose(run(None), run(ht.dist.DataParallel()), rtol=2e-5)
+
+
+def test_make_mesh_axes():
+    mesh = ht.make_mesh({"dp": 2, "tp": 4})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
