@@ -1,0 +1,111 @@
+"""Benchmark driver — prints ONE JSON line for the round harness.
+
+Primary config (BASELINE.json): BERT-base MLM pretraining, samples/sec/chip
+and MFU vs the 45%-MFU north-star target.  ``--config resnet18`` covers the
+CIFAR10 step-time config.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _params_count(ex):
+    return int(sum(np.prod(v.shape) for n, v in ex.var_values.items()
+                   if n.trainable))
+
+
+def bench_bert(batch_size=32, seq_len=128, steps=20, warmup=3):
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,
+                                      synthetic_mlm_batch)
+
+    cfg = BertConfig.base(batch_size=batch_size, seq_len=seq_len)
+    feeds, loss, logits = bert_pretrain_graph(cfg)
+    opt = ht.optim.AdamOptimizer(1e-4)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    ids, tt, labels = synthetic_mlm_batch(cfg)
+    fd = {feeds["input_ids"]: ids, feeds["token_type_ids"]: tt,
+          feeds["masked_lm_labels"]: labels}
+
+    for _ in range(warmup):
+        out = ex.run("train", feed_dict=fd)
+    out[0].wait()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = ex.run("train", feed_dict=fd)
+    out[0].wait()
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = _params_count(ex)
+    tokens = batch_size * seq_len
+    # training FLOPs/token: 6N for matmul params + attention score/value terms
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers \
+        * cfg.hidden_size * seq_len
+    flops_per_step = flops_per_token * tokens
+    n_dev = len(jax.devices())
+    peak = {"tpu": 197e12}.get(jax.default_backend(), 50e12)  # v5e bf16 peak
+    mfu = flops_per_step / dt / (peak * n_dev)
+    samples_per_sec_chip = batch_size / dt / n_dev
+    return {
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "value": round(samples_per_sec_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),  # fraction of 45%-MFU north star
+        "extra": {
+            "mfu": round(mfu, 4),
+            "step_time_ms": round(dt * 1e3, 2),
+            "batch_size": batch_size, "seq_len": seq_len,
+            "params": n_params, "backend": jax.default_backend(),
+            "devices": n_dev,
+        },
+    }
+
+
+def bench_resnet18(batch_size=128, steps=20, warmup=3):
+    import jax
+    import hetu_tpu as ht
+    sys.path.insert(0, "examples/cnn")
+    import models
+
+    x = ht.placeholder_op("x", shape=(batch_size, 3, 32, 32))
+    y_ = ht.placeholder_op("y", shape=(batch_size, 10))
+    loss, y = models.resnet18(x, y_)
+    ex = ht.Executor({"train": [loss, ht.optim.MomentumOptimizer(0.1).minimize(loss)]})
+    rng = np.random.RandomState(0)
+    xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
+    fd = {x: xv, y_: yv}
+    for _ in range(warmup):
+        out = ex.run("train", feed_dict=fd)
+    out[0].wait()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = ex.run("train", feed_dict=fd)
+    out[0].wait()
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "metric": "resnet18_cifar10_step_time",
+        "value": round(dt * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": 0.0,
+        "extra": {"batch_size": batch_size,
+                  "backend": jax.default_backend()},
+    }
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="bert", choices=["bert", "resnet18"])
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+    if args.config == "bert":
+        res = bench_bert(batch_size=args.batch_size or 32, steps=args.steps)
+    else:
+        res = bench_resnet18(batch_size=args.batch_size or 128,
+                             steps=args.steps)
+    print(json.dumps(res))

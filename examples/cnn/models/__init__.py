@@ -1,0 +1,9 @@
+from .mlp import mlp
+from .logreg import logreg
+from .cnn import cnn_3_layers
+from .lenet import lenet
+from .alexnet import alexnet
+from .vgg import vgg, vgg16, vgg19
+from .resnet import resnet, resnet18, resnet34
+from .rnn import rnn
+from .lstm import lstm

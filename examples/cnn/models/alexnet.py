@@ -1,0 +1,19 @@
+import hetu_tpu as ht
+from .common import conv2d, bn, fc, ce_loss
+
+
+def alexnet(x, y_, num_class=10):
+    """CIFAR-scale AlexNet (reference examples/cnn/models/AlexNet.py)."""
+    x = bn(conv2d(x, 3, 64, 5, 1, 2, "a1"), 64, "a1bn", relu=True)
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = bn(conv2d(x, 64, 192, 3, 1, 1, "a2"), 192, "a2bn", relu=True)
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = ht.relu_op(conv2d(x, 192, 384, 3, 1, 1, "a3"))
+    x = ht.relu_op(conv2d(x, 384, 256, 3, 1, 1, "a4"))
+    x = ht.relu_op(conv2d(x, 256, 256, 3, 1, 1, "a5"))
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = ht.array_reshape_op(x, output_shape=(-1, 256 * 4 * 4))
+    x = ht.dropout_op(fc(x, (256 * 4 * 4, 1024), "f1", relu=True), 0.5)
+    x = ht.dropout_op(fc(x, (1024, 512), "f2", relu=True), 0.5)
+    logits = fc(x, (512, num_class), "f3")
+    return ce_loss(logits, y_)

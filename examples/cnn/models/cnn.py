@@ -1,0 +1,13 @@
+import hetu_tpu as ht
+from .common import conv2d, fc, ce_loss
+
+
+def cnn_3_layers(x, y_, num_class=10):
+    """3-conv CNN on 28x28 inputs (reference examples/cnn/models/CNN.py)."""
+    x = ht.array_reshape_op(x, output_shape=(-1, 1, 28, 28))
+    x = ht.relu_op(conv2d(x, 1, 32, 5, 1, 2, "c1"))
+    x = ht.relu_op(conv2d(x, 32, 64, 5, 2, 2, "c2"))
+    x = ht.relu_op(conv2d(x, 64, 64, 5, 2, 2, "c3"))
+    x = ht.array_reshape_op(x, output_shape=(-1, 7 * 7 * 64))
+    logits = fc(x, (7 * 7 * 64, num_class), "fc")
+    return ce_loss(logits, y_)

@@ -1,0 +1,29 @@
+"""Shared graph-building helpers for the CNN model zoo (parity with the
+reference examples/cnn/models helper style)."""
+import hetu_tpu as ht
+from hetu_tpu import initializers as init
+
+
+def conv2d(x, in_ch, out_ch, kernel_size=3, stride=1, padding=1, name="conv"):
+    w = init.he_normal(shape=(out_ch, in_ch, kernel_size, kernel_size),
+                       name=name + "_weight")
+    return ht.conv2d_op(x, w, stride=stride, padding=padding)
+
+
+def bn(x, ch, name, relu=False):
+    scale = init.ones(shape=(ch,), name=name + "_scale")
+    bias = init.zeros(shape=(ch,), name=name + "_bias")
+    x = ht.batch_normalization_op(x, scale, bias, momentum=0.9, eps=1e-5)
+    return ht.relu_op(x) if relu else x
+
+
+def fc(x, shape, name, relu=False):
+    w = init.he_normal(shape=shape, name=name + "_weight")
+    b = init.zeros(shape=shape[-1:], name=name + "_bias")
+    x = ht.linear_op(x, w, b)
+    return ht.relu_op(x) if relu else x
+
+
+def ce_loss(logits, y_):
+    loss = ht.softmaxcrossentropy_op(logits, y_)
+    return ht.reduce_mean_op(loss, [0]), ht.softmax_op(logits)

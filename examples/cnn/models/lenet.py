@@ -1,0 +1,16 @@
+import hetu_tpu as ht
+from .common import conv2d, fc, ce_loss
+
+
+def lenet(x, y_, num_class=10):
+    """LeNet-5 (reference examples/cnn/models/LeNet.py)."""
+    x = ht.array_reshape_op(x, output_shape=(-1, 1, 28, 28))
+    x = ht.relu_op(conv2d(x, 1, 6, 5, 1, 2, "l1"))
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = ht.relu_op(conv2d(x, 6, 16, 5, 1, 0, "l2"))
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = ht.array_reshape_op(x, output_shape=(-1, 16 * 5 * 5))
+    x = fc(x, (16 * 5 * 5, 120), "f1", relu=True)
+    x = fc(x, (120, 84), "f2", relu=True)
+    logits = fc(x, (84, num_class), "f3")
+    return ce_loss(logits, y_)

@@ -1,0 +1,8 @@
+from .common import fc, ce_loss
+
+
+def logreg(x, y_, num_class=10):
+    """Logistic regression (reference examples/cnn/models/LogReg.py)."""
+    logits = fc(x, (784, num_class), "logreg")
+    loss, y = ce_loss(logits, y_)
+    return loss, y

@@ -1,0 +1,25 @@
+import hetu_tpu as ht
+from hetu_tpu import initializers as init
+from .common import fc, ce_loss
+
+
+def lstm(x, y_, num_class=10, hidden=128, timesteps=28, dim=28):
+    """LSTM over row-sliced MNIST (reference examples/cnn/models/LSTM.py);
+    the 4 gates are one fused (dim, 4*hidden) matmul — MXU-friendly."""
+    wx = init.xavier_uniform(shape=(dim, 4 * hidden), name="lstm_wx")
+    wh = init.xavier_uniform(shape=(hidden, 4 * hidden), name="lstm_wh")
+    b = init.zeros(shape=(4 * hidden,), name="lstm_b")
+    h = c = None
+    for t in range(timesteps):
+        xt = ht.slice_op(x, begin=(0, t * dim), size=(-1, dim))
+        z = ht.linear_op(xt, wx, b)
+        if h is not None:
+            z = z + ht.matmul_op(h, wh)
+        i = ht.sigmoid_op(ht.slice_op(z, begin=(0, 0), size=(-1, hidden)))
+        f = ht.sigmoid_op(ht.slice_op(z, begin=(0, hidden), size=(-1, hidden)))
+        o = ht.sigmoid_op(ht.slice_op(z, begin=(0, 2 * hidden), size=(-1, hidden)))
+        g = ht.tanh_op(ht.slice_op(z, begin=(0, 3 * hidden), size=(-1, hidden)))
+        c = i * g if c is None else f * c + i * g
+        h = o * ht.tanh_op(c)
+    logits = fc(h, (hidden, num_class), "lstm_head")
+    return ce_loss(logits, y_)

@@ -1,0 +1,11 @@
+import hetu_tpu as ht
+from .common import fc, ce_loss
+
+
+def mlp(x, y_, num_class=10, hidden=256):
+    """3-layer MLP (reference examples/cnn/models/MLP.py)."""
+    x = fc(x, (784, hidden), "mlp_fc1", relu=True)
+    x = fc(x, (hidden, hidden), "mlp_fc2", relu=True)
+    logits = fc(x, (hidden, num_class), "mlp_fc3")
+    loss, y = ce_loss(logits, y_)
+    return loss, y

@@ -1,0 +1,41 @@
+import hetu_tpu as ht
+from .common import conv2d, bn, fc, ce_loss
+
+
+def _basic_block(x, in_ch, out_ch, stride, name):
+    shortcut = x
+    x = bn(conv2d(x, in_ch, out_ch, 3, stride, 1, name + "_c1"), out_ch,
+           name + "_bn1", relu=True)
+    x = bn(conv2d(x, out_ch, out_ch, 3, 1, 1, name + "_c2"), out_ch,
+           name + "_bn2")
+    if in_ch != out_ch or stride > 1:
+        shortcut = bn(conv2d(shortcut, in_ch, out_ch, 1, stride, 0,
+                             name + "_cs"), out_ch, name + "_bns")
+    return ht.relu_op(x + shortcut)
+
+
+_LAYERS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3)}
+
+
+def resnet(x, y_, num_layers=18, num_class=10):
+    """ResNet-18/34, CIFAR stem (reference examples/cnn/models/ResNet.py)."""
+    reps = _LAYERS[num_layers]
+    x = bn(conv2d(x, 3, 64, 3, 1, 1, "stem"), 64, "stem_bn", relu=True)
+    in_ch = 64
+    for stage, (rep, ch) in enumerate(zip(reps, (64, 128, 256, 512))):
+        for r in range(rep):
+            stride = 2 if (stage > 0 and r == 0) else 1
+            x = _basic_block(x, in_ch, ch, stride, f"s{stage}b{r}")
+            in_ch = ch
+    x = ht.avg_pool2d_op(x, 4, 4, 0, 4)
+    x = ht.array_reshape_op(x, output_shape=(-1, 512))
+    logits = fc(x, (512, num_class), "head")
+    return ce_loss(logits, y_)
+
+
+def resnet18(x, y_, num_class=10):
+    return resnet(x, y_, 18, num_class)
+
+
+def resnet34(x, y_, num_class=10):
+    return resnet(x, y_, 34, num_class)

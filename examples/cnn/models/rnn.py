@@ -1,0 +1,21 @@
+import hetu_tpu as ht
+from hetu_tpu import initializers as init
+from .common import fc, ce_loss
+
+
+def rnn(x, y_, num_class=10, hidden=128, timesteps=28, dim=28):
+    """Elman RNN over row-sliced MNIST (reference examples/cnn/models/RNN.py).
+    The reference unrolls with per-step slice ops; we do the same at graph
+    level — XLA fuses the unrolled steps."""
+    wx = init.xavier_uniform(shape=(dim, hidden), name="rnn_wx")
+    wh = init.xavier_uniform(shape=(hidden, hidden), name="rnn_wh")
+    b = init.zeros(shape=(hidden,), name="rnn_b")
+    h = None
+    for t in range(timesteps):
+        xt = ht.slice_op(x, begin=(0, t * dim), size=(-1, dim))
+        z = ht.linear_op(xt, wx, b)
+        if h is not None:
+            z = z + ht.matmul_op(h, wh)
+        h = ht.tanh_op(z)
+    logits = fc(h, (hidden, num_class), "rnn_head")
+    return ce_loss(logits, y_)

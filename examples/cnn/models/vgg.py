@@ -1,0 +1,30 @@
+import hetu_tpu as ht
+from .common import conv2d, bn, fc, ce_loss
+
+_CFG = {16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}
+
+
+def vgg(x, y_, num_layers, num_class=10):
+    """VGG-16/19 with BN, CIFAR head (reference examples/cnn/models/VGG.py)."""
+    reps = _CFG[num_layers]
+    chans = (64, 128, 256, 512, 512)
+    in_ch = 3
+    for b, (rep, ch) in enumerate(zip(reps, chans)):
+        for r in range(rep):
+            x = bn(conv2d(x, in_ch, ch, 3, 1, 1, f"v{b}_{r}"), ch,
+                   f"v{b}_{r}bn", relu=True)
+            in_ch = ch
+        x = ht.max_pool2d_op(x, 2, 2, 0, 2)
+    x = ht.array_reshape_op(x, output_shape=(-1, 512))
+    x = fc(x, (512, 4096), "f1", relu=True)
+    x = fc(x, (4096, 4096), "f2", relu=True)
+    logits = fc(x, (4096, num_class), "f3")
+    return ce_loss(logits, y_)
+
+
+def vgg16(x, y_, num_class=10):
+    return vgg(x, y_, 16, num_class)
+
+
+def vgg19(x, y_, num_class=10):
+    return vgg(x, y_, 19, num_class)

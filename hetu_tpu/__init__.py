@@ -32,6 +32,7 @@ from .data import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
 from . import data
 from . import parallel
 from . import parallel as dist  # reference alias: ht.dist.DataParallel
+from .parallel.dispatch import dispatch
 from . import layers
 from . import metrics
 

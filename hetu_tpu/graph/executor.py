@@ -40,6 +40,12 @@ def _key(node):
     return f"n{node.id}"
 
 
+def _filter_spec(mesh, spec):
+    """Drop axes the mesh doesn't have (e.g. 'ep' under pure DP)."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*[a if a in mesh.axis_names else None for a in spec])
+
+
 class SubExecutor:
     """One fetch-list → one jitted step function."""
 
@@ -93,10 +99,13 @@ class SubExecutor:
                     env[node] = feeds[k]
             else:
                 env[node] = node.lower(ctx, *[env[i] for i in node.inputs])
-            if node.sharding is not None and self.ex.mesh is not None:
+            if node.sharding is not None and self.ex.mesh is not None \
+                    and not isinstance(node, PlaceholderOp):
                 from jax.sharding import NamedSharding
                 env[node] = jax.lax.with_sharding_constraint(
-                    env[node], NamedSharding(self.ex.mesh, node.sharding))
+                    env[node],
+                    NamedSharding(self.ex.mesh,
+                                  _filter_spec(self.ex.mesh, node.sharding)))
         updates = {_key(n): v for n, v in ctx.state_updates.items()}
         return env, updates
 
@@ -138,6 +147,7 @@ class SubExecutor:
             return outs, tparams, updates, opt_states
 
         # donate params & optimizer state: lets XLA update weights in place
+        self._step_fn = step
         self._jit = jax.jit(step, donate_argnums=(0, 2))
 
     # -- run --------------------------------------------------------------
@@ -284,11 +294,16 @@ class Executor:
                 raise ValueError(f"variable {node} has no value/initializer")
             self.var_values[node] = self._place_param(np.asarray(val, np.float32)
                                                       if np.asarray(val).dtype == np.float64
-                                                      else np.asarray(val))
+                                                      else np.asarray(val), node)
 
-    def _place_param(self, val):
+    def _place_param(self, val, node=None):
         import jax
-        if self._replicated_sharding is not None:
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            spec = getattr(node, "sharding", None)
+            if spec is not None:
+                return jax.device_put(val, NamedSharding(
+                    self.mesh, _filter_spec(self.mesh, spec)))
             return jax.device_put(val, self._replicated_sharding)
         return jax.device_put(val)
 
@@ -299,10 +314,14 @@ class Executor:
         val = np.asarray(val) if not hasattr(val, "dtype") else val
         if getattr(val, "dtype", None) == np.float64:
             val = np.asarray(val, np.float32)
-        if self.mesh is not None and self.dist_strategy is not None:
+        if self.mesh is not None:
             from jax.sharding import NamedSharding
-            spec = self.dist_strategy.feed_spec(node, np.ndim(val))
-            return jax.device_put(val, NamedSharding(self.mesh, spec))
+            if node.sharding is not None:  # explicit ht.dispatch on a feed
+                return jax.device_put(val, NamedSharding(
+                    self.mesh, _filter_spec(self.mesh, node.sharding)))
+            if self.dist_strategy is not None:
+                spec = self.dist_strategy.feed_spec(node, np.ndim(val))
+                return jax.device_put(val, NamedSharding(self.mesh, spec))
         return jax.device_put(val)
 
     # -- public API (reference parity) ------------------------------------
@@ -320,6 +339,39 @@ class Executor:
 
     def profile(self, name="default", feed_dict=None, log_file=None):
         return self.subexecutors[name].profile(feed_dict or {}, log_file)
+
+    def export_step(self, name="default"):
+        """Export the subgraph as a pure jittable function + example args.
+
+        Returns ``(fn, example_args)`` where ``fn(tparams, sparams,
+        opt_states, feeds, key, lrs)`` is the exact step the executor jits
+        (params update + state side-channel included).  Feeds in the example
+        args are zeros of the dataloader/placeholder shapes.
+        """
+        import jax
+        sub = self.subexecutors[name]
+        from ..data.dataloader import DataloaderOp
+        feeds = {}
+        for node in sub.feed_nodes:
+            if isinstance(node, DataloaderOp):
+                arr = np.zeros(node.get_cur_shape(name), np.float32)
+            else:
+                if node.shape is None:
+                    raise ValueError(
+                        f"feed {node} needs a static shape for export; "
+                        "pass shape= to placeholder_op")
+                arr = np.zeros(node.shape, node.dtype or np.float32)
+            feeds[_key(node)] = arr
+        tparams = {_key(n): self.var_values[n] for n in sub.trainable_vars}
+        sparams = {_key(n): self.var_values[n] for n in sub.state_vars}
+        opt_states = {_key(op): self.opt_states[op] for op in sub.opt_ops}
+        lrs = np.asarray([op.optimizer.host_lr(0) for op in sub.opt_ops],
+                         np.float32)
+        key = jax.random.key(self.seed)
+        if sub._jit is None:
+            sub._build_step()
+        # _step_fn is the raw pure step (the executor's own jit adds donation)
+        return sub._step_fn, (tparams, sparams, opt_states, feeds, key, lrs)
 
     def get_batch_num(self, name="default"):
         from ..data.dataloader import DataloaderOp
@@ -365,6 +417,10 @@ class Executor:
         for name, st in blob.get("opt_states", {}).items():
             if name in by_name:
                 import jax
+                # optimizer state shards like its params; without per-leaf
+                # node info, restore replicated-or-sharded via the param map
+                # below after params are placed (leaves follow params in the
+                # next jitted step's constraint anyway)
                 self.opt_states[by_name[name]] = jax.tree.map(
                     self._place_param, st)
         self.step_counter = blob.get("step", 0)
@@ -373,7 +429,8 @@ class Executor:
         by_name = {self.var_names[n]: n for n in self.var_values}
         for name, val in state_dict.items():
             if name in by_name:
-                self.var_values[by_name[name]] = self._place_param(np.asarray(val))
+                node = by_name[name]
+                self.var_values[node] = self._place_param(np.asarray(val), node)
 
     def return_tensor_values(self):
         return {self.var_names[n]: np.asarray(v)

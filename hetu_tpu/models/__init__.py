@@ -1,0 +1,4 @@
+"""Flagship model zoo built on the graph API (reference keeps these in
+``examples/transformers/*``; they live in-package here so benchmarks, the
+graft entry and examples share one implementation)."""
+from .bert import BertConfig, bert_model, bert_pretrain_graph

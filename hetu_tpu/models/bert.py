@@ -1,0 +1,148 @@
+"""BERT (reference ``examples/transformers/bert/hetu_bert.py`` — an HF-style
+BERT built from hetu ops).  TPU-native rewrite: same graph-API surface, but
+attention is the fused ``sdpa_op`` (Pallas flash kernel on TPU) instead of
+composed batch_matmul+softmax, and activations flow as (batch*seq, hidden)
+2-D tensors so every projection is one MXU matmul.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from .. import initializers as init
+from ..graph.node import Variable
+from ..layers.attention import MultiHeadAttention
+from ..layers.core import Linear, LayerNorm, DropOut, Embedding
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12,
+                 batch_size=8, seq_len=128):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_hidden_layers", 24)
+        kw.setdefault("num_attention_heads", 16)
+        kw.setdefault("intermediate_size", 4096)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 2)
+        kw.setdefault("intermediate_size", 512)
+        kw.setdefault("vocab_size", 1024)
+        return cls(**kw)
+
+
+def _embeddings(cfg, input_ids, token_type_ids, name="embeddings"):
+    word = Embedding(cfg.vocab_size, cfg.hidden_size,
+                     init.GenTruncatedNormal(0.0, 0.02), name + ".word")
+    pos_table = init.truncated_normal(
+        (cfg.max_position_embeddings, cfg.hidden_size), 0.0, 0.02,
+        name=name + ".position")
+    ttype = Embedding(cfg.type_vocab_size, cfg.hidden_size,
+                      init.GenTruncatedNormal(0.0, 0.02), name + ".token_type")
+    positions = Variable(
+        name + ".pos_ids",
+        value=np.arange(cfg.seq_len, dtype=np.float32), trainable=False)
+    e = word(input_ids) + ops.embedding_lookup_op(pos_table, positions) \
+        + ttype(token_type_ids)
+    e = ops.array_reshape_op(
+        e, output_shape=(cfg.batch_size * cfg.seq_len, cfg.hidden_size))
+    e = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name + ".ln")(e)
+    return ops.dropout_op(e, 1.0 - cfg.hidden_dropout_prob)
+
+
+def _encoder_layer(cfg, x, name):
+    mha = MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads,
+                             dropout=cfg.attention_probs_dropout_prob,
+                             name=name + ".attn")
+    attn = mha(x, cfg.batch_size, cfg.seq_len)
+    x = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps,
+                  name + ".ln1")(x + attn)
+    h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
+               initializer=init.GenTruncatedNormal(0.0, 0.02),
+               name=name + ".ffn1")(x)
+    h = Linear(cfg.intermediate_size, cfg.hidden_size,
+               initializer=init.GenTruncatedNormal(0.0, 0.02),
+               name=name + ".ffn2")(h)
+    h = ops.dropout_op(h, 1.0 - cfg.hidden_dropout_prob)
+    return LayerNorm(cfg.hidden_size, cfg.layer_norm_eps,
+                     name + ".ln2")(x + h)
+
+
+def bert_model(cfg, input_ids, token_type_ids, name="bert"):
+    """Returns sequence_output node of shape (batch*seq, hidden)."""
+    x = _embeddings(cfg, input_ids, token_type_ids, name + ".embeddings")
+    for i in range(cfg.num_hidden_layers):
+        x = _encoder_layer(cfg, x, f"{name}.layer{i}")
+    return x
+
+
+def bert_pretrain_graph(cfg, name="bert"):
+    """Full MLM pretraining graph (reference train_hetu_bert_dp.py flow).
+
+    Returns (placeholders dict, loss node, logits node).
+    masked_lm_labels: (batch, seq) with -1 for unmasked positions.
+    """
+    from ..graph.node import placeholder_op
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape)
+    token_type_ids = placeholder_op("token_type_ids", shape=shape)
+    labels = placeholder_op("masked_lm_labels", shape=shape)
+
+    seq = bert_model(cfg, input_ids, token_type_ids, name)
+    # MLM head: transform + tied-ish decoder (fresh decoder weights, like the
+    # reference which also keeps an independent decoder matrix)
+    h = Linear(cfg.hidden_size, cfg.hidden_size, activation="gelu",
+               initializer=init.GenTruncatedNormal(0.0, 0.02),
+               name=name + ".mlm_transform")(seq)
+    h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name + ".mlm_ln")(h)
+    logits = Linear(cfg.hidden_size, cfg.vocab_size,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".mlm_decoder")(h)
+    flat_labels = ops.array_reshape_op(
+        labels, output_shape=(cfg.batch_size * cfg.seq_len,))
+    per_tok = ops.softmaxcrossentropy_sparse_op(logits, flat_labels,
+                                                ignored_index=-1)
+    # mean over masked tokens only
+    is_masked = ops.ne_op(flat_labels, flat_labels * 0.0 - 1.0)
+    denom = ops.reduce_sum_op(is_masked, [0]) + 1e-6
+    loss = ops.reduce_sum_op(per_tok, [0]) / denom
+    feeds = {"input_ids": input_ids, "token_type_ids": token_type_ids,
+             "masked_lm_labels": labels}
+    return feeds, loss, logits
+
+
+def synthetic_mlm_batch(cfg, seed=0, mask_frac=0.15):
+    """Deterministic synthetic MLM batch (hermetic benches/tests)."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
+    tt = np.zeros((cfg.batch_size, cfg.seq_len), np.float32)
+    labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int64)
+    mask = rng.rand(cfg.batch_size, cfg.seq_len) < mask_frac
+    labels[mask] = ids[mask]
+    return (ids.astype(np.float32), tt, labels.astype(np.float32))

@@ -1,1 +1,4 @@
 from .strategies import Strategy, DataParallel, ModelParallel
+from .dispatch import dispatch
+from . import collectives
+from .collectives import CommGroup, new_group_comm

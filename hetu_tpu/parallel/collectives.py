@@ -1,0 +1,127 @@
+"""Collective communication layer — the TPU-native equivalent of the
+reference's MPI+NCCL bridge (``src/communication/mpi_nccl_communication.cu``:
+dlarrayAllReduce:313, Reduce:326, Broadcast:340, AllGather:353,
+ReduceScatter:369, AllToAll:383, HAllToAll:396, Send:409/Recv:421) and its
+Python wrapper (``communicator/mpi_nccl_comm.py``).
+
+Design (SURVEY.md §5.8): collectives are expressed over NAMED MESH AXES and
+executed by XLA over ICI.  Two complementary surfaces:
+
+1. Implicit — jit + shardings: XLA inserts the collectives (used by the
+   Executor; covers the reference's allreduce-behind-optimizer pattern).
+2. Explicit — these wrappers inside ``shard_map`` per-device programs, for
+   schedules XLA can't infer (pipeline microbatching, ring attention,
+   hierarchical MoE a2a).  ``ppermute`` is the native ICI primitive that
+   replaces NCCL grouped Send/Recv.
+
+Group communicators over device subsets (``mpi_nccl_comm.py:157-250``) map
+to sub-meshes / axis subsets: every wrapper takes ``axis_name`` and operates
+on exactly that mesh dimension.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# -- explicit collectives (inside shard_map) --------------------------------
+
+def all_reduce(x, axis_name, op="sum"):
+    """NCCL allreduce parity (ncclAllReduce; avg used by preduce)."""
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op in ("avg", "mean"):
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis=0, concat_axis=0):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, axis_name, root=0):
+    """Broadcast from ``root`` along the axis (ncclBroadcast parity)."""
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)),
+                        axis_name)
+
+
+def reduce(x, axis_name, root=0, op="sum"):
+    """Reduce-to-root (ncclReduce parity): non-roots get zeros."""
+    total = all_reduce(x, axis_name, op)
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == root, total, jnp.zeros_like(total))
+
+
+def ppermute(x, axis_name, perm):
+    """Collective-permute — the ICI-native replacement for NCCL grouped
+    Send/Recv (GroupStart/End, mpi_nccl_communication.cu:129-134)."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send_next(x, axis_name, n):
+    """Shift by +1 around the ring (pipeline send to next stage)."""
+    return ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_prev(x, axis_name, n):
+    return ppermute(x, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+
+def hierarchical_all_to_all(x, outer_axis, inner_axis):
+    """2-level a2a (reference HAllToAll:396 + HA2AGather/Scatter: intra-node
+    gather → inter-node a2a).  On a 2-D (DCN, ICI) mesh: a2a over the inner
+    (fast) axis first, then over the outer axis — XLA overlaps both; kept as
+    an explicit schedule for DCN-bound MoE."""
+    x = all_to_all(x, inner_axis, 0, 0)
+    return all_to_all(x, outer_axis, 0, 0)
+
+
+# -- group communicators (reference mpi_nccl_comm group concept) ------------
+
+class CommGroup:
+    """A named-axis communicator over a sub-mesh — the analogue of
+    ``new_group_comm(DeviceGroup)`` (executor.py re-export).  Wraps shard_map
+    so callers write per-device code with the group's axis in scope."""
+
+    def __init__(self, mesh: Mesh, axis_name: str):
+        assert axis_name in mesh.axis_names
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    @property
+    def size(self):
+        return self.mesh.shape[self.axis_name]
+
+    def run(self, fn, *args, in_specs=None, out_specs=None):
+        in_specs = in_specs or tuple(P(self.axis_name) for _ in args)
+        out_specs = out_specs if out_specs is not None else P(self.axis_name)
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(*args)
+
+    def allreduce(self, x, op="sum"):
+        return self.run(functools.partial(all_reduce, axis_name=self.axis_name,
+                                          op=op), x,
+                        in_specs=(P(self.axis_name),), out_specs=P())
+
+
+def new_group_comm(mesh, axis_name="dp"):
+    """Reference-parity constructor (``new_group_comm``)."""
+    return CommGroup(mesh, axis_name)

@@ -1,0 +1,54 @@
+"""``ht.dispatch`` — intra-op (tensor) model parallelism.
+
+The reference DECLARED this API but never built the rewriter
+(``gpu_ops/Dispatch.py`` — vestigial, SURVEY.md §2.3: "no graph rewriter
+consumes DispatchOp").  Here the declared semantics become real: a dispatch
+is a GSPMD sharding annotation; the XLA SPMD partitioner generates the
+halo/allreduce/all-gather program the reference never got to.
+
+``parts`` follows the reference surface: a tuple with one entry per tensor
+dim — an int (ignored: the mesh axis size determines the split), a mesh axis
+name ('dp'/'tp'/'ep'/'cp'/'pp'), or None/-1 for replicated dims.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+from ..context import MESH_AXES
+
+
+_INT_AXIS_ORDER = ("tp", "dp", "ep")  # dims split by bare ints, in order
+
+
+def _to_spec(parts):
+    axes = []
+    next_int_axis = 0
+    for p in parts:
+        if p in MESH_AXES:
+            if p in axes:
+                raise ValueError(f"mesh axis {p!r} used twice in {parts!r}")
+            axes.append(p)
+        elif isinstance(p, int) and p > 1:
+            # reference int parts = "split this dim"; successive int dims map
+            # to distinct mesh axes (tp, then dp, then ep)
+            while (next_int_axis < len(_INT_AXIS_ORDER)
+                   and _INT_AXIS_ORDER[next_int_axis] in axes):
+                next_int_axis += 1
+            if next_int_axis >= len(_INT_AXIS_ORDER):
+                raise ValueError(f"too many int split dims in {parts!r}; "
+                                 "use explicit mesh axis names")
+            axes.append(_INT_AXIS_ORDER[next_int_axis])
+            next_int_axis += 1
+        else:
+            axes.append(None)
+    return PartitionSpec(*axes)
+
+
+def dispatch(node, parts):
+    """Annotate ``node`` (and return it) with a partition over the mesh.
+
+    ``ht.dispatch(x, (2, 1))`` → shard dim 0 over 'tp' (reference int style);
+    ``ht.dispatch(x, ('dp', None))`` → explicit axis names.
+    """
+    node.sharding = parts if isinstance(parts, PartitionSpec) else _to_spec(parts)
+    return node
