@@ -33,6 +33,7 @@ from . import data
 from . import parallel
 from . import parallel as dist  # reference alias: ht.dist.DataParallel
 from .parallel.dispatch import dispatch
+from .parallel.pipeline import pipeline_block, PipelineParallel
 from . import layers
 from . import metrics
 

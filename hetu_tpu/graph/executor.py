@@ -77,6 +77,10 @@ class SubExecutor:
         if len(losses) > 1:
             raise ValueError("multiple distinct losses in one subgraph")
         self.loss_node = next(iter(losses)) if losses else None
+        # graphs with a PipelineBlockOp pipeline via shard_map inside the
+        # block; executor-level microbatching would double-split the batch
+        self.has_pipeline_block = any(
+            n.op_type == "PipelineBlock" for n in self.topo)
         self._jit = None
 
     # -- lowering ---------------------------------------------------------
@@ -84,7 +88,8 @@ class SubExecutor:
     def _forward(self, tparams, sparams, feeds, key):
         """Evaluate every non-grad node; returns (env, state_updates)."""
         import jax
-        ctx = LowerCtx(self.training, key, self.ex.mesh)
+        ctx = LowerCtx(self.training, key, self.ex.mesh,
+                       num_microbatches=self.ex.num_microbatches)
         env = {}
         for node in self.topo:
             if isinstance(node, GradientOp) or node in self.opt_ops:
@@ -116,16 +121,22 @@ class SubExecutor:
 
         def step(tparams, sparams, opt_states, feeds, key, lrs):
             if self.grad_ops:
-                def loss_fn(tp):
-                    env, updates = self._forward(tp, sparams, feeds, key)
+                def loss_fn(tp, fd, sp, k):
+                    env, updates = self._forward(tp, sp, fd, k)
                     aux_vals = [None if f is None or f in self.opt_ops
                                 or isinstance(f, GradientOp)
                                 else env[f] for f in fetch_nodes]
                     return env[self.loss_node], (aux_vals, updates)
 
-                (loss_val, (aux_vals, updates)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(tparams)
-                del loss_val
+                M = self.ex.num_microbatches or 1
+                if self.ex.pipeline and M > 1 and not self.has_pipeline_block:
+                    aux_vals, updates, grads = self._microbatched_grads(
+                        loss_fn, tparams, sparams, feeds, key, M)
+                else:
+                    (loss_val, (aux_vals, updates)), grads = \
+                        jax.value_and_grad(loss_fn, has_aux=True)(
+                            tparams, feeds, sparams, key)
+                    del loss_val
                 new_tparams = dict(tparams)
                 new_opt_states = dict(opt_states)
                 for i, opt_op in enumerate(self.opt_ops):
@@ -149,6 +160,88 @@ class SubExecutor:
         # donate params & optimizer state: lets XLA update weights in place
         self._step_fn = step
         self._jit = jax.jit(step, donate_argnums=(0, 2))
+
+    def _microbatched_grads(self, loss_fn, tparams, sparams, feeds, key, M):
+        """GPipe-semantics microbatch gradient accumulation.
+
+        Replaces the reference's per-rank microbatch scheduler loops
+        (``gpipe_subexecutor.py:79-89``, 1F1B ``pipedream_subexecutor.py``)
+        with a ``lax.scan`` over microbatches inside the jitted step; stage-
+        level overlap comes from ``pipeline_block``'s shard_map schedule.
+        ``pipeline='pipedream'``/'hetpipe' additionally remat the per-
+        microbatch forward (1F1B's activation footprint); grads are
+        averaged, so the result equals the full-batch gradient for
+        mean-reduced losses.  Stateful updates (BN stats) are threaded
+        sequentially microbatch→microbatch, matching per-microbatch
+        execution in the reference schedulers.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # Only feeds whose leading dim IS the batch get split; scalars and
+        # constant side-inputs (masks, tables) broadcast to every microbatch.
+        # Batch size: explicit via Executor(microbatch_feeds=[...]), else the
+        # most common leading dim (ties → larger).
+        explicit = self.ex._extra_config.get("microbatch_feeds")
+        if explicit:
+            names = {f"n{n.id}" if isinstance(n, Op) else n for n in explicit}
+            cand = [v.shape[0] for k, v in feeds.items()
+                    if k in names and v.ndim]
+        else:
+            cand = [v.shape[0] for v in feeds.values() if v.ndim]
+        from collections import Counter
+        counts = Counter(cand)
+        B = max(counts, key=lambda d: (counts[d], d)) if counts else 0
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible into {M} microbatches")
+        split = {k: v for k, v in feeds.items()
+                 if v.ndim and v.shape[0] == B
+                 and (not explicit or k in names)}
+        if not split:
+            raise ValueError("pipeline microbatching needs at least one "
+                             "batch-shaped feed")
+        rest = {k: v for k, v in feeds.items() if k not in split}
+        feeds_mb = {k: v.reshape((M, B // M) + v.shape[1:])
+                    for k, v in split.items()}
+        fn = loss_fn
+        if self.ex.pipeline in ("pipedream", "hetpipe"):
+            fn = jax.checkpoint(loss_fn, static_argnums=())
+
+        grad_fn = jax.value_and_grad(fn, has_aux=True)
+
+        def body(carry, xs):
+            fd_mb, i = xs
+            acc, sp = carry
+            # per-microbatch key: independent dropout masks across the scan
+            (_, (aux, updates)), g = grad_fn(
+                tparams, {**fd_mb, **rest}, sp, jax.random.fold_in(key, i))
+            acc = jax.tree.map(jnp.add, acc, g)
+            sp = {**sp, **updates}
+            return (acc, sp), aux
+
+        zeros = jax.tree.map(jnp.zeros_like, tparams)
+        (acc, sp_final), aux_stack = jax.lax.scan(
+            body, (zeros, dict(sparams)), (feeds_mb, jnp.arange(M)))
+        grads = jax.tree.map(lambda g: g / M, acc)
+        # scalar fetches → mean over microbatches; batch-derived fetches
+        # (per-microbatch leading dim a multiple of mb, covering token-
+        # flattened tensors) → re-concat; anything else (weights) → last copy
+        mb = B // M if M else 0
+
+        def merge_aux(a):
+            if a is None:
+                return None
+            if a.ndim <= 1:
+                return jnp.mean(a, 0)
+            if a.ndim >= 2 and mb and a.shape[1] % mb == 0:
+                return a.reshape((-1,) + a.shape[2:])
+            return a[-1]
+
+        aux_vals = [merge_aux(a) for a in aux_stack]
+        # threaded state comes back committed wholesale (unchanged leaves
+        # round-trip through the scan with their original values)
+        return aux_vals, dict(sp_final), grads
 
     # -- run --------------------------------------------------------------
 
@@ -227,7 +320,8 @@ class Executor:
     """
 
     def __init__(self, eval_node_dict, ctx=None, seed=None, dist_strategy=None,
-                 mesh=None, comm_mode=None, **kwargs):
+                 mesh=None, comm_mode=None, pipeline=None, num_microbatches=None,
+                 **kwargs):
         import jax
         if isinstance(eval_node_dict, dict):
             self.eval_node_dict = dict(eval_node_dict)
@@ -237,6 +331,15 @@ class Executor:
         self.master_key = jax.random.key(self.seed)
         self.step_counter = 0
         self.comm_mode = comm_mode
+        if pipeline is None and getattr(dist_strategy, "schedule", None):
+            pipeline = dist_strategy.schedule  # PipelineParallel(schedule=..)
+        if pipeline is not None and pipeline not in (
+                "gpipe", "pipedream", "hetpipe"):
+            raise ValueError(f"unknown pipeline schedule {pipeline!r}")
+        self.pipeline = pipeline
+        self.num_microbatches = num_microbatches
+        if pipeline and not num_microbatches:
+            self.num_microbatches = 4  # reference default microbatch count
         self._extra_config = kwargs
 
         # distribution

@@ -44,12 +44,16 @@ class LowerCtx:
       ops can emit sharding constraints or shard_map collectives.
     """
 
-    def __init__(self, training: bool, base_key=None, mesh=None):
+    def __init__(self, training: bool, base_key=None, mesh=None,
+                 num_microbatches=None):
         self.training = training
         self._base_key = base_key
         self._rng_count = 0
         self.state_updates = {}
         self.mesh = mesh
+        # executor-level microbatch setting; pipeline_block inherits it
+        # when its own n_microbatches is unset
+        self.num_microbatches = num_microbatches
 
     def rng(self):
         if self._base_key is None:

@@ -2,3 +2,6 @@ from .strategies import Strategy, DataParallel, ModelParallel
 from .dispatch import dispatch
 from . import collectives
 from .collectives import CommGroup, new_group_comm
+from .pipeline import (PipelineParallel, pipeline_block, pipeline_apply,
+                       serial_apply, spmd_pipeline_local, gpipe_schedule,
+                       pipedream_schedule, hetpipe_sync_steps)

@@ -1,0 +1,389 @@
+"""Pipeline parallelism over the 'pp' mesh axis — TPU-native schedules.
+
+Re-design of the reference pipeline engine (``python/hetu/gpu_ops/
+pipeline_subexecutor.py:13`` partitioning, ``gpipe_subexecutor.py:7`` GPipe,
+``pipedream_subexecutor.py:51`` 1F1B with weight stashing, HetPipe local
+accumulation ``pipedream_subexecutor.py:77-83,317-328``).  The reference runs
+a *Python scheduler per rank* that pushes microbatches through NCCL
+send/recv (``PipelineSend.py:5`` / ``PipelineReceive.py:5``) with group-call
+deadlock avoidance; here the whole schedule is ONE scanned SPMD program:
+
+* stages live on the ``pp`` axis of a ``jax.sharding.Mesh``; stage weights
+  are *stacked* along a leading axis sharded over ``pp``;
+* activations move stage→stage with ``lax.ppermute`` (the native ICI
+  collective-permute) inside ``jax.shard_map``;
+* the tick loop is ``lax.scan`` over ``M + S - 1`` ticks (M microbatches,
+  S stages) — the GPipe schedule, compiled once by XLA;
+* backward is simply ``jax.grad`` through the scanned program: transposing
+  ``ppermute`` reverses the permutation, so the backward pipeline runs in
+  the opposite direction automatically — no hand-written 1F1B scheduler,
+  no weight stashing (sync SPMD training has exactly one weight version,
+  removing PipeDream's staleness machinery by construction);
+* memory: ``remat=True`` recomputes each stage in backward
+  (``jax.checkpoint``), matching 1F1B's activation footprint;
+* HetPipe's local-accumulate-then-sync is subsumed by microbatch gradient
+  accumulation (:class:`hetu_tpu.graph.executor` ``pipeline=`` mode) — under
+  synchronous SPMD there is no parameter server to defer syncs against.
+
+Stage functions must be shape-homogeneous (input shape == output shape),
+the standard contract for transformer-stack pipelining.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def spmd_pipeline_local(stage_fn, params, x_mb, axis_name="pp", remat=False,
+                        key=None):
+    """GPipe tick loop — call INSIDE ``shard_map`` over the ``pp`` axis.
+
+    Args:
+      stage_fn: ``(params, x, key) -> y`` for ONE mesh stage, with
+        ``y.shape == x.shape``.  ``params`` may stack several model layers
+        per rank (leading dim v) — the caller composes them.
+      params: this device's stage parameters (any pytree).
+      x_mb: ``[M, mb, ...]`` microbatched input (replicated over ``pp``).
+      key: optional PRNG key; each (rank, tick) gets a distinct fold.
+    Returns:
+      ``[M, mb, ...]`` outputs of the last stage (identical on every
+      pp rank after the closing psum).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = lax.psum(1, axis_name)
+    s = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    dev_key = None if key is None else jax.random.fold_in(key, s)
+
+    def tick(carry, t):
+        state, outputs = carry
+        inject = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        inp = jnp.where(s == 0, inject, state)
+        k = None if dev_key is None else jax.random.fold_in(dev_key, t)
+        y = fn(params, inp, k)
+        out_t = t - (S - 1)
+        valid = jnp.logical_and(s == S - 1,
+                                jnp.logical_and(out_t >= 0, out_t < M))
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_t, 0, M - 1), 0)
+        outputs = jnp.where(valid, upd, outputs)
+        from .collectives import send_next
+        state = send_next(y, axis_name, S)
+        return (state, outputs), None
+
+    state0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+    outs0 = jnp.zeros_like(x_mb)
+    (state, outputs), _ = lax.scan(
+        tick, (state0, outs0), jnp.arange(M + S - 1))
+    del state
+    # only the last stage wrote non-zeros; psum replicates its buffer
+    return lax.psum(outputs, axis_name)
+
+
+def _normalize_stage_fn(stage_fn):
+    """Accept both (params, x) and (params, x, key) stage functions."""
+    import inspect
+    try:
+        n = len(inspect.signature(stage_fn).parameters)
+    except (TypeError, ValueError):
+        n = 3
+    if n >= 3:
+        return stage_fn
+    return lambda p, x, key: stage_fn(p, x)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh,
+                   axis_name="pp", batch_axis="dp", remat=False, key=None):
+    """Run a stacked-stage pipeline over a mesh (the jit-level entry).
+
+    Args:
+      stage_fn: ``(params, x[, key]) -> y`` for one model stage
+        (shape-preserving).
+      stacked_params: pytree whose leaves have leading dim ``n_stages``; must
+        be a multiple of the mesh's ``pp`` size — with ``v = n_stages // pp``
+        stages per rank, each rank applies its ``v`` stages sequentially
+        (the standard looping layout).
+      x: ``[B, ...]`` full batch, ``B % n_microbatches == 0``.
+      mesh: the active :class:`jax.sharding.Mesh` (must contain ``axis_name``).
+      batch_axis: mesh axis sharding the within-microbatch batch dim (or
+        ``None``); combined dp×pp runs shard activations over dp too.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    stage_fn = _normalize_stage_fn(stage_fn)
+    S = mesh.shape[axis_name]
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages % S:
+        raise ValueError(f"{n_stages} stages not divisible over pp={S} ranks")
+    M = int(n_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+
+    dp = batch_axis if (batch_axis in mesh.axis_names) else None
+    x_spec = P(None, dp, *([None] * (x.ndim - 1)))
+    p_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+
+    def rank_fn(params, h, k):
+        # apply this rank's v stages sequentially (scan over local stack)
+        if k is None:
+            def body(hh, p_i):
+                return stage_fn(p_i, hh, None), None
+            out, _ = lax.scan(body, h, params)
+        else:
+            v = jax.tree.leaves(params)[0].shape[0]
+
+            def body(hh, xs):
+                p_i, ki = xs
+                return stage_fn(p_i, hh, ki), None
+            out, _ = lax.scan(body, h, (params, jax.random.split(k, v)))
+        return out
+
+    def local(params, xm):
+        return spmd_pipeline_local(rank_fn, params, xm,
+                                   axis_name=axis_name, remat=remat, key=key)
+
+    y_mb = jax.shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
+                         out_specs=x_spec, check_vma=False)(
+        stacked_params, x_mb)
+    return y_mb.reshape((B,) + y_mb.shape[2:])
+
+
+def serial_apply(stage_fn, stacked_params, x, remat=False, key=None):
+    """Reference semantics: apply S stages sequentially (scan-over-layers).
+
+    Numerically identical to :func:`pipeline_apply` for batch-elementwise
+    deterministic stages; used on single-device/no-'pp' meshes and in
+    parity tests.
+    """
+    import jax
+    from jax import lax
+
+    stage_fn = _normalize_stage_fn(stage_fn)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    S = jax.tree.leaves(stacked_params)[0].shape[0]
+    keys = jax.random.split(key, S) if key is not None else None
+
+    if keys is None:
+        def body(h, params):
+            return fn(params, h, None), None
+        y, _ = lax.scan(body, x, stacked_params)
+    else:
+        def body(h, xs):
+            params, k = xs
+            return fn(params, h, k), None
+        y, _ = lax.scan(body, x, (stacked_params, keys))
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Schedules as explicit generators (reference-parity introspection surface:
+# ``pipedream_subexecutor.pipedream_scheduler:25-48``). The SPMD program above
+# realizes these orders implicitly; the generators document/teach them and
+# drive the schedule-visualization tests.
+# ---------------------------------------------------------------------------
+
+def gpipe_schedule(n_stages, n_microbatches):
+    """Yield per-tick lists of (stage, microbatch, phase) for GPipe:
+    all-forward then all-backward (reference ``gpipe_subexecutor.py:79-89``)."""
+    ticks = []
+    for t in range(n_microbatches + n_stages - 1):
+        ticks.append([(s, t - s, "fwd") for s in range(n_stages)
+                      if 0 <= t - s < n_microbatches])
+    for t in range(n_microbatches + n_stages - 1):
+        ticks.append([(s, t - (n_stages - 1 - s), "bwd")
+                      for s in range(n_stages)
+                      if 0 <= t - (n_stages - 1 - s) < n_microbatches])
+    return ticks
+
+
+def pipedream_schedule(n_stages, n_microbatches):
+    """1F1B order per stage: warmup (n_stages - stage) forwards, then
+    alternate 1F1B, then drain (reference ``pipedream_scheduler``:25-48)."""
+    per_stage = {}
+    for s in range(n_stages):
+        warmup = min(n_stages - s, n_microbatches)
+        order = [("fwd", m) for m in range(warmup)]
+        f, b = warmup, 0
+        while b < n_microbatches:
+            order.append(("bwd", b)); b += 1
+            if f < n_microbatches:
+                order.append(("fwd", f)); f += 1
+        per_stage[s] = order
+    return per_stage
+
+
+def hetpipe_sync_steps(step, pp_nrank):
+    """HetPipe applies the global (PS) sync every ``pp_nrank`` local steps
+    (reference ``pipedream_subexecutor.py:317-328``)."""
+    return (step + 1) % pp_nrank == 0
+
+
+# ---------------------------------------------------------------------------
+# Graph-frontend op: ht.pipeline_block — define ONE stage as a subgraph,
+# replicate S× with stacked pp-sharded weights.
+# ---------------------------------------------------------------------------
+
+def pipeline_block(x, builder, n_stages, n_microbatches=None, remat=False,
+                   name="pipe"):
+    """Build an S-stage pipelined block in the define-then-run graph.
+
+    ``builder(stage_in_node) -> out_node`` constructs ONE stage's subgraph
+    (Variables created inside become per-stage weights; each stage gets an
+    independently-initialized copy, stacked ``[S, ...]`` and sharded over
+    'pp').  Under a mesh with a 'pp' axis the op lowers to the shard_map
+    GPipe program; otherwise to scan-over-stages (identical numerics).
+
+    This realizes the reference's *intended but incomplete* auto-partition
+    path (``pipeline_subexecutor.py:46`` reads config fields that are never
+    set — SURVEY.md §7 vestigial list) as a first-class TPU construct.
+    """
+    from ..graph.node import PlaceholderOp, topo_sort, placeholder_op
+
+    stage_in = placeholder_op(f"{name}.stage_in")
+    watermark = stage_in.id  # nodes created by the builder have larger ids
+    out_node = builder(stage_in)
+    topo = topo_sort([out_node])
+    if any(isinstance(n, PlaceholderOp) and not n.is_variable
+           and n is not stage_in for n in topo):
+        raise ValueError("pipeline stage builder may only consume its input "
+                         "node (Variables are allowed)")
+    template_vars = [n for n in topo
+                     if isinstance(n, PlaceholderOp) and n.is_variable]
+    outer = [v for v in template_vars if v.id < watermark]
+    if outer:
+        raise ValueError(
+            f"pipeline stage builder references pre-existing Variables "
+            f"{[v.name for v in outer]}; each stage gets independent "
+            "stacked weights, so sharing a variable with the outer graph "
+            "would silently fork it — create the Variables inside the "
+            "builder instead")
+
+    stacked_vars = [_make_stacked_var(v, n_stages, name)
+                    for v in template_vars]
+    return PipelineBlockOp(x, stacked_vars, stage_in, out_node, topo,
+                           template_vars, n_stages, n_microbatches, remat,
+                           name=name)
+
+
+def _make_stacked_var(template, n_stages, prefix):
+    from ..graph.node import PlaceholderOp
+    from jax.sharding import PartitionSpec as P
+
+    def stacked_init(shape, key):
+        import jax
+        vals = [np.asarray(template.get_init_value(
+            None if key is None else jax.random.fold_in(key, s)))
+            for s in range(n_stages)]
+        return np.stack(vals, 0)
+
+    if template.shape is None:
+        raise ValueError(f"pipeline stage variable {template.name} needs a "
+                         "static shape")
+    v = PlaceholderOp(f"{prefix}.{template.name}",
+                      initializer=stacked_init, trainable=template.trainable,
+                      shape=(n_stages,) + tuple(template.shape),
+                      dtype=template.dtype)
+    v.sharding = P("pp", *([None] * len(template.shape)))
+    return v
+
+
+_PIPELINE_BLOCK_CLS = None
+
+
+def _pipeline_block_class():
+    """Create the Op subclass once (lazy: graph.node imports parallel)."""
+    global _PIPELINE_BLOCK_CLS
+    if _PIPELINE_BLOCK_CLS is not None:
+        return _PIPELINE_BLOCK_CLS
+    from ..graph.node import Op, LowerCtx
+
+    class PipelineBlockOpImpl(Op):
+        op_type = "PipelineBlock"
+
+        def __init__(self, x, stacked_vars, stage_in, out_node, topo,
+                     template_vars, n_stages, n_microbatches, remat, name):
+            super().__init__([x] + stacked_vars, name=name)
+            self.stage_in = stage_in
+            self.out_node = out_node
+            self.topo = topo
+            self.template_vars = template_vars
+            self.n_stages = n_stages
+            self.n_microbatches = n_microbatches
+            self.remat = remat
+
+        def _stage_fn(self, ctx):
+            def fn(params, xval, key):
+                env = {self.stage_in: xval}
+                env.update(dict(zip(self.template_vars, params)))
+                # per-stage/per-tick key threaded in as a traced value,
+                # so stages and microbatches get independent dropout
+                # masks (distinct from the enclosing graph's keys)
+                sub = LowerCtx(ctx.training, key, ctx.mesh)
+                for node in self.topo:
+                    if node in env:
+                        continue
+                    env[node] = node.lower(
+                        sub, *[env[i] for i in node.inputs])
+                if sub.state_updates:
+                    raise NotImplementedError(
+                        "stateful ops (e.g. BatchNorm running stats) "
+                        "inside a pipeline_block stage are not supported"
+                        " — their per-stage state updates cannot be "
+                        "committed through the stacked-stage scan")
+                return env[self.out_node]
+            return fn
+
+        def lower(self, ctx, xval, *stacked_vals):
+            mesh = ctx.mesh
+            fn = self._stage_fn(ctx)
+            params = list(stacked_vals)
+            key = ctx.rng() if ctx._base_key is not None else None
+            if mesh is not None and "pp" in mesh.axis_names \
+                    and mesh.shape["pp"] > 1:
+                M = (self.n_microbatches or ctx.num_microbatches
+                     or mesh.shape["pp"])
+                return pipeline_apply(fn, params, xval, M, mesh,
+                                      remat=self.remat, key=key)
+            return serial_apply(fn, params, xval, remat=self.remat,
+                                key=key)
+
+        def infer_shape(self, input_shapes):
+            return input_shapes[0]
+
+    _PIPELINE_BLOCK_CLS = PipelineBlockOpImpl
+    return PipelineBlockOpImpl
+
+
+def PipelineBlockOp(*args, **kwargs):
+    return _pipeline_block_class()(*args, **kwargs)
+
+
+class PipelineParallel:
+    """Strategy: dp×pp mesh (reference ``Executor(..., pipeline=...)`` +
+    DeviceGroup stage placement, SURVEY.md §2.3)."""
+
+    def __init__(self, pp, dp=1, schedule="gpipe"):
+        assert schedule in ("gpipe", "pipedream", "hetpipe")
+        self.pp, self.dp, self.schedule = int(pp), int(dp), schedule
+
+    def make_mesh(self):
+        import jax
+        from ..context import make_mesh
+        return make_mesh({"dp": self.dp, "pp": self.pp},
+                         jax.devices()[:self.dp * self.pp])
+
+    def feed_spec(self, node, ndim):
+        from jax.sharding import PartitionSpec
+        if ndim and self.dp > 1:
+            return PartitionSpec("dp", *([None] * (ndim - 1)))
+        return PartitionSpec()

@@ -1,0 +1,224 @@
+"""Pipeline-parallel tests on the simulated 8-device CPU mesh.
+
+House invariant (SURVEY.md §4): N-device pipelined runs must match the
+single-device serial run on the same seed — the TPU-native replacement for
+the reference's mpirun `validate_results.py` pipeline checks.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.parallel.pipeline import (
+    pipeline_apply, serial_apply, gpipe_schedule, pipedream_schedule,
+    hetpipe_sync_steps)
+
+
+def _stage_fn(params, x):
+    import jax.numpy as jnp
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _stacked_params(rng, S, d):
+    w = rng.randn(S, d, d).astype(np.float32) * 0.3
+    b = rng.randn(S, d).astype(np.float32) * 0.1
+    return [w, b]
+
+
+def test_spmd_pipeline_matches_serial_forward():
+    import jax
+    rng = np.random.RandomState(0)
+    S, d, B, M = 4, 8, 16, 4
+    params = _stacked_params(rng, S, d)
+    x = rng.randn(B, d).astype(np.float32)
+    mesh = ht.make_mesh({"pp": S}, jax.devices()[:S])
+    serial = serial_apply(_stage_fn, params, x)
+    piped = pipeline_apply(_stage_fn, params, x, M, mesh)
+    np.testing.assert_allclose(np.asarray(serial), np.asarray(piped),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_pipeline_matches_serial_grad():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    S, d, B, M = 4, 8, 16, 8
+    params = _stacked_params(rng, S, d)
+    x = rng.randn(B, d).astype(np.float32)
+    mesh = ht.make_mesh({"pp": S}, jax.devices()[:S])
+
+    def loss_serial(p):
+        return jnp.mean(serial_apply(_stage_fn, p, x) ** 2)
+
+    def loss_piped(p):
+        return jnp.mean(pipeline_apply(_stage_fn, p, x, M, mesh) ** 2)
+
+    gs = jax.grad(loss_serial)(params)
+    gp = jax.grad(loss_piped)(params)
+    for a, b in zip(gs, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_spmd_pipeline_multi_stage_per_rank():
+    # 8 model stages over 4 pp ranks (v=2 looping layout)
+    import jax
+    rng = np.random.RandomState(11)
+    S, d, B, M = 8, 8, 16, 4
+    params = _stacked_params(rng, S, d)
+    x = rng.randn(B, d).astype(np.float32)
+    mesh = ht.make_mesh({"pp": 4}, jax.devices()[:4])
+    serial = serial_apply(_stage_fn, params, x)
+    piped = pipeline_apply(_stage_fn, params, x, M, mesh)
+    np.testing.assert_allclose(np.asarray(serial), np.asarray(piped),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_pipeline_stage_count_mismatch_raises():
+    import jax
+    rng = np.random.RandomState(12)
+    params = _stacked_params(rng, 3, 8)
+    x = rng.randn(8, 8).astype(np.float32)
+    mesh = ht.make_mesh({"pp": 2}, jax.devices()[:2])
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(_stage_fn, params, x, 4, mesh)
+
+
+def test_pipeline_strategy_schedule_wires_to_executor():
+    x, y_, ex = _pipe_graph_executor(
+        ht.PipelineParallel(pp=4, schedule="pipedream"))
+    assert ex.pipeline == "pipedream"
+    assert ex.num_microbatches == 4
+
+
+def test_spmd_pipeline_dp_times_pp():
+    import jax
+    rng = np.random.RandomState(2)
+    S, d, B, M = 4, 8, 16, 4
+    params = _stacked_params(rng, S, d)
+    x = rng.randn(B, d).astype(np.float32)
+    mesh = ht.make_mesh({"dp": 2, "pp": S})
+    serial = serial_apply(_stage_fn, params, x)
+    piped = pipeline_apply(_stage_fn, params, x, M, mesh)
+    np.testing.assert_allclose(np.asarray(serial), np.asarray(piped),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_pipeline_remat_matches():
+    import jax
+    rng = np.random.RandomState(3)
+    S, d, B, M = 2, 4, 8, 4
+    params = _stacked_params(rng, S, d)
+    x = rng.randn(B, d).astype(np.float32)
+    mesh = ht.make_mesh({"pp": S}, jax.devices()[:S])
+    a = pipeline_apply(_stage_fn, params, x, M, mesh, remat=False)
+    b = pipeline_apply(_stage_fn, params, x, M, mesh, remat=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def _pipe_graph_executor(strategy, pipeline=None, n_stages=4, seed=0):
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y_")
+
+    def stage(h):
+        lin = ht.layers.Linear(8, 8, activation="relu", name="pstage")
+        return lin(h)
+
+    h = ht.pipeline_block(x, stage, n_stages, n_microbatches=4)
+    rng = np.random.RandomState(100)
+    wout = ht.Variable("wout", value=rng.randn(8, 3).astype(np.float32) * 0.2)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, wout), y_), [0])
+    opt = ht.optim.SGDOptimizer(0.1)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     dist_strategy=strategy, seed=seed, pipeline=pipeline,
+                     num_microbatches=4 if pipeline else None)
+    return x, y_, ex
+
+
+def test_graph_pipeline_block_matches_single_device():
+    losses = {}
+    for key, strat in (("single", None),
+                       ("pp4", ht.PipelineParallel(pp=4)),
+                       ("dp2pp4", ht.PipelineParallel(pp=4, dp=2))):
+        x, y_, ex = _pipe_graph_executor(strat, seed=0)
+        rng = np.random.RandomState(7)
+        xv = rng.randn(16, 8).astype(np.float32)
+        yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        losses[key] = [float(ex.run("train", feed_dict={x: xv, y_: yv}
+                                    )[0].asnumpy()) for _ in range(4)]
+    np.testing.assert_allclose(losses["single"], losses["pp4"], rtol=2e-5)
+    np.testing.assert_allclose(losses["single"], losses["dp2pp4"], rtol=2e-5)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "pipedream", "hetpipe"])
+def test_executor_microbatch_pipeline_matches_full_batch(schedule):
+    def run(pipeline):
+        # plain graph (no pipeline_block) → executor-level microbatching
+        rng = np.random.RandomState(50)
+        x = ht.placeholder_op("x")
+        y_ = ht.placeholder_op("y_")
+        w1 = ht.Variable("w1", value=rng.randn(8, 16).astype(np.float32) * .2)
+        w2 = ht.Variable("w2", value=rng.randn(16, 3).astype(np.float32) * .2)
+        h = ht.relu_op(ht.matmul_op(x, w1))
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+        opt = ht.optim.SGDOptimizer(0.1)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=1,
+                         pipeline=pipeline,
+                         num_microbatches=4 if pipeline else None)
+        rng = np.random.RandomState(8)
+        xv = rng.randn(16, 8).astype(np.float32)
+        yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        return [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+                for _ in range(3)]
+    # mean-reduced loss ⇒ microbatched grads == full-batch grads
+    np.testing.assert_allclose(run(None), run(schedule), rtol=2e-5)
+
+
+def test_executor_microbatch_broadcasts_nonbatch_feeds():
+    rng = np.random.RandomState(51)
+    x = ht.placeholder_op("x")
+    scale = ht.placeholder_op("scale")  # [8,8] constant side input != batch
+    y_ = ht.placeholder_op("y_")
+    w = ht.Variable("w", value=rng.randn(8, 3).astype(np.float32) * .2)
+    h = ht.matmul_op(ht.matmul_op(x, scale), w)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(h, y_), [0])
+    ex = ht.Executor({"train": [loss, ht.optim.SGDOptimizer(0.1).minimize(loss)]},
+                     seed=2, pipeline="gpipe", num_microbatches=4)
+    xv = rng.randn(16, 8).astype(np.float32)
+    sv = np.eye(8, dtype=np.float32)
+    yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    out = ex.run("train", feed_dict={x: xv, scale: sv, y_: yv})
+    assert np.isfinite(float(out[0].asnumpy()))
+
+
+def test_gpipe_schedule_order():
+    ticks = gpipe_schedule(3, 4)
+    fwd = [t for t in ticks if any(p == "fwd" for _, _, p in t)]
+    # stage s processes microbatch m at tick s+m
+    assert (0, 0, "fwd") in fwd[0]
+    assert (1, 0, "fwd") in fwd[1] and (0, 1, "fwd") in fwd[1]
+    all_fwd = [(s, m) for t in ticks for s, m, p in t if p == "fwd"]
+    assert len(all_fwd) == 12 and len(set(all_fwd)) == 12
+
+
+def test_pipedream_schedule_1f1b():
+    per_stage = pipedream_schedule(4, 8)
+    last = per_stage[3]
+    # last stage: 1 warmup forward then strict 1F1B alternation
+    assert last[0] == ("fwd", 0) and last[1] == ("bwd", 0)
+    for s, order in per_stage.items():
+        assert sorted(m for ph, m in order if ph == "fwd") == list(range(8))
+        assert sorted(m for ph, m in order if ph == "bwd") == list(range(8))
+        done = set()
+        for ph, m in order:
+            if ph == "bwd":
+                assert m in done
+            else:
+                done.add(m)
+
+
+def test_hetpipe_sync_steps():
+    assert [hetpipe_sync_steps(i, 4) for i in range(8)] == \
+        [False, False, False, True] * 2
