@@ -34,6 +34,7 @@ from . import parallel
 from . import parallel as dist  # reference alias: ht.dist.DataParallel
 from .parallel.dispatch import dispatch
 from .parallel.pipeline import pipeline_block, PipelineParallel
+from .parallel.ring_attention import ContextParallel
 from . import layers
 from . import metrics
 

@@ -81,6 +81,15 @@ class SubExecutor:
         # block; executor-level microbatching would double-split the batch
         self.has_pipeline_block = any(
             n.op_type == "PipelineBlock" for n in self.topo)
+        # which fetches are batch-derived (transitively consume a fed
+        # placeholder)? drives how microbatched aux outputs recombine
+        feed_set = set(self.feed_nodes)
+        deps = {}
+        for node in self.topo:
+            deps[node] = node in feed_set or any(
+                deps.get(i, False) for i in node.inputs)
+        self.fetch_depends_feed = [f is not None and deps.get(f, False)
+                                   for f in self.fetches]
         self._jit = None
 
     # -- lowering ---------------------------------------------------------
@@ -224,21 +233,26 @@ class SubExecutor:
         (acc, sp_final), aux_stack = jax.lax.scan(
             body, (zeros, dict(sparams)), (feeds_mb, jnp.arange(M)))
         grads = jax.tree.map(lambda g: g / M, acc)
-        # scalar fetches → mean over microbatches; batch-derived fetches
-        # (per-microbatch leading dim a multiple of mb, covering token-
-        # flattened tensors) → re-concat; anything else (weights) → last copy
+        # recombination by fetch kind: batch-derived fetches (transitively
+        # consume a fed placeholder) re-concat along the microbatch dim
+        # (token-flattened leading dims included); batch-aggregated ones
+        # (e.g. per-feature stats) average; feed-independent fetches
+        # (weights, constants) are identical per microbatch → last copy
         mb = B // M if M else 0
 
-        def merge_aux(a):
+        def merge_aux(a, dep):
             if a is None:
                 return None
             if a.ndim <= 1:
                 return jnp.mean(a, 0)
-            if a.ndim >= 2 and mb and a.shape[1] % mb == 0:
-                return a.reshape((-1,) + a.shape[2:])
+            if dep:
+                if mb and a.shape[1] % mb == 0:
+                    return a.reshape((-1,) + a.shape[2:])
+                return jnp.mean(a, 0)
             return a[-1]
 
-        aux_vals = [merge_aux(a) for a in aux_stack]
+        aux_vals = [merge_aux(a, d) for a, d in
+                    zip(aux_stack, self.fetch_depends_feed)]
         # threaded state comes back committed wholesale (unchanged leaves
         # round-trip through the scan with their original values)
         return aux_vals, dict(sp_final), grads

@@ -10,12 +10,14 @@ from ..ops.attention import sdpa_op
 
 class MultiHeadAttention(BaseLayer):
     def __init__(self, hidden_size, num_heads, dropout=0.0, causal=False,
-                 name="mha"):
+                 context_parallel=None, name="mha"):
         assert hidden_size % num_heads == 0
+        assert context_parallel in (None, "ring", "ulysses")
         self.h = num_heads
         self.dk = hidden_size // num_heads
         self.hidden = hidden_size
         self.causal = causal
+        self.context_parallel = context_parallel
         self.q = Linear(hidden_size, hidden_size, name=name + ".q")
         self.k = Linear(hidden_size, hidden_size, name=name + ".k")
         self.v = Linear(hidden_size, hidden_size, name=name + ".v")
@@ -28,10 +30,13 @@ class MultiHeadAttention(BaseLayer):
 
     def __call__(self, x, batch, seq):
         """x: (batch*seq, hidden) (reference models flatten); returns same."""
+        from ..ops.attention import ring_attention_op, ulysses_attention_op
         q = self._split(self.q(x), batch, seq)
         k = self._split(self.k(x), batch, seq)
         v = self._split(self.v(x), batch, seq)
-        o = sdpa_op(q, k, v, causal=self.causal)
+        attn = {None: sdpa_op, "ring": ring_attention_op,
+                "ulysses": ulysses_attention_op}[self.context_parallel]
+        o = attn(q, k, v, causal=self.causal)
         o = ops.transpose_op(o, perm=(0, 2, 1, 3))
         o = ops.array_reshape_op(o, output_shape=(batch * seq, self.hidden))
         o = self.o(o)

@@ -51,3 +51,31 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
 
 
 sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)
+
+
+def _has_cp(mesh):
+    return mesh is not None and "cp" in mesh.axis_names \
+        and mesh.shape["cp"] > 1
+
+
+def _ring_attention(c, q, k, v, causal=False, scale=None):
+    """Ring attention over the 'cp' mesh axis; plain sdpa when no cp axis
+    (identical numerics — parity-tested in tests/test_context_parallel.py)."""
+    if _has_cp(c.mesh):
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, c.mesh, causal=causal, scale=scale)
+    return _sdpa(c, q, k, v, causal=causal, scale=scale)
+
+
+ring_attention_op = def_op("RingAttention", _ring_attention)
+
+
+def _ulysses_attention(c, q, k, v, causal=False, scale=None):
+    """Ulysses head-sharded all-to-all attention over the 'cp' axis."""
+    if _has_cp(c.mesh):
+        from ..parallel.ring_attention import ulysses_attention
+        return ulysses_attention(q, k, v, c.mesh, causal=causal, scale=scale)
+    return _sdpa(c, q, k, v, causal=causal, scale=scale)
+
+
+ulysses_attention_op = def_op("UlyssesAttention", _ulysses_attention)

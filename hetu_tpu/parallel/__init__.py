@@ -5,3 +5,5 @@ from .collectives import CommGroup, new_group_comm
 from .pipeline import (PipelineParallel, pipeline_block, pipeline_apply,
                        serial_apply, spmd_pipeline_local, gpipe_schedule,
                        pipedream_schedule, hetpipe_sync_steps)
+from .ring_attention import (ContextParallel, ring_attention,
+                             ulysses_attention)

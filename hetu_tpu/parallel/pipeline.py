@@ -30,9 +30,11 @@ the standard contract for transformer-stack pipelining.
 """
 from __future__ import annotations
 
-import functools
-
 import numpy as np
+
+from ..graph.node import Op, LowerCtx, PlaceholderOp, topo_sort, \
+    placeholder_op
+from .strategies import Strategy
 
 
 def spmd_pipeline_local(stage_fn, params, x_mb, axis_name="pp", remat=False,
@@ -247,8 +249,6 @@ def pipeline_block(x, builder, n_stages, n_microbatches=None, remat=False,
     path (``pipeline_subexecutor.py:46`` reads config fields that are never
     set — SURVEY.md §7 vestigial list) as a first-class TPU construct.
     """
-    from ..graph.node import PlaceholderOp, topo_sort, placeholder_op
-
     stage_in = placeholder_op(f"{name}.stage_in")
     watermark = stage_in.id  # nodes created by the builder have larger ids
     out_node = builder(stage_in)
@@ -276,7 +276,6 @@ def pipeline_block(x, builder, n_stages, n_microbatches=None, remat=False,
 
 
 def _make_stacked_var(template, n_stages, prefix):
-    from ..graph.node import PlaceholderOp
     from jax.sharding import PartitionSpec as P
 
     def stacked_init(shape, key):
@@ -297,78 +296,61 @@ def _make_stacked_var(template, n_stages, prefix):
     return v
 
 
-_PIPELINE_BLOCK_CLS = None
+class PipelineBlockOp(Op):
+    op_type = "PipelineBlock"
+
+    def __init__(self, x, stacked_vars, stage_in, out_node, topo,
+                 template_vars, n_stages, n_microbatches, remat, name):
+        super().__init__([x] + stacked_vars, name=name)
+        self.stage_in = stage_in
+        self.out_node = out_node
+        self.topo = topo
+        self.template_vars = template_vars
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.remat = remat
+
+    def _stage_fn(self, ctx):
+        def fn(params, xval, key):
+            env = {self.stage_in: xval}
+            env.update(dict(zip(self.template_vars, params)))
+            # per-stage/per-tick key threaded in as a traced value,
+            # so stages and microbatches get independent dropout
+            # masks (distinct from the enclosing graph's keys)
+            sub = LowerCtx(ctx.training, key, ctx.mesh)
+            for node in self.topo:
+                if node in env:
+                    continue
+                env[node] = node.lower(
+                    sub, *[env[i] for i in node.inputs])
+            if sub.state_updates:
+                raise NotImplementedError(
+                    "stateful ops (e.g. BatchNorm running stats) "
+                    "inside a pipeline_block stage are not supported"
+                    " — their per-stage state updates cannot be "
+                    "committed through the stacked-stage scan")
+            return env[self.out_node]
+        return fn
+
+    def lower(self, ctx, xval, *stacked_vals):
+        mesh = ctx.mesh
+        fn = self._stage_fn(ctx)
+        params = list(stacked_vals)
+        key = ctx.rng() if ctx._base_key is not None else None
+        if mesh is not None and "pp" in mesh.axis_names \
+                and mesh.shape["pp"] > 1:
+            M = (self.n_microbatches or ctx.num_microbatches
+                 or mesh.shape["pp"])
+            return pipeline_apply(fn, params, xval, M, mesh,
+                                  remat=self.remat, key=key)
+        return serial_apply(fn, params, xval, remat=self.remat,
+                            key=key)
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
 
 
-def _pipeline_block_class():
-    """Create the Op subclass once (lazy: graph.node imports parallel)."""
-    global _PIPELINE_BLOCK_CLS
-    if _PIPELINE_BLOCK_CLS is not None:
-        return _PIPELINE_BLOCK_CLS
-    from ..graph.node import Op, LowerCtx
-
-    class PipelineBlockOpImpl(Op):
-        op_type = "PipelineBlock"
-
-        def __init__(self, x, stacked_vars, stage_in, out_node, topo,
-                     template_vars, n_stages, n_microbatches, remat, name):
-            super().__init__([x] + stacked_vars, name=name)
-            self.stage_in = stage_in
-            self.out_node = out_node
-            self.topo = topo
-            self.template_vars = template_vars
-            self.n_stages = n_stages
-            self.n_microbatches = n_microbatches
-            self.remat = remat
-
-        def _stage_fn(self, ctx):
-            def fn(params, xval, key):
-                env = {self.stage_in: xval}
-                env.update(dict(zip(self.template_vars, params)))
-                # per-stage/per-tick key threaded in as a traced value,
-                # so stages and microbatches get independent dropout
-                # masks (distinct from the enclosing graph's keys)
-                sub = LowerCtx(ctx.training, key, ctx.mesh)
-                for node in self.topo:
-                    if node in env:
-                        continue
-                    env[node] = node.lower(
-                        sub, *[env[i] for i in node.inputs])
-                if sub.state_updates:
-                    raise NotImplementedError(
-                        "stateful ops (e.g. BatchNorm running stats) "
-                        "inside a pipeline_block stage are not supported"
-                        " — their per-stage state updates cannot be "
-                        "committed through the stacked-stage scan")
-                return env[self.out_node]
-            return fn
-
-        def lower(self, ctx, xval, *stacked_vals):
-            mesh = ctx.mesh
-            fn = self._stage_fn(ctx)
-            params = list(stacked_vals)
-            key = ctx.rng() if ctx._base_key is not None else None
-            if mesh is not None and "pp" in mesh.axis_names \
-                    and mesh.shape["pp"] > 1:
-                M = (self.n_microbatches or ctx.num_microbatches
-                     or mesh.shape["pp"])
-                return pipeline_apply(fn, params, xval, M, mesh,
-                                      remat=self.remat, key=key)
-            return serial_apply(fn, params, xval, remat=self.remat,
-                                key=key)
-
-        def infer_shape(self, input_shapes):
-            return input_shapes[0]
-
-    _PIPELINE_BLOCK_CLS = PipelineBlockOpImpl
-    return PipelineBlockOpImpl
-
-
-def PipelineBlockOp(*args, **kwargs):
-    return _pipeline_block_class()(*args, **kwargs)
-
-
-class PipelineParallel:
+class PipelineParallel(Strategy):
     """Strategy: dp×pp mesh (reference ``Executor(..., pipeline=...)`` +
     DeviceGroup stage placement, SURVEY.md §2.3)."""
 

@@ -1,0 +1,152 @@
+"""Context/sequence parallelism: ring attention + Ulysses (all-to-all).
+
+The reference has NO sequence-parallel machinery (SURVEY.md §5.7 — its long-
+sequence story is algorithmic models like longformer/bigbird, and its
+adjacent plumbing is the MoE AllToAll, ``src/communication/
+mpi_nccl_communication.cu:383``).  Capability parity for "scale the sequence
+length" is therefore delivered the TPU-native way, as first-class schedules
+over a ``cp`` mesh axis:
+
+* **Ring attention** (Liu et al. '23 pattern): K/V chunks rotate around the
+  ``cp`` ring via ``lax.ppermute`` while each device keeps an online-softmax
+  accumulator over its resident Q chunk — peak memory O(S/cp), comms ride
+  the ICI ring, and blockwise compute overlaps with the permute.
+* **Ulysses** (DeepSpeed-Ulysses pattern): ``lax.all_to_all`` reshards
+  [B, H, S/cp, D] → [B, H/cp, S, D] so each device runs FULL-sequence
+  attention over a head subset, then the inverse all-to-all restores the
+  sequence sharding — the same a2a plumbing expert parallelism uses.
+
+Both are differentiable (ppermute/all_to_all transpose to their inverses,
+so the backward pass is itself ring-/a2a-scheduled) and compose with dp
+(batch axis) and tp (head axis, Ulysses excepted) on the same mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .strategies import Strategy
+
+_NEG_INF = -1e30  # finite: keeps exp(m - m_new) well-defined on masked rows
+
+
+def ring_attention_local(q, k, v, axis_name="cp", causal=False, scale=None):
+    """Online-softmax ring attention — call INSIDE shard_map over ``cp``.
+
+    q, k, v: local chunks [B, H, Sc, D] (sequence dim sharded over the ring).
+    Returns the local output chunk [B, H, Sc, D].
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    B, H, Sc, D = q.shape
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32) * sc
+
+    q_pos = r * Sc + jnp.arange(Sc)
+
+    def step(carry, t):
+        kc, vc, m, l, o = carry
+        src = (r - t) % S  # which global chunk we currently hold
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            k_pos = src * Sc + jnp.arange(Sc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l = l * alpha + p.sum(-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m_new, l, o), None
+
+    m0 = jnp.full((B, H, Sc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sc), jnp.float32)
+    o0 = jnp.zeros((B, H, Sc, D), jnp.float32)
+    (kc, vc, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(S))
+    del kc, vc, m
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zero output
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name="cp", causal=False,
+                            scale=None, attn_fn=None):
+    """Ulysses head/sequence all-to-all attention — INSIDE shard_map.
+
+    q, k, v: local chunks [B, H, Sc, D]; H must divide by the ``cp`` size.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = lax.psum(1, axis_name)
+    if q.shape[1] % S:
+        raise ValueError(f"heads {q.shape[1]} not divisible by cp={S}")
+    # [B, H, Sc, D] → [B, H/cp, S, D]: trade head shards for full sequence
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name,
+                            split_axis=1, concat_axis=2, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    if attn_fn is None:
+        from ..ops.attention import sdpa_reference
+        attn_fn = functools.partial(sdpa_reference, causal=causal,
+                                    scale=scale)
+    oh = attn_fn(qh, kh, vh)
+    # inverse: [B, H/cp, S, D] → [B, H, Sc, D]
+    return lax.all_to_all(oh, axis_name=axis_name, split_axis=2,
+                          concat_axis=1, tiled=True)
+
+
+def _cp_spec(mesh, batch_axis="dp"):
+    from jax.sharding import PartitionSpec as P
+    dp = batch_axis if batch_axis in mesh.axis_names else None
+    return P(dp, None, "cp", None)
+
+
+def ring_attention(q, k, v, mesh, axis_name="cp", causal=False, scale=None,
+                   batch_axis="dp"):
+    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'."""
+    import jax
+    spec = _cp_spec(mesh, batch_axis)
+    fn = functools.partial(ring_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="cp", causal=False,
+                      scale=None, batch_axis="dp"):
+    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'."""
+    import jax
+    spec = _cp_spec(mesh, batch_axis)
+    fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+class ContextParallel(Strategy):
+    """Strategy: dp×cp mesh for long-sequence training (new axis vs the
+    reference — SURVEY.md §7 design mapping 'SP/CP')."""
+
+    def __init__(self, cp, dp=1):
+        self.cp, self.dp = int(cp), int(dp)
+
+    def make_mesh(self):
+        import jax
+        from ..context import make_mesh
+        return make_mesh({"dp": self.dp, "cp": self.cp},
+                         jax.devices()[:self.dp * self.cp])
+
+    def feed_spec(self, node, ndim):
+        from jax.sharding import PartitionSpec
+        if ndim and self.dp > 1:
+            return PartitionSpec("dp", *([None] * (ndim - 1)))
+        return PartitionSpec()

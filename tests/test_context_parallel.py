@@ -1,0 +1,109 @@
+"""Context/sequence-parallel attention tests on the 8-device CPU mesh.
+
+Ring + Ulysses sharded runs must match the full (unsharded) reference
+attention bit-for-bit-ish (fp32 tolerance) — same invariant style as the
+dp/pp parity tests (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.ops.attention import sdpa_reference
+from hetu_tpu.parallel.ring_attention import (ring_attention,
+                                              ulysses_attention)
+
+
+def _qkv(rng, B=2, H=4, S=32, D=8):
+    return [rng.randn(B, H, S, D).astype(np.float32) for _ in range(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    import jax
+    rng = np.random.RandomState(0)
+    q, k, v = _qkv(rng)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    ref = sdpa_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    import jax
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, H=8)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    ref = sdpa_reference(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_attention_grads_match():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, S=16)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+
+    def loss_ref(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_ring_attention_dp_times_cp():
+    import jax
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, B=4)
+    mesh = ht.make_mesh({"dp": 2, "cp": 4})
+    ref = sdpa_reference(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ulysses_head_divisibility_error():
+    import jax
+    rng = np.random.RandomState(4)
+    q, k, v = _qkv(rng, H=3)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="not divisible"):
+        np.asarray(ulysses_attention(q, k, v, mesh))
+
+
+@pytest.mark.parametrize("flavor", ["ring", "ulysses"])
+def test_graph_mha_context_parallel_matches_single(flavor):
+    def run(strategy, cp_flavor):
+        rng = np.random.RandomState(10)
+        B, S, hid = 2, 16, 32
+        x = ht.placeholder_op("x")
+        y_ = ht.placeholder_op("y_")
+        mha = ht.layers.MultiHeadAttention(hid, 4, causal=True,
+                                           context_parallel=cp_flavor,
+                                           name="cpmha")
+        h = mha(x, B, S)
+        w = ht.Variable("w", value=rng.randn(hid, 3).astype(np.float32) * .2)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+        opt = ht.optim.AdamOptimizer(1e-2)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                         dist_strategy=strategy, seed=0)
+        rng = np.random.RandomState(11)
+        xv = rng.randn(B * S, hid).astype(np.float32)
+        yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, B * S)]
+        return [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+                for _ in range(4)]
+
+    single = run(None, None)
+    sharded = run(ht.ContextParallel(cp=4), flavor)
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)
