@@ -1,0 +1,65 @@
+"""CTR training driver (reference ``examples/embedding/ctr/run_hetu.py``).
+
+    python examples/ctr/run_ctr.py --model wdl --embed dense
+    python examples/ctr/run_ctr.py --model deepfm --embed ps
+    python examples/ctr/run_ctr.py --model dcn --embed lru --bound 10
+
+``--embed`` selects where the embedding table lives: in-graph ("dense"),
+host PS store ("ps"), or PS + HET bounded-staleness cache
+("lru"/"lfu"/"lfuopt" — reference --cache flag, run_hetu.py:121-126).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
+sys.path.insert(0, _HERE)
+import models  # noqa: E402
+import hetu_tpu as ht  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="wdl",
+                   choices=["wdl", "deepfm", "dcn"])
+    p.add_argument("--embed", default="dense",
+                   choices=["dense", "ps", "lru", "lfu", "lfuopt"])
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=100000)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    dense = ht.placeholder_op("dense")
+    sparse = ht.placeholder_op("sparse")
+    y_ = ht.placeholder_op("y")
+    builder = {"wdl": models.wdl_criteo, "deepfm": models.deepfm_criteo,
+               "dcn": models.dcn_criteo}[args.model]
+    loss, prob = builder(dense, sparse, y_, args.batch_size,
+                         vocab=args.vocab, dim=args.dim,
+                         embed_mode=args.embed, lr=args.lr)
+    opt = ht.optim.SGDOptimizer(args.lr)
+    ex = ht.Executor({"train": [loss, prob, opt.minimize(loss)]}, seed=0)
+
+    t0 = time.time()
+    for it in range(args.iters):
+        dv, sv, yv = models.synthetic_criteo(args.batch_size,
+                                             vocab=args.vocab, seed=it)
+        out = ex.run("train", feed_dict={dense: dv, sparse: sv, y_: yv})
+        if it % 20 == 0 or it == args.iters - 1:
+            lv = float(out[0].asnumpy())
+            auc = ht.metrics.auc(np.asarray(out[1].asnumpy()).ravel(),
+                                 yv.ravel())
+            print(f"iter {it:4d}  loss {lv:.4f}  auc {auc:.4f}")
+    dt = time.time() - t0
+    print(f"{args.model}/{args.embed}: {args.iters} iters in {dt:.1f}s "
+          f"({args.iters * args.batch_size / dt:.0f} samples/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,5 +37,8 @@ from .parallel.pipeline import pipeline_block, PipelineParallel
 from .parallel.ring_attention import ContextParallel
 from . import layers
 from . import metrics
+from . import ps
+from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
+                 default_store)
 
 __version__ = "0.1.0"

@@ -63,8 +63,14 @@ class SubExecutor:
         # matching would misfire on names like 'pretrain_eval'.
         self.training = bool(self.opt_ops or self.grad_ops) or name == "train"
 
+        # PS-backed embedding leaves: their per-step value is pulled from the
+        # host store before the step; their gradient is pushed back after
+        # (reference EmbeddingLookUp PS path, SURVEY.md §3.3)
+        self.ps_nodes = [n for n in self.topo
+                         if getattr(n, "is_ps", False)]
         self.feed_nodes = [n for n in self.topo
-                           if isinstance(n, PlaceholderOp) and not n.is_variable]
+                           if isinstance(n, PlaceholderOp) and not n.is_variable
+                           and not getattr(n, "is_ps", False)]
         self.trainable_vars = sorted(
             {g.wrt for g in self.grad_ops}, key=lambda n: n.id)
         for v in self.trainable_vars:
@@ -128,6 +134,8 @@ class SubExecutor:
 
         fetch_nodes = self.fetches
 
+        ps_keys = [_key(n) for n in self.ps_nodes]
+
         def step(tparams, sparams, opt_states, feeds, key, lrs):
             if self.grad_ops:
                 def loss_fn(tp, fd, sp, k):
@@ -146,6 +154,11 @@ class SubExecutor:
                         jax.value_and_grad(loss_fn, has_aux=True)(
                             tparams, feeds, sparams, key)
                     del loss_val
+                # PS-embedding row-gradients ride the updates side-channel;
+                # the executor pushes them into the host store post-step
+                for k in ps_keys:
+                    if k in grads:
+                        updates["psgrad:" + k] = grads[k]
                 new_tparams = dict(tparams)
                 new_opt_states = dict(opt_states)
                 for i, opt_op in enumerate(self.opt_ops):
@@ -276,8 +289,34 @@ class SubExecutor:
                 raise ValueError(f"missing feed for {node}")
             feeds[_key(node)] = ex._place_feed(node, val)
 
+        # PS pulls: resolve the ids batch host-side, pull rows (through the
+        # HET cache if configured), feed them as leaf params so jax computes
+        # their gradient alongside the model's
+        ps_vals = {}
+        for node in self.ps_nodes:
+            idn = node.ids_node
+            if _key(idn) in feeds:
+                ids = np.asarray(feeds[_key(idn)])
+            elif idn in feed_dict:
+                ids = np.asarray(feed_dict[idn])
+            elif isinstance(idn, DataloaderOp):
+                ids = np.asarray(idn.get_arr(self.name))
+            else:
+                raise ValueError(f"cannot resolve ids for PS embedding {node}")
+            ps_vals[_key(node)] = ex._place_feed(node, node.pull(ids))
+
         tparams = {_key(n): ex.var_values[n] for n in self.trainable_vars}
         sparams = {_key(n): ex.var_values[n] for n in self.state_vars}
+        if self.ps_nodes:
+            # only the executor-level microbatch path splits feeds; PS rows
+            # are pulled full-batch, so the two are mutually exclusive
+            if self.grad_ops and self.ex.pipeline \
+                    and (self.ex.num_microbatches or 1) > 1 \
+                    and not self.has_pipeline_block:
+                raise NotImplementedError(
+                    "PS embeddings + executor-level pipeline microbatching "
+                    "are mutually exclusive (rows are pulled full-batch)")
+            (tparams if self.grad_ops else sparams).update(ps_vals)
         opt_states = {_key(op): ex.opt_states[op] for op in self.opt_ops}
         lrs = np.asarray(
             [op.optimizer.host_lr(ex.step_counter) for op in self.opt_ops],
@@ -287,6 +326,10 @@ class SubExecutor:
         outs, new_tparams, updates, new_opt_states = self._jit(
             tparams, sparams, opt_states, feeds, key, lrs)
 
+        for node in self.ps_nodes:
+            g = updates.pop("psgrad:" + _key(node), None)
+            if g is not None:
+                node.push(np.asarray(g))
         for n in self.trainable_vars:
             ex.var_values[n] = new_tparams[_key(n)]
         for n in self.state_vars:
@@ -467,6 +510,10 @@ class Executor:
         """
         import jax
         sub = self.subexecutors[name]
+        if sub.ps_nodes:
+            raise NotImplementedError(
+                "export_step on a subgraph with PS embeddings is unsupported "
+                "(row values are pulled host-side per step)")
         from ..data.dataloader import DataloaderOp
         feeds = {}
         for node in sub.feed_nodes:

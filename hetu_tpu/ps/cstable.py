@@ -1,0 +1,147 @@
+"""CacheSparseTable — HET bounded-staleness embedding cache client.
+
+API parity with the reference's ``python/hetu/cstable.py:19`` (which wraps
+the pybind11 ``hetu_cache`` module, ``src/hetu_cache/include/cache.h:21``):
+``embedding_lookup`` / ``embedding_update`` / ``embedding_push_pull`` return
+futures (the reference's ``wait_t``); eviction policy ∈ {LRU, LFU, LFUOPT};
+``pull_bound``/``push_bound`` bound read/write staleness in versions
+(HET, VLDB'22).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .build import get_lib
+from .store import EmbeddingStore, default_store
+
+_POLICY = {"LRU": 0, "LFU": 1, "LFUOPT": 2}
+
+
+class CacheSparseTable:
+    def __init__(self, limit, length, width, node_id=0, policy="LRU",
+                 bound=100, pull_bound=None, push_bound=None, store=None,
+                 table=None, opt="sgd", lr=0.01, seed=0):
+        """``limit``: max cached rows; ``length``×``width``: table shape;
+        ``bound``: default staleness bound (pull & push), overridable
+        separately (reference setPullBound/setPushBound)."""
+        self.store = store or default_store()
+        if table is None:
+            table = self.store.init_table(length, width, opt=opt, lr=lr,
+                                          seed=seed)
+        self.table = table
+        self.length, self.width = length, width
+        self.node_id = node_id
+        policy = policy.upper()
+        if policy not in _POLICY:
+            raise ValueError(f"unknown cache policy {policy!r}")
+        self.policy = policy
+        pull_bound = bound if pull_bound is None else pull_bound
+        push_bound = bound if push_bound is None else push_bound
+        self._lib = get_lib()
+        self._h = None
+        if self._lib and self.store._h:
+            self._h = self._lib.hetu_cache_create(
+                self.store._h, table, limit, _POLICY[policy],
+                pull_bound, push_bound)
+        self._pool = ThreadPoolExecutor(max_workers=1)  # ordered async ops
+
+    # -- bounds ------------------------------------------------------------
+    def set_pull_bound(self, bound):
+        if self._h:
+            self._lib.hetu_cache_set_bounds(self._h, bound, -1)
+
+    def set_push_bound(self, bound):
+        if self._h:
+            self._lib.hetu_cache_set_bounds(self._h, -1, bound)
+
+    def bypass(self, on=True):
+        if self._h:
+            self._lib.hetu_cache_bypass(self._h, int(on))
+
+    # -- core (sync) -------------------------------------------------------
+    def _check_keys(self, keys):
+        if keys.size and (keys.min() < 0 or keys.max() >= self.length):
+            raise IndexError(
+                f"embedding key out of range: [{keys.min()}, {keys.max()}] "
+                f"vs table length {self.length}")
+
+    def _lookup_sync(self, keys, dest):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        self._check_keys(keys)
+        if self._h:
+            import ctypes
+            self._lib.hetu_cache_lookup(
+                self._h,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                dest.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            dest.reshape(keys.size, self.width)[:] = \
+                self.store.pull(self.table, keys)
+        return dest
+
+    def _update_sync(self, keys, grads):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        self._check_keys(keys)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._h:
+            import ctypes
+            self._lib.hetu_cache_update(
+                self._h,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            self.store.push(self.table, keys, grads)
+
+    # -- reference async API ----------------------------------------------
+    def embedding_lookup(self, keys, dest=None):
+        """Async lookup; returns a future resolving to ``dest``
+        (keys.shape + (width,))."""
+        keys = np.asarray(keys)
+        if dest is None:
+            dest = np.empty(keys.shape + (self.width,), np.float32)
+        return self._pool.submit(self._lookup_sync, keys, dest)
+
+    def embedding_update(self, keys, grads):
+        return self._pool.submit(self._update_sync, keys, grads)
+
+    def embedding_push_pull(self, push_keys, grads, pull_keys, dest=None):
+        if dest is None:
+            dest = np.empty(np.asarray(pull_keys).shape + (self.width,),
+                            np.float32)
+
+        def run():
+            self._update_sync(push_keys, grads)
+            return self._lookup_sync(np.asarray(pull_keys), dest)
+        return self._pool.submit(run)
+
+    # -- maintenance -------------------------------------------------------
+    def flush(self):
+        """Push every dirty cached row to the store (checkpoint barrier)."""
+        self._pool.submit(lambda: None).result()  # drain queue
+        if self._h:
+            self._lib.hetu_cache_flush(self._h)
+
+    def perf(self):
+        if not self._h:
+            return {}
+        import ctypes
+        out = np.zeros(6, np.int64)
+        self._lib.hetu_cache_perf(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        keys = ["lookups", "hits", "evictions", "pushes", "fetches", "size"]
+        return dict(zip(keys, out.tolist()))
+
+    def __len__(self):
+        return int(self._lib.hetu_cache_size(self._h)) if self._h else 0
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._pool.shutdown(wait=True)
+                self._lib.hetu_cache_destroy(self._h)
+        except Exception:
+            pass

@@ -1,0 +1,70 @@
+"""PS-backed embedding lookup as a graph op.
+
+Reference behavior (``gpu_ops/EmbeddingLookUp.py:10``): with a CPU/PS
+context, the lookup's compute is replaced by a PS SparsePull of the batch's
+rows (forward_hook:56-76), and the backward pushes IndexedSlices grads via
+``ParameterServerCommunicateOp`` (backward_hook:77; SURVEY.md §3.3).
+
+TPU-native: the table lives in the host store (:mod:`hetu_tpu.ps.store`) —
+only the batch's rows enter the jitted XLA program, as a *leaf input* whose
+gradient jax computes like any parameter.  The executor pulls rows (through
+the HET cache when given a :class:`CacheSparseTable`) right before the step
+and pushes the dense row-gradient straight after, so the device never holds
+the full table — that is the trillion-parameter capability path
+(reference README.md:19).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import PlaceholderOp
+from .cstable import CacheSparseTable
+from .store import EmbeddingStore, default_store
+
+
+class PSEmbeddingLookupOp(PlaceholderOp):
+    """Leaf node whose per-step value = pulled embedding rows for the batch."""
+
+    op_type = "PSEmbeddingLookup"
+    is_ps = True
+
+    def __init__(self, table, ids_node, width=None, name=None):
+        super().__init__(name=name or "ps_embedding", shape=None)
+        self.inputs = []           # leaf: ids resolved host-side per step
+        self.ids_node = ids_node
+        self._last_ids = None
+        if isinstance(table, CacheSparseTable):
+            self.cache = table
+            self.store, self.table = table.store, table.table
+            self.width = table.width
+        elif isinstance(table, tuple):
+            self.cache = None
+            self.store, self.table = table
+            self.width = width
+        else:  # bare table id on the default store
+            self.cache = None
+            self.store, self.table = default_store(), int(table)
+            self.width = width
+
+    # host-side pull/push used by the executor around the jitted step
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64)
+        self._last_ids = ids
+        if self.cache is not None:
+            dest = np.empty(ids.shape + (self.cache.width,), np.float32)
+            return self.cache._lookup_sync(ids, dest)
+        return self.store.pull(self.table, ids)
+
+    def push(self, grads):
+        if self._last_ids is None:
+            return
+        if self.cache is not None:
+            self.cache._update_sync(self._last_ids, grads)
+        else:
+            self.store.push(self.table, self._last_ids, grads)
+
+
+def ps_embedding_lookup_op(table, ids_node, width=None, name=None):
+    """``ht.ps_embedding_lookup_op(table, ids)`` — embedding rows for the ids
+    batch, stored host-side (PS capability parity; see class docstring)."""
+    return PSEmbeddingLookupOp(table, ids_node, width=width, name=name)

@@ -1,0 +1,249 @@
+"""Host-resident sharded embedding store (parameter-server capability).
+
+TPU-native replacement for the reference's ps-lite server stack
+(``ps-lite/include/ps/worker/PSAgent.h:50`` vecPushSparse/vecSDPushPull,
+server ``PSFHandle.h:17``, server-side optimizers ``optimizer.h``): the
+"server" is host RAM next to the TPU chips.  On a multi-host pod each
+process owns the key range ``hash(key) % nprocs == process_index`` so pulls
+and pushes stay host-local for the rows a host's data shard touches; the
+HET-style client cache (:class:`hetu_tpu.ps.cstable.CacheSparseTable`)
+absorbs cross-host skew with bounded staleness.
+
+A pure-numpy fallback covers environments without a C++ toolchain.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .build import get_lib
+
+_OPT_IDS = {"sgd": 0, "momentum": 1, "nesterov": 2, "adagrad": 3, "adam": 4}
+
+
+class _NumpyTable:
+    """Fallback with identical semantics to the native Table (SGD/… updates,
+    per-row versions).  Used only when g++ is unavailable."""
+
+    def __init__(self, rows, width, opt, lr, m1, m2, eps, seed, scale):
+        rng = np.random.RandomState(seed & 0xFFFFFFFF)
+        self.data = (rng.uniform(-scale, scale, (rows, width))
+                     if scale else np.zeros((rows, width))).astype(np.float32)
+        self.version = np.zeros(rows, np.int64)
+        self.opt, self.lr, self.m1, self.m2, self.eps = opt, lr, m1, m2, eps
+        self.s0 = np.zeros_like(self.data) if opt in (1, 2, 3, 4) else None
+        self.s1 = np.zeros_like(self.data) if opt == 4 else None
+        self.t = np.zeros(rows, np.int32) if opt == 4 else None
+
+    def pull(self, keys):
+        return self.data[keys]
+
+    def push(self, keys, grads, lr=-1.0):
+        elr = self.lr if lr <= 0 else lr
+        uk, inv = np.unique(keys, return_inverse=True)
+        acc = np.zeros((len(uk), self.data.shape[1]), np.float32)
+        np.add.at(acc, inv, grads.reshape(len(keys), -1))
+        if self.opt == 0:
+            self.data[uk] -= elr * acc
+        elif self.opt in (1, 2):
+            prev = self.s0[uk]
+            v = self.m1 * prev - elr * acc
+            self.s0[uk] = v
+            self.data[uk] += (-self.m1 * prev + (1 + self.m1) * v) \
+                if self.opt == 2 else v
+        elif self.opt == 3:
+            self.s0[uk] += acc * acc
+            self.data[uk] -= elr * acc / (np.sqrt(self.s0[uk]) + self.eps)
+        else:
+            self.t[uk] += 1
+            t = self.t[uk][:, None].astype(np.float32)
+            m = self.m1 * self.s0[uk] + (1 - self.m1) * acc
+            v = self.m2 * self.s1[uk] + (1 - self.m2) * acc * acc
+            self.s0[uk], self.s1[uk] = m, v
+            self.data[uk] -= elr * (m / (1 - self.m1 ** t)) / (
+                np.sqrt(v / (1 - self.m2 ** t)) + self.eps)
+        self.version[uk] += 1
+
+
+class EmbeddingStore:
+    """A set of host-RAM parameter tables with server-side optimizers.
+
+    API parity with the worker surface of the reference PS
+    (ParameterInit / SparsePull / SparsePush / SDPushPull / Save / Load,
+    ``PSAgent.h:124-447``) plus SSP clock sync (``ssp_handler.h``).
+    """
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._h = self._lib.hetu_ps_create() if self._lib else None
+        self._np_tables = []
+
+    # -- table management --------------------------------------------------
+    def init_table(self, rows, width, opt="sgd", lr=0.01, beta1=0.9,
+                   beta2=0.999, eps=1e-7, seed=0, init_scale=None):
+        if init_scale is None:
+            init_scale = float(np.sqrt(1.0 / width))  # reference default-ish
+        o = _OPT_IDS[opt]
+        if self._lib:
+            return int(self._lib.hetu_ps_init_table(
+                self._h, rows, width, o, lr, beta1, beta2, eps, seed,
+                init_scale))
+        self._np_tables.append(
+            _NumpyTable(rows, width, o, lr, beta1, beta2, eps, seed,
+                        init_scale))
+        return len(self._np_tables) - 1
+
+    def set_data(self, table, arr):
+        arr = np.ascontiguousarray(arr, np.float32)
+        if self._lib:
+            import ctypes
+            self._lib.hetu_ps_set_data(
+                self._h, table,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            self._np_tables[table].data[:] = arr
+
+    def get_data(self, table):
+        if self._lib:
+            import ctypes
+            rows = self._lib.hetu_ps_rows(self._h, table)
+            width = self._lib.hetu_ps_width(self._h, table)
+            out = np.empty((rows, width), np.float32)
+            self._lib.hetu_ps_get_data(
+                self._h, table,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return out
+        return self._np_tables[table].data.copy()
+
+    def _check_keys(self, table, keys):
+        if keys.size == 0:
+            return
+        lo, hi = int(keys.min()), int(keys.max())
+        rows = (self._lib.hetu_ps_rows(self._h, table) if self._lib
+                else self._np_tables[table].data.shape[0])
+        if lo < 0 or hi >= rows:
+            raise IndexError(
+                f"embedding key out of range: [{lo}, {hi}] vs table rows "
+                f"{rows}")
+
+    # -- sparse ops --------------------------------------------------------
+    def pull(self, table, keys):
+        """SparsePull: rows for ``keys`` (any shape) → keys.shape + (width,)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        self._check_keys(table, keys)
+        if self._lib:
+            import ctypes
+            width = self._lib.hetu_ps_width(self._h, table)
+            flat = keys.reshape(-1)
+            out = np.empty((flat.size, width), np.float32)
+            self._lib.hetu_ps_pull(
+                self._h, table,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                flat.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return out.reshape(keys.shape + (width,))
+        out = self._np_tables[table].pull(keys.reshape(-1))
+        return out.reshape(keys.shape + out.shape[-1:])
+
+    def push(self, table, keys, grads, lr=-1.0):
+        """SparsePush: apply per-key accumulated grads via server optimizer."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        self._check_keys(table, keys)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
+        if self._lib:
+            import ctypes
+            self._lib.hetu_ps_push(
+                self._h, table,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                float(lr))
+        else:
+            self._np_tables[table].push(keys, grads, lr)
+
+    def push_pull(self, table, push_keys, grads, pull_keys, lr=-1.0):
+        """Fused SDPushPull (PsfType kSDPushPull)."""
+        self.push(table, push_keys, grads, lr)
+        return self.pull(table, pull_keys)
+
+    def dense_push(self, table, grad, lr=-1.0):
+        """DensePush: whole-table gradient through the server optimizer
+        (PsfType DensePush); excludes concurrent sparse pushes."""
+        grad = np.ascontiguousarray(grad, np.float32)
+        if self._lib:
+            import ctypes
+            self._lib.hetu_ps_dense_push(
+                self._h, table,
+                grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                float(lr))
+        else:
+            t = self._np_tables[table]
+            t.push(np.arange(t.data.shape[0]), grad, lr)
+
+    def versions(self, table, keys):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        if self._lib:
+            import ctypes
+            out = np.empty(keys.size, np.int64)
+            self._lib.hetu_ps_versions(
+                self._h, table,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            return out
+        return self._np_tables[table].version[keys].copy()
+
+    # -- persistence (SaveParam/LoadParam parity) --------------------------
+    def save(self, table, path):
+        if self._lib:
+            rc = self._lib.hetu_ps_save(self._h, table, path.encode())
+            if rc:
+                raise IOError(f"ps save failed rc={rc}")
+        else:
+            np.save(path, self._np_tables[table].data)
+
+    def load(self, table, path):
+        if self._lib:
+            rc = self._lib.hetu_ps_load(self._h, table, path.encode())
+            if rc:
+                raise IOError(f"ps load failed rc={rc}")
+        else:
+            self._np_tables[table].data[:] = np.load(path)
+
+    # -- SSP (bounded staleness barrier) ----------------------------------
+    def ssp_init(self, n_workers):
+        if self._lib:
+            self._lib.hetu_ps_ssp_init(self._h, n_workers)
+        else:
+            self._clocks = np.zeros(n_workers, np.int64)
+
+    def clock(self, worker):
+        if self._lib:
+            self._lib.hetu_ps_clock(self._h, worker)
+        else:
+            self._clocks[worker] += 1
+
+    def ssp_sync(self, worker, staleness, timeout_ms=0):
+        """Block until this worker is within ``staleness`` clocks of the
+        slowest worker. Returns False on timeout."""
+        if self._lib:
+            return self._lib.hetu_ps_ssp_sync(
+                self._h, worker, staleness, timeout_ms) == 0
+        return bool(self._clocks[worker] - self._clocks.min() <= staleness)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) and getattr(self, "_h", None):
+            try:
+                self._lib.hetu_ps_destroy(self._h)
+            except Exception:
+                pass
+
+
+_default_store = None
+
+
+def default_store():
+    """Process-wide store (the reference's implicit `ps.get_comm()`)."""
+    global _default_store
+    if _default_store is None:
+        _default_store = EmbeddingStore()
+    return _default_store

@@ -1,0 +1,205 @@
+"""PS/embedding subsystem tests (reference tests/pstests/test_apis.py:22 and
+tests/hetu_cache/hetu_cache_test.py patterns: numerical push/pull semantics,
+cache-vs-store consistency, SSP sync)."""
+import threading
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.ps import EmbeddingStore, CacheSparseTable
+from hetu_tpu.ps.build import get_lib
+
+
+def test_native_lib_builds():
+    assert get_lib() is not None, "C++ PS core failed to build"
+
+
+def test_pull_push_sgd_semantics():
+    st = EmbeddingStore()
+    t = st.init_table(100, 8, opt="sgd", lr=0.5, seed=1)
+    before = st.get_data(t)
+    keys = np.array([3, 7, 3])  # duplicate key accumulates
+    grads = np.ones((3, 8), np.float32)
+    st.push(t, keys, grads)
+    after = st.get_data(t)
+    np.testing.assert_allclose(after[3], before[3] - 0.5 * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(after[7], before[7] - 0.5 * 1.0, rtol=1e-6)
+    np.testing.assert_allclose(after[5], before[5])
+    # pull returns rows in key order, duplicates included
+    rows = st.pull(t, np.array([[3, 7], [5, 3]]))
+    assert rows.shape == (2, 2, 8)
+    np.testing.assert_allclose(rows[0, 0], after[3])
+    np.testing.assert_allclose(rows[1, 1], after[3])
+
+
+@pytest.mark.parametrize("opt", ["momentum", "adagrad", "adam"])
+def test_server_optimizers_match_numpy(opt):
+    """Native server-side optimizer == the numpy fallback table."""
+    from hetu_tpu.ps.store import _NumpyTable, _OPT_IDS
+    st = EmbeddingStore()
+    t = st.init_table(20, 4, opt=opt, lr=0.1, seed=3)
+    ref = _NumpyTable(20, 4, _OPT_IDS[opt], 0.1, 0.9, 0.999, 1e-7, 3, 0.0)
+    ref.data[:] = st.get_data(t)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        keys = rng.randint(0, 20, 6)
+        grads = rng.randn(6, 4).astype(np.float32)
+        st.push(t, keys, grads)
+        ref.push(keys, grads)
+    np.testing.assert_allclose(st.get_data(t), ref.data, rtol=2e-5, atol=1e-6)
+
+
+def test_versions_and_save_load(tmp_path):
+    st = EmbeddingStore()
+    t = st.init_table(10, 4, seed=0)
+    st.push(t, np.array([1, 1, 2]), np.ones((3, 4), np.float32))
+    v = st.versions(t, np.arange(10))
+    assert v[1] == 1 and v[2] == 1 and v[0] == 0
+    path = str(tmp_path / "table.bin")
+    st.save(t, path)
+    data = st.get_data(t)
+    st.push(t, np.array([1]), np.ones((1, 4), np.float32))
+    st.load(t, path)
+    np.testing.assert_allclose(st.get_data(t), data)
+
+
+def test_cache_write_through_consistency():
+    """With bound=0 the cache is write-through: equals a bare store."""
+    st = EmbeddingStore()
+    t = st.init_table(50, 4, opt="sgd", lr=0.2, seed=7)
+    raw = st.get_data(t)
+    cache = CacheSparseTable(limit=8, length=50, width=4, store=st, table=t,
+                             bound=0)
+    rng = np.random.RandomState(1)
+    ref = raw.copy()
+    for _ in range(10):
+        keys = rng.randint(0, 50, 5)
+        rows = cache.embedding_lookup(keys).result()
+        np.testing.assert_allclose(rows, ref[keys], rtol=1e-5, atol=1e-6)
+        grads = rng.randn(5, 4).astype(np.float32)
+        cache.embedding_update(keys, grads).result()
+        uk, inv = np.unique(keys, return_inverse=True)
+        acc = np.zeros((len(uk), 4), np.float32)
+        np.add.at(acc, inv, grads)
+        ref[uk] -= 0.2 * acc
+    cache.flush()
+    np.testing.assert_allclose(st.get_data(t), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cache_bounded_staleness_and_eviction():
+    st = EmbeddingStore()
+    t = st.init_table(100, 4, opt="sgd", lr=0.1, seed=2)
+    cache = CacheSparseTable(limit=4, length=100, width=4, store=st, table=t,
+                             policy="LFU", bound=50)
+    # touch more rows than the limit → evictions must flush dirty lines
+    for k in range(10):
+        cache.embedding_lookup(np.array([k])).result()
+        cache.embedding_update(np.array([k]),
+                               np.ones((1, 4), np.float32)).result()
+    cache.flush()
+    perf = cache.perf()
+    assert perf["evictions"] >= 6
+    data = st.get_data(t)
+    # every touched row received its one SGD step despite eviction order
+    base = EmbeddingStore()
+    t2 = base.init_table(100, 4, opt="sgd", lr=0.1, seed=2)
+    for k in range(10):
+        base.push(t2, np.array([k]), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(data, base.get_data(t2), rtol=1e-5, atol=1e-6)
+
+
+def test_ssp_sync_blocks_fast_worker():
+    st = EmbeddingStore()
+    st.ssp_init(2)
+    st.clock(0)
+    st.clock(0)  # worker0 at 2, worker1 at 0 → staleness 1 violated
+    assert not st.ssp_sync(0, staleness=1, timeout_ms=100)
+    done = []
+
+    def slow():
+        st.clock(1)
+        done.append(1)
+
+    th = threading.Timer(0.05, slow)
+    th.start()
+    assert st.ssp_sync(0, staleness=1, timeout_ms=2000)  # unblocks on clock
+    th.join()
+    assert done
+
+
+def test_ps_embedding_end_to_end_matches_dense():
+    """Graph with a PS-backed embedding == same graph with a dense variable.
+
+    Mirrors the reference's PS-vs-allreduce numerical validation
+    (tests/pstests/test_apis.py)."""
+    rng = np.random.RandomState(0)
+    vocab, dim, batch = 30, 8, 16
+    table0 = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    ids_v = rng.randint(0, vocab, batch)
+    w0 = rng.randn(dim, 4).astype(np.float32) * 0.3
+    y_v = np.eye(4, dtype=np.float32)[rng.randint(0, 4, batch)]
+
+    def build_dense():
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        emb = ht.Variable("emb", value=table0.copy(), trainable=True)
+        w = ht.Variable("w", value=w0.copy(), trainable=True)
+        h = ht.embedding_lookup_op(emb, ids)
+        logits = ht.matmul_op(h, w)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(logits, y_), [0])
+        opt = ht.optim.SGDOptimizer(0.5)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+        return ex, ids, y_, emb, w
+
+    ex_d, ids_d, y_d, emb_node, w_node = build_dense()
+    for _ in range(3):
+        ex_d.run("train", feed_dict={ids_d: ids_v, y_d: y_v})
+
+    # PS version: embedding rows live in the host store
+    st = EmbeddingStore()
+    t = st.init_table(vocab, dim, opt="sgd", lr=0.5, seed=0)
+    st.set_data(t, table0.copy())
+    ids = ht.placeholder_op("ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((st, t), ids, width=dim)
+    w = ht.Variable("w", value=w0.copy(), trainable=True)
+    logits = ht.matmul_op(h, w)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    opt = ht.optim.SGDOptimizer(0.5)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    for _ in range(3):
+        ex.run("train", feed_dict={ids: ids_v, y_: y_v})
+
+    dense_emb = np.asarray(ex_d.var_values[emb_node])
+    np.testing.assert_allclose(st.get_data(t), dense_emb, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ex.var_values[w]),
+                               np.asarray(ex_d.var_values[w_node]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ps_embedding_through_cache():
+    """PS embedding op routed through a CacheSparseTable still trains."""
+    rng = np.random.RandomState(0)
+    vocab, dim, batch = 20, 4, 8
+    cache = CacheSparseTable(limit=16, length=vocab, width=dim, bound=0,
+                             opt="sgd", lr=0.3, seed=5)
+    ids = ht.placeholder_op("ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op(cache, ids)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(h, ht.Variable(
+            "w", value=rng.randn(dim, 3).astype(np.float32))), y_), [0])
+    opt = ht.optim.SGDOptimizer(0.3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    ids_v = rng.randint(0, vocab, batch)
+    y_v = np.eye(3, dtype=np.float32)[rng.randint(0, 3, batch)]
+    before = cache.store.get_data(cache.table)[np.unique(ids_v)].copy()
+    losses = [float(ex.run("train", feed_dict={ids: ids_v, y_: y_v}
+                           )[0].asnumpy()) for _ in range(5)]
+    cache.flush()
+    after = cache.store.get_data(cache.table)[np.unique(ids_v)]
+    assert losses[-1] < losses[0]
+    assert np.abs(after - before).max() > 0
