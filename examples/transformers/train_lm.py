@@ -1,0 +1,99 @@
+"""Transformer-family training driver (reference ``examples/transformers/*``
+per-model scripts, e.g. bert/train_hetu_bert_dp.py:68-69).
+
+    python examples/transformers/train_lm.py --model bert --dp     # 8-way DP
+    python examples/transformers/train_lm.py --model gpt2 --size tiny
+    python examples/transformers/train_lm.py --model t5
+    python examples/transformers/train_lm.py --model vit
+    python examples/transformers/train_lm.py --model transformer
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu import models  # noqa: E402
+
+
+def build(model, size, batch_size, seq_len):
+    if model == "bert":
+        cfg = getattr(models.BertConfig, size)(batch_size=batch_size,
+                                               seq_len=seq_len)
+        feeds, loss, logits = models.bert_pretrain_graph(cfg)
+        from hetu_tpu.models.bert import synthetic_mlm_batch
+        ids, tt, labels = synthetic_mlm_batch(cfg)
+        vals = {"input_ids": ids, "token_type_ids": tt,
+                "masked_lm_labels": labels}
+    elif model == "gpt2":
+        cfg = getattr(models.GPT2Config, size)(batch_size=batch_size,
+                                               seq_len=seq_len)
+        feeds, loss, logits = models.gpt2_lm_graph(cfg)
+        ids, labels = models.synthetic_lm_batch(cfg)
+        vals = {"input_ids": ids, "labels": labels}
+    elif model == "t5":
+        cfg = getattr(models.T5Config, size)(batch_size=batch_size,
+                                             src_len=seq_len, tgt_len=seq_len)
+        feeds, loss, logits = models.t5_seq2seq_graph(cfg)
+        src, tgt_in, labels = models.synthetic_seq2seq_batch(cfg)
+        vals = {"input_ids": src, "decoder_input_ids": tgt_in,
+                "labels": labels}
+    elif model == "vit":
+        cfg = getattr(models.ViTConfig, size)(batch_size=batch_size)
+        feeds, loss, logits = models.vit_classify_graph(cfg)
+        imgs, y = models.synthetic_image_batch(cfg)
+        vals = {"images": imgs, "labels": y}
+    else:
+        cfg = getattr(models.TransformerConfig, size)(
+            batch_size=batch_size, src_len=seq_len, tgt_len=seq_len)
+        feeds, loss, logits = models.transformer_graph(cfg)
+        src, tgt_in, labels = models.synthetic_copy_batch(cfg)
+        vals = {"src_ids": src, "tgt_ids": tgt_in, "labels": labels}
+    return feeds, loss, vals
+
+
+SIZES = {"bert": ["tiny", "base", "large"], "gpt2": ["tiny", "small",
+                                                     "medium"],
+         "t5": ["tiny", "small"], "vit": ["tiny", "base"],
+         "transformer": ["tiny"]}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="bert",
+                   choices=list(SIZES))
+    p.add_argument("--size", default="tiny")
+    p.add_argument("--dp", action="store_true",
+                   help="data-parallel over all local devices")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--lr", type=float, default=1e-4)
+    args = p.parse_args()
+    if args.size not in SIZES[args.model]:
+        p.error(f"--size {args.size!r} invalid for {args.model}; "
+                f"choose from {SIZES[args.model]}")
+
+    feeds, loss, vals = build(args.model, args.size, args.batch_size,
+                              args.seq_len)
+    opt = ht.optim.AdamOptimizer(args.lr)
+    strategy = ht.dist.DataParallel() if args.dp else None
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     dist_strategy=strategy)
+    fd = {feeds[k]: v for k, v in vals.items()}
+    t0 = time.time()
+    for it in range(args.iters):
+        out = ex.run("train", feed_dict=fd)
+        if it % 10 == 0 or it == args.iters - 1:
+            print(f"iter {it:4d}  loss {float(out[0].asnumpy()):.4f}")
+    dt = time.time() - t0
+    print(f"{args.model}/{args.size}: {args.iters} iters, "
+          f"{args.iters * args.batch_size / dt:.1f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

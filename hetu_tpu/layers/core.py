@@ -121,6 +121,20 @@ class LayerNorm(BaseLayer):
                                           eps=self.eps)
 
 
+class RMSNorm(BaseLayer):
+    """Root-mean-square norm (T5LayerNorm: no mean subtraction, no bias)."""
+
+    def __init__(self, num_channels, eps=1e-6, name="rmsnorm"):
+        self.scale_var = init.ones(shape=(num_channels,), name=name + ".scale")
+        self.eps = eps
+
+    def __call__(self, x):
+        ms = ops.reduce_mean_op(ops.mul_op(x, x), [-1], keepdims=True)
+        normed = ops.mul_op(x, ops.broadcastto_op(
+            ops.rsqrt_op(ms + self.eps), x))
+        return ops.mul_op(normed, ops.broadcastto_op(self.scale_var, normed))
+
+
 class Embedding(BaseLayer):
     def __init__(self, num_embeddings, embedding_dim, initializer=None,
                  name="embedding", ctx=None):

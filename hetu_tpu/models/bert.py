@@ -124,14 +124,8 @@ def bert_pretrain_graph(cfg, name="bert"):
     logits = Linear(cfg.hidden_size, cfg.vocab_size,
                     initializer=init.GenTruncatedNormal(0.0, 0.02),
                     name=name + ".mlm_decoder")(h)
-    flat_labels = ops.array_reshape_op(
-        labels, output_shape=(cfg.batch_size * cfg.seq_len,))
-    per_tok = ops.softmaxcrossentropy_sparse_op(logits, flat_labels,
-                                                ignored_index=-1)
-    # mean over masked tokens only
-    is_masked = ops.ne_op(flat_labels, flat_labels * 0.0 - 1.0)
-    denom = ops.reduce_sum_op(is_masked, [0]) + 1e-6
-    loss = ops.reduce_sum_op(per_tok, [0]) / denom
+    from .common import masked_lm_loss
+    loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.seq_len)
     feeds = {"input_ids": input_ids, "token_type_ids": token_type_ids,
              "masked_lm_labels": labels}
     return feeds, loss, logits

@@ -1,0 +1,15 @@
+"""Shared model-graph helpers."""
+from .. import ops
+
+
+def masked_lm_loss(logits, labels, n_tokens, ignored_index=-1):
+    """Token-masked cross-entropy: mean over positions whose label !=
+    ``ignored_index``.  ``logits``: (n_tokens, vocab); ``labels``: any shape
+    flattening to (n_tokens,).  Used by every LM head (BERT MLM, GPT-2
+    causal LM, T5/transformer seq2seq)."""
+    flat = ops.array_reshape_op(labels, output_shape=(n_tokens,))
+    per_tok = ops.softmaxcrossentropy_sparse_op(logits, flat,
+                                                ignored_index=ignored_index)
+    valid = ops.ne_op(flat, flat * 0.0 + float(ignored_index))
+    return ops.reduce_sum_op(per_tok, [0]) \
+        / (ops.reduce_sum_op(valid, [0]) + 1e-6)

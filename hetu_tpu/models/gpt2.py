@@ -1,0 +1,112 @@
+"""GPT-2 (reference ``examples/transformers/gpt2/hetu_gpt2.py`` — HF-style
+GPT-2 composed from hetu ops).  TPU-native rewrite: pre-LN blocks, fused
+causal ``sdpa_op`` (Pallas flash kernel on TPU) instead of composed
+batch_matmul+softmax+mask, activations as (batch*seq, hidden) MXU matmuls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from .. import initializers as init
+from ..graph.node import Variable, placeholder_op
+from ..layers.attention import MultiHeadAttention
+from ..layers.core import Linear, LayerNorm
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50257, n_positions=1024, n_embd=768,
+                 n_layer=12, n_head=12, resid_pdrop=0.1, embd_pdrop=0.1,
+                 attn_pdrop=0.1, layer_norm_epsilon=1e-5,
+                 batch_size=8, seq_len=128):
+        self.vocab_size = vocab_size
+        self.n_positions = n_positions
+        self.n_embd = n_embd
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.resid_pdrop = resid_pdrop
+        self.embd_pdrop = embd_pdrop
+        self.attn_pdrop = attn_pdrop
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+
+    @classmethod
+    def small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw):
+        kw.setdefault("n_embd", 1024)
+        kw.setdefault("n_layer", 24)
+        kw.setdefault("n_head", 16)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("n_embd", 128)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 2)
+        kw.setdefault("vocab_size", 512)
+        return cls(**kw)
+
+
+def _block(cfg, x, name):
+    """Pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln1")(x)
+    mha = MultiHeadAttention(cfg.n_embd, cfg.n_head, dropout=cfg.attn_pdrop,
+                             causal=True, name=name + ".attn")
+    x = x + mha(h, cfg.batch_size, cfg.seq_len)
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln2")(x)
+    h = Linear(cfg.n_embd, 4 * cfg.n_embd, activation="gelu",
+               initializer=init.GenTruncatedNormal(0.0, 0.02),
+               name=name + ".mlp_fc")(h)
+    h = Linear(4 * cfg.n_embd, cfg.n_embd,
+               initializer=init.GenTruncatedNormal(0.0, 0.02),
+               name=name + ".mlp_proj")(h)
+    h = ops.dropout_op(h, 1.0 - cfg.resid_pdrop)
+    return x + h
+
+
+def gpt2_model(cfg, input_ids, name="gpt2"):
+    """Returns hidden states node of shape (batch*seq, n_embd)."""
+    wte = init.truncated_normal((cfg.vocab_size, cfg.n_embd), 0.0, 0.02,
+                                name=name + ".wte")
+    wpe = init.truncated_normal((cfg.n_positions, cfg.n_embd), 0.0, 0.01,
+                                name=name + ".wpe")
+    positions = Variable(name + ".pos_ids",
+                         value=np.arange(cfg.seq_len, dtype=np.float32),
+                         trainable=False)
+    x = ops.embedding_lookup_op(wte, input_ids) \
+        + ops.embedding_lookup_op(wpe, positions)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size * cfg.seq_len, cfg.n_embd))
+    x = ops.dropout_op(x, 1.0 - cfg.embd_pdrop)
+    for i in range(cfg.n_layer):
+        x = _block(cfg, x, f"{name}.h{i}")
+    return LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln_f")(x)
+
+
+def gpt2_lm_graph(cfg, name="gpt2"):
+    """Causal LM training graph: next-token prediction.
+
+    Returns (feeds dict, loss node, logits node).  ``labels``: (batch, seq)
+    with -1 at padded positions (ignored).
+    """
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape)
+    labels = placeholder_op("labels", shape=shape)
+    hidden = gpt2_model(cfg, input_ids, name)
+    logits = Linear(cfg.n_embd, cfg.vocab_size,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".lm_head")(hidden)
+    from .common import masked_lm_loss
+    loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.seq_len)
+    return {"input_ids": input_ids, "labels": labels}, loss, logits
+
+
+def synthetic_lm_batch(cfg, seed=0):
+    """Next-token synthetic batch: ids shifted left for labels."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len + 1))
+    return (ids[:, :-1].astype(np.float32), ids[:, 1:].astype(np.float32))

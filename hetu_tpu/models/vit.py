@@ -1,0 +1,135 @@
+"""ViT (reference ``examples/transformers/vit/``).
+
+TPU-native rewrite: patchify is a reshape+transpose+matmul (one MXU GEMM —
+equivalent to the reference's strided conv but lays out directly for the
+systolic array), pre-LN encoder blocks with fused ``sdpa_op``, learned
+position embeddings, mean-pool head (static-shape-friendly alternative to
+the class token, selectable).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from .. import initializers as init
+from ..graph.node import Variable, placeholder_op
+from ..layers.attention import MultiHeadAttention
+from ..layers.core import Linear, LayerNorm
+
+
+class ViTConfig:
+    def __init__(self, image_size=224, patch_size=16, num_channels=3,
+                 hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 hidden_dropout_prob=0.0, layer_norm_eps=1e-6,
+                 num_classes=1000, batch_size=8):
+        assert image_size % patch_size == 0
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.num_channels = num_channels
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+        self.num_classes = num_classes
+        self.batch_size = batch_size
+        self.num_patches = (image_size // patch_size) ** 2
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 2)
+        kw.setdefault("intermediate_size", 256)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+
+def _patchify(cfg, images, name):
+    """(B, C, H, W) → (B*P, hidden) with one matmul.
+
+    reshape (B,C,gh,p,gw,p) → transpose → (B*gh*gw, C*p*p) @ W.
+    """
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = ops.array_reshape_op(
+        images, output_shape=(cfg.batch_size, cfg.num_channels, g, p, g, p))
+    x = ops.transpose_op(x, perm=(0, 2, 4, 1, 3, 5))  # B,gh,gw,C,p,p
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size * g * g,
+                         cfg.num_channels * p * p))
+    return Linear(cfg.num_channels * p * p, cfg.hidden_size,
+                  initializer=init.GenTruncatedNormal(0.0, 0.02),
+                  name=name + ".proj")(x)
+
+
+def vit_model(cfg, images, name="vit"):
+    """Returns patch-sequence hidden states (batch*num_patches, hidden)."""
+    x = _patchify(cfg, images, name + ".patch")
+    pos = init.truncated_normal((cfg.num_patches, cfg.hidden_size), 0.0, 0.02,
+                                name=name + ".pos_embed")
+    pos_ids = Variable(name + ".pos_ids",
+                       value=np.arange(cfg.num_patches, dtype=np.float32),
+                       trainable=False)
+    pe = ops.embedding_lookup_op(pos, pos_ids)        # (P, hidden)
+    pe = ops.array_reshape_op(pe, output_shape=(1, cfg.num_patches,
+                                                cfg.hidden_size))
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.hidden_size))
+    x = x + ops.broadcastto_op(pe, x)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size * cfg.num_patches, cfg.hidden_size))
+    x = ops.dropout_op(x, 1.0 - cfg.hidden_dropout_prob)
+    for i in range(cfg.num_hidden_layers):
+        ln = f"{name}.layer{i}"
+        h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln1")(x)
+        mha = MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads,
+                                 name=ln + ".attn")
+        x = x + mha(h, cfg.batch_size, cfg.num_patches)
+        h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln2")(x)
+        h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
+                   initializer=init.GenTruncatedNormal(0.0, 0.02),
+                   name=ln + ".mlp1")(h)
+        h = Linear(cfg.intermediate_size, cfg.hidden_size,
+                   initializer=init.GenTruncatedNormal(0.0, 0.02),
+                   name=ln + ".mlp2")(h)
+        x = x + ops.dropout_op(h, 1.0 - cfg.hidden_dropout_prob)
+    return LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name + ".ln_f")(x)
+
+
+def vit_classify_graph(cfg, name="vit"):
+    """Image classification graph: mean-pooled patches → linear head.
+
+    Returns (feeds dict, loss node, logits node).
+    """
+    images = placeholder_op("images", shape=(cfg.batch_size, cfg.num_channels,
+                                             cfg.image_size, cfg.image_size))
+    labels = placeholder_op("labels", shape=(cfg.batch_size,
+                                             cfg.num_classes))
+    x = vit_model(cfg, images, name)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.hidden_size))
+    pooled = ops.reduce_mean_op(x, [1])
+    logits = Linear(cfg.hidden_size, cfg.num_classes,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".head")(pooled)
+    loss = ops.reduce_mean_op(
+        ops.softmaxcrossentropy_op(logits, labels), [0])
+    return {"images": images, "labels": labels}, loss, logits
+
+
+def synthetic_image_batch(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = rng.rand(cfg.batch_size, cfg.num_channels, cfg.image_size,
+                    cfg.image_size).astype(np.float32)
+    y = np.eye(cfg.num_classes, dtype=np.float32)[
+        rng.randint(0, cfg.num_classes, cfg.batch_size)]
+    return imgs, y

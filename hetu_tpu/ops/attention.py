@@ -17,12 +17,14 @@ from .base import def_op
 _FLASH_MIN_LEN = 256  # below this, XLA's fused softmax-matmul is fine
 
 
-def sdpa_reference(q, k, v, causal=False, scale=None, mask=None):
+def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
     """(B, H, S, D) reference attention in plain jnp."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:  # additive position bias (T5-style), broadcastable
+        logits = logits + bias
     if causal:
         s_q, s_k = logits.shape[-2:]
         cmask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
@@ -51,6 +53,14 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
 
 
 sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)
+
+
+def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
+    """Attention with an additive logit bias (T5 relative position bias)."""
+    return sdpa_reference(q, k, v, causal=causal, scale=scale, bias=bias)
+
+
+sdpa_bias_op = def_op("ScaledDotProductAttentionBias", _sdpa_bias)
 
 
 def _has_cp(mesh):
