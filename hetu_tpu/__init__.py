@@ -37,6 +37,7 @@ from .parallel.pipeline import pipeline_block, PipelineParallel
 from .parallel.ring_attention import ContextParallel
 from . import layers
 from . import metrics
+from . import tokenizers
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)

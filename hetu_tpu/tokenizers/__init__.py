@@ -1,0 +1,21 @@
+"""hetu_tpu.tokenizers — native subword tokenizers for all model families.
+
+Capability parity with the reference's ``python/hetu/tokenizers/`` (11 files,
+~3.6k LoC) from four algorithm cores; batch encoding emits static-shape
+int32 arrays so jitted TPU programs are reused across batches.
+"""
+from .base import Tokenizer, load_merges_file
+from .algorithms import (BasicTokenizer, WordPiece, ByteLevelBPE, Unigram,
+                         WordLevel, bytes_to_unicode, train_bpe)
+from .families import (BertTokenizer, Gpt2Tokenizer, BartTokenizer,
+                       LongformerTokenizer, CLIPTokenizer, T5Tokenizer,
+                       XLNetTokenizer, BigBirdTokenizer, ReformerTokenizer,
+                       TransfoXLTokenizer)
+
+__all__ = [
+    "Tokenizer", "load_merges_file", "BasicTokenizer", "WordPiece",
+    "ByteLevelBPE", "Unigram", "WordLevel", "bytes_to_unicode", "train_bpe",
+    "BertTokenizer", "Gpt2Tokenizer", "BartTokenizer", "LongformerTokenizer",
+    "CLIPTokenizer", "T5Tokenizer", "XLNetTokenizer", "BigBirdTokenizer",
+    "ReformerTokenizer", "TransfoXLTokenizer",
+]

@@ -1,0 +1,335 @@
+"""Subword tokenization algorithms implemented natively.
+
+Four cores cover all ten reference tokenizer families
+(``python/hetu/tokenizers/*.py``): WordPiece (BERT), byte-level BPE
+(GPT-2/RoBERTa/BART/Longformer/CLIP), Unigram-Viterbi (T5/XLNet/BigBird/
+Reformer sentencepiece models), and word-level (Transformer-XL).
+"""
+from __future__ import annotations
+
+import unicodedata
+
+import regex as re
+
+
+def _is_whitespace(ch):
+    if ch in " \t\n\r":
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in "\t\n\r":
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) \
+            or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp):
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation pre-tokenizer with unicode cleanup.
+
+    Mirrors the behavior of the reference's BERT basic tokenizer: strips
+    control chars, optionally lowercases + strips accents, isolates CJK
+    chars and punctuation as single tokens.
+    """
+
+    def __init__(self, do_lower_case=True, never_split=()):
+        self.do_lower_case = do_lower_case
+        self.never_split = set(never_split)
+
+    def _clean(self, text):
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        return "".join(out)
+
+    def _split_cjk(self, text):
+        out = []
+        for ch in text:
+            if _is_cjk(ord(ch)):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    def _strip_accents(self, text):
+        return "".join(ch for ch in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(ch) != "Mn")
+
+    def _split_punct(self, token):
+        if token in self.never_split:
+            return [token]
+        out, cur = [], []
+        for ch in token:
+            if _is_punctuation(ch):
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+                out.append(ch)
+            else:
+                cur.append(ch)
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    def tokenize(self, text):
+        text = self._split_cjk(self._clean(text))
+        tokens = []
+        for tok in text.split():
+            if tok not in self.never_split and self.do_lower_case:
+                tok = self._strip_accents(tok.lower())
+            tokens.extend(self._split_punct(tok))
+        return tokens
+
+
+class WordPiece:
+    """Greedy longest-match-first subword segmentation (BERT wordpiece)."""
+
+    def __init__(self, vocab, unk_token="[UNK]", prefix="##",
+                 max_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.prefix = prefix
+        self.max_chars_per_word = max_chars_per_word
+
+    def tokenize(self, word):
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = self.prefix + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+
+def bytes_to_unicode():
+    """GPT-2's reversible byte→printable-unicode map (keeps BPE lossless)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+# GPT-2's pre-tokenization pattern: contractions, letter runs, digit runs,
+# punctuation runs, whitespace
+GPT2_SPLIT_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+                      r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+# CLIP's pattern: bare words (no leading-space convention); the end-of-word
+# suffix carries the word boundary instead
+CLIP_SPLIT_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d|\p{L}+|\p{N}"
+                      r"|[^\s\p{L}\p{N}]+")
+
+
+class ByteLevelBPE:
+    """Byte-level BPE with a merge-rank table (GPT-2 family)."""
+
+    def __init__(self, vocab, merges, split_pattern=GPT2_SPLIT_PATTERN,
+                 end_of_word_suffix=None):
+        self.vocab = vocab
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.pattern = re.compile(split_pattern)
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.end_of_word_suffix = end_of_word_suffix
+        self._cache = {}
+
+    def _bpe(self, token):
+        if token in self._cache:
+            return self._cache[token]
+        word = list(token)
+        if self.end_of_word_suffix and word:
+            word[-1] = word[-1] + self.end_of_word_suffix
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            merged, i = [], 0
+            while i < len(word):
+                if (i < len(word) - 1
+                        and (word[i], word[i + 1]) == best):
+                    merged.append(word[i] + word[i + 1])
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+        self._cache[token] = word
+        return word
+
+    def tokenize(self, text):
+        pieces = []
+        for tok in self.pattern.findall(text):
+            mapped = "".join(self.byte_encoder[b]
+                             for b in tok.encode("utf-8"))
+            pieces.extend(self._bpe(mapped))
+        return pieces
+
+    def _decode_mapped(self, text):
+        data = bytearray(self.byte_decoder.get(ch, ord("?"))
+                         for ch in text)
+        return data.decode("utf-8", errors="replace")
+
+    def detokenize(self, pieces):
+        text = "".join(pieces)
+        if self.end_of_word_suffix:
+            # the suffix marks word ends; split before byte-decoding (a raw
+            # space is not part of the byte-unicode alphabet)
+            segs = text.split(self.end_of_word_suffix)
+            return " ".join(self._decode_mapped(s) for s in segs).strip()
+        return self._decode_mapped(text)
+
+
+class Unigram:
+    """Unigram LM segmentation by Viterbi (sentencepiece inference).
+
+    ``vocab_scores``: list of ``(piece, logprob)``. Pieces use the
+    sentencepiece word-boundary marker ``▁``.
+    """
+
+    WS = "▁"  # ▁
+
+    def __init__(self, vocab_scores, unk_token="<unk>", unk_penalty=-10.0):
+        self.pieces = {p: s for p, s in vocab_scores}
+        self.unk_token = unk_token
+        self.unk_penalty = unk_penalty
+        self.max_piece_len = max((len(p) for p in self.pieces), default=1)
+        min_score = min((s for s in self.pieces.values()), default=0.0)
+        self._unk_score = min_score + unk_penalty
+
+    def _viterbi(self, text):
+        n = len(text)
+        best = [float("-inf")] * (n + 1)
+        back = [None] * (n + 1)
+        best[0] = 0.0
+        for end in range(1, n + 1):
+            for start in range(max(0, end - self.max_piece_len), end):
+                piece = text[start:end]
+                score = self.pieces.get(piece)
+                if score is None:
+                    if end - start > 1:
+                        continue
+                    score = self._unk_score  # single-char fallback
+                cand = best[start] + score
+                if cand > best[end]:
+                    best[end] = cand
+                    back[end] = start
+        pieces = []
+        end = n
+        while end > 0:
+            start = back[end]
+            if start is None:  # unreachable; defensive
+                start = end - 1
+            pieces.append(text[start:end])
+            end = start
+        return pieces[::-1]
+
+    def tokenize(self, text):
+        text = self.WS + text.replace(" ", self.WS)
+        out = []
+        for piece in self._viterbi(text):
+            if piece in self.pieces:
+                out.append(piece)
+            else:
+                out.append(self.unk_token)
+        return out
+
+    def detokenize(self, pieces):
+        return "".join(pieces).replace(self.WS, " ").strip()
+
+
+class WordLevel:
+    """Whitespace word-level tokenization with an optional lowercase pass
+    (Transformer-XL style)."""
+
+    def __init__(self, vocab, unk_token="<unk>", lower_case=False):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.lower_case = lower_case
+
+    def tokenize(self, text):
+        if self.lower_case:
+            text = text.lower()
+        return text.split()
+
+
+def train_bpe(texts, vocab_size, split_pattern=GPT2_SPLIT_PATTERN):
+    """Tiny reference BPE trainer (for tests/demos, not production scale).
+
+    Returns ``(vocab, merges)`` over the byte-unicode alphabet.
+    """
+    byte_encoder = bytes_to_unicode()
+    pattern = re.compile(split_pattern)
+    words = {}
+    for text in texts:
+        for tok in pattern.findall(text):
+            mapped = tuple(byte_encoder[b] for b in tok.encode("utf-8"))
+            words[mapped] = words.get(mapped, 0) + 1
+    vocab = {ch: i for i, ch in enumerate(sorted(byte_encoder.values()))}
+    merges = []
+    while len(vocab) < vocab_size:
+        counts = {}
+        for word, freq in words.items():
+            for i in range(len(word) - 1):
+                pair = (word[i], word[i + 1])
+                counts[pair] = counts.get(pair, 0) + freq
+        if not counts:
+            break
+        best = max(counts, key=counts.get)
+        merges.append(best)
+        vocab["".join(best)] = len(vocab)
+        new_words = {}
+        for word, freq in words.items():
+            merged, i = [], 0
+            while i < len(word):
+                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+                    merged.append(word[i] + word[i + 1])
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            new_words[tuple(merged)] = freq
+        words = new_words
+    return vocab, merges
+
+
+__all__ = ["BasicTokenizer", "WordPiece", "ByteLevelBPE", "Unigram",
+           "WordLevel", "bytes_to_unicode", "train_bpe",
+           "GPT2_SPLIT_PATTERN", "CLIP_SPLIT_PATTERN"]

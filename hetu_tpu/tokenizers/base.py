@@ -1,0 +1,295 @@
+"""Tokenizer base class: vocab handling, special tokens, batch encoding.
+
+Capability parity with the reference's HF-style tokenizer family
+(``python/hetu/tokenizers/utils.py`` — PreTrainedTokenizer surface), designed
+TPU-first: batch encoding pads to static shapes (optionally to a multiple of
+the TPU lane width) so downstream ``jit`` traces are reused across batches.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+
+class Tokenizer:
+    """Base tokenizer: subclasses implement ``_tokenize`` (text → pieces).
+
+    Provides the reference-compatible surface: ``tokenize``, ``encode``,
+    ``decode``, ``convert_tokens_to_ids``, ``convert_ids_to_tokens``,
+    ``build_inputs_with_special_tokens``, ``__call__`` batch encoding.
+    """
+
+    #: subclasses set: model_input_names, default special tokens
+    model_input_names = ("input_ids", "attention_mask")
+
+    def __init__(self, vocab=None, unk_token="[UNK]", pad_token="[PAD]",
+                 bos_token=None, eos_token=None, cls_token=None,
+                 sep_token=None, mask_token=None,
+                 additional_special_tokens=()):
+        self.vocab = OrderedDict(vocab or {})
+        self.ids_to_tokens = {i: t for t, i in self.vocab.items()}
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.mask_token = mask_token
+        self.additional_special_tokens = list(additional_special_tokens)
+
+    # -- vocab ---------------------------------------------------------------
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+    def get_vocab(self):
+        return dict(self.vocab)
+
+    def _add_token(self, token):
+        if token is not None and token not in self.vocab:
+            idx = len(self.vocab)
+            self.vocab[token] = idx
+            self.ids_to_tokens[idx] = token
+
+    def add_special_tokens(self, tokens):
+        for t in tokens:
+            self._add_token(t)
+            if t not in self.additional_special_tokens:
+                self.additional_special_tokens.append(t)
+
+    @property
+    def all_special_tokens(self):
+        named = [self.unk_token, self.pad_token, self.bos_token,
+                 self.eos_token, self.cls_token, self.sep_token,
+                 self.mask_token]
+        out = []
+        for t in named + self.additional_special_tokens:
+            if t is not None and t not in out:
+                out.append(t)
+        return out
+
+    def _special_id(self, token):
+        if token is None or token not in self.vocab:
+            return None
+        return self.vocab[token]
+
+    @property
+    def pad_token_id(self):
+        return self._special_id(self.pad_token)
+
+    @property
+    def unk_token_id(self):
+        return self._special_id(self.unk_token)
+
+    @property
+    def bos_token_id(self):
+        return self._special_id(self.bos_token)
+
+    @property
+    def eos_token_id(self):
+        return self._special_id(self.eos_token)
+
+    @property
+    def cls_token_id(self):
+        return self._special_id(self.cls_token)
+
+    @property
+    def sep_token_id(self):
+        return self._special_id(self.sep_token)
+
+    @property
+    def mask_token_id(self):
+        return self._special_id(self.mask_token)
+
+    # -- core API ------------------------------------------------------------
+    def _tokenize(self, text):
+        raise NotImplementedError
+
+    def tokenize(self, text):
+        """Split text into sub-word pieces, keeping special tokens atomic."""
+        specials = [t for t in self.all_special_tokens if t in text]
+        if not specials:
+            return self._tokenize(text)
+        # split on special tokens, tokenize the in-between spans
+        pieces, rest = [], text
+        while rest:
+            hits = [(rest.find(s), s) for s in specials if s in rest]
+            if not hits:
+                pieces.extend(self._tokenize(rest))
+                break
+            pos, s = min(hits)
+            if pos > 0:
+                pieces.extend(self._tokenize(rest[:pos]))
+            pieces.append(s)
+            rest = rest[pos + len(s):]
+        return pieces
+
+    def convert_tokens_to_ids(self, tokens):
+        if isinstance(tokens, str):
+            return self.vocab.get(tokens, self.vocab.get(self.unk_token, 0))
+        return [self.convert_tokens_to_ids(t) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids):
+        if isinstance(ids, (int, np.integer)):
+            return self.ids_to_tokens.get(int(ids), self.unk_token)
+        return [self.convert_ids_to_tokens(i) for i in ids]
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        """Default: no specials added; subclasses override (CLS/SEP etc.)."""
+        if ids1 is None:
+            return list(ids0)
+        return list(ids0) + list(ids1)
+
+    def create_token_type_ids_from_sequences(self, ids0, ids1=None):
+        full = self.build_inputs_with_special_tokens(ids0, ids1)
+        if ids1 is None:
+            return [0] * len(full)
+        first = len(self.build_inputs_with_special_tokens(ids0))
+        return [0] * first + [1] * (len(full) - first)
+
+    def num_special_tokens_to_add(self, pair=False):
+        if pair:
+            return len(self.build_inputs_with_special_tokens([], []))
+        return len(self.build_inputs_with_special_tokens([]))
+
+    def encode_plus(self, text, text_pair=None, add_special_tokens=True,
+                    max_length=None, truncation=False):
+        """Encode one (pair of) text(s) → dict with aligned ``input_ids``
+        and ``token_type_ids`` (both plain lists, unpadded)."""
+        ids0 = self.convert_tokens_to_ids(self.tokenize(text))
+        ids1 = (self.convert_tokens_to_ids(self.tokenize(text_pair))
+                if text_pair is not None else None)
+        if truncation and max_length is not None:
+            budget = max_length
+            if add_special_tokens:
+                budget -= self.num_special_tokens_to_add(ids1 is not None)
+            budget = max(budget, 0)
+            if ids1 is None:
+                ids0 = ids0[:budget]
+            else:  # longest-first truncation
+                while len(ids0) + len(ids1) > budget:
+                    if len(ids0) >= len(ids1):
+                        ids0 = ids0[:-1]
+                    else:
+                        ids1 = ids1[:-1]
+        if add_special_tokens:
+            input_ids = self.build_inputs_with_special_tokens(ids0, ids1)
+            token_type_ids = self.create_token_type_ids_from_sequences(
+                ids0, ids1)
+        else:
+            input_ids = list(ids0) if ids1 is None else list(ids0) + list(ids1)
+            token_type_ids = ([0] * len(ids0) if ids1 is None
+                              else [0] * len(ids0) + [1] * len(ids1))
+        return {"input_ids": input_ids, "token_type_ids": token_type_ids}
+
+    def encode(self, text, text_pair=None, add_special_tokens=True,
+               max_length=None, truncation=False):
+        return self.encode_plus(text, text_pair,
+                                add_special_tokens=add_special_tokens,
+                                max_length=max_length,
+                                truncation=truncation)["input_ids"]
+
+    def _decode_tokens(self, tokens):
+        return " ".join(tokens)
+
+    def decode(self, ids, skip_special_tokens=False):
+        tokens = self.convert_ids_to_tokens(list(ids))
+        if skip_special_tokens:
+            specials = set(self.all_special_tokens)
+            tokens = [t for t in tokens if t not in specials]
+        return self._decode_tokens(tokens)
+
+    # -- batch encoding (static-shape friendly) ------------------------------
+    def __call__(self, texts, text_pairs=None, max_length=None,
+                 padding=True, truncation=True, add_special_tokens=True,
+                 pad_to_multiple_of=None, return_token_type_ids=None):
+        """Encode a batch into dense int32 numpy arrays.
+
+        Static shapes are what keep XLA retraces away: with ``max_length``
+        (or ``pad_to_multiple_of``) every batch of similar length maps to the
+        same compiled program.
+        """
+        if isinstance(texts, str):
+            texts = [texts]
+            if isinstance(text_pairs, str):
+                text_pairs = [text_pairs]
+        pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        encoded = [self.encode_plus(t, p,
+                                    add_special_tokens=add_special_tokens,
+                                    max_length=max_length,
+                                    truncation=truncation)
+                   for t, p in zip(texts, pairs)]
+        seqs = [e["input_ids"] for e in encoded]
+        want_tt = return_token_type_ids or (return_token_type_ids is None
+                                            and text_pairs is not None)
+        ttids = [e["token_type_ids"] for e in encoded] if want_tt else None
+        if not padding:
+            out = {"input_ids": [np.asarray(s, np.int32) for s in seqs]}
+            if ttids is not None:
+                out["token_type_ids"] = [np.asarray(t, np.int32)
+                                         for t in ttids]
+            return out
+        longest = max(len(s) for s in seqs)
+        length = max_length or longest
+        if not truncation:
+            # never silently slice: a caller who disabled truncation gets
+            # padding up to the longest sequence instead
+            length = max(length, longest)
+        if pad_to_multiple_of:
+            length = -(-length // pad_to_multiple_of) * pad_to_multiple_of
+        pad_id = self.pad_token_id if self.pad_token_id is not None else 0
+        n = len(seqs)
+        input_ids = np.full((n, length), pad_id, np.int32)
+        attention = np.zeros((n, length), np.int32)
+        for i, s in enumerate(seqs):
+            s = s[:length]
+            input_ids[i, :len(s)] = s
+            attention[i, :len(s)] = 1
+        out = {"input_ids": input_ids, "attention_mask": attention}
+        if ttids is not None:
+            tt_arr = np.zeros((n, length), np.int32)
+            for i, t in enumerate(ttids):
+                t = t[:length]
+                tt_arr[i, :len(t)] = t
+            out["token_type_ids"] = tt_arr
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def save_vocabulary(self, path):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.vocab, f, ensure_ascii=False)
+        return path
+
+    @staticmethod
+    def load_vocab_file(path):
+        """Load a vocab: .json dict or .txt one-token-per-line."""
+        if path.endswith(".json"):
+            with open(path, encoding="utf-8") as f:
+                return OrderedDict(json.load(f))
+        vocab = OrderedDict()
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                tok = line.rstrip("\n")
+                if tok:
+                    vocab[tok] = len(vocab)
+        return vocab
+
+
+def load_merges_file(path):
+    """Load a BPE merges file: one 'a b' pair per line (# comments skipped)."""
+    merges = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = tuple(line.split())
+            if len(parts) == 2:
+                merges.append(parts)
+    return merges
+
+
+__all__ = ["Tokenizer", "load_merges_file"]

@@ -1,0 +1,278 @@
+"""The ten model-family tokenizers (reference: ``python/hetu/tokenizers/``).
+
+Each family is a thin policy layer (special tokens, pre/post-processing)
+over one of the four cores in :mod:`hetu_tpu.tokenizers.algorithms`:
+
+=============  =====================  ==============================
+Family         Core                   Reference file
+=============  =====================  ==============================
+Bert           BasicTok + WordPiece   tokenizers/bert.py
+Gpt2           byte-level BPE         tokenizers/gpt2.py
+Bart           byte-level BPE         tokenizers/bart.py (roberta style)
+Longformer     byte-level BPE         tokenizers/longformer.py
+CLIP           byte-level BPE (+</w>) tokenizers/clip.py
+T5             Unigram                tokenizers/t5.py
+XLNet          Unigram                tokenizers/xlnet.py
+BigBird        Unigram                tokenizers/bigbird.py
+Reformer       Unigram                tokenizers/reformer.py
+TransfoXL      WordLevel              tokenizers/transfoxl.py
+=============  =====================  ==============================
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .algorithms import (CLIP_SPLIT_PATTERN, GPT2_SPLIT_PATTERN,
+                         BasicTokenizer, ByteLevelBPE, Unigram, WordLevel,
+                         WordPiece, bytes_to_unicode)
+from .base import Tokenizer, load_merges_file
+
+
+class BertTokenizer(Tokenizer):
+    """Basic + WordPiece with [CLS] ... [SEP] pair formatting."""
+
+    def __init__(self, vocab_file=None, vocab=None, do_lower_case=True,
+                 do_basic_tokenize=True, **kw):
+        vocab = vocab if vocab is not None else \
+            Tokenizer.load_vocab_file(vocab_file)
+        kw.setdefault("unk_token", "[UNK]")
+        kw.setdefault("pad_token", "[PAD]")
+        kw.setdefault("cls_token", "[CLS]")
+        kw.setdefault("sep_token", "[SEP]")
+        kw.setdefault("mask_token", "[MASK]")
+        super().__init__(vocab, **kw)
+        self.do_basic_tokenize = do_basic_tokenize
+        self.basic = BasicTokenizer(do_lower_case=do_lower_case,
+                                    never_split=self.all_special_tokens)
+        self.wordpiece = WordPiece(self.vocab, unk_token=self.unk_token)
+
+    def _tokenize(self, text):
+        out = []
+        words = (self.basic.tokenize(text) if self.do_basic_tokenize
+                 else text.split())
+        for word in words:
+            if word in self.all_special_tokens:
+                out.append(word)
+            else:
+                out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        cls, sep = [self.cls_token_id], [self.sep_token_id]
+        if ids1 is None:
+            return cls + list(ids0) + sep
+        return cls + list(ids0) + sep + list(ids1) + sep
+
+    def _decode_tokens(self, tokens):
+        return " ".join(tokens).replace(" ##", "")
+
+
+class _BPETokenizer(Tokenizer):
+    """Shared byte-level-BPE plumbing for GPT-2/BART/Longformer/CLIP."""
+
+    _suffix = None
+    _split_pattern = GPT2_SPLIT_PATTERN
+
+    def __init__(self, vocab_file=None, merges_file=None, vocab=None,
+                 merges=None, **kw):
+        vocab = vocab if vocab is not None else \
+            Tokenizer.load_vocab_file(vocab_file)
+        merges = merges if merges is not None else \
+            load_merges_file(merges_file)
+        super().__init__(vocab, **kw)
+        self.bpe = ByteLevelBPE(self.vocab, merges,
+                                split_pattern=self._split_pattern,
+                                end_of_word_suffix=self._suffix)
+
+    def _tokenize(self, text):
+        return self.bpe.tokenize(text)
+
+    def _decode_tokens(self, tokens):
+        return self.bpe.detokenize(tokens)
+
+
+class Gpt2Tokenizer(_BPETokenizer):
+    def __init__(self, *a, **kw):
+        kw.setdefault("unk_token", "<|endoftext|>")
+        kw.setdefault("bos_token", "<|endoftext|>")
+        kw.setdefault("eos_token", "<|endoftext|>")
+        kw.setdefault("pad_token", "<|endoftext|>")
+        super().__init__(*a, **kw)
+
+
+class BartTokenizer(_BPETokenizer):
+    """RoBERTa-style: <s> seq </s> (</s> </s> between pairs)."""
+
+    def __init__(self, *a, **kw):
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("pad_token", "<pad>")
+        kw.setdefault("bos_token", "<s>")
+        kw.setdefault("eos_token", "</s>")
+        kw.setdefault("cls_token", "<s>")
+        kw.setdefault("sep_token", "</s>")
+        kw.setdefault("mask_token", "<mask>")
+        super().__init__(*a, **kw)
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        bos, eos = [self.bos_token_id], [self.eos_token_id]
+        if ids1 is None:
+            return bos + list(ids0) + eos
+        return bos + list(ids0) + eos + eos + list(ids1) + eos
+
+
+class LongformerTokenizer(BartTokenizer):
+    pass
+
+
+class CLIPTokenizer(_BPETokenizer):
+    """Lowercased BPE with the ``</w>`` end-of-word suffix."""
+
+    _suffix = "</w>"
+    _split_pattern = CLIP_SPLIT_PATTERN
+
+    def __init__(self, *a, **kw):
+        kw.setdefault("unk_token", "<|endoftext|>")
+        kw.setdefault("bos_token", "<|startoftext|>")
+        kw.setdefault("eos_token", "<|endoftext|>")
+        kw.setdefault("pad_token", "<|endoftext|>")
+        super().__init__(*a, **kw)
+
+    def _tokenize(self, text):
+        import regex as re
+        text = re.sub(r"\s+", " ", text).strip().lower()
+        return super()._tokenize(text)
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        bos, eos = [self.bos_token_id], [self.eos_token_id]
+        if ids1 is None:
+            return bos + list(ids0) + eos
+        return bos + list(ids0) + eos + bos + list(ids1) + eos
+
+
+class _UnigramTokenizer(Tokenizer):
+    """Shared sentencepiece-unigram plumbing (T5/XLNet/BigBird/Reformer).
+
+    ``vocab_scores``: list of (piece, logprob). A plain iterable of pieces is
+    accepted too (scores default to -len(piece), longest-match-biased).
+    """
+
+    def __init__(self, vocab_scores, **kw):
+        vocab_scores = [(p, s) if isinstance(p, str) else tuple(p)
+                        for p, s in ((v if isinstance(v, tuple) else
+                                      (v, -float(len(v))))
+                                     for v in vocab_scores)]
+        vocab = OrderedDict()
+        for tok in [kw.get("pad_token"), kw.get("unk_token"),
+                    kw.get("bos_token"), kw.get("eos_token"),
+                    kw.get("cls_token"), kw.get("sep_token"),
+                    kw.get("mask_token")]:
+            if tok is not None and tok not in vocab:
+                vocab[tok] = len(vocab)
+        for piece, _ in vocab_scores:
+            if piece not in vocab:
+                vocab[piece] = len(vocab)
+        super().__init__(vocab, **kw)
+        self.unigram = Unigram(vocab_scores, unk_token=self.unk_token)
+
+    def _tokenize(self, text):
+        return self.unigram.tokenize(text)
+
+    def _decode_tokens(self, tokens):
+        return self.unigram.detokenize(tokens)
+
+
+class T5Tokenizer(_UnigramTokenizer):
+    """Unigram with </s> EOS and <extra_id_N> sentinel tokens."""
+
+    def __init__(self, vocab_scores, extra_ids=100, **kw):
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("pad_token", "<pad>")
+        kw.setdefault("eos_token", "</s>")
+        super().__init__(vocab_scores, **kw)
+        self.add_special_tokens(
+            [f"<extra_id_{i}>" for i in range(extra_ids)])
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        eos = [self.eos_token_id]
+        if ids1 is None:
+            return list(ids0) + eos
+        return list(ids0) + eos + list(ids1) + eos
+
+
+class XLNetTokenizer(_UnigramTokenizer):
+    """Unigram with trailing <sep> <cls> (XLNet puts CLS last)."""
+
+    def __init__(self, vocab_scores, **kw):
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("pad_token", "<pad>")
+        kw.setdefault("bos_token", "<s>")
+        kw.setdefault("eos_token", "</s>")
+        kw.setdefault("cls_token", "<cls>")
+        kw.setdefault("sep_token", "<sep>")
+        kw.setdefault("mask_token", "<mask>")
+        super().__init__(vocab_scores, **kw)
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        sep, cls = [self.sep_token_id], [self.cls_token_id]
+        if ids1 is None:
+            return list(ids0) + sep + cls
+        return list(ids0) + sep + list(ids1) + sep + cls
+
+
+class BigBirdTokenizer(_UnigramTokenizer):
+    """Unigram with BERT-style [CLS] ... [SEP] formatting."""
+
+    def __init__(self, vocab_scores, **kw):
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("pad_token", "<pad>")
+        kw.setdefault("bos_token", "<s>")
+        kw.setdefault("eos_token", "</s>")
+        kw.setdefault("cls_token", "[CLS]")
+        kw.setdefault("sep_token", "[SEP]")
+        kw.setdefault("mask_token", "[MASK]")
+        super().__init__(vocab_scores, **kw)
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        cls, sep = [self.cls_token_id], [self.sep_token_id]
+        if ids1 is None:
+            return cls + list(ids0) + sep
+        return cls + list(ids0) + sep + list(ids1) + sep
+
+
+class ReformerTokenizer(_UnigramTokenizer):
+    """Bare unigram: no special-token wrapping."""
+
+    def __init__(self, vocab_scores, **kw):
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("eos_token", "</s>")
+        kw.setdefault("pad_token", "<pad>")
+        super().__init__(vocab_scores, **kw)
+
+
+class TransfoXLTokenizer(Tokenizer):
+    """Word-level vocabulary with <eos> sentence terminator."""
+
+    def __init__(self, vocab_file=None, vocab=None, lower_case=False, **kw):
+        vocab = vocab if vocab is not None else \
+            Tokenizer.load_vocab_file(vocab_file)
+        kw.setdefault("unk_token", "<unk>")
+        kw.setdefault("eos_token", "<eos>")
+        kw.setdefault("pad_token", "<pad>")
+        super().__init__(vocab, **kw)
+        self.word = WordLevel(self.vocab, unk_token=self.unk_token,
+                              lower_case=lower_case)
+
+    def _tokenize(self, text):
+        return self.word.tokenize(text)
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        eos = [self.eos_token_id]
+        if ids1 is None:
+            return list(ids0) + eos
+        return list(ids0) + eos + list(ids1) + eos
+
+
+__all__ = ["BertTokenizer", "Gpt2Tokenizer", "BartTokenizer",
+           "LongformerTokenizer", "CLIPTokenizer", "T5Tokenizer",
+           "XLNetTokenizer", "BigBirdTokenizer", "ReformerTokenizer",
+           "TransfoXLTokenizer"]
