@@ -38,6 +38,7 @@ from .parallel.ring_attention import ContextParallel
 from . import layers
 from . import metrics
 from . import tokenizers
+from .profiler import HetuProfiler, CollectiveProfiler
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)

@@ -39,6 +39,11 @@ class Tokenizer:
         self.sep_token = sep_token
         self.mask_token = mask_token
         self.additional_special_tokens = list(additional_special_tokens)
+        self._specials_cache = None
+        # every named special must resolve to an id (no-op for pretrained
+        # vocabs that already contain them)
+        for t in self.all_special_tokens:
+            self._add_token(t)
 
     # -- vocab ---------------------------------------------------------------
     @property
@@ -59,17 +64,25 @@ class Tokenizer:
             self._add_token(t)
             if t not in self.additional_special_tokens:
                 self.additional_special_tokens.append(t)
+        self._specials_cache = None
 
     @property
     def all_special_tokens(self):
-        named = [self.unk_token, self.pad_token, self.bos_token,
-                 self.eos_token, self.cls_token, self.sep_token,
-                 self.mask_token]
-        out = []
-        for t in named + self.additional_special_tokens:
-            if t is not None and t not in out:
-                out.append(t)
-        return out
+        if self._specials_cache is None:
+            named = [self.unk_token, self.pad_token, self.bos_token,
+                     self.eos_token, self.cls_token, self.sep_token,
+                     self.mask_token]
+            out = []
+            for t in named + self.additional_special_tokens:
+                if t is not None and t not in out:
+                    out.append(t)
+            self._specials_cache = (out, frozenset(out))
+        return list(self._specials_cache[0])
+
+    @property
+    def special_tokens_set(self):
+        self.all_special_tokens  # ensure cache
+        return self._specials_cache[1]
 
     def _special_id(self, token):
         if token is None or token not in self.vocab:
@@ -216,6 +229,10 @@ class Tokenizer:
             texts = [texts]
             if isinstance(text_pairs, str):
                 text_pairs = [text_pairs]
+        if text_pairs is not None and len(text_pairs) != len(texts):
+            raise ValueError(
+                f"texts ({len(texts)}) and text_pairs ({len(text_pairs)}) "
+                "must have the same length")
         pairs = text_pairs if text_pairs is not None else [None] * len(texts)
         encoded = [self.encode_plus(t, p,
                                     add_special_tokens=add_special_tokens,

@@ -51,7 +51,7 @@ class BertTokenizer(Tokenizer):
         words = (self.basic.tokenize(text) if self.do_basic_tokenize
                  else text.split())
         for word in words:
-            if word in self.all_special_tokens:
+            if word in self.special_tokens_set:
                 out.append(word)
             else:
                 out.extend(self.wordpiece.tokenize(word))
@@ -217,6 +217,12 @@ class XLNetTokenizer(_UnigramTokenizer):
         if ids1 is None:
             return list(ids0) + sep + cls
         return list(ids0) + sep + list(ids1) + sep + cls
+
+    def create_token_type_ids_from_sequences(self, ids0, ids1=None):
+        # XLNet puts <sep><cls> at the END; segment ids are 0s | 1s | cls=2
+        if ids1 is None:
+            return [0] * (len(ids0) + 1) + [2]
+        return ([0] * (len(ids0) + 1) + [1] * (len(ids1) + 1) + [2])
 
 
 class BigBirdTokenizer(_UnigramTokenizer):

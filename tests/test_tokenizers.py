@@ -130,8 +130,9 @@ def _bpe_assets():
 def test_bpe_family_roundtrip(cls, bos, eos):
     vocab, merges = _bpe_assets()
     tok = cls(vocab=dict(vocab), merges=merges)
-    for t in tok.all_special_tokens:
-        tok._add_token(t)
+    # named specials are auto-added to the vocab at construction
+    assert all(tok.convert_tokens_to_ids(t) is not None
+               for t in tok.all_special_tokens)
     ids = tok.encode("the quick brown fox", add_special_tokens=False)
     assert tok.decode(ids) == "the quick brown fox"
     wrapped = tok.convert_ids_to_tokens(
@@ -151,8 +152,6 @@ def test_clip_lowercases_and_uses_eow_suffix():
               + [c + "</w>" for c in "aphotocf"]):
         vocab.setdefault(w, len(vocab))
     tok = CLIPTokenizer(vocab=vocab, merges=merges)
-    for t in tok.all_special_tokens:
-        tok._add_token(t)
     ids = tok.encode("A Photo", add_special_tokens=False)
     assert tok.decode(ids).strip() == "a photo"
 
@@ -179,6 +178,23 @@ def test_xlnet_trailing_cls():
     tok = XLNetTokenizer(UNI_SCORES)
     toks = tok.convert_ids_to_tokens(tok.encode("the fox"))
     assert toks[-1] == "<cls>" and toks[-2] == "<sep>"
+
+
+def test_xlnet_pair_token_types():
+    tok = XLNetTokenizer(UNI_SCORES)
+    enc = tok.encode_plus("the dog", "the fox")
+    toks = tok.convert_ids_to_tokens(enc["input_ids"])
+    tt = enc["token_type_ids"]
+    assert len(tt) == len(toks)
+    first_sep = toks.index("<sep>")
+    # segment B starts right after the first <sep>; trailing <cls> is 2
+    assert tt[first_sep] == 0 and tt[first_sep + 1] == 1 and tt[-1] == 2
+
+
+def test_mismatched_pair_lengths_raise():
+    tok = BertTokenizer(vocab=BERT_VOCAB)
+    with pytest.raises(ValueError):
+        tok(["a", "b", "c"], ["p1", "p2"])
 
 
 def test_bigbird_bert_style_wrapping():
