@@ -39,6 +39,7 @@ from . import layers
 from . import metrics
 from . import tokenizers
 from .profiler import HetuProfiler, CollectiveProfiler
+from . import autoparallel
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)

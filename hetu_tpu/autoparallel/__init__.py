@@ -1,0 +1,73 @@
+"""hetu_tpu.autoparallel — Galvatron-parity hybrid-parallel strategy search.
+
+Workflow (reference ``tools/Galvatron/README.md:15-100``):
+
+1. **profile** — measure device flops + collective bandwidths
+   (:class:`hetu_tpu.profiler.CollectiveProfiler`) or supply a
+   :class:`HardwareSpec`;
+2. **search** — :func:`search` runs the layerwise DP algorithm
+   (:class:`DPAlg`) over (pp, tp, dp, fsdp) candidates under the memory
+   budget;
+3. **train** — :meth:`ParallelPlan.strategy` + :meth:`ParallelPlan.apply`
+   hand the result to the executor as a mesh + GSPMD sharding annotations.
+"""
+from .cost_model import (HardwareSpec, LayerSpec, MemoryCostModel, Strategy,
+                         TimeCostModel, transformer_layer_spec)
+from .search import DPAlg, candidate_strategies, search
+from .plan import ParallelPlan
+
+
+def calibrate_hardware(mesh=None, mem_bytes=None):
+    """Measure a HardwareSpec from the live devices (profile step of the
+    Galvatron workflow): matmul-probe flops + collective bandwidth."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..profiler import CollectiveProfiler
+
+    n, chain = 4096, 64
+
+    def probe(a, length):
+        # data-dependent matmul chain returning a SCALAR: remote platforms
+        # (axon tunnel) don't honor block_until_ready, and reading a full
+        # result array back is transfer-dominated — a 4-byte scalar read
+        # is the only reliable sync
+        def body(y, _):
+            return y @ a, None
+        y, _ = jax.lax.scan(body, a, None, length=length)
+        return jnp.float32(jnp.sum(y))
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16) * 0.01
+    f = jax.jit(probe, static_argnums=1)
+    float(f(x, chain))  # warm both lengths
+    float(f(x, 1))
+    t0 = time.perf_counter()
+    float(f(x, 1))
+    lat = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(f(x, chain))
+    dt = time.perf_counter() - t0
+    per_matmul = max((dt - lat) / (chain - 1), 1e-9)
+    flops = 2 * n ** 3 / per_matmul
+    prof = CollectiveProfiler(mesh=mesh, repeats=3)
+    width = prof.mesh.shape[prof.axis]
+    if width > 1:
+        ar = prof.profile_allreduce(1 << 22)
+        ici_bw = ((1 << 22) * 2 * (width - 1) / width / ar) if ar > 0 \
+            else HardwareSpec.ici_bw
+    else:  # bandwidth unmeasurable on a 1-wide axis; keep the default
+        ici_bw = HardwareSpec.ici_bw
+    dev = jax.local_devices()[0]
+    if mem_bytes is None:
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+        mem_bytes = (stats or {}).get("bytes_limit", 16e9)
+    return HardwareSpec(flops=flops, mem_bytes=float(mem_bytes),
+                        ici_bw=float(ici_bw))
+
+
+__all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
+           "Strategy", "transformer_layer_spec", "DPAlg",
+           "candidate_strategies", "search", "ParallelPlan",
+           "calibrate_hardware"]

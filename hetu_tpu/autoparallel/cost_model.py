@@ -1,0 +1,172 @@
+"""Memory and time cost models for hybrid-parallel strategy search.
+
+Capability parity with Galvatron (reference ``tools/Galvatron/utils/
+cost_model.py:3`` MemoryCostModel, ``:38`` TimeCostModel_with_overlap),
+re-targeted at TPU meshes: a *strategy* is ``(pp, tp, dp, fsdp)`` — pipeline
+stages, tensor-parallel width, data-parallel width, and whether optimizer
+state + params are fully sharded over dp (ZeRO-3 semantics, which is how the
+"PS/fsdp" capability maps to synchronous TPU training).
+
+All byte counts are per-device; bandwidths come from a measured
+:class:`hetu_tpu.profiler.CollectiveProfiler` table or caller-supplied
+constants.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Strategy:
+    """One per-layer parallelization choice."""
+    pp: int = 1
+    tp: int = 1
+    dp: int = 1
+    fsdp: bool = False
+
+    @property
+    def world(self):
+        return self.pp * self.tp * self.dp
+
+    def __str__(self):
+        tag = f"pp{self.pp}-tp{self.tp}-dp{self.dp}"
+        return tag + ("-fsdp" if self.fsdp else "")
+
+
+@dataclass
+class LayerSpec:
+    """Static per-layer workload description (Galvatron profiles these;
+    we derive them from model config or HLO cost analysis).
+
+    * ``param_bytes`` — parameter bytes of one layer replica
+    * ``fwd_flops`` — forward FLOPs for the whole (global) batch
+    * ``act_bytes`` — activation bytes for the whole batch (what pipeline
+      p2p moves, and what remat trades)
+    * ``count`` — how many identical layers share this spec
+    """
+    name: str
+    param_bytes: float
+    fwd_flops: float
+    act_bytes: float
+    count: int = 1
+
+
+@dataclass
+class HardwareSpec:
+    """Device + interconnect model.
+
+    ``flops``: sustained per-device FLOP/s (not peak — calibrate with a
+    matmul probe). Bandwidths in bytes/s. ``overlap`` ∈ [0,1]: fraction of
+    dp grad-allreduce hidden behind backward compute (Galvatron's
+    overlap_coe).
+    """
+    flops: float = 100e12          # ~bf16 sustained on one v5e core
+    mem_bytes: float = 16e9
+    ici_bw: float = 4.5e10         # allreduce algo-bandwidth over ICI
+    dcn_bw: float = 2.5e9
+    overlap: float = 0.7
+
+    def coll_bw(self, width):
+        """Bandwidth for a collective of given participant count; >8-wide
+        groups are assumed to cross DCN (multi-host)."""
+        return self.ici_bw if width <= 8 else self.dcn_bw
+
+
+OPT_STATE_MULT = 3.0   # param + adam m + v, fp32 master (bytes ×3 of fp32)
+GRAD_MULT = 1.0
+
+
+class MemoryCostModel:
+    """Per-device memory of running one layer under a strategy
+    (Galvatron MemoryCostModel: model states ×1/dp under fsdp:18-23)."""
+
+    def __init__(self, hw: HardwareSpec, microbatches: int = 1,
+                 remat: bool = False):
+        self.hw = hw
+        self.microbatches = max(1, microbatches)
+        self.remat = remat
+
+    def layer_bytes(self, spec: LayerSpec, s: Strategy):
+        shard = s.tp  # params shard over tp always
+        params = spec.param_bytes / shard
+        states = params * OPT_STATE_MULT
+        grads = params * GRAD_MULT
+        if s.fsdp:
+            states /= s.dp
+            params /= s.dp  # gathered transiently; steady-state sharded
+            grads /= s.dp   # reduce-scattered
+        acts = spec.act_bytes / (s.dp * s.tp) / self.microbatches
+        if self.remat:
+            acts = acts / 4 + spec.act_bytes * 0.01  # boundary stashes
+        return params + states + grads + acts
+
+    def stage_bytes(self, specs, strategies):
+        """Total per-device bytes when each layer i runs strategy[i] —
+        layers divide over pp stages, so each stage holds 1/pp of them."""
+        per_stage = {}
+        for spec, s in zip(specs, strategies):
+            b = self.layer_bytes(spec, s) * spec.count / s.pp
+            per_stage[s.pp] = per_stage.get(s.pp, 0.0) + b
+        return max(per_stage.values()) if per_stage else 0.0
+
+    def fits(self, specs, strategies):
+        return self.stage_bytes(specs, strategies) <= self.hw.mem_bytes
+
+
+class TimeCostModel:
+    """Per-layer step time under a strategy (Galvatron
+    TimeCostModel_with_overlap:38): compute + tp collectives + un-overlapped
+    dp gradient sync + pp bubble amortization."""
+
+    def __init__(self, hw: HardwareSpec, microbatches: int = 1):
+        self.hw = hw
+        self.microbatches = max(1, microbatches)
+
+    def layer_time(self, spec: LayerSpec, s: Strategy):
+        hw = self.hw
+        # fwd+bwd ≈ 3× fwd flops, spread over tp*dp devices (batch over dp,
+        # matmul width over tp)
+        compute = 3.0 * spec.fwd_flops / (s.tp * s.dp) / hw.flops
+        # TP: 2 allreduces fwd + 2 bwd per transformer layer over the
+        # activation bytes (Megatron pattern), ring cost ×2(n-1)/n
+        tp_comm = 0.0
+        if s.tp > 1:
+            vol = 4.0 * spec.act_bytes / (s.dp * s.tp)
+            tp_comm = vol * 2 * (s.tp - 1) / s.tp / hw.coll_bw(s.tp)
+        # DP: grad allreduce (or reduce-scatter+all-gather for fsdp — same
+        # ring volume), partly overlapped with backward
+        dp_comm = 0.0
+        if s.dp > 1:
+            vol = (spec.param_bytes / s.tp) * 2 * (s.dp - 1) / s.dp
+            dp_comm = vol / hw.coll_bw(s.dp) * (1.0 - hw.overlap)
+        if s.fsdp and s.dp > 1:
+            # extra fwd all-gather of sharded params (not overlappable fully)
+            vol = (spec.param_bytes / s.tp) * (s.dp - 1) / s.dp
+            dp_comm += vol / hw.coll_bw(s.dp) * 0.5
+        # PP: p2p activations between stages + bubble overhead factor
+        pp_cost = 0.0
+        if s.pp > 1:
+            p2p = spec.act_bytes / (s.dp * s.tp) / hw.coll_bw(2)
+            bubble = (s.pp - 1) / self.microbatches
+            pp_cost = p2p + compute * bubble
+        return compute + tp_comm + dp_comm + pp_cost
+
+    def total(self, specs, strategies):
+        return sum(self.layer_time(sp, st) * sp.count
+                   for sp, st in zip(specs, strategies))
+
+
+def transformer_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,
+                           name="layer", count=1):
+    """Derive a LayerSpec for one transformer block from model dims."""
+    params = (4 * hidden * hidden + 2 * ffn_mult * hidden * hidden) \
+        * dtype_bytes
+    tokens = batch * seq
+    flops = 2 * tokens * (4 * hidden * hidden + 2 * ffn_mult * hidden
+                          * hidden) + 2 * 2 * batch * seq * seq * hidden
+    acts = tokens * hidden * dtype_bytes * 12  # rough per-block liveset
+    return LayerSpec(name, float(params), float(flops), float(acts), count)
+
+
+__all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
+           "TimeCostModel", "transformer_layer_spec"]

@@ -1,0 +1,115 @@
+"""ParallelPlan: the search result, emitted as mesh axes + shardings.
+
+Where Galvatron emits per-layer NCCL process groups + Megatron module
+wrappers (``tools/Galvatron/*/hybrid_parallel_model.py``), the TPU plan is
+declarative: a mesh ``{'pp','dp','tp'}`` plus per-layer GSPMD sharding
+directives that :func:`apply` attaches to a model's layers through the
+existing ``ht.dispatch`` / ``pipeline_block`` machinery.
+"""
+from __future__ import annotations
+
+from .cost_model import Strategy
+
+
+class ParallelPlan:
+    def __init__(self, specs, strategies, n_devices, est_time=None,
+                 microbatches=1):
+        self.specs = list(specs)
+        self.strategies = list(strategies)
+        self.n_devices = n_devices
+        self.est_time = est_time
+        self.microbatches = microbatches
+
+    # -- mesh emission -------------------------------------------------------
+    @property
+    def uniform(self):
+        return len(set(self.strategies)) == 1
+
+    def mesh_axes(self):
+        """Axis sizes for ``ht.make_mesh``. For non-uniform plans the mesh
+        uses the max width per axis; narrower layers replicate over the
+        leftover (GSPMD handles specs that omit an axis)."""
+        pp = max(s.pp for s in self.strategies)
+        tp = max(s.tp for s in self.strategies)
+        dp = self.n_devices // (pp * tp)
+        axes = {}
+        if pp > 1:
+            axes["pp"] = pp
+        if dp > 1:
+            axes["dp"] = dp
+        if tp > 1:
+            axes["tp"] = tp
+        return axes or {"dp": 1}
+
+    def strategy(self):
+        """An executor-ready distribution strategy for this plan."""
+        from ..parallel.strategies import DataParallel, ModelParallel
+        axes = self.mesh_axes()
+        if set(axes) <= {"dp"}:
+            return DataParallel()
+        return ModelParallel(axes)
+
+    # -- layer sharding directives ------------------------------------------
+    def layer_specs(self):
+        """Per-layer sharding directives:
+        ``[{'stage': int, 'tp': int, 'fsdp': bool,
+            'kernel_spec': P(None,'tp'), 'out_spec': P('tp',None)}, ...]``
+
+        ``kernel_spec``/``out_spec`` are the canonical Megatron pair —
+        column-parallel then row-parallel — to hand to ``ht.dispatch`` for a
+        layer's two linear kernels.
+        """
+        from jax.sharding import PartitionSpec as P
+        out, stage_of = [], {}
+        pp = max(s.pp for s in self.strategies)
+        n = len(self.specs)
+        for i, (spec, s) in enumerate(zip(self.specs, self.strategies)):
+            stage = min(i * pp // max(1, n), pp - 1)
+            d = {
+                "name": spec.name,
+                "stage": stage,
+                "tp": s.tp,
+                "dp": s.dp,
+                "fsdp": s.fsdp,
+                "kernel_spec": P(None, "tp") if s.tp > 1 else P(),
+                "out_kernel_spec": P("tp", None) if s.tp > 1 else P(),
+                "param_spec": (P("dp") if s.fsdp else P()),
+            }
+            out.append(d)
+            stage_of[spec.name] = stage
+        return out
+
+    def apply(self, layers):
+        """Annotate model layers in place.
+
+        ``layers``: sequence of objects exposing (any of) ``weight_var`` /
+        ``in_kernels`` / ``out_kernels`` — e.g. our Linear / attention /
+        FFN layers. Column-parallel specs go on ``in_kernels``,
+        row-parallel on ``out_kernels``.
+        """
+        from ..parallel.dispatch import dispatch
+        directives = self.layer_specs()
+        if len(layers) != len(directives):
+            raise ValueError(
+                f"plan has {len(directives)} layers, model has {len(layers)}")
+        for layer, d in zip(layers, directives):
+            if d["tp"] > 1:
+                for v in getattr(layer, "in_kernels", []):
+                    dispatch(v, d["kernel_spec"])
+                for v in getattr(layer, "out_kernels", []):
+                    dispatch(v, d["out_kernel_spec"])
+                w = getattr(layer, "weight_var", None)
+                if w is not None and not getattr(layer, "in_kernels", None):
+                    dispatch(w, d["kernel_spec"])
+        return directives
+
+    def describe(self):
+        lines = [f"devices={self.n_devices} mesh={self.mesh_axes()} "
+                 f"est_step={self.est_time:.4f}s "
+                 f"microbatches={self.microbatches}"]
+        for spec, s in zip(self.specs, self.strategies):
+            lines.append(f"  {spec.name} x{spec.count}: {s}")
+        return "\n".join(lines)
+
+
+__all__ = ["ParallelPlan", "Strategy"]

@@ -1,0 +1,159 @@
+"""Layerwise dynamic-programming strategy search (Galvatron DPAlg parity).
+
+Reference: ``tools/Galvatron/utils/dp_utils.py:55`` — per-layer strategy
+selection by DP under a per-device memory budget, with a resharding penalty
+when consecutive layers change strategy. Emits TPU mesh axes + sharding
+specs rather than NCCL groups.
+"""
+from __future__ import annotations
+
+from .cost_model import (HardwareSpec, MemoryCostModel, Strategy,
+                         TimeCostModel)
+
+
+def candidate_strategies(n_devices, allow_pp=True, allow_fsdp=True,
+                         max_tp=None):
+    """All (pp, tp, dp, fsdp) factorizations of n_devices (powers of 2)."""
+    cands = []
+    pps = [1]
+    p = 2
+    while allow_pp and p <= n_devices:
+        pps.append(p)
+        p *= 2
+    for pp in pps:
+        rest = n_devices // pp
+        if pp * rest != n_devices:
+            continue
+        tp = 1
+        while tp <= rest:
+            if max_tp and tp > max_tp:
+                break
+            dp = rest // tp
+            if tp * dp == rest:
+                cands.append(Strategy(pp, tp, dp, False))
+                if allow_fsdp and dp > 1:
+                    cands.append(Strategy(pp, tp, dp, True))
+            tp *= 2
+    return cands
+
+
+def _switch_cost(a: Strategy, b: Strategy, act_bytes, hw: HardwareSpec):
+    """Resharding cost between consecutive layers with different layouts —
+    an all-to-allish move of the activations (Galvatron models this as a
+    fixed transfer coefficient)."""
+    if (a.tp, a.dp, a.pp) == (b.tp, b.dp, b.pp):
+        return 0.0
+    return act_bytes / hw.coll_bw(max(a.world, b.world))
+
+
+class DPAlg:
+    """min-time DP over layers × strategies with a memory constraint.
+
+    Memory is tracked as the running per-stage total; a strategy chain is
+    feasible iff the projected stage bytes stay under ``hw.mem_bytes``.
+    (Galvatron discretizes memory; layer counts here are small enough to
+    track exact floats per DP state.)
+    """
+
+    def __init__(self, specs, n_devices, hw=None, microbatches=1,
+                 remat=False, allow_pp=True, allow_fsdp=True, max_tp=None):
+        self.specs = list(specs)
+        self.hw = hw or HardwareSpec()
+        self.mem = MemoryCostModel(self.hw, microbatches, remat)
+        self.time = TimeCostModel(self.hw, microbatches)
+        self.cands = candidate_strategies(n_devices, allow_pp, allow_fsdp,
+                                          max_tp)
+        if not self.cands:
+            raise ValueError(f"no strategy candidates for {n_devices} devices")
+
+    #: cap on Pareto states kept per (layer, strategy) cell
+    MAX_FRONTIER = 32
+
+    @staticmethod
+    def _pareto(entries, cap):
+        """Prune (time, mem, chain) entries to the Pareto frontier over
+        (time, mem); keep at most ``cap``, fastest first.
+
+        A pure min-time DP is wrong here: the fastest chain so far may be
+        memory-heavy and infeasible to extend, while a slower lean chain
+        survives — (time, mem) trade off, so both must be kept.
+        """
+        entries.sort(key=lambda e: (e[0], e[1]))
+        out = []
+        best_mem = float("inf")
+        for e in entries:
+            if e[1] < best_mem:  # strictly less memory than any faster chain
+                out.append(e)
+                best_mem = e[1]
+            if len(out) >= cap:
+                break
+        return out
+
+    def fit(self):
+        """Returns (best_time, [Strategy per spec]) or (inf, None)."""
+        INF = float("inf")
+        # state: strategy index -> Pareto list of (time, mem, chain)
+        layer0 = self.specs[0]
+        states = {}
+        for i, s in enumerate(self.cands):
+            t = self.time.layer_time(layer0, s) * layer0.count
+            m = self.mem.layer_bytes(layer0, s) * layer0.count / s.pp
+            if m <= self.hw.mem_bytes:
+                states[i] = [(t, m, (i,))]
+        if not states:
+            return INF, None
+        for li in range(1, len(self.specs)):
+            spec = self.specs[li]
+            new_states = {}
+            for j, s in enumerate(self.cands):
+                lt = self.time.layer_time(spec, s) * spec.count
+                lm = self.mem.layer_bytes(spec, s) * spec.count / s.pp
+                cands = []
+                for i, frontier in states.items():
+                    sw = _switch_cost(self.cands[i], s, spec.act_bytes,
+                                      self.hw)
+                    for (t, m, chain) in frontier:
+                        cand_m = m + lm
+                        if cand_m > self.hw.mem_bytes:
+                            continue
+                        cands.append((t + lt + sw, cand_m, chain + (j,)))
+                if cands:
+                    new_states[j] = self._pareto(cands, self.MAX_FRONTIER)
+            if not new_states:
+                return INF, None
+            states = new_states
+        best = min((f[0] for f in states.values()), key=lambda e: e[0])
+        return best[0], [self.cands[i] for i in best[2]]
+
+
+def search(specs, n_devices, hw=None, microbatches=1, remat=False,
+           uniform=False, **kw):
+    """Top-level search → :class:`ParallelPlan`.
+
+    ``uniform=True`` restricts to one strategy for all layers (the common
+    deployment case; also what the executor's single-mesh emission needs).
+    """
+    from .plan import ParallelPlan
+    alg = DPAlg(specs, n_devices, hw=hw, microbatches=microbatches,
+                remat=remat, **kw)
+    if uniform:
+        best = (float("inf"), None)
+        for s in alg.cands:
+            strategies = [s] * len(specs)
+            if not alg.mem.fits(specs, strategies):
+                continue
+            t = alg.time.total(specs, strategies)
+            if t < best[0]:
+                best = (t, strategies)
+        t, strategies = best
+    else:
+        t, strategies = alg.fit()
+    if strategies is None:
+        raise ValueError(
+            "no feasible strategy under the memory budget; raise mem_bytes, "
+            "enable remat, or increase device count")
+    return ParallelPlan(specs, strategies, n_devices, est_time=t,
+                        microbatches=microbatches)
+
+
+__all__ = ["DPAlg", "candidate_strategies", "search"]

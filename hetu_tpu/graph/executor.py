@@ -354,15 +354,14 @@ class SubExecutor:
         return results
 
     def profile(self, feed_dict, log_file=None):
-        """Per-step timing via real execution (reference SubExecutor.profile:686)."""
-        import time
-        self.run(feed_dict)  # compile
-        t0 = time.perf_counter()
-        outs = self.run(feed_dict)
-        for o in outs:
-            if o is not None:
-                o.wait()
-        dt = time.perf_counter() - t0
+        """Per-step timing via real execution (reference SubExecutor.profile:686).
+
+        Delegates to :class:`hetu_tpu.profiler.HetuProfiler` — one timer,
+        one sync discipline (remote platforms need a host read to sync).
+        """
+        from ..profiler import HetuProfiler
+        prof = HetuProfiler(self.ex, self.name, repeats=3, warmup=1)
+        dt = prof.profile_step(feed_dict) / 1e3
         if log_file:
             with open(log_file, "a") as f:
                 f.write(f"{self.name}: {dt * 1e3:.3f} ms/step\n")

@@ -125,13 +125,14 @@ class HetuProfiler:
             try:
                 fn = jax.jit(one)
                 out = fn(ins)
-                jax.block_until_ready(out)
+                self._sync([out])
                 for _ in range(self.warmup):
-                    fn(ins)
+                    out = fn(ins)
+                self._sync([out])  # warmup drained before timing
                 t0 = time.perf_counter()
                 for _ in range(self.repeats):
                     out = fn(ins)
-                jax.block_until_ready(out)
+                self._sync([out])
                 dt = (time.perf_counter() - t0) / self.repeats
             except Exception as e:  # collective ops outside their mesh scope
                 self.skipped[f"{node.op_type}:{node.name}"] = repr(e)
@@ -145,17 +146,38 @@ class HetuProfiler:
                     f.write(f"{k}\tSKIPPED\t{why}\n")
         return results
 
+    @staticmethod
+    def _sync(outs):
+        """Force completion of a step's outputs.
+
+        ``block_until_ready`` is not honored by remote-tunnel platforms
+        (axon), so read one element back to host — consecutive training
+        steps form a data-dependent chain through the params, so syncing
+        the last outputs syncs every dispatched step.
+        """
+        import jax
+        for o in outs:
+            if o is None:
+                continue
+            arr = o.jax() if hasattr(o, "jax") else o
+            for leaf in jax.tree.leaves(arr):
+                if getattr(leaf, "ndim", 0):
+                    # device-side gather → 4-byte host read
+                    leaf = leaf.ravel()[0]
+                np.asarray(leaf)
+
     def profile_step(self, feed_dict):
         """Fused whole-step wall time (ms) — the number that matters on TPU."""
-        import jax
-        self.sub.run(feed_dict)  # compile + warm
+        self.sub.run(feed_dict)  # compile
+        outs = None
         for _ in range(self.warmup):
             outs = self.sub.run(feed_dict)
+        if outs is not None:
+            self._sync(outs)  # warmup must finish before the timer starts
         t0 = time.perf_counter()
         for _ in range(self.repeats):
             outs = self.sub.run(feed_dict)
-        jax.block_until_ready([o.data if hasattr(o, "data") else o
-                               for o in outs if o is not None])
+        self._sync(outs)
         return (time.perf_counter() - t0) / self.repeats * 1e3
 
     def hlo_cost(self, feed_dict):

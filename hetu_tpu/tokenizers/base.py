@@ -41,7 +41,15 @@ class Tokenizer:
         self.additional_special_tokens = list(additional_special_tokens)
         self._specials_cache = None
         # every named special must resolve to an id (no-op for pretrained
-        # vocabs that already contain them)
+        # vocabs that already contain them); warn when the vocab grows so a
+        # checkpoint whose embedding table lacks the new rows is noticed
+        missing = [t for t in self.all_special_tokens if t not in self.vocab]
+        if missing and self.vocab:
+            import warnings
+            warnings.warn(
+                f"special tokens {missing} absent from the vocab were "
+                f"appended (ids {len(self.vocab)}..); resize the model's "
+                "embedding table if loading pretrained weights")
         for t in self.all_special_tokens:
             self._add_token(t)
 
@@ -68,21 +76,25 @@ class Tokenizer:
 
     @property
     def all_special_tokens(self):
-        if self._specials_cache is None:
-            named = [self.unk_token, self.pad_token, self.bos_token,
-                     self.eos_token, self.cls_token, self.sep_token,
-                     self.mask_token]
+        named = (self.unk_token, self.pad_token, self.bos_token,
+                 self.eos_token, self.cls_token, self.sep_token,
+                 self.mask_token)
+        # cache keyed by the current attribute values, so direct mutation
+        # (tok.pad_token = ..., additional_special_tokens.append) is seen
+        cache_key = (named, tuple(self.additional_special_tokens))
+        if self._specials_cache is None or \
+                self._specials_cache[0] != cache_key:
             out = []
-            for t in named + self.additional_special_tokens:
+            for t in list(named) + self.additional_special_tokens:
                 if t is not None and t not in out:
                     out.append(t)
-            self._specials_cache = (out, frozenset(out))
-        return list(self._specials_cache[0])
+            self._specials_cache = (cache_key, out, frozenset(out))
+        return list(self._specials_cache[1])
 
     @property
     def special_tokens_set(self):
-        self.all_special_tokens  # ensure cache
-        return self._specials_cache[1]
+        self.all_special_tokens  # refresh cache
+        return self._specials_cache[2]
 
     def _special_id(self, token):
         if token is None or token not in self.vocab:
@@ -229,6 +241,10 @@ class Tokenizer:
             texts = [texts]
             if isinstance(text_pairs, str):
                 text_pairs = [text_pairs]
+        elif isinstance(text_pairs, str):
+            raise ValueError(
+                "text_pairs is a single string but texts is a batch; pass "
+                "a list of pair texts")
         if text_pairs is not None and len(text_pairs) != len(texts):
             raise ValueError(
                 f"texts ({len(texts)}) and text_pairs ({len(text_pairs)}) "
