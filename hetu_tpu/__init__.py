@@ -40,6 +40,7 @@ from . import metrics
 from . import tokenizers
 from .profiler import HetuProfiler, CollectiveProfiler
 from . import autoparallel
+from . import onnx
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)

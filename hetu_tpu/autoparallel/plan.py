@@ -31,6 +31,12 @@ class ParallelPlan:
         leftover (GSPMD handles specs that omit an axis)."""
         pp = max(s.pp for s in self.strategies)
         tp = max(s.tp for s in self.strategies)
+        if pp * tp > self.n_devices:
+            raise ValueError(
+                f"mixed plan needs a pp{pp} x tp{tp} mesh but only "
+                f"{self.n_devices} devices exist; re-search with "
+                "uniform=True (one strategy for all layers) or restrict "
+                "candidates (allow_pp/max_tp)")
         dp = self.n_devices // (pp * tp)
         axes = {}
         if pp > 1:
@@ -60,13 +66,20 @@ class ParallelPlan:
         layer's two linear kernels.
         """
         from jax.sharding import PartitionSpec as P
-        out, stage_of = [], {}
         pp = max(s.pp for s in self.strategies)
-        n = len(self.specs)
-        for i, (spec, s) in enumerate(zip(self.specs, self.strategies)):
-            stage = min(i * pp // max(1, n), pp - 1)
-            d = {
-                "name": spec.name,
+        # expand by spec.count: one directive per ACTUAL model layer, so
+        # apply() lines up with the model's layer list and the pp-stage
+        # split weights repeated blocks correctly
+        expanded = [(spec, s, i) for spec, s in zip(self.specs,
+                                                    self.strategies)
+                    for i in range(spec.count)]
+        n = len(expanded)
+        out = []
+        for j, (spec, s, i) in enumerate(expanded):
+            stage = min(j * pp // max(1, n), pp - 1)
+            out.append({
+                "name": spec.name if spec.count == 1
+                else f"{spec.name}.{i}",
                 "stage": stage,
                 "tp": s.tp,
                 "dp": s.dp,
@@ -74,9 +87,7 @@ class ParallelPlan:
                 "kernel_spec": P(None, "tp") if s.tp > 1 else P(),
                 "out_kernel_spec": P("tp", None) if s.tp > 1 else P(),
                 "param_spec": (P("dp") if s.fsdp else P()),
-            }
-            out.append(d)
-            stage_of[spec.name] = stage
+            })
         return out
 
     def apply(self, layers):

@@ -1,0 +1,13 @@
+"""hetu_tpu.onnx — ONNX export/import without external deps.
+
+Reference parity: ``python/hetu/onnx/`` (hetu2onnx, onnx2hetu, 24 opset
+handlers). The protobuf wire format is hand-coded in :mod:`.proto`, so the
+files interoperate with onnxruntime/Netron even though the environment has
+no ``onnx`` package.
+"""
+from .hetu2onnx import export, register_exporter
+from .onnx2hetu import load, register_importer, ImportedModel
+from .proto import Model, Graph, Node, Tensor, ValueInfo
+
+__all__ = ["export", "load", "register_exporter", "register_importer",
+           "ImportedModel", "Model", "Graph", "Node", "Tensor", "ValueInfo"]

@@ -1,0 +1,393 @@
+"""Export a hetu_tpu graph to ONNX (reference ``onnx/hetu2onnx.py:27``).
+
+``export(executor_or_fetches, path)`` walks the topo from the fetches and
+emits one ONNX node per graph op through per-op-type handlers (the
+reference's ``onnx_opset/`` table). Variables become initializers (values
+taken from the executor when given, else the node's init value).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import PlaceholderOp
+from ..graph.executor import Executor, topo_sort
+from . import proto
+from .proto import Attribute, Graph, Model, Node, Tensor, ValueInfo
+
+_EXPORTERS = {}
+
+
+def register_exporter(op_type):
+    def deco(fn):
+        _EXPORTERS[op_type] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Export context: names, extra nodes, extra initializers."""
+
+    def __init__(self):
+        self.counter = 0
+        self.extra_inits = []
+
+    def const(self, name, arr):
+        self.extra_inits.append(Tensor(name, np.asarray(arr)))
+        return name
+
+    def fresh(self, base):
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+
+def _n(node):
+    return f"n{node.id}_{node.op_type}"
+
+
+# -- handlers ---------------------------------------------------------------
+
+_UNARY = {"Relu": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
+          "Exp": "Exp", "Log": "Log", "Sqrt": "Sqrt", "Abs": "Abs",
+          "Floor": "Floor", "Sin": "Sin", "Cos": "Cos",
+          "Softmax": "Softmax", "LogSoftmax": "LogSoftmax",
+          "Opposite": "Neg", "Gelu": "Gelu", "Flatten": "Flatten"}
+
+_BINARY = {"AddElewise": "Add", "MinusElewise": "Sub",
+           "MultiplyElewise": "Mul", "Division": "Div", "Pow": "Pow",
+           "MatrixDot": None}
+
+
+def _simple(onnx_op, **attrs):
+    def fn(node, ins, out, ctx):
+        return [Node(onnx_op, ins, [out], name=out, **attrs)]
+    return fn
+
+
+for ht_op, ox in _UNARY.items():
+    _EXPORTERS[ht_op] = _simple(ox)
+for ht_op, ox in _BINARY.items():
+    if ox:
+        _EXPORTERS[ht_op] = _simple(ox)
+
+
+@register_exporter("MatrixMult")
+def _mm(node, ins, out, ctx):
+    a, b = ins
+    nodes = []
+    if node.attrs.get("trans_A"):
+        t = ctx.fresh(out + "_tA")
+        nodes.append(Node("Transpose", [a], [t], name=t, perm=[1, 0]))
+        a = t
+    if node.attrs.get("trans_B"):
+        t = ctx.fresh(out + "_tB")
+        nodes.append(Node("Transpose", [b], [t], name=t, perm=[1, 0]))
+        b = t
+    nodes.append(Node("MatMul", [a, b], [out], name=out))
+    return nodes
+
+
+@register_exporter("Linear")
+def _linear(node, ins, out, ctx):
+    # Gemm does alpha*A'*B' + beta*C in one op
+    return [Node("Gemm", ins, [out], name=out,
+                 transA=int(bool(node.attrs.get("trans_A"))),
+                 transB=int(bool(node.attrs.get("trans_B"))))]
+
+
+@register_exporter("BatchMatrixMult")
+def _bmm(node, ins, out, ctx):
+    a, b = ins
+    nodes = []
+    if node.attrs.get("trans_A"):
+        t = ctx.fresh(out + "_tA")
+        nodes.append(Node("Transpose", [a], [t], name=t))
+        a = t
+    if node.attrs.get("trans_B"):
+        t = ctx.fresh(out + "_tB")
+        nodes.append(Node("Transpose", [b], [t], name=t))
+        b = t
+    nodes.append(Node("MatMul", [a, b], [out], name=out))
+    return nodes
+
+
+def _const_binary(onnx_op, swap=False):
+    def fn(node, ins, out, ctx):
+        cname = ctx.const(ctx.fresh(out + "_c"),
+                          np.float32(node.attrs.get("const_attr", 0.0)))
+        operands = [cname, ins[0]] if swap else [ins[0], cname]
+        return [Node(onnx_op, operands, [out], name=out)]
+    return fn
+
+
+_EXPORTERS["AddConst"] = _const_binary("Add")
+_EXPORTERS["MinusByConst"] = _const_binary("Sub")
+_EXPORTERS["MultiplyConst"] = _const_binary("Mul")
+# DivConst's lowering is a * const_attr (callers pre-invert, node.py:136)
+_EXPORTERS["DivConst"] = _const_binary("Mul")
+_EXPORTERS["ConstDiv"] = _const_binary("Div", swap=True)
+_EXPORTERS["ConstPow"] = _const_binary("Pow", swap=True)
+
+
+@register_exporter("Fmod")
+def _fmod(node, ins, out, ctx):
+    # fmod=1 → C-style float fmod (sign of dividend), matching jnp.fmod;
+    # the default fmod=0 is integer-only and numerically different
+    return [Node("Mod", ins, [out], name=out, fmod=1)]
+
+
+@register_exporter("LeakyRelu")
+def _leaky(node, ins, out, ctx):
+    return [Node("LeakyRelu", ins, [out], name=out,
+                 alpha=float(node.attrs.get("alpha", 0.01)))]
+
+
+@register_exporter("Conv2d")
+def _conv(node, ins, out, ctx):
+    p = node.attrs.get("padding", 0)
+    s = node.attrs.get("stride", 1)
+    ph, pw = (p, p) if isinstance(p, int) else p
+    sh, sw = (s, s) if isinstance(s, int) else s
+    return [Node("Conv", ins, [out], name=out,
+                 pads=[ph, pw, ph, pw], strides=[sh, sw])]
+
+
+_EXPORTERS["Conv2dAddBias"] = _EXPORTERS["Conv2d"]
+
+
+def _pool(onnx_op):
+    def fn(node, ins, out, ctx):
+        a = node.attrs
+        p, s = a.get("padding", 0), a.get("stride", 1)
+        ph, pw = (p, p) if isinstance(p, int) else p
+        sh, sw = (s, s) if isinstance(s, int) else s
+        return [Node(onnx_op, ins, [out], name=out,
+                     kernel_shape=[a["kernel_H"], a["kernel_W"]],
+                     pads=[ph, pw, ph, pw], strides=[sh, sw])]
+    return fn
+
+
+_EXPORTERS["MaxPool2d"] = _pool("MaxPool")
+_EXPORTERS["AvgPool2d"] = _pool("AveragePool")
+
+
+@register_exporter("ArrayReshape")
+def _reshape(node, ins, out, ctx):
+    shape = ctx.const(ctx.fresh(out + "_shape"),
+                      np.asarray(node.attrs["output_shape"], np.int64))
+    return [Node("Reshape", [ins[0], shape], [out], name=out)]
+
+
+@register_exporter("Transpose")
+def _transpose(node, ins, out, ctx):
+    perm = node.attrs.get("perm")
+    attrs = {"perm": [int(p) for p in perm]} if perm is not None else {}
+    return [Node("Transpose", ins, [out], name=out, **attrs)]
+
+
+@register_exporter("Concat")
+def _concat(node, ins, out, ctx):
+    return [Node("Concat", ins, [out], name=out,
+                 axis=int(node.attrs.get("axis", 0)))]
+
+
+_EXPORTERS["Concatenate"] = _concat
+
+
+def _reduce(onnx_op):
+    def fn(node, ins, out, ctx):
+        axes = node.attrs.get("axes")
+        kd = int(bool(node.attrs.get("keepdims", False)))
+        axes_c = ctx.const(ctx.fresh(out + "_axes"),
+                           np.asarray(axes, np.int64))
+        return [Node(onnx_op, [ins[0], axes_c], [out], name=out,
+                     keepdims=kd)]
+    return fn
+
+
+_EXPORTERS["ReduceMean"] = _reduce("ReduceMean")
+_EXPORTERS["ReduceSum"] = _reduce("ReduceSum")
+
+
+@register_exporter("EmbeddingLookup")
+def _embed(node, ins, out, ctx):
+    table, ids = ins
+    ids64 = ctx.fresh(out + "_ids64")
+    return [Node("Cast", [ids], [ids64], name=ids64, to=proto.INT64),
+            Node("Gather", [table, ids64], [out], name=out)]
+
+
+@register_exporter("OneHot")
+def _onehot(node, ins, out, ctx):
+    depth = ctx.const(ctx.fresh(out + "_d"),
+                      np.int64(node.attrs["num_classes"]))
+    vals = ctx.const(ctx.fresh(out + "_v"),
+                     np.asarray([0.0, 1.0], np.float32))
+    ids64 = ctx.fresh(out + "_i64")
+    return [Node("Cast", [ins[0]], [ids64], name=ids64, to=proto.INT64),
+            Node("OneHot", [ids64, depth, vals], [out], name=out)]
+
+
+@register_exporter("Where")
+def _where(node, ins, out, ctx):
+    cond = ctx.fresh(out + "_b")
+    return [Node("Cast", [ins[0]], [cond], name=cond, to=proto.BOOL),
+            Node("Where", [cond, ins[1], ins[2]], [out], name=out)]
+
+
+@register_exporter("Dropout")
+def _dropout(node, ins, out, ctx):  # inference export: identity
+    return [Node("Identity", [ins[0]], [out], name=out)]
+
+
+_EXPORTERS["Dropout2d"] = _dropout
+
+
+@register_exporter("LayerNorm")
+def _layernorm(node, ins, out, ctx):
+    return [Node("LayerNormalization", ins, [out], name=out,
+                 epsilon=float(node.attrs.get("eps", 1e-5)), axis=-1)]
+
+
+@register_exporter("BatchNorm")
+def _batchnorm(node, ins, out, ctx):
+    # inputs are (x, scale, bias, running_mean, running_var) — the trained
+    # stats are real graph variables and export as initializers
+    return [Node("BatchNormalization", list(ins[:5]), [out],
+                 name=out, epsilon=float(node.attrs.get("eps", 1e-5)))]
+
+
+@register_exporter("SoftmaxCrossEntropy")
+def _sce(node, ins, out, ctx):
+    lsm = ctx.fresh(out + "_lsm")
+    prod = ctx.fresh(out + "_prod")
+    neg = ctx.fresh(out + "_neg")
+    axes = ctx.const(ctx.fresh(out + "_axes"), np.asarray([-1], np.int64))
+    return [Node("LogSoftmax", [ins[0]], [lsm], name=lsm, axis=-1),
+            Node("Mul", [lsm, ins[1]], [prod], name=prod),
+            Node("ReduceSum", [prod, axes], [neg], name=neg, keepdims=0),
+            Node("Neg", [neg], [out], name=out)]
+
+
+@register_exporter("SoftmaxCrossEntropySparse")
+def _sces(node, ins, out, ctx):
+    ids64 = ctx.fresh(out + "_i64")
+    return [Node("Cast", [ins[1]], [ids64], name=ids64, to=proto.INT64),
+            Node("SoftmaxCrossEntropyLoss", [ins[0], ids64], [out],
+                 name=out, reduction="none")]
+
+
+INT64_MAX = (1 << 63) - 1
+
+
+@register_exporter("Slice")
+def _slice(node, ins, out, ctx):
+    starts = np.asarray(node.attrs["begin"], np.int64)
+    if node.attrs.get("size") is not None:
+        # hetu convention: size < 0 means "to the end of the dim"
+        # (ops/transform.py _slice); ONNX clamps ends to the dim, so the
+        # INT64_MAX sentinel expresses the same thing
+        ends = np.asarray(
+            [INT64_MAX if s < 0 else b + s
+             for b, s in zip(starts, node.attrs["size"])], np.int64)
+    else:
+        ends = np.asarray(node.attrs["end"], np.int64)
+    s_c = ctx.const(ctx.fresh(out + "_s"), starts)
+    e_c = ctx.const(ctx.fresh(out + "_e"), ends)
+    return [Node("Slice", [ins[0], s_c, e_c], [out], name=out)]
+
+
+@register_exporter("Pad")
+def _pad(node, ins, out, ctx):
+    pads = node.attrs.get("paddings")
+    flat = np.asarray(pads).reshape(-1, 2)
+    onnx_pads = np.concatenate([flat[:, 0], flat[:, 1]]).astype(np.int64)
+    p_c = ctx.const(ctx.fresh(out + "_p"), onnx_pads)
+    return [Node("Pad", [ins[0], p_c], [out], name=out)]
+
+
+@register_exporter("BroadcastTo")
+def _bto(node, ins, out, ctx):
+    shape = ctx.const(ctx.fresh(out + "_shape"),
+                      np.asarray(node.attrs["output_shape"], np.int64))
+    return [Node("Expand", [ins[0], shape], [out], name=out)]
+
+
+@register_exporter("Unsqueeze")
+def _unsq(node, ins, out, ctx):
+    ax = ctx.const(ctx.fresh(out + "_ax"),
+                   np.asarray([node.attrs.get("axis", 0)], np.int64))
+    return [Node("Unsqueeze", [ins[0], ax], [out], name=out)]
+
+
+@register_exporter("Squeeze")
+def _sq(node, ins, out, ctx):
+    ax = node.attrs.get("axis")
+    if ax is None:
+        return [Node("Squeeze", [ins[0]], [out], name=out)]
+    ax_c = ctx.const(ctx.fresh(out + "_ax"), np.asarray([ax], np.int64))
+    return [Node("Squeeze", [ins[0], ax_c], [out], name=out)]
+
+
+# -- driver -----------------------------------------------------------------
+
+
+def export(source, path, name="hetu_graph", feed_shapes=None, opset=20):
+    """Export to an ONNX file.
+
+    ``source``: an :class:`Executor` (variables exported with current
+    values) or a fetch list of graph nodes. Feeds become graph inputs —
+    supply ``feed_shapes={node: shape}`` when placeholders carry none.
+    """
+    if isinstance(source, Executor):
+        fetches = [f for fs in (s.fetches for s in
+                                source.subexecutors.values())
+                   for f in fs if f is not None]
+        var_values = {n: np.asarray(v)
+                      for n, v in source.var_values.items()}
+    else:
+        fetches = list(source)
+        var_values = {}
+    from ..optim.optimizer import OptimizerOp
+    from ..graph.gradients import GradientOp
+    fetches = [f for f in fetches
+               if not isinstance(f, (OptimizerOp, GradientOp))]
+    topo = topo_sort(fetches)
+    ctx = _Ctx()
+    names, nodes, inputs, inits = {}, [], [], []
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            nm = node.name
+            names[node] = nm
+            if node.is_variable or node in var_values:
+                val = var_values.get(node)
+                if val is None:
+                    val = np.asarray(node.get_init_value())
+                inits.append(Tensor(nm, val))
+            else:
+                shape = node.shape or (feed_shapes or {}).get(node)
+                if shape is None:
+                    raise ValueError(
+                        f"feed {node} needs a shape: pass feed_shapes")
+                dt = proto.NP2ONNX.get(np.dtype(node.dtype or np.float32),
+                                       proto.FLOAT)
+                inputs.append(ValueInfo(nm, dt, list(shape)))
+            continue
+        handler = _EXPORTERS.get(node.op_type)
+        if handler is None:
+            raise NotImplementedError(
+                f"no ONNX exporter for op {node.op_type!r}")
+        out = _n(node)
+        names[node] = out
+        ins = [names[i] for i in node.inputs]
+        nodes.extend(handler(node, ins, out, ctx))
+    outputs = [ValueInfo(names[f], proto.FLOAT,
+                         list(getattr(f, "shape", None) or []))
+               for f in fetches]
+    graph = Graph(name=name, nodes=nodes, inputs=inputs, outputs=outputs,
+                  initializers=inits + ctx.extra_inits)
+    model = Model(graph, opset=opset)
+    model.save(path)
+    return model
+
+
+__all__ = ["export", "register_exporter"]

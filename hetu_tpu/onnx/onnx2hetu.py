@@ -1,0 +1,302 @@
+"""Import an ONNX model into a hetu_tpu graph (reference ``onnx/onnx2hetu.py``).
+
+``load(path)`` → :class:`ImportedModel` with placeholder feeds per graph
+input and output graph nodes ready for an :class:`Executor`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..graph.node import Variable, placeholder_op
+from .proto import Model, ONNX2NP
+
+_IMPORTERS = {}
+
+
+def register_importer(op_type):
+    def deco(fn):
+        _IMPORTERS[op_type] = fn
+        return fn
+    return deco
+
+
+def _const_value(env, name):
+    v = env.get(name)
+    return v if isinstance(v, np.ndarray) else None
+
+
+# unary/binary direct maps
+for ox, ctor in {
+        "Relu": _ops.relu_op, "Sigmoid": _ops.sigmoid_op,
+        "Tanh": _ops.tanh_op, "Exp": _ops.exp_op, "Log": _ops.log_op,
+        "Sqrt": _ops.sqrt_op, "Abs": _ops.abs_op, "Floor": _ops.floor_op,
+        "Sin": _ops.sin_op, "Cos": _ops.cos_op, "Neg": _ops.opposite_op,
+        "Gelu": _ops.gelu_op, "Identity": lambda x: x,
+        "Flatten": _ops.flatten_op}.items():
+    _IMPORTERS[ox] = (lambda c: lambda node, ins, env: c(ins[0]))(ctor)
+
+for ox, ctor in {"Add": _ops.add_op, "Sub": _ops.minus_op,
+                 "Mul": _ops.mul_op, "Div": _ops.div_op,
+                 "Pow": _ops.pow_op, "MatMul": _ops.matmul_op}.items():
+    def _bin(node, ins, env, _c=ctor, _ox=ox):
+        a, b = ins
+        # constant operand → const-op forms where available
+        av, bv = _const_value(env, node.inputs[0]), \
+            _const_value(env, node.inputs[1])
+        if _ox in ("Add", "Sub", "Mul", "Div") and (
+                (av is not None and av.ndim == 0)
+                or (bv is not None and bv.ndim == 0)):
+            if bv is not None and bv.ndim == 0:
+                c = float(bv)
+                return {"Add": lambda: _ops.addbyconst_op(a, const_attr=c),
+                        "Sub": lambda: _ops.addbyconst_op(a, const_attr=-c),
+                        "Mul": lambda: _ops.mulbyconst_op(a, const_attr=c),
+                        "Div": lambda: _ops.mulbyconst_op(
+                            a, const_attr=1.0 / c)}[_ox]()
+            c = float(av)
+            if _ox == "Div":
+                return _ops.const_div_op(b, const_attr=c)
+            if _ox == "Sub":
+                return _ops.opposite_op(
+                    _ops.addbyconst_op(b, const_attr=-c))
+            return {"Add": lambda: _ops.addbyconst_op(b, const_attr=c),
+                    "Mul": lambda: _ops.mulbyconst_op(b, const_attr=c)}[_ox]()
+        return _c(a, b)
+    _IMPORTERS[ox] = _bin
+
+
+@register_importer("Gemm")
+def _gemm(node, ins, env):
+    a = node.attrs
+    alpha = float(a.get("alpha", 1.0))
+    beta = float(a.get("beta", 1.0))
+    out = _ops.matmul_op(ins[0], ins[1],
+                         trans_A=bool(a.get("transA")),
+                         trans_B=bool(a.get("transB")))
+    if alpha != 1.0:
+        out = _ops.mulbyconst_op(out, const_attr=alpha)
+    if len(ins) == 3 and beta != 0.0:
+        c = ins[2] if beta == 1.0 else \
+            _ops.mulbyconst_op(ins[2], const_attr=beta)
+        out = out + c
+    return out
+
+
+@register_importer("Transpose")
+def _transpose(node, ins, env):
+    return _ops.transpose_op(ins[0], perm=node.attrs.get("perm"))
+
+
+@register_importer("Reshape")
+def _reshape(node, ins, env):
+    shape = _const_value(env, node.inputs[1])
+    if shape is None:
+        raise NotImplementedError("dynamic Reshape shape unsupported")
+    return _ops.array_reshape_op(ins[0],
+                                 output_shape=tuple(int(d) for d in shape))
+
+
+@register_importer("Concat")
+def _concat(node, ins, env):
+    return _ops.concatenate_op(list(ins), axis=int(node.attrs.get("axis", 0)))
+
+
+@register_importer("Conv")
+def _conv(node, ins, env):
+    pads = node.attrs.get("pads", [0, 0, 0, 0])
+    strides = node.attrs.get("strides", [1, 1])
+    if len(ins) == 3:
+        return _ops.conv2d_add_bias_op(
+            ins[0], ins[1], ins[2], padding=(pads[0], pads[1]),
+            stride=tuple(strides))
+    return _ops.conv2d_op(ins[0], ins[1], padding=(pads[0], pads[1]),
+                          stride=tuple(strides))
+
+
+@register_importer("MaxPool")
+def _maxpool(node, ins, env):
+    k = node.attrs["kernel_shape"]
+    pads = node.attrs.get("pads", [0, 0, 0, 0])
+    strides = node.attrs.get("strides", [1, 1])
+    return _ops.max_pool2d_op(ins[0], k[0], k[1],
+                              padding=(pads[0], pads[1]),
+                              stride=tuple(strides))
+
+
+@register_importer("AveragePool")
+def _avgpool(node, ins, env):
+    k = node.attrs["kernel_shape"]
+    pads = node.attrs.get("pads", [0, 0, 0, 0])
+    strides = node.attrs.get("strides", [1, 1])
+    return _ops.avg_pool2d_op(ins[0], k[0], k[1],
+                              padding=(pads[0], pads[1]),
+                              stride=tuple(strides))
+
+
+@register_importer("Softmax")
+def _softmax(node, ins, env):
+    return _ops.softmax_op(ins[0])
+
+
+@register_importer("LogSoftmax")
+def _logsoftmax(node, ins, env):
+    return _ops.log_softmax_op(ins[0])
+
+
+@register_importer("LayerNormalization")
+def _layernorm(node, ins, env):
+    return _ops.layer_normalization_op(
+        ins[0], ins[1], ins[2], eps=float(node.attrs.get("epsilon", 1e-5)))
+
+
+@register_importer("BatchNormalization")
+def _batchnorm(node, ins, env):
+    return _ops.batch_normalization_op(
+        ins[0], ins[1], ins[2], eps=float(node.attrs.get("epsilon", 1e-5)))
+
+
+@register_importer("Gather")
+def _gather(node, ins, env):
+    return _ops.embedding_lookup_op(ins[0], ins[1])
+
+
+@register_importer("Cast")
+def _cast(node, ins, env):  # dtypes are handled inside lowerings
+    return ins[0]
+
+
+def _reduce_axes(node, env):
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        vals = _const_value(env, node.inputs[1])
+        axes = list(vals) if vals is not None else None
+    if axes is None:
+        # ONNX default = reduce over ALL axes; rank is unknown without
+        # shape propagation, so this form is unsupported here
+        raise NotImplementedError(
+            f"{node.op_type} without explicit axes (reduce-all) is "
+            "unsupported; re-export with axes")
+    return [int(a) for a in axes]
+
+
+@register_importer("ReduceMean")
+def _rmean(node, ins, env):
+    return _ops.reduce_mean_op(ins[0], _reduce_axes(node, env),
+                               keepdims=bool(node.attrs.get("keepdims")))
+
+
+@register_importer("ReduceSum")
+def _rsum(node, ins, env):
+    return _ops.reduce_sum_op(ins[0], _reduce_axes(node, env),
+                              keepdims=bool(node.attrs.get("keepdims")))
+
+
+@register_importer("Slice")
+def _slice(node, ins, env):
+    starts = _const_value(env, node.inputs[1])
+    ends = _const_value(env, node.inputs[2])
+    return _ops.slice_op(ins[0], begin=[int(s) for s in starts],
+                         end=[int(e) for e in ends])
+
+
+@register_importer("Expand")
+def _expand(node, ins, env):
+    shape = _const_value(env, node.inputs[1])
+    return _ops.broadcastto_op(
+        ins[0], output_shape=tuple(int(d) for d in shape))
+
+
+@register_importer("Unsqueeze")
+def _unsq(node, ins, env):
+    axes = node.attrs.get("axes")
+    if axes is None:
+        axes = list(_const_value(env, node.inputs[1]))
+    return _ops.unsqueeze_op(ins[0], axis=int(axes[0]))
+
+
+@register_importer("Squeeze")
+def _sq(node, ins, env):
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = list(_const_value(env, node.inputs[1]))
+    return _ops.squeeze_op(ins[0], axis=int(axes[0]) if axes else None)
+
+
+@register_importer("Where")
+def _where(node, ins, env):
+    return _ops.where_op(ins[0], ins[1], ins[2])
+
+
+@register_importer("SoftmaxCrossEntropyLoss")
+def _scel(node, ins, env):
+    out = _ops.softmaxcrossentropy_sparse_op(ins[0], ins[1])
+    if node.attrs.get("reduction", "mean") == "mean":
+        out = _ops.reduce_mean_op(out, [0])
+    return out
+
+
+class ImportedModel:
+    """Result of :func:`load`: feeds (name → placeholder), outputs, params."""
+
+    def __init__(self, feeds, outputs, params):
+        self.feeds = feeds
+        self.outputs = outputs
+        self.params = params
+
+    def executor(self, **kw):
+        from ..graph.executor import Executor
+        return Executor({"default": list(self.outputs)}, **kw)
+
+
+def load(path):
+    model = Model.load(path)
+    g = model.graph
+    env = {}     # name -> graph node | np.ndarray (constants)
+    params = {}
+    for t in g.initializers:
+        env[t.name] = t.array
+    feeds = {}
+    init_names = {t.name for t in g.initializers}
+    for vi in g.inputs:
+        if vi.name in init_names:
+            continue
+        dt = ONNX2NP.get(vi.dtype, np.dtype(np.float32))
+        shape = tuple(d if isinstance(d, int) else None for d in vi.shape)
+        feeds[vi.name] = placeholder_op(
+            vi.name, dtype=dt,
+            shape=shape if all(d is not None for d in shape) else None)
+        env[vi.name] = feeds[vi.name]
+
+    def as_node(name):
+        v = env[name]
+        if isinstance(v, np.ndarray):
+            var = Variable(name, value=v, trainable=True)
+            params[name] = var
+            env[name] = var
+            return var
+        return v
+
+    for node in g.nodes:
+        handler = _IMPORTERS.get(node.op_type)
+        if handler is None:
+            raise NotImplementedError(
+                f"no importer for ONNX op {node.op_type!r}")
+        # Cast/shape-consuming handlers read raw constants via env; regular
+        # inputs become graph nodes lazily
+        ins = []
+        for i, iname in enumerate(node.inputs):
+            v = env[iname]
+            if isinstance(v, np.ndarray) and node.op_type in (
+                    "Reshape", "Expand", "Slice", "ReduceMean", "ReduceSum",
+                    "Unsqueeze", "Squeeze") and i >= 1:
+                ins.append(v)  # shape-like constant consumed host-side
+            else:
+                ins.append(as_node(iname))
+        out = handler(node, ins, env)
+        env[node.outputs[0]] = out
+    outputs = [env[vi.name] for vi in g.outputs]
+    return ImportedModel(feeds, outputs, params)
+
+
+__all__ = ["load", "register_importer", "ImportedModel"]

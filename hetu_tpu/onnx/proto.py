@@ -1,0 +1,441 @@
+"""Minimal protobuf wire-format codec for the ONNX schema subset.
+
+The environment has no ``onnx`` package, so this module hand-encodes the
+ONNX ``ModelProto`` family directly in protobuf wire format (varint /
+length-delimited fields per the public onnx.proto3 schema). Files written
+here open in onnxruntime / Netron; files from other exporters parse back.
+
+Reference capability: ``python/hetu/onnx/`` (hetu2onnx.py:27, onnx2hetu.py).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# -- wire primitives ---------------------------------------------------------
+
+
+def _varint(n):
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field, value):
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field, s):
+    return _len_field(field, s.encode("utf-8") if isinstance(s, str) else s)
+
+
+def _packed_floats(field, vals):
+    return _len_field(field, struct.pack(f"<{len(vals)}f", *vals))
+
+
+def _packed_int64s(field, vals):
+    return _len_field(field, b"".join(_varint(v) for v in vals))
+
+
+class _Reader:
+    def __init__(self, data):
+        self.d = data
+        self.p = 0
+
+    def eof(self):
+        return self.p >= len(self.d)
+
+    def varint(self):
+        shift = result = 0
+        while True:
+            b = self.d[self.p]
+            self.p += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def svarint(self):
+        v = self.varint()
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    def field(self):
+        key = self.varint()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            return field, self.svarint()
+        if wire == 2:
+            n = self.varint()
+            out = self.d[self.p:self.p + n]
+            self.p += n
+            return field, out
+        if wire == 5:
+            out = struct.unpack("<f", self.d[self.p:self.p + 4])[0]
+            self.p += 4
+            return field, out
+        if wire == 1:
+            out = struct.unpack("<d", self.d[self.p:self.p + 8])[0]
+            self.p += 8
+            return field, out
+        raise ValueError(f"unsupported wire type {wire}")
+
+
+# -- ONNX dtypes -------------------------------------------------------------
+
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = \
+    1, 2, 3, 6, 7, 9, 10, 11
+BFLOAT16 = 16
+
+NP2ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.float64): DOUBLE,
+           np.dtype(np.int32): INT32, np.dtype(np.int64): INT64,
+           np.dtype(np.bool_): BOOL, np.dtype(np.float16): FLOAT16,
+           np.dtype(np.uint8): UINT8, np.dtype(np.int8): INT8}
+ONNX2NP = {v: k for k, v in NP2ONNX.items()}
+
+
+# -- message classes ---------------------------------------------------------
+
+
+class Tensor:
+    """TensorProto: named constant data (initializers)."""
+
+    def __init__(self, name, array):
+        self.name = name
+        self.array = np.asarray(array)
+
+    def encode(self):
+        a = self.array
+        dt = NP2ONNX.get(a.dtype)
+        if dt is None:
+            a = a.astype(np.float32)
+            dt = FLOAT
+        out = b"".join(_int_field(1, int(d)) for d in a.shape)
+        out += _int_field(2, dt)
+        out += _str_field(8, self.name)
+        out += _len_field(9, a.tobytes())       # raw_data
+        return out
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        dims, dtype, name = [], FLOAT, ""
+        raw = None
+        floats, int64s, int32s = [], [], []
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                dims.append(v)
+            elif f == 2:
+                dtype = v
+            elif f == 8:
+                name = v.decode("utf-8")
+            elif f == 9:
+                raw = v
+            elif f == 4:  # packed float_data
+                floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            elif f == 7:  # packed int64_data
+                rr = _Reader(v)
+                while not rr.eof():
+                    int64s.append(rr.svarint())
+            elif f == 5:  # packed int32_data
+                rr = _Reader(v)
+                while not rr.eof():
+                    int32s.append(rr.svarint())
+        np_dt = ONNX2NP.get(dtype, np.dtype(np.float32))
+        if raw is not None:
+            arr = np.frombuffer(raw, np_dt).reshape(dims)
+        elif floats:
+            arr = np.asarray(floats, np_dt).reshape(dims)
+        elif int64s:
+            arr = np.asarray(int64s, np_dt).reshape(dims)
+        elif int32s:
+            arr = np.asarray(int32s, np_dt).reshape(dims)
+        else:
+            arr = np.zeros(dims, np_dt)
+        return cls(name, arr)
+
+
+class Attribute:
+    """AttributeProto: name + one typed payload."""
+    FLOAT_T, INT_T, STRING_T, TENSOR_T, FLOATS_T, INTS_T, STRINGS_T = \
+        1, 2, 3, 4, 6, 7, 8
+
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def encode(self):
+        out = _str_field(1, self.name)
+        v = self.value
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(v, float):
+            out += _tag(2, 5) + struct.pack("<f", v)
+            out += _int_field(20, self.FLOAT_T)
+        elif isinstance(v, int):
+            out += _int_field(3, v)
+            out += _int_field(20, self.INT_T)
+        elif isinstance(v, str):
+            out += _str_field(4, v)
+            out += _int_field(20, self.STRING_T)
+        elif isinstance(v, Tensor):
+            out += _len_field(5, v.encode())
+            out += _int_field(20, self.TENSOR_T)
+        elif isinstance(v, (list, tuple)) and v and \
+                isinstance(v[0], float):
+            out += _packed_floats(7, list(v))
+            out += _int_field(20, self.FLOATS_T)
+        elif isinstance(v, (list, tuple)):
+            out += _packed_int64s(8, [int(x) for x in v])
+            out += _int_field(20, self.INTS_T)
+        else:
+            raise TypeError(f"unsupported attribute {self.name}={v!r}")
+        return out
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        name, atype = "", None
+        f_v = i_v = s_v = t_v = None
+        floats, ints = [], []
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                name = v.decode("utf-8")
+            elif f == 2:
+                f_v = v
+            elif f == 3:
+                i_v = v
+            elif f == 4:
+                s_v = v.decode("utf-8")
+            elif f == 5:
+                t_v = Tensor.decode(v)
+            elif f == 7:
+                floats = list(struct.unpack(f"<{len(v) // 4}f", v))
+            elif f == 8:
+                rr = _Reader(v)
+                while not rr.eof():
+                    ints.append(rr.svarint())
+            elif f == 20:
+                atype = v
+        if atype == cls.FLOAT_T:
+            return cls(name, f_v)
+        if atype == cls.INT_T:
+            return cls(name, i_v)
+        if atype == cls.STRING_T:
+            return cls(name, s_v)
+        if atype == cls.TENSOR_T:
+            return cls(name, t_v)
+        if atype == cls.FLOATS_T:
+            return cls(name, floats)
+        if atype == cls.INTS_T:
+            return cls(name, ints)
+        # untyped: best effort by presence
+        for v in (i_v, f_v, s_v, t_v, ints or None, floats or None):
+            if v is not None:
+                return cls(name, v)
+        return cls(name, None)
+
+
+class Node:
+    """NodeProto."""
+
+    def __init__(self, op_type, inputs, outputs, name="", **attrs):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name
+        self.attrs = dict(attrs)
+
+    def encode(self):
+        out = b"".join(_str_field(1, s) for s in self.inputs)
+        out += b"".join(_str_field(2, s) for s in self.outputs)
+        out += _str_field(3, self.name or f"{self.op_type}_node")
+        out += _str_field(4, self.op_type)
+        for k in sorted(self.attrs):
+            out += _len_field(5, Attribute(k, self.attrs[k]).encode())
+        return out
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        ins, outs, name, op = [], [], "", ""
+        attrs = {}
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                ins.append(v.decode("utf-8"))
+            elif f == 2:
+                outs.append(v.decode("utf-8"))
+            elif f == 3:
+                name = v.decode("utf-8")
+            elif f == 4:
+                op = v.decode("utf-8")
+            elif f == 5:
+                a = Attribute.decode(v)
+                attrs[a.name] = a.value
+        return cls(op, ins, outs, name, **attrs)
+
+
+class ValueInfo:
+    """ValueInfoProto: name + tensor type (elem type, static shape)."""
+
+    def __init__(self, name, dtype, shape):
+        self.name = name
+        self.dtype = dtype  # onnx enum
+        self.shape = list(shape)
+
+    def encode(self):
+        dims = b""
+        for d in self.shape:
+            if isinstance(d, str):
+                dim = _str_field(2, d)      # dim_param
+            else:
+                dim = _int_field(1, int(d))  # dim_value
+            dims += _len_field(1, dim)
+        shape_proto = dims
+        tensor_t = _int_field(1, self.dtype) + _len_field(2, shape_proto)
+        type_proto = _len_field(1, tensor_t)
+        return _str_field(1, self.name) + _len_field(2, type_proto)
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        name, dtype, shape = "", FLOAT, []
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                name = v.decode("utf-8")
+            elif f == 2:  # TypeProto
+                tr = _Reader(v)
+                while not tr.eof():
+                    tf, tv = tr.field()
+                    if tf == 1:  # tensor_type
+                        ttr = _Reader(tv)
+                        while not ttr.eof():
+                            ttf, ttv = ttr.field()
+                            if ttf == 1:
+                                dtype = ttv
+                            elif ttf == 2:  # TensorShapeProto
+                                sr = _Reader(ttv)
+                                while not sr.eof():
+                                    sf, sv = sr.field()
+                                    if sf == 1:  # Dimension
+                                        dr = _Reader(sv)
+                                        dim = None
+                                        while not dr.eof():
+                                            df, dv = dr.field()
+                                            if df == 1:
+                                                dim = dv
+                                            elif df == 2:
+                                                dim = dv.decode("utf-8")
+                                        shape.append(dim)
+        return cls(name, dtype, shape)
+
+
+class Graph:
+    """GraphProto."""
+
+    def __init__(self, name="graph", nodes=(), inputs=(), outputs=(),
+                 initializers=()):
+        self.name = name
+        self.nodes = list(nodes)
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.initializers = list(initializers)
+
+    def encode(self):
+        out = b"".join(_len_field(1, n.encode()) for n in self.nodes)
+        out += _str_field(2, self.name)
+        out += b"".join(_len_field(5, t.encode())
+                        for t in self.initializers)
+        out += b"".join(_len_field(11, vi.encode()) for vi in self.inputs)
+        out += b"".join(_len_field(12, vi.encode()) for vi in self.outputs)
+        return out
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        g = cls()
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                g.nodes.append(Node.decode(v))
+            elif f == 2:
+                g.name = v.decode("utf-8")
+            elif f == 5:
+                g.initializers.append(Tensor.decode(v))
+            elif f == 11:
+                g.inputs.append(ValueInfo.decode(v))
+            elif f == 12:
+                g.outputs.append(ValueInfo.decode(v))
+        return g
+
+
+class Model:
+    """ModelProto with a default opset import."""
+
+    def __init__(self, graph, ir_version=9, opset=17,
+                 producer="hetu_tpu"):
+        self.graph = graph
+        self.ir_version = ir_version
+        self.opset = opset
+        self.producer = producer
+
+    def encode(self):
+        opset = _str_field(1, "") + _int_field(2, self.opset)
+        out = _int_field(1, self.ir_version)
+        out += _str_field(2, self.producer)
+        out += _len_field(7, self.graph.encode())
+        out += _len_field(8, opset)
+        return out
+
+    @classmethod
+    def decode(cls, data):
+        r = _Reader(data)
+        graph, ir, opset, producer = None, 8, 17, ""
+        while not r.eof():
+            f, v = r.field()
+            if f == 1:
+                ir = v
+            elif f == 2:
+                producer = v.decode("utf-8")
+            elif f == 7:
+                graph = Graph.decode(v)
+            elif f == 8:
+                rr = _Reader(v)
+                while not rr.eof():
+                    ff, vv = rr.field()
+                    if ff == 2:
+                        opset = vv
+        m = cls(graph, ir, opset, producer)
+        return m
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            f.write(self.encode())
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls.decode(f.read())
+
+
+__all__ = ["Model", "Graph", "Node", "Tensor", "Attribute", "ValueInfo",
+           "NP2ONNX", "ONNX2NP", "FLOAT", "INT32", "INT64"]

@@ -110,6 +110,26 @@ def test_plan_emission_and_execution():
     assert np.isfinite(vals).all() and vals[-1] < vals[0]
 
 
+def test_mixed_plan_mesh_overflow_raises():
+    from hetu_tpu.autoparallel.plan import ParallelPlan
+    specs = [transformer_layer_spec(256, 64, 8, name=f"l{i}")
+             for i in range(2)]
+    plan = ParallelPlan(specs, [Strategy(4, 1, 2), Strategy(1, 4, 2)], 8)
+    with pytest.raises(ValueError, match="uniform"):
+        plan.mesh_axes()
+
+
+def test_layer_specs_expand_by_count():
+    specs = [transformer_layer_spec(256, 64, 8, name="blk", count=24)]
+    plan = search(specs, 8, hw=HardwareSpec(mem_bytes=64e9))
+    directives = plan.layer_specs()
+    assert len(directives) == 24
+    assert directives[0]["name"] == "blk.0"
+    pp = max(s.pp for s in plan.strategies)
+    stages = {d["stage"] for d in directives}
+    assert stages == set(range(pp))  # blocks spread over all stages
+
+
 def test_describe_is_readable():
     specs = [transformer_layer_spec(256, 64, 8, name="blk", count=4)]
     plan = search(specs, 8, hw=HardwareSpec(mem_bytes=64e9))
