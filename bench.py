@@ -12,12 +12,25 @@ import time
 import numpy as np
 
 
+def _sync(outs):
+    """Force completion: remote platforms (axon tunnel) do not honor
+    block_until_ready/wait, so read one element back to host — training
+    steps chain through the params, so this syncs every dispatched step."""
+    for o in outs:
+        if o is None:
+            continue
+        arr = o.jax() if hasattr(o, "jax") else o
+        if getattr(arr, "ndim", 0):
+            arr = arr.ravel()[0]
+        np.asarray(arr)
+
+
 def _params_count(ex):
     return int(sum(np.prod(v.shape) for n, v in ex.var_values.items()
                    if n.trainable))
 
 
-def bench_bert(batch_size=32, seq_len=128, steps=20, warmup=3):
+def bench_bert(batch_size=192, seq_len=128, steps=20, warmup=3):
     import jax
     import hetu_tpu as ht
     from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,
@@ -26,18 +39,21 @@ def bench_bert(batch_size=32, seq_len=128, steps=20, warmup=3):
     cfg = BertConfig.base(batch_size=batch_size, seq_len=seq_len)
     feeds, loss, logits = bert_pretrain_graph(cfg)
     opt = ht.optim.AdamOptimizer(1e-4)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     compute_dtype="bfloat16")
     ids, tt, labels = synthetic_mlm_batch(cfg)
-    fd = {feeds["input_ids"]: ids, feeds["token_type_ids"]: tt,
-          feeds["masked_lm_labels"]: labels}
+    import jax as _jax  # pre-place feeds on device once: the bench measures
+    fd = {feeds["input_ids"]: _jax.device_put(np.asarray(ids, np.float32)),
+          feeds["token_type_ids"]: _jax.device_put(np.asarray(tt, np.float32)),
+          feeds["masked_lm_labels"]: _jax.device_put(np.asarray(labels, np.float32))}
 
     for _ in range(warmup):
         out = ex.run("train", feed_dict=fd)
-    out[0].wait()
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = ex.run("train", feed_dict=fd)
-    out[0].wait()
+    _sync(out)
     dt = (time.perf_counter() - t0) / steps
 
     n_params = _params_count(ex)
@@ -74,18 +90,19 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
     x = ht.placeholder_op("x", shape=(batch_size, 3, 32, 32))
     y_ = ht.placeholder_op("y", shape=(batch_size, 10))
     loss, y = models.resnet18(x, y_)
-    ex = ht.Executor({"train": [loss, ht.optim.MomentumOptimizer(0.1).minimize(loss)]})
+    ex = ht.Executor({"train": [loss, ht.optim.MomentumOptimizer(0.1).minimize(loss)]},
+                     compute_dtype="bfloat16")
     rng = np.random.RandomState(0)
     xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
     yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
-    fd = {x: xv, y_: yv}
+    fd = {x: jax.device_put(xv), y_: jax.device_put(yv)}  # on-device feeds
     for _ in range(warmup):
         out = ex.run("train", feed_dict=fd)
-    out[0].wait()
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = ex.run("train", feed_dict=fd)
-    out[0].wait()
+    _sync(out)
     dt = (time.perf_counter() - t0) / steps
     return {
         "metric": "resnet18_cifar10_step_time",
@@ -104,7 +121,7 @@ if __name__ == "__main__":
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args()
     if args.config == "bert":
-        res = bench_bert(batch_size=args.batch_size or 32, steps=args.steps)
+        res = bench_bert(batch_size=args.batch_size or 192, steps=args.steps)
     else:
         res = bench_resnet18(batch_size=args.batch_size or 128,
                              steps=args.steps)

@@ -136,9 +136,37 @@ class SubExecutor:
 
         ps_keys = [_key(n) for n in self.ps_nodes]
 
+        from contextlib import nullcontext
+
+        def _precision_scope():
+            prec = self.ex.matmul_precision
+            return jax.default_matmul_precision(prec) if prec \
+                else nullcontext()
+
+        import jax.numpy as jnp
+
+        def _cast_tree(tree, dt, src=None):
+            src_dt = jnp.dtype(src) if src else jnp.float32
+            def cast(x):
+                if hasattr(x, "dtype") and x.dtype == src_dt:
+                    return x.astype(dt)
+                return x
+            return jax.tree.map(cast, tree)
+
         def step(tparams, sparams, opt_states, feeds, key, lrs):
+            with _precision_scope():
+                return _step_inner(tparams, sparams, opt_states, feeds,
+                                   key, lrs)
+
+        def _step_inner(tparams, sparams, opt_states, feeds, key, lrs):
+            cd = self.ex.compute_dtype
+            if cd:  # mixed precision: bf16 inside the step, fp32 masters out
+                sparams = _cast_tree(sparams, cd)
+                feeds = _cast_tree(feeds, cd)
             if self.grad_ops:
                 def loss_fn(tp, fd, sp, k):
+                    if cd:
+                        tp = _cast_tree(tp, cd)
                     env, updates = self._forward(tp, sp, fd, k)
                     aux_vals = [None if f is None or f in self.opt_ops
                                 or isinstance(f, GradientOp)
@@ -174,9 +202,17 @@ class SubExecutor:
                         outs.append(grads[_key(f.wrt)])
                     else:
                         outs.append(a)
+                if cd:  # fetched values & state updates leave in fp32
+                    outs = _cast_tree(outs, jnp.float32, src=cd)
+                    updates = _cast_tree(updates, jnp.float32, src=cd)
                 return outs, new_tparams, updates, new_opt_states
-            env, updates = self._forward(tparams, sparams, feeds, key)
+            env, updates = self._forward(
+                _cast_tree(tparams, cd) if cd else tparams,
+                sparams, feeds, key)
             outs = [None if f is None else env[f] for f in fetch_nodes]
+            if cd:
+                outs = _cast_tree(outs, jnp.float32, src=cd)
+                updates = _cast_tree(updates, jnp.float32, src=cd)
             return outs, tparams, updates, opt_states
 
         # donate params & optimizer state: lets XLA update weights in place
@@ -377,12 +413,19 @@ class Executor:
 
     def __init__(self, eval_node_dict, ctx=None, seed=None, dist_strategy=None,
                  mesh=None, comm_mode=None, pipeline=None, num_microbatches=None,
-                 **kwargs):
+                 matmul_precision=None, **kwargs):
         import jax
         if isinstance(eval_node_dict, dict):
             self.eval_node_dict = dict(eval_node_dict)
         else:
             self.eval_node_dict = {"default": list(eval_node_dict)}
+        # 'bfloat16' runs fp32 matmuls as single-pass bf16 on the MXU (the
+        # TPU mixed-precision fast path); None keeps jax's default
+        self.matmul_precision = matmul_precision
+        # compute_dtype='bfloat16': cast float params/feeds to bf16 inside
+        # the step (fp32 master weights + optimizer state stay outside) —
+        # halves HBM traffic for the bandwidth-bound elementwise ops
+        self.compute_dtype = kwargs.pop("compute_dtype", None)
         self.seed = 0 if seed is None else int(seed)
         self.master_key = jax.random.key(self.seed)
         self.step_counter = 0

@@ -67,10 +67,12 @@ def _pair(v):
 def _conv2d(c, x, w, padding=0, stride=1):
     ph, pw = _pair(padding)
     sh, sw = _pair(stride)
+    # no preferred_element_type: the TPU MXU accumulates in f32 regardless,
+    # and requesting f32 output breaks the conv transpose rule under bf16
+    # mixed precision (f32 cotangent vs bf16 residual)
     return jax.lax.conv_general_dilated(
         x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
 
 
 def _conv2d_shape(x, w, padding=0, stride=1):
