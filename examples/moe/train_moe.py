@@ -1,0 +1,94 @@
+"""MoE transformer-block training with every gate family.
+
+Reference parity: ``examples/moe/test_moe_{base,top,hash,ktop1,sam}.py``
+(single script, --gate flag). Runs EP-sharded when devices allow:
+``python examples/moe/train_moe.py --gate top2 --ep 4``.
+"""
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu.layers import (Expert, KTop1Gate, MoELayer, SAMGate,  # noqa
+                             TopKGate)
+from hetu_tpu.layers.gates import BalanceAssignmentGate, HashGate  # noqa
+from hetu_tpu.layers.moe_layer import BalancedMoELayer  # noqa
+
+
+class _HashGateAdapter:
+    """HashGate routes on token IDS (reference HashGate.py), not embeddings;
+    adapt it to the MoELayer gate(x) calling convention."""
+
+    def __init__(self, gate, ids_node):
+        self.gate = gate
+        self.ids_node = ids_node
+
+    def __call__(self, x):
+        return self.gate(self.ids_node)
+
+
+def build_gate(kind, d, tokens, experts, ids_node=None):
+    if kind == "base":  # BASE layer: balanced assignment (auction)
+        return BalanceAssignmentGate(d, tokens, experts)
+    if kind == "top1":
+        return TopKGate(d, tokens, experts, k=1, capacity_factor=1.5)
+    if kind == "top2":
+        return TopKGate(d, tokens, experts, k=2, capacity_factor=2.0)
+    if kind == "hash":
+        return _HashGateAdapter(
+            HashGate(tokens, experts, capacity_factor=2.0), ids_node)
+    if kind == "ktop1":
+        return KTop1Gate(d, tokens, experts, k=2, capacity_factor=2.0)
+    if kind == "sam":
+        return SAMGate(d, tokens, experts, k=1, capacity_factor=4.0,
+                       num_local_devices=2)
+    raise ValueError(kind)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--gate", default="top2",
+                   choices=["base", "top1", "top2", "hash", "ktop1", "sam"])
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel width (mesh 'ep' axis)")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--dim", type=int, default=32)
+    p.add_argument("--tokens", type=int, default=256)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    d, tokens, e = args.dim, args.tokens, args.experts
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    ids_node = ht.Variable("token_ids",
+                           value=(np.arange(tokens) % 97).astype(np.int32),
+                           trainable=False)
+    gate = build_gate(args.gate, d, tokens, e, ids_node=ids_node)
+    if args.gate == "base":
+        moe = BalancedMoELayer(gate, Expert(e, d, 2 * d), e, tokens, d)
+    else:
+        moe = MoELayer(gate, Expert(e, d, 2 * d))
+    h, aux = moe(x)
+    from hetu_tpu.layers import Linear
+    logits = Linear(d, 8, name="head")(h)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    if aux is not None:
+        loss = loss + aux * 0.01
+    strategy = ht.dist.ModelParallel({"ep": args.ep}) if args.ep > 1 else None
+    ex = ht.Executor({"train": [loss,
+                                ht.optim.AdamOptimizer(1e-3).minimize(loss)]},
+                     dist_strategy=strategy, seed=0)
+    x_np = rng.randn(tokens, d).astype(np.float32)
+    y_np = np.argmax(x_np[:, :8], axis=-1).astype(np.int32)
+    for step in range(args.steps):
+        out = ex.run("train", feed_dict={x: x_np, y: y_np})
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={float(out[0].asnumpy()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,11 @@ from . import tokenizers
 from .profiler import HetuProfiler, CollectiveProfiler
 from . import autoparallel
 from . import onnx
+from . import gnn
+from . import graphboard
+from . import launcher
+from .gnn import csrmm_op, csrmv_op, gcn_aggregate_op
+from .launcher import init_distributed
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)

@@ -193,15 +193,25 @@ def make_mesh(axis_sizes=None, devices=None):
     if axis_sizes is None:
         axis_sizes = {"dp": n}
     names, sizes = [], []
-    for ax in MESH_AXES:
+    # canonical axes first (stable order), then any custom axes
+    # (e.g. 'row' for the 1.5D GCN partition)
+    ordered = [ax for ax in MESH_AXES if ax in axis_sizes] + \
+        [ax for ax in axis_sizes if ax not in MESH_AXES]
+    for ax in ordered:
         s = int(axis_sizes.get(ax, 1))
-        if s > 1 or (ax in axis_sizes and s == 1):
+        if s >= 1:
             names.append(ax)
             sizes.append(s)
     total = int(np.prod(sizes)) if sizes else 1
-    if total != n:
+    if total > n:
         raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} "
                          f"devices, got {n}")
+    if total < n:  # use a subset (reference DeviceGroup picks GPUs the same way)
+        import warnings
+        warnings.warn(
+            f"mesh axes {dict(zip(names, sizes))} use {total} of {n} "
+            f"devices; {n - total} devices are left idle")
+        devices = list(devices)[:total]
     dev_array = np.asarray(devices).reshape(sizes if sizes else (1,))
     return Mesh(dev_array, tuple(names) if names else ("dp",))
 

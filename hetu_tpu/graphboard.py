@@ -1,0 +1,83 @@
+"""Dataflow-graph visualization (reference ``python/graphboard/graph2fig.py``).
+
+``to_dot(fetches)`` emits Graphviz DOT text; ``graph2fig(fetches, path)``
+renders a layered matplotlib figure (no graphviz dependency needed).
+"""
+from __future__ import annotations
+
+from .graph.node import PlaceholderOp, topo_sort
+
+
+def _label(node):
+    if isinstance(node, PlaceholderOp):
+        kind = "var" if node.is_variable else "feed"
+        return f"{node.name}\\n[{kind}]"
+    return f"{node.op_type}\\n{node.name}"
+
+
+def to_dot(fetches, name="hetu_graph"):
+    """Graphviz DOT text for the graph reaching ``fetches``."""
+    topo = topo_sort([f for f in fetches if f is not None])
+    lines = [f"digraph {name} {{", "  rankdir=TB;"]
+    for n in topo:
+        shape = "box" if isinstance(n, PlaceholderOp) else "ellipse"
+        lines.append(f'  n{n.id} [label="{_label(n)}" shape={shape}];')
+    for n in topo:
+        for i in n.inputs:
+            lines.append(f"  n{i.id} -> n{n.id};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _layers(topo):
+    depth = {}
+    for n in topo:
+        depth[n] = 1 + max((depth[i] for i in n.inputs), default=-1)
+    layers = {}
+    for n, d in depth.items():
+        layers.setdefault(d, []).append(n)
+    return layers
+
+
+def graph2fig(fetches, path=None, figsize=None):
+    """Render the graph as a layered figure; save to ``path`` if given."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    topo = topo_sort([f for f in fetches if f is not None])
+    layers = _layers(topo)
+    pos = {}
+    for d, nodes in layers.items():
+        for i, n in enumerate(sorted(nodes, key=lambda x: x.id)):
+            pos[n] = (i - (len(nodes) - 1) / 2.0, -d)
+    depth = len(layers)
+    width = max(len(v) for v in layers.values())
+    fig, ax = plt.subplots(
+        figsize=figsize or (max(6, width * 2.2), max(4, depth * 0.9)))
+    for n in topo:
+        x, y = pos[n]
+        for i in n.inputs:
+            xi, yi = pos[i]
+            ax.annotate("", xy=(x, y + 0.18), xytext=(xi, yi - 0.18),
+                        arrowprops=dict(arrowstyle="->", lw=0.7,
+                                        color="#888888"))
+    for n in topo:
+        x, y = pos[n]
+        is_ph = isinstance(n, PlaceholderOp)
+        ax.text(x, y, _label(n).replace("\\n", "\n"),
+                ha="center", va="center", fontsize=7,
+                bbox=dict(boxstyle="round,pad=0.3" if not is_ph
+                          else "square,pad=0.3",
+                          fc="#cfe3ff" if is_ph else "#e8f5e9",
+                          ec="#555555", lw=0.6))
+    ax.set_axis_off()
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+        plt.close(fig)
+        return path
+    return fig
+
+
+__all__ = ["to_dot", "graph2fig"]

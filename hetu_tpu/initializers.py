@@ -75,6 +75,25 @@ class TruncatedNormalInit(BaseInit):
             key, -2.0, 2.0, shape)
 
 
+class OrthogonalInit(BaseInit):
+    """Orthogonal init (QR of a normal matrix) — the canonical recurrent
+    w_hh initializer (Saxe et al.)."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def init(self, shape, key):
+        import jax
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = np.linalg.qr(np.asarray(a))
+        q = q * np.sign(np.diag(r))  # deterministic sign convention
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape) \
+            .astype(np.float32)
+
+
 def _fans(shape, mode):
     shape = tuple(shape)
     if len(shape) == 2:
@@ -143,6 +162,10 @@ class LecunNormalInit(GeneralXavierNormalInit):
 
 def _make(init, shape, name, trainable, is_embed=False):
     return init(shape, name=name, trainable=trainable, is_embed=is_embed)
+
+
+def orthogonal(shape, gain=1.0, name=None, trainable=True, ctx=None):
+    return _make(OrthogonalInit(gain), shape, name, trainable)
 
 
 def zeros(shape, name=None, trainable=True, ctx=None):

@@ -1,0 +1,116 @@
+"""Cluster launcher (reference ``bin/heturun`` → ``python/runner.py:150-255``).
+
+The reference forks PS scheduler/server processes and mpirun's one worker
+per GPU over SSH. On TPU the runtime owns topology: every host in a pod
+slice runs the SAME program and ``jax.distributed.initialize`` wires the
+mesh over ICI/DCN. So the launcher's job shrinks to:
+
+* single host: exec the script (optionally with a virtual device count);
+* multi host: spawn one process per host over ssh with
+  ``coordinator/process_id/num_processes`` env, or export the settings for
+  an external scheduler (GKE/xmanager-style);
+* in-process: :func:`init_distributed` for scripts that want the reference's
+  ``worker_init()`` call-shape.
+
+CLI: ``python -m hetu_tpu.launcher -c cluster.yml train.py [args...]``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from .context import DistConfig
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Initialize multi-host JAX (the reference's worker_init + MPI_Init).
+
+    No-ops on a single host so scripts are portable (reference scripts call
+    ``ht.worker_init()`` unconditionally, launcher.py:41-57).
+    """
+    import jax
+    if num_processes is None:
+        num_processes = int(os.environ.get("HETU_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator
+        or os.environ.get("HETU_COORDINATOR", "localhost:8476"),
+        num_processes=num_processes,
+        process_id=process_id
+        if process_id is not None
+        else int(os.environ.get("HETU_PROCESS_ID", "0")))
+
+
+def _host_env(config, rank, coordinator_port=8476):
+    env = dict(os.environ)
+    env["HETU_COORDINATOR"] = f"{config.chief}:{coordinator_port}"
+    env["HETU_NUM_PROCESSES"] = str(config.num_hosts)
+    env["HETU_PROCESS_ID"] = str(rank)
+    return env
+
+
+def launch(config, script, script_args=(), local_devices=None, ssh=True):
+    """Run ``script`` on every host in the cluster config.
+
+    Local host runs in-process-group (inherits stdio); remote hosts via
+    ``ssh host python script`` with the coordination env exported on the
+    command line (the reference pushes env the same way, runner.py:203-255).
+    Returns the list of Popen handles.
+    """
+    procs = []
+    for rank, host in enumerate(config.hosts):
+        env = _host_env(config, rank)
+        if local_devices:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{local_devices}").strip()
+        cmd = [sys.executable, script, *script_args]
+        if host in ("localhost", "127.0.0.1") or not ssh:
+            procs.append(subprocess.Popen(cmd, env=env))
+        else:
+            import shlex
+            exports = " ".join(
+                f"{k}={shlex.quote(env[k])}" for k in
+                ("HETU_COORDINATOR", "HETU_NUM_PROCESSES",
+                 "HETU_PROCESS_ID", "XLA_FLAGS") if env.get(k))
+            remote_cmd = " ".join(shlex.quote(a) for a in cmd)
+            procs.append(subprocess.Popen(
+                ["ssh", host,
+                 f"cd {shlex.quote(os.getcwd())} && {exports} {remote_cmd}"]))
+    return procs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="heturun", description="hetu_tpu cluster launcher")
+    p.add_argument("-c", "--config", default=None,
+                   help="cluster yaml (reference DistConfig format)")
+    p.add_argument("-n", "--num-hosts", type=int, default=None,
+                   help="override host count (localhost processes)")
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="virtual device count per process (CPU testing)")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="spawn all ranks locally (simulation)")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    if args.config:
+        config = DistConfig(file=args.config)
+    else:
+        n = args.num_hosts or 1
+        config = DistConfig(num_hosts=n, hosts=["localhost"] * n)
+    procs = launch(config, args.script, args.script_args,
+                   local_devices=args.local_devices,
+                   ssh=not args.no_ssh)
+    rc = 0
+    for pr in procs:
+        rc = pr.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

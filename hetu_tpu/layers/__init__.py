@@ -3,7 +3,8 @@ classes over the op library; MoE layers/gates live in ``moe_layer.py``."""
 from .base import BaseLayer
 from .core import (Linear, Conv2d, BatchNorm, LayerNorm, Embedding, DropOut,
                    MaxPool2d, AvgPool2d, Relu, Reshape, Identity, Sequence,
-                   Concatenate, ConcatenateLayers, SumLayers, Slice)
+                   Concatenate, ConcatenateLayers, SumLayers, Slice,
+                   RNN, LSTM, GRU)
 from .moe_layer import Expert, MoELayer
 from .gates import TopKGate, HashGate, KTop1Gate, SAMGate, BalanceAssignmentGate
 from .attention import MultiHeadAttention

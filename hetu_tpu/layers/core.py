@@ -230,3 +230,54 @@ class Slice(BaseLayer):
 
     def __call__(self, x):
         return ops.slice_op(x, begin=self.begin, size=self.size)
+
+
+class RNN(BaseLayer):
+    """Vanilla RNN layer over (batch, time, features) via one scanned loop."""
+
+    def __init__(self, in_dim, hidden, activation="tanh", name="rnn"):
+        from .. import initializers as init
+        from ..ops.rnn import rnn_op
+        self._op = rnn_op
+        self.activation = activation
+        self.w_ih = init.xavier_uniform((in_dim, hidden), name=f"{name}.w_ih")
+        self.w_hh = init.orthogonal((hidden, hidden), name=f"{name}.w_hh")
+        self.b = init.zeros((hidden,), name=f"{name}.b")
+
+    def __call__(self, x):
+        return self._op(x, self.w_ih, self.w_hh, self.b,
+                        activation=self.activation)
+
+
+class LSTM(BaseLayer):
+    """LSTM layer (i,f,g,o gates packed 4H) scanned over time."""
+
+    def __init__(self, in_dim, hidden, name="lstm"):
+        from .. import initializers as init
+        from ..ops.rnn import lstm_op
+        self._op = lstm_op
+        self.w_ih = init.xavier_uniform((in_dim, 4 * hidden),
+                                        name=f"{name}.w_ih")
+        self.w_hh = init.xavier_uniform((hidden, 4 * hidden),
+                                        name=f"{name}.w_hh")
+        self.b = init.zeros((4 * hidden,), name=f"{name}.b")
+
+    def __call__(self, x):
+        return self._op(x, self.w_ih, self.w_hh, self.b)
+
+
+class GRU(BaseLayer):
+    """GRU layer (r,z,n gates packed 3H) scanned over time."""
+
+    def __init__(self, in_dim, hidden, name="gru"):
+        from .. import initializers as init
+        from ..ops.rnn import gru_op
+        self._op = gru_op
+        self.w_ih = init.xavier_uniform((in_dim, 3 * hidden),
+                                        name=f"{name}.w_ih")
+        self.w_hh = init.xavier_uniform((hidden, 3 * hidden),
+                                        name=f"{name}.w_hh")
+        self.b = init.zeros((3 * hidden,), name=f"{name}.b")
+
+    def __call__(self, x):
+        return self._op(x, self.w_ih, self.w_hh, self.b)

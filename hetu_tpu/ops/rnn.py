@@ -1,0 +1,92 @@
+"""Recurrent ops lowered to ``lax.scan`` (TPU-friendly static control flow).
+
+Reference: ``examples/rnn/models/`` composes RNNs from per-timestep matmul/
+slice ops in Python; here the whole sequence is ONE scanned XLA loop — the
+compiler-friendly equivalent (no per-step op dispatch, weights stay in
+registers/VMEM across steps).
+
+Layout: inputs (batch, time, features); hidden state (batch, hidden).
+Weights follow the torch convention: w_ih (in, 4H/3H/H), w_hh (H, ...),
+bias (4H/3H/H,). Returns the full output sequence (batch, time, H).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import def_op
+
+
+def _scan_time(cell, x, init_carry):
+    xt = jnp.swapaxes(x, 0, 1)  # (T, B, F) for scan
+
+    def body(carry, x_t):
+        carry, out = cell(carry, x_t)
+        return carry, out
+
+    _, outs = jax.lax.scan(body, init_carry, xt)
+    return jnp.swapaxes(outs, 0, 1)  # back to (B, T, H)
+
+
+def _rnn(c, x, w_ih, w_hh, b, activation="tanh"):
+    H = w_hh.shape[0]
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h0 = jnp.zeros((x.shape[0], H), x.dtype)
+
+    def cell(h, x_t):
+        h = act(x_t @ w_ih + h @ w_hh + b)
+        return h, h
+
+    return _scan_time(cell, x, h0)
+
+
+rnn_op = def_op(
+    "RNN", _rnn,
+    lambda x, w_ih, w_hh, b, activation="tanh": (x[0], x[1], w_hh[0]))
+
+
+def _lstm(c, x, w_ih, w_hh, b):
+    H = w_hh.shape[0]
+    B = x.shape[0]
+    init = (jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype))
+
+    def cell(carry, x_t):
+        h, cs = carry
+        gates = x_t @ w_ih + h @ w_hh + b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        cs = f * cs + i * g
+        h = o * jnp.tanh(cs)
+        return (h, cs), h
+
+    return _scan_time(cell, x, init)
+
+
+lstm_op = def_op("LSTM", _lstm,
+                 lambda x, w_ih, w_hh, b: (x[0], x[1], w_hh[0]))
+
+
+def _gru(c, x, w_ih, w_hh, b):
+    H = w_hh.shape[0]
+    h0 = jnp.zeros((x.shape[0], H), x.dtype)
+
+    def cell(h, x_t):
+        gi = x_t @ w_ih + b
+        gh = h @ w_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * h
+        return h, h
+
+    return _scan_time(cell, x, h0)
+
+
+gru_op = def_op("GRU", _gru,
+                lambda x, w_ih, w_hh, b: (x[0], x[1], w_hh[0]))
+
+
+__all__ = ["rnn_op", "lstm_op", "gru_op"]

@@ -1,0 +1,107 @@
+"""RNN op/layer tests vs numpy step-by-step references
+(reference test style: tests/test_gpu_op.py numpy cross-check)."""
+import numpy as np
+
+import hetu_tpu as ht
+
+
+def _np_lstm(x, w_ih, w_hh, b):
+    B, T, _ = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    outs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] @ w_ih + h @ w_hh + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        i, f, o = sig(i), sig(f), sig(o)
+        c = f * c + i * np.tanh(gg)
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, axis=1)
+
+
+def _np_gru(x, w_ih, w_hh, b):
+    B, T, _ = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((B, H), np.float32)
+    outs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        gi = x[:, t] @ w_ih + b
+        gh = h @ w_hh
+        i_r, i_z, i_n = np.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = np.split(gh, 3, axis=-1)
+        r, z = sig(i_r + h_r), sig(i_z + h_z)
+        n = np.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * h
+        outs.append(h)
+    return np.stack(outs, axis=1)
+
+
+def test_lstm_op_matches_numpy():
+    rng = np.random.RandomState(0)
+    B, T, F, H = 4, 7, 5, 6
+    x_np = rng.randn(B, T, F).astype(np.float32)
+    wi = rng.randn(F, 4 * H).astype(np.float32) * 0.3
+    wh = rng.randn(H, 4 * H).astype(np.float32) * 0.3
+    b = rng.randn(4 * H).astype(np.float32) * 0.1
+    x = ht.placeholder_op("x")
+    out = ht.lstm_op(x, ht.Variable("wi", value=wi),
+                     ht.Variable("wh", value=wh), ht.Variable("b", value=b))
+    ex = ht.Executor({"default": [out]})
+    got = np.asarray(ex.run("default", feed_dict={x: x_np})[0].asnumpy())
+    np.testing.assert_allclose(got, _np_lstm(x_np, wi, wh, b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gru_op_matches_numpy():
+    rng = np.random.RandomState(1)
+    B, T, F, H = 3, 5, 4, 8
+    x_np = rng.randn(B, T, F).astype(np.float32)
+    wi = rng.randn(F, 3 * H).astype(np.float32) * 0.3
+    wh = rng.randn(H, 3 * H).astype(np.float32) * 0.3
+    b = rng.randn(3 * H).astype(np.float32) * 0.1
+    x = ht.placeholder_op("x")
+    out = ht.gru_op(x, ht.Variable("wi", value=wi),
+                    ht.Variable("wh", value=wh), ht.Variable("b", value=b))
+    ex = ht.Executor({"default": [out]})
+    got = np.asarray(ex.run("default", feed_dict={x: x_np})[0].asnumpy())
+    np.testing.assert_allclose(got, _np_gru(x_np, wi, wh, b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_layer_trains_sequence_task():
+    """Learnable probe: predict last input token class from the sequence."""
+    rng = np.random.RandomState(2)
+    B, T, F = 32, 6, 8
+    x_np = rng.randn(B, T, F).astype(np.float32)
+    y_np = np.argmax(x_np[:, -1, :4], axis=-1).astype(np.int32)
+
+    from hetu_tpu.layers import LSTM, Linear
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    seq = LSTM(F, 16)(x)
+    last = ht.slice_op(seq, begin=[0, T - 1, 0], size=[-1, 1, -1])
+    last = ht.array_reshape_op(last, output_shape=(B, 16))
+    logits = Linear(16, 4, name="head")(last)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    ex = ht.Executor({"train": [loss,
+                                ht.optim.AdamOptimizer(1e-2).minimize(loss)]},
+                     seed=0)
+    ls = [float(ex.run("train", feed_dict={x: x_np, y: y_np})[0].asnumpy())
+          for _ in range(60)]
+    assert ls[-1] < 0.25 * ls[0], ls[::10]
+
+
+def test_vanilla_rnn_shapes():
+    rng = np.random.RandomState(3)
+    from hetu_tpu.layers import RNN
+    x = ht.placeholder_op("x")
+    out = RNN(5, 9)(x)
+    ex = ht.Executor({"default": [out]})
+    got = ex.run("default",
+                 feed_dict={x: rng.randn(2, 4, 5).astype(np.float32)})
+    assert np.asarray(got[0].asnumpy()).shape == (2, 4, 9)
