@@ -1,2 +1,4 @@
 from .dataloader import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
 from .datasets import mnist, cifar10, cifar100, normalize_cifar
+from . import transforms
+from .transforms import Compose, Normalize, RandomHorizontalFlip, RandomCrop

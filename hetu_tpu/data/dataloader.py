@@ -16,11 +16,22 @@ from ..graph.node import PlaceholderOp
 
 
 class Dataloader:
-    """One split of data batched for one subgraph name."""
+    """One split of data batched for one subgraph name.
+
+    ``dp_rank``/``dp_nrank`` shard the dataset across data-parallel workers
+    (reference dataloader.py:96-101); ``prefetch`` batches are prepared on a
+    background thread (the reference's triple-buffer queue:103) so host-side
+    augmentation overlaps device compute.
+    """
 
     def __init__(self, raw_data, batch_size, name="default", func=None,
-                 drop_last=True, shuffle=False, seed=0):
-        self.raw_data = np.asarray(raw_data, np.float32)
+                 drop_last=True, shuffle=False, seed=0,
+                 dp_rank=0, dp_nrank=1, prefetch=2):
+        data = np.asarray(raw_data, np.float32)
+        if dp_nrank > 1:  # contiguous shard per dp worker
+            per = len(data) // dp_nrank
+            data = data[dp_rank * per:(dp_rank + 1) * per]
+        self.raw_data = data
         self.batch_size = int(batch_size)
         self.name = name
         self.func = func
@@ -31,6 +42,8 @@ class Dataloader:
         self._cursor = 0
         if shuffle:
             self._rng.shuffle(self._order)
+        self._queue = None
+        self._prefetch = max(0, int(prefetch))
 
     @property
     def batch_num(self):
@@ -39,7 +52,7 @@ class Dataloader:
             n += 1
         return n
 
-    def get_arr(self):
+    def _produce(self):
         idx = self._order[self._cursor * self.batch_size:
                           (self._cursor + 1) * self.batch_size]
         batch = self.raw_data[idx]
@@ -51,6 +64,39 @@ class Dataloader:
             if self.shuffle:
                 self._rng.shuffle(self._order)
         return batch
+
+    def _ensure_thread(self):
+        if self._queue is not None or self._prefetch == 0:
+            return
+        import queue
+        import threading
+        self._queue = queue.Queue(maxsize=self._prefetch)
+
+        def worker():
+            while True:
+                self._queue.put(self._produce())
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+
+    def _take(self):
+        if self._prefetch:
+            self._ensure_thread()
+            return self._queue.get()
+        return self._produce()
+
+    def get_arr(self):
+        if getattr(self, "_peeked", None) is not None:
+            batch, self._peeked = self._peeked, None
+            return batch
+        return self._take()
+
+    def get_next_arr(self):
+        """Peek the upcoming batch without consuming it (reference lookahead
+        used for PS SparsePull prefetch, ParameterServerCommunicate.py:69-77)."""
+        if getattr(self, "_peeked", None) is None:
+            self._peeked = self._take()
+        return self._peeked
 
     def get_cur_shape(self):
         return (self.batch_size,) + self.raw_data.shape[1:]

@@ -1,0 +1,90 @@
+"""Host-side data transforms (reference ``python/hetu/transforms.py``).
+
+Numpy-batch functions composable via :class:`Compose` and passable as the
+``func=`` of :class:`hetu_tpu.data.Dataloader` — they run on the prefetch
+thread, overlapping device compute.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, batch):
+        for t in self.transforms:
+            batch = t(batch)
+        return batch
+
+
+class Normalize:
+    """(x - mean) / std per channel (NCHW or flat)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, batch):
+        if batch.ndim == 4:  # NCHW
+            m = self.mean.reshape(1, -1, 1, 1)
+            s = self.std.reshape(1, -1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (batch - m) / s
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p=0.5, seed=0):
+        self.p = p
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, batch):
+        flip = self._rng.rand(len(batch)) < self.p
+        out = batch.copy()
+        out[flip] = out[flip, ..., ::-1]
+        return out
+
+
+class RandomCrop:
+    """Pad-and-crop augmentation (NCHW)."""
+
+    def __init__(self, size, padding=4, seed=0):
+        self.size = size
+        self.padding = padding
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, batch):
+        n, c, h, w = batch.shape
+        p = self.padding
+        padded = np.pad(batch, ((0, 0), (0, 0), (p, p), (p, p)))
+        out = np.empty((n, c, self.size, self.size), batch.dtype)
+        ys = self._rng.randint(0, h + 2 * p - self.size + 1, n)
+        xs = self._rng.randint(0, w + 2 * p - self.size + 1, n)
+        for i in range(n):
+            out[i] = padded[i, :, ys[i]:ys[i] + self.size,
+                            xs[i]:xs[i] + self.size]
+        return out
+
+
+class Cutout:
+    def __init__(self, length=8, seed=0):
+        self.length = length
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, batch):
+        n, _, h, w = batch.shape
+        out = batch.copy()
+        ys = self._rng.randint(0, h, n)
+        xs = self._rng.randint(0, w, n)
+        half = self.length // 2
+        for i in range(n):
+            y0, y1 = max(0, ys[i] - half), min(h, ys[i] + half)
+            x0, x1 = max(0, xs[i] - half), min(w, xs[i] + half)
+            out[i, :, y0:y1, x0:x1] = 0.0
+        return out
+
+
+__all__ = ["Compose", "Normalize", "RandomHorizontalFlip", "RandomCrop",
+           "Cutout"]

@@ -426,6 +426,9 @@ class Executor:
         # the step (fp32 master weights + optimizer state stay outside) —
         # halves HBM traffic for the bandwidth-bound elementwise ops
         self.compute_dtype = kwargs.pop("compute_dtype", None)
+        # reference Executor(timing=...) — per-run wall timers + logOut API
+        self.timing = bool(kwargs.pop("timing", False))
+        self.timer_logs = {}
         self.seed = 0 if seed is None else int(seed)
         self.master_key = jax.random.key(self.seed)
         self.step_counter = 0
@@ -537,7 +540,35 @@ class Executor:
         if eval_node_list:
             warnings.warn("eval_node_list override is ignored; fetches are "
                           "fixed per subgraph at construction")
+        if self.timing:
+            # in-training timers (reference timer_subexecutor.py:109 /
+            # Executor(timing=...)); dispatch wall time per subgraph —
+            # per-op timing under fusion comes from HetuProfiler instead
+            import time
+            t0 = time.perf_counter()
+            out = self.subexecutors[name].run(feed_dict,
+                                              convert_to_numpy_ret_vals)
+            self.timer_logs.setdefault(name, []).append(
+                (time.perf_counter() - t0) * 1e3)
+            return out
         return self.subexecutors[name].run(feed_dict, convert_to_numpy_ret_vals)
+
+    def logOut(self, path, clear=True):
+        """Write recorded step timings (reference Executor.logOut:548)."""
+        with open(path, "a") as f:
+            for name, times in self.timer_logs.items():
+                for t in times:
+                    f.write(f"{name}\t{t:.3f} ms\n")
+        if clear:
+            self.clearTimer()
+
+    def clearTimer(self):
+        self.timer_logs = {}
+
+    def recordLoads(self):
+        """Dump PS key-access loads (reference Executor.recordLoads:543)."""
+        from ..ps import default_store
+        return default_store().get_loads()
 
     def profile(self, name="default", feed_dict=None, log_file=None):
         return self.subexecutors[name].profile(feed_dict or {}, log_file)

@@ -37,6 +37,7 @@ from .attention import (sdpa_op, sdpa_masked_op, sdpa_bias_op,
                         ring_attention_op, ulysses_attention_op)
 from .matmul import einsum_op
 from .rnn import rnn_op, lstm_op, gru_op
+from .transform import clone_op, cumsum_op, group_topk_idx_op
 
 # reference-name aliases
 slice_gradient_op = slice_op

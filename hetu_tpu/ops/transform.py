@@ -185,6 +185,24 @@ topk_idx_op = def_op("TopKIdx",
 one_hot_op = def_op("OneHot",
                     lambda c, a, num_classes=2: jax.nn.one_hot(a.astype(jnp.int32), num_classes))
 
+clone_op = def_op("Clone", lambda c, a: jnp.array(a), lambda a: tuple(a))
+
+cumsum_op = def_op("CumSum",
+                   lambda c, a, axis=0: jnp.cumsum(a, axis=axis),
+                   lambda a, axis=0: tuple(a))
+
+
+def _group_topk_idx(c, a, k=1, group_size=1):
+    """Top-k indices within contiguous groups of the last dim
+    (reference GroupTopKIdx.cu, used by SAM gating)."""
+    g = a.reshape(a.shape[:-1] + (a.shape[-1] // group_size, group_size))
+    import jax
+    _, idx = jax.lax.top_k(g, k)
+    return idx
+
+
+group_topk_idx_op = def_op("GroupTopKIdx", _group_topk_idx)
+
 cumsum_with_bias_op = def_op(
     "CumsumWithBias",
     lambda c, a, bias=0.0, dim=0: jnp.cumsum(a, axis=dim) + bias)

@@ -1,0 +1,96 @@
+"""Partial reduce — straggler-tolerant dynamic-group gradient averaging.
+
+Reference: ``python/hetu/preduce.py:8`` (P-Reduce, SIGMOD'21): each step a
+worker asks the PS for the subset of workers that arrived within a wait
+window (``preduce_get_partner``, ps-lite ``preduce_handler.h``), then
+NCCL-avg-allreduces over that dynamic subgroup.
+
+TPU-native redesign: XLA SPMD programs are lockstep, so group membership
+cannot change *inside* a compiled step — instead membership is an INPUT.
+The controller (host side) decides the active mask per step (arrival
+simulation, data availability, failed-host report, ...) and the compiled
+step computes
+
+    mean_active(g) = psum(mask * g) / psum(mask)
+
+over the full axis — numerically identical to an allreduce over the active
+subgroup, with no recompilation and no communicator rebuilds when
+membership changes (the reference caches per-subset NCCL comms instead,
+preduce.py:32-42).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class PartialReduce:
+    """Controller + SPMD helpers for dynamic-group gradient averaging.
+
+    ``get_partner(rank, step)`` mirrors the reference API: returns the
+    active-worker mask for this step. Arrival bookkeeping lives host-side
+    (here: a pluggable ``arrival_fn``; in a multi-host deployment the PS
+    store's SSP clocks supply it).
+    """
+
+    def __init__(self, n_workers, max_wait_ms=100.0, min_workers=2,
+                 arrival_fn=None):
+        self.n_workers = n_workers
+        self.max_wait_ms = max_wait_ms
+        self.min_workers = max(1, min_workers)
+        self.arrival_fn = arrival_fn
+        self._arrivals = {}
+
+    # -- host-side group formation ------------------------------------------
+    def report_arrival(self, rank, step, t=None):
+        """A worker announces it reached the sync point for ``step``."""
+        self._arrivals.setdefault(step, {})[rank] = \
+            time.monotonic() if t is None else t
+
+    def get_partner(self, rank, step):
+        """Active mask (float32, shape (n_workers,)) for this step.
+
+        Workers that arrived within ``max_wait_ms`` of the first arrival
+        are in; the caller's own rank is always in (reference semantics:
+        you are part of whatever group the PS hands you).
+        """
+        if self.arrival_fn is not None:
+            mask = np.asarray(self.arrival_fn(step), np.float32)
+        else:
+            arr = self._arrivals.get(step, {})
+            if not arr:
+                mask = np.ones(self.n_workers, np.float32)
+            else:
+                t0 = min(arr.values())
+                mask = np.zeros(self.n_workers, np.float32)
+                for r, t in arr.items():
+                    if (t - t0) * 1e3 <= self.max_wait_ms:
+                        mask[r] = 1.0
+        mask[rank] = 1.0
+        if mask.sum() < self.min_workers:
+            mask = np.ones(self.n_workers, np.float32)
+        return mask
+
+    # -- SPMD reduction ------------------------------------------------------
+    @staticmethod
+    def preduce(grad, mask, axis_name):
+        """Inside shard_map/jit: average grads over the active subgroup.
+
+        ``mask`` is the per-device activity scalar (this device's entry of
+        the get_partner mask). Inactive devices contribute zeros and still
+        receive the group mean (they apply it or ignore it — reference
+        PipeDream applies it, pipedream_subexecutor.py:301-313).
+        """
+        import jax
+        num = jax.lax.psum(jax.tree.map(lambda g: g * mask, grad), axis_name)
+        den = jax.lax.psum(mask, axis_name)
+        return jax.tree.map(lambda v: v / den, num)
+
+
+def preduce_mean(grad, mask, axis_name="dp"):
+    """Functional alias of :meth:`PartialReduce.preduce`."""
+    return PartialReduce.preduce(grad, mask, axis_name)
+
+
+__all__ = ["PartialReduce", "preduce_mean"]

@@ -126,10 +126,28 @@ class EmbeddingStore:
                 f"{rows}")
 
     # -- sparse ops --------------------------------------------------------
+    # -- load recording (reference startRecord/getLoads,
+    #    ps-lite python_binding.cc:121-127, executor.py:356-359) ------------
+    def start_record(self):
+        self._loads = {}
+
+    def get_loads(self):
+        """{(table, 'pull'|'push'): {key: count}} since start_record."""
+        return getattr(self, "_loads", {})
+
+    def _record(self, table, kind, keys):
+        loads = getattr(self, "_loads", None)
+        if loads is None:
+            return
+        bucket = loads.setdefault((table, kind), {})
+        for k, n in zip(*np.unique(keys, return_counts=True)):
+            bucket[int(k)] = bucket.get(int(k), 0) + int(n)
+
     def pull(self, table, keys):
         """SparsePull: rows for ``keys`` (any shape) → keys.shape + (width,)."""
         keys = np.ascontiguousarray(keys, np.int64)
         self._check_keys(table, keys)
+        self._record(table, "pull", keys.reshape(-1))
         if self._lib:
             import ctypes
             width = self._lib.hetu_ps_width(self._h, table)
@@ -148,6 +166,7 @@ class EmbeddingStore:
         """SparsePush: apply per-key accumulated grads via server optimizer."""
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
         self._check_keys(table, keys)
+        self._record(table, "push", keys)
         grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
         if self._lib:
             import ctypes

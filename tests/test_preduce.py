@@ -1,0 +1,95 @@
+"""Partial-reduce tests (reference tests/pstests/test_ps_preduce.py:24 —
+partner formation + subgroup averaging semantics)."""
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.parallel.preduce import PartialReduce, preduce_mean
+
+
+def test_partner_formation_by_arrival_window():
+    pr = PartialReduce(n_workers=4, max_wait_ms=10.0, min_workers=2)
+    pr.report_arrival(0, step=0, t=0.000)
+    pr.report_arrival(1, step=0, t=0.005)   # within 10ms window
+    pr.report_arrival(2, step=0, t=0.050)   # straggler: outside
+    mask = pr.get_partner(rank=0, step=0)
+    assert mask.tolist() == [1.0, 1.0, 0.0, 0.0]
+    # the asking straggler is always part of its own group
+    mask2 = pr.get_partner(rank=2, step=0)
+    assert mask2[2] == 1.0
+
+
+def test_min_workers_fallback():
+    pr = PartialReduce(n_workers=4, max_wait_ms=1.0, min_workers=3)
+    pr.report_arrival(0, step=1, t=0.0)
+    pr.report_arrival(1, step=1, t=5.0)  # too late -> group would be {0}
+    mask = pr.get_partner(rank=0, step=1)
+    assert mask.sum() == 4  # fallback to full group
+
+
+def test_preduce_mean_matches_subgroup_average():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = ht.make_mesh({"dp": 8})
+    grads = np.arange(8, dtype=np.float32).reshape(8, 1) + 1.0  # 1..8
+    mask = np.array([1, 1, 0, 1, 0, 0, 1, 0], np.float32).reshape(8, 1)
+
+    def step(g, m):
+        return preduce_mean(g, m[0], "dp")
+
+    out = jax.jit(jax.shard_map(step, mesh=mesh,
+                                in_specs=(P("dp"), P("dp")),
+                                out_specs=P("dp")))(grads, mask)
+    active = grads[mask[:, 0] == 1].mean()
+    np.testing.assert_allclose(np.asarray(out)[:, 0],
+                               np.full(8, active), rtol=1e-6)
+
+
+def test_executor_timing_and_logout(tmp_path):
+    x = ht.placeholder_op("x")
+    w = ht.init.xavier_uniform((8, 4), name="w")
+    out = ht.matmul_op(x, w)
+    ex = ht.Executor({"default": [out]}, timing=True)
+    for _ in range(3):
+        ex.run("default", feed_dict={x: np.ones((2, 8), np.float32)})
+    assert len(ex.timer_logs["default"]) == 3
+    path = tmp_path / "t.log"
+    ex.logOut(str(path))
+    assert path.read_text().count("default") == 3
+    assert ex.timer_logs == {}
+
+
+def test_ps_load_recording():
+    store = ht.EmbeddingStore()
+    t = store.init_table(10, 4, opt="sgd", lr=0.1, seed=0)
+    store.start_record()
+    store.pull(t, np.array([1, 1, 3]))
+    store.push(t, np.array([3]), np.ones((1, 4), np.float32))
+    loads = store.get_loads()
+    assert loads[(t, "pull")][1] == 2 and loads[(t, "pull")][3] == 1
+    assert loads[(t, "push")][3] == 1
+
+
+def test_dataloader_dp_shard_prefetch_and_peek():
+    data = np.arange(64, dtype=np.float32).reshape(32, 2)
+    dl0 = ht.Dataloader(data, 4, dp_rank=0, dp_nrank=2, prefetch=2)
+    dl1 = ht.Dataloader(data, 4, dp_rank=1, dp_nrank=2, prefetch=0)
+    assert dl0.batch_num == 4 and dl1.batch_num == 4
+    b1 = dl1.get_arr()
+    assert b1[0, 0] == 32.0  # second shard starts at row 16 (val 32)
+    peek = dl0.get_next_arr()
+    got = dl0.get_arr()
+    np.testing.assert_array_equal(peek, got)  # peek does not consume
+    nxt = dl0.get_arr()
+    assert not np.array_equal(got, nxt)
+
+
+def test_transforms_compose():
+    from hetu_tpu.data import Compose, Normalize, RandomCrop
+    batch = np.random.RandomState(0).rand(4, 3, 32, 32).astype(np.float32)
+    tf = Compose([RandomCrop(32, padding=4),
+                  Normalize([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])])
+    out = tf(batch)
+    assert out.shape == batch.shape
+    assert abs(out.mean()) < 2.0
