@@ -3,13 +3,26 @@
 Primary config (BASELINE.json): BERT-base MLM pretraining, samples/sec/chip
 and MFU vs the 45%-MFU north-star target.  ``--config resnet18`` covers the
 CIFAR10 step-time config.
+
+Hardened against a flaky TPU backend (the round-1 artifact died with
+"Unable to initialize backend 'axon'" and a >9-min hang): the parent process
+runs the measurement in a child with a hard wall-clock budget and bounded
+retries, and ALWAYS prints exactly one JSON line — with an ``error`` field
+instead of a traceback/hang on failure.
 """
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+CHILD_ENV_FLAG = "_HETU_BENCH_CHILD"
+CHILD_TIMEOUT_S = int(os.environ.get("HETU_BENCH_CHILD_TIMEOUT", "420"))
+TOTAL_BUDGET_S = int(os.environ.get("HETU_BENCH_BUDGET", "900"))
+MAX_ATTEMPTS = 3
 
 
 def _sync(outs):
@@ -42,10 +55,11 @@ def bench_bert(batch_size=192, seq_len=128, steps=20, warmup=3):
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
                      compute_dtype="bfloat16")
     ids, tt, labels = synthetic_mlm_batch(cfg)
-    import jax as _jax  # pre-place feeds on device once: the bench measures
-    fd = {feeds["input_ids"]: _jax.device_put(np.asarray(ids, np.float32)),
-          feeds["token_type_ids"]: _jax.device_put(np.asarray(tt, np.float32)),
-          feeds["masked_lm_labels"]: _jax.device_put(np.asarray(labels, np.float32))}
+    # ids/labels stay int32 end-to-end: integer feeds are exempt from the
+    # bf16 compute_dtype cast (bf16 is exact only up to 256)
+    fd = {feeds["input_ids"]: jax.device_put(np.asarray(ids, np.int32)),
+          feeds["token_type_ids"]: jax.device_put(np.asarray(tt, np.int32)),
+          feeds["masked_lm_labels"]: jax.device_put(np.asarray(labels, np.int32))}
 
     for _ in range(warmup):
         out = ex.run("train", feed_dict=fd)
@@ -66,6 +80,8 @@ def bench_bert(batch_size=192, seq_len=128, steps=20, warmup=3):
     peak = {"tpu": 197e12}.get(jax.default_backend(), 50e12)  # v5e bf16 peak
     mfu = flops_per_step / dt / (peak * n_dev)
     samples_per_sec_chip = batch_size / dt / n_dev
+    final_loss = float(np.asarray(out[0].jax() if hasattr(out[0], "jax")
+                                  else out[0]))
     return {
         "metric": "bert_base_pretrain_samples_per_sec_per_chip",
         "value": round(samples_per_sec_chip, 2),
@@ -76,7 +92,7 @@ def bench_bert(batch_size=192, seq_len=128, steps=20, warmup=3):
             "step_time_ms": round(dt * 1e3, 2),
             "batch_size": batch_size, "seq_len": seq_len,
             "params": n_params, "backend": jax.default_backend(),
-            "devices": n_dev,
+            "devices": n_dev, "loss": round(final_loss, 4),
         },
     }
 
@@ -114,15 +130,70 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
     }
 
 
-if __name__ == "__main__":
-    p = argparse.ArgumentParser()
-    p.add_argument("--config", default="bert", choices=["bert", "resnet18"])
-    p.add_argument("--batch-size", type=int, default=None)
-    p.add_argument("--steps", type=int, default=20)
-    args = p.parse_args()
+def _child_main(args):
     if args.config == "bert":
         res = bench_bert(batch_size=args.batch_size or 192, steps=args.steps)
     else:
         res = bench_resnet18(batch_size=args.batch_size or 128,
                              steps=args.steps)
     print(json.dumps(res))
+
+
+def _error_result(args, msg):
+    metric = ("bert_base_pretrain_samples_per_sec_per_chip"
+              if args.config == "bert" else "resnet18_cifar10_step_time")
+    unit = "samples/s/chip" if args.config == "bert" else "ms/step"
+    return {"metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "error": msg[-2000:]}
+
+
+def _parent_main(args):
+    """Run the bench in a child process with retries + a hard time budget."""
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    last_err = "no attempts made"
+    for attempt in range(MAX_ATTEMPTS):
+        remaining = deadline - time.monotonic()
+        if remaining <= 10:
+            last_err += " | total time budget exhausted"
+            break
+        env = dict(os.environ, **{CHILD_ENV_FLAG: "1"})
+        if attempt > 0:
+            # flaky-backend fallback: let jax pick any available backend
+            env["JAX_PLATFORMS"] = ""
+            time.sleep(min(10.0 * attempt, remaining / 10))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env, capture_output=True, text=True,
+                timeout=min(CHILD_TIMEOUT_S, remaining))
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: child exceeded " \
+                       f"{min(CHILD_TIMEOUT_S, remaining):.0f}s wall clock"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in parsed:
+                    if attempt > 0:
+                        parsed.setdefault("extra", {})["attempt"] = attempt
+                    print(json.dumps(parsed))
+                    return
+        last_err = f"attempt {attempt}: rc={proc.returncode} " \
+                   f"stderr: {proc.stderr[-1500:]}"
+    print(json.dumps(_error_result(args, last_err)))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="bert", choices=["bert", "resnet18"])
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+    if os.environ.get(CHILD_ENV_FLAG):
+        _child_main(args)
+    else:
+        _parent_main(args)

@@ -519,6 +519,13 @@ class Executor:
         val = np.asarray(val) if not hasattr(val, "dtype") else val
         if getattr(val, "dtype", None) == np.float64:
             val = np.asarray(val, np.float32)
+        # feeds adopt the placeholder's declared dtype: int placeholders
+        # (token ids, labels) must stay integral so the compute_dtype bf16
+        # cast never rounds them (bf16 is exact only up to 256)
+        want = getattr(node, "dtype", None)
+        if want is not None and getattr(val, "dtype", None) != np.dtype(want):
+            val = val.astype(np.dtype(want)) if hasattr(val, "astype") \
+                else np.asarray(val, want)
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             if node.sharding is not None:  # explicit ht.dispatch on a feed
@@ -536,6 +543,10 @@ class Executor:
         if isinstance(name, dict):  # run(feed_dict) shorthand
             feed_dict = name
             name = "default"
+        if isinstance(eval_node_list, dict) and feed_dict is None:
+            # run(name, feed_dict) positional shorthand — a dict here is
+            # unambiguously a feed_dict, not a fetch-list override
+            feed_dict, eval_node_list = eval_node_list, None
         feed_dict = feed_dict or {}
         if eval_node_list:
             warnings.warn("eval_node_list override is ignored; fetches are "

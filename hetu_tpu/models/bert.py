@@ -110,9 +110,12 @@ def bert_pretrain_graph(cfg, name="bert"):
     """
     from ..graph.node import placeholder_op
     shape = (cfg.batch_size, cfg.seq_len)
-    input_ids = placeholder_op("input_ids", shape=shape)
-    token_type_ids = placeholder_op("token_type_ids", shape=shape)
-    labels = placeholder_op("masked_lm_labels", shape=shape)
+    # int32 placeholders: token ids/labels must never ride the fp32→bf16
+    # compute_dtype cast (bf16 only represents integers exactly up to 256)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    token_type_ids = placeholder_op("token_type_ids", shape=shape,
+                                    dtype=np.int32)
+    labels = placeholder_op("masked_lm_labels", shape=shape, dtype=np.int32)
 
     seq = bert_model(cfg, input_ids, token_type_ids, name)
     # MLM head: transform + tied-ish decoder (fresh decoder weights, like the
@@ -135,8 +138,8 @@ def synthetic_mlm_batch(cfg, seed=0, mask_frac=0.15):
     """Deterministic synthetic MLM batch (hermetic benches/tests)."""
     rng = np.random.RandomState(seed)
     ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
-    tt = np.zeros((cfg.batch_size, cfg.seq_len), np.float32)
+    tt = np.zeros((cfg.batch_size, cfg.seq_len), np.int32)
     labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int64)
     mask = rng.rand(cfg.batch_size, cfg.seq_len) < mask_frac
     labels[mask] = ids[mask]
-    return (ids.astype(np.float32), tt, labels.astype(np.float32))
+    return (ids.astype(np.int32), tt, labels.astype(np.int32))
