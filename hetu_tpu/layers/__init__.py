@@ -5,6 +5,7 @@ from .core import (Linear, Conv2d, BatchNorm, LayerNorm, Embedding, DropOut,
                    MaxPool2d, AvgPool2d, Relu, Reshape, Identity, Sequence,
                    Concatenate, ConcatenateLayers, SumLayers, Slice,
                    RNN, LSTM, GRU)
-from .moe_layer import Expert, MoELayer
-from .gates import TopKGate, HashGate, KTop1Gate, SAMGate, BalanceAssignmentGate
+from .moe_layer import Expert, MoELayer, SparseMoELayer, BalancedMoELayer
+from .gates import (TopKGate, TopKGateSparse, HashGate, KTop1Gate,
+                    SAMGate, BalanceAssignmentGate)
 from .attention import MultiHeadAttention

@@ -32,6 +32,20 @@ class TopKGate(BaseLayer):
         return topk_gate_op(logits, k=self.k, capacity=self.capacity)
 
 
+class TopKGateSparse(TopKGate):
+    """TopKGate emitting index maps for the Pallas row-gather dispatch
+    (O(s·m) memory — use for large expert pools where the dense (s, e, c)
+    one-hot tensors of :class:`TopKGate` dominate memory).
+
+    ``__call__(x)`` → (token_of_slot, slot_of_token, k_of_slot, gate_w, aux).
+    """
+
+    def __call__(self, x):
+        from ..ops.moe import topk_gate_sparse_op
+        logits = ops.matmul_op(x, self.wg)
+        return topk_gate_sparse_op(logits, k=self.k, capacity=self.capacity)
+
+
 class HashGate(BaseLayer):
     """Token-id hash routing (no learned params, reference HashGate.py)."""
 

@@ -63,6 +63,45 @@ class MoELayer(BaseLayer):
         return y, aux
 
 
+class SparseMoELayer(BaseLayer):
+    """MoE layer on the Pallas row-gather dispatch path (see
+    :mod:`hetu_tpu.ops.pallas.moe_dispatch`): no (s, e, c) one-hot tensors,
+    so memory stays O(s·d) + O(e·c·d) for any expert count.
+
+    ``gate`` must be a :class:`~hetu_tpu.layers.gates.TopKGateSparse` —
+    expert count and capacity are read from it (single source of truth).
+    """
+
+    def __init__(self, gate, experts, embed_dim, name="sparse_moe"):
+        self.gate = gate
+        self.experts = experts
+        self.embed_dim = embed_dim
+
+    @property
+    def num_experts(self):
+        return self.gate.num_experts
+
+    @property
+    def capacity(self):
+        return self.gate.capacity
+
+    def __call__(self, x):
+        from ..ops.moe import sparse_dispatch_op, sparse_combine_op
+        tos, sot, kos, gate_w, aux = self.gate(x)
+        flat = sparse_dispatch_op(x, tos, sot)              # (E*C, d)
+        expert_in = ops.array_reshape_op(
+            flat, output_shape=(self.num_experts, self.capacity,
+                                self.embed_dim))
+        expert_in.sharding = PartitionSpec("ep")
+        expert_out = self.experts(expert_in)                # (E, C, d)
+        expert_out.sharding = PartitionSpec("ep")
+        out_flat = ops.array_reshape_op(
+            expert_out, output_shape=(self.num_experts * self.capacity,
+                                      self.embed_dim))
+        y = sparse_combine_op(out_flat, gate_w, sot, tos, kos)
+        return y, aux
+
+
 class BalancedMoELayer(BaseLayer):
     """BASE-layer variant (reference moe_layer.py:90-133): balanced-assignment
     permutation instead of capacity gating — every expert gets exactly

@@ -32,7 +32,8 @@ from .embedding import embedding_lookup_op
 from .moe import (topk_gate_op, ktop1_gate_op, sam_gate_op,
                   layout_transform_op, reverse_layout_transform_op,
                   hash_dispatch_op, balance_assignment_op, alltoall_op,
-                  halltoall_op)
+                  halltoall_op, topk_gate_sparse_op, sparse_dispatch_op,
+                  sparse_combine_op)
 from .attention import (sdpa_op, sdpa_masked_op, sdpa_bias_op,
                         ring_attention_op, ulysses_attention_op)
 from .matmul import einsum_op

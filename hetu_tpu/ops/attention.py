@@ -37,9 +37,10 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
 
 
 def _sdpa(c, q, k, v, causal=False, scale=None):
-    seq = q.shape[-2]
+    s_q, s_kv = q.shape[-2], k.shape[-2]
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and seq >= _FLASH_MIN_LEN and seq % 128 == 0:
+    if on_tpu and s_q >= _FLASH_MIN_LEN and s_q % 128 == 0 \
+            and s_kv % 128 == 0:
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return sdpa_reference(q, k, v, causal=causal, scale=scale)

@@ -304,3 +304,87 @@ def _alltoall(c, x):
 
 alltoall_op = def_op("AllToAll", _alltoall)
 halltoall_op = def_op("HAllToAll", _alltoall)  # 2-level mesh handled by XLA
+
+
+# ---------------------------------------------------------------------------
+# Sparse (index-map) dispatch path — Pallas row-gather kernel, O(s·m) memory
+# instead of the (s, e, c) one-hot tensors above; same routing/drop semantics.
+# ---------------------------------------------------------------------------
+
+def _topk_sparse_indices(logits, k, capacity):
+    """GShard top-1/2 routing as index maps (no (s,e,c) tensors).
+
+    Returns (token_of_slot (e*cap,), slot_of_token (s, k),
+    k_of_slot (e*cap,), gate_w (s, k), aux_loss) with routing, capacity
+    drops, gate normalisation, and aux loss identical to
+    :func:`_top1_gating` / :func:`_top2_gating`.
+    """
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    remaining = gates
+    count_prev = jnp.zeros((1, e), jnp.float32)
+    slots, gws, masks = [], [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = _one_hot_f(idx, e)
+        pos = (jnp.cumsum(mask, axis=0) * mask - mask) + count_prev * mask
+        keep = mask * (pos < capacity)
+        kept = jnp.sum(keep, axis=-1) > 0                     # (s,) bool
+        gws.append(jnp.sum(gates * keep, axis=-1))            # (s,)
+        p = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+        slot = jnp.where(kept, idx.astype(jnp.int32) * capacity + p, -1)
+        slots.append(slot)
+        masks.append(mask)
+        count_prev = count_prev + jnp.sum(mask, axis=0, keepdims=True)
+        remaining = remaining * (1 - mask)
+    gate_w = jnp.stack(gws, axis=1)                           # (s, k)
+    if k > 1:  # top-2 renormalisation (reference TopGate.py)
+        denom = jnp.maximum(jnp.sum(gate_w, axis=1, keepdims=True), 1e-9)
+        gate_w = gate_w / denom
+    slot_of_token = jnp.stack(slots, axis=1)                  # (s, k)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux = jnp.sum(me * ce) * e
+
+    n_slots = e * capacity
+    tok_ids = jnp.arange(s, dtype=jnp.int32)
+    token_of_slot = jnp.full((n_slots,), -1, jnp.int32)
+    k_of_slot = jnp.zeros((n_slots,), jnp.int32)
+    for j in range(k):
+        tgt = jnp.where(slots[j] >= 0, slots[j], n_slots)
+        token_of_slot = token_of_slot.at[tgt].set(tok_ids, mode="drop")
+        k_of_slot = k_of_slot.at[tgt].set(j, mode="drop")
+    return token_of_slot, slot_of_token, k_of_slot, gate_w, aux
+
+
+def topk_gate_sparse_op(logits_node, k=1, capacity=None, name=None):
+    """Sparse GShard gating → (token_of_slot, slot_of_token, k_of_slot,
+    gate_w, aux_loss) nodes for the Pallas dispatch path."""
+    node = SimpleOp("TopKGateSparse", [logits_node],
+                    lambda c, logits, k=1, capacity=None:
+                        _topk_sparse_indices(logits, k, capacity),
+                    name=name, k=k, capacity=capacity)
+    return tuple_outputs(node, 5)
+
+
+def _pallas_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _sparse_dispatch_lower(c, tokens, token_of_slot, slot_of_token):
+    from .pallas.moe_dispatch import sparse_dispatch
+    return sparse_dispatch(tokens, token_of_slot, slot_of_token,
+                           _pallas_interpret())
+
+
+sparse_dispatch_op = def_op("SparseDispatch", _sparse_dispatch_lower)
+
+
+def _sparse_combine_lower(c, buffers, gate_w, slot_of_token, token_of_slot,
+                          k_of_slot):
+    from .pallas.moe_dispatch import sparse_combine
+    return sparse_combine(buffers, gate_w, slot_of_token, token_of_slot,
+                          k_of_slot, _pallas_interpret())
+
+
+sparse_combine_op = def_op("SparseCombine", _sparse_combine_lower)

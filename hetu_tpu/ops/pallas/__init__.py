@@ -1,2 +1,13 @@
 """Pallas TPU kernels for ops where XLA fusion is insufficient
-(SURVEY.md §7: fused attention, MoE dispatch, embedding scatter-add)."""
+(SURVEY.md §7: fused attention, MoE dispatch, embedding scatter-add).
+
+- :mod:`flash_attention` — blockwise online-softmax attention, fwd+bwd.
+- :mod:`moe_dispatch` — row-gather sparse dispatch/combine (O(s·m) memory).
+- :mod:`segment_sum` — sorted-run segment sum / IndexedSlices dedup.
+
+Every kernel runs under ``interpret=True`` in CPU CI (tests/test_pallas.py)
+so the exact TPU kernel code is exercised without hardware.
+"""
+from .flash_attention import flash_attention
+from .moe_dispatch import row_gather, sparse_dispatch, sparse_combine
+from .segment_sum import sorted_segment_sum, dedup_rows

@@ -1,10 +1,323 @@
-"""Flash attention — Pallas TPU kernel (placeholder lowering for now).
+"""Flash attention — Pallas TPU kernel (forward + backward).
 
-Falls back to the fused-XLA reference attention until the blockwise kernel
-lands; the call signature is stable so callers don't change.
+The reference has no attention kernel at all (SURVEY.md §5.7): its
+transformers compose batch_matmul + softmax ops, materialising the (S, S)
+score matrix in HBM.  This kernel is the TPU-native replacement: blockwise
+online-softmax attention that keeps scores in VMEM, with a custom VJP whose
+backward recomputes scores per block (flash-attention-2 style), so memory is
+O(S·D) instead of O(S²).
+
+Layout: inputs are (B, H, S, D); the kernel runs on (B·H, S, D) with a
+sequential TPU grid (bh, q_block, kv_block) — accumulators live in VMEM
+scratch and persist across the minor-most kv grid steps; outputs are written
+once on the final kv step (standard TPU revisiting-grid pattern).
+
+Causal masking prunes fully-masked blocks via ``pl.when`` (no FLOPs spent
+above the diagonal) and masks the diagonal blocks with -1e30 logits.
 """
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
-    from ..attention import sdpa_reference
-    return sdpa_reference(q, k, v, causal=causal, scale=scale)
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                num_kv, kv_off):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: block (qi, ki) contributes iff some q row >= some k col
+    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
+        if causal else True
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]                                   # (bq, d)
+        k = k_ref[0]                                   # (bk, d)
+        v = v_ref[0]                                   # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + kv_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, :1]                          # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # (bq, bk)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    num_q = s_q // block_q
+    num_kv = s_kv // block_k
+    grid = (bh, num_q, num_kv)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_kv=num_kv,
+        kv_off=s_kv - s_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k, num_kv,
+               kv_off):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
+        if causal else True
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]                                  # (bq, d)
+        lse = lse_ref[0][:, None]                       # (bq, 1)
+        delta = delta_ref[0][:, None]                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + kv_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                            # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k, num_q, kv_off):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
+        if causal else True
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]                                    # (bq, d)
+        k = k_ref[0]                                    # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + kv_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # (bq, bk)
+        # dV += P^T @ dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - delta) * scale                    # (bq, bk)
+        # dK += dS^T @ Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+               interpret):
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    num_q = s_q // block_q
+    num_kv = s_kv // block_k
+    # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # (bh, s_q)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv=num_kv,
+                          kv_off=s_kv - s_q),
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          kv_off=s_kv - s_q),
+        grid=(bh, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public op
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q3, k3, v3, out, lse = res
+    dq, dk, dv = _flash_bwd(q3, k3, v3, out, lse, do, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=None, block_k=None, interpret=False):
+    """Blockwise flash attention for (B, H, S, D) inputs.
+
+    Requires S divisible by the block size (the ``sdpa_op`` dispatcher
+    falls back to the XLA-composed reference otherwise).  ``interpret=True``
+    runs the Pallas interpreter so CPU CI exercises the same kernel code.
+    """
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    if s_q % 128 or s_kv % 128:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by 128, got "
+            f"({s_q}, {s_kv}) — use sdpa_reference for ragged shapes")
+    block_q = block_q or min(DEFAULT_BLOCK_Q, s_q)
+    block_k = block_k or min(DEFAULT_BLOCK_K, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"flash_attention needs seq divisible by block "
+            f"({s_q}, {s_kv}) vs ({block_q}, {block_k})")
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    q3 = q.reshape(b * h, s_q, d)
+    k3 = k.reshape(b * h, s_kv, d)
+    v3 = v.reshape(b * h, s_kv, d)
+    out = _flash(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, s_q, d)

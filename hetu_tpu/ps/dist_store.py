@@ -1,0 +1,396 @@
+"""Multi-host sharded parameter server — TCP-routed key ownership.
+
+Round-1 shipped a single-process host store; this module delivers the
+reference's multi-server topology (``ps-lite/src/van.cc`` ZMQ transport,
+worker routing ``include/ps/worker/PSAgent.h:50``, server shards
+``PSFHandle.h``): every process owns the keys with ``key % world == rank``
+(the promised ``hash(key) % nprocs`` ownership), runs a TCP server thread
+answering pull/push/versions/SSP for its shard (backed by the native C++
+:class:`~hetu_tpu.ps.store.EmbeddingStore`), and routes non-owned keys to
+their owner over persistent sockets with a compact binary wire format
+(length-prefixed frames; int64 keys + float32 rows — no pickle).
+
+ASP (reference ``ParameterServerCommunicate.py:38`` async path):
+``push_async`` enqueues onto a bounded background queue so device steps
+overlap with PS traffic; ``flush`` drains.  SSP clocks live on rank 0
+(the reference's scheduler role).
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .store import EmbeddingStore
+
+OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
+    OP_SHUTDOWN = range(1, 8)
+
+_HDR = struct.Struct("<BiqdI")  # op, table, nkeys, lr, payload_width
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+def _send_frame(sock, *parts):
+    body = b"".join(parts)
+    sock.sendall(struct.pack("<q", len(body)) + body)
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class StoreServer:
+    """Serves one process's shard over TCP (the reference server role)."""
+
+    def __init__(self, local: EmbeddingStore, world: int, rank: int,
+                 host="127.0.0.1", port=0):
+        self.local, self.world, self.rank = local, world, rank
+        self._ssp_lock = threading.Condition()
+        self._clocks = None
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                body = _recv_frame(conn)
+                op, table, nkeys, lr, width = _HDR.unpack_from(body)
+                off = _HDR.size
+                keys = np.frombuffer(body, np.int64, nkeys, off)
+                off += nkeys * 8
+                if op == OP_PULL:
+                    local_keys = keys // self.world
+                    out = self.local.pull(table, local_keys)
+                    _send_frame(conn, np.ascontiguousarray(
+                        out, np.float32).tobytes())
+                elif op == OP_PUSH:
+                    grads = np.frombuffer(
+                        body, np.float32, nkeys * width, off
+                    ).reshape(nkeys, width)
+                    self.local.push(table, keys // self.world, grads, lr)
+                    _send_frame(conn, b"\x01")
+                elif op == OP_VERSIONS:
+                    v = self.local.versions(table, keys // self.world)
+                    _send_frame(conn, np.ascontiguousarray(
+                        v, np.int64).tobytes())
+                elif op == OP_SSP_INIT:
+                    with self._ssp_lock:
+                        self._clocks = np.zeros(int(keys[0]), np.int64)
+                    _send_frame(conn, b"\x01")
+                elif op == OP_CLOCK:
+                    with self._ssp_lock:
+                        self._clocks[int(keys[0])] += 1
+                        self._ssp_lock.notify_all()
+                    _send_frame(conn, b"\x01")
+                elif op == OP_SSP_SYNC:
+                    worker, staleness = int(keys[0]), int(keys[1])
+                    timeout = lr if lr > 0 else None
+                    ok = True
+                    with self._ssp_lock:
+                        while self._clocks[worker] - self._clocks.min() \
+                                > staleness:
+                            if not self._ssp_lock.wait(timeout):
+                                ok = False
+                                break
+                    _send_frame(conn, b"\x01" if ok else b"\x00")
+                elif op == OP_SHUTDOWN:
+                    _send_frame(conn, b"\x01")
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class DistributedStore:
+    """Worker+server pair with ``key % world`` routing (EmbeddingStore API).
+
+    ``endpoints``: list of (host, port) for every rank, index = rank; this
+    process's entry may be None (it uses its own server's bound port).
+    """
+
+    def __init__(self, rank, world, endpoints=None, host="127.0.0.1",
+                 port=0, async_queue=64):
+        self.rank, self.world = rank, world
+        self.local = EmbeddingStore()
+        self.server = StoreServer(self.local, world, rank, host, port)
+        self.endpoints = list(endpoints) if endpoints else [None] * world
+        self.endpoints[rank] = (host, self.server.port)
+        self._conns = {}
+        self._conn_locks = {}
+        self._connect_lock = threading.Lock()  # guards first contact
+        self._tables = {}
+        self._queue = queue.Queue(maxsize=async_queue)
+        self._async_thread = None
+
+    # -- connections -------------------------------------------------------
+    def _conn(self, peer):
+        with self._connect_lock:
+            if peer not in self._conns:
+                s = socket.create_connection(self.endpoints[peer], timeout=30)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conn_locks[peer] = threading.Lock()
+                self._conns[peer] = s
+            return self._conns[peer], self._conn_locks[peer]
+
+    def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0):
+        sock, lock = self._conn(peer)
+        keys = np.ascontiguousarray(keys, np.int64)
+        with lock:
+            _send_frame(sock, _HDR.pack(op, table, keys.size, lr, width),
+                        keys.tobytes(), payload)
+            return _recv_frame(sock)
+
+    # -- tables ------------------------------------------------------------
+    def _local_rows(self, rows):
+        return (rows - self.rank + self.world - 1) // self.world
+
+    def init_table(self, rows, width, **kw):
+        tid = self.local.init_table(self._local_rows(rows), width, **kw)
+        self._tables[tid] = (rows, width)
+        return tid
+
+    def width(self, table):
+        return self._tables[table][1]
+
+    # -- sparse ops (EmbeddingStore API) -----------------------------------
+    def pull(self, table, keys):
+        keys = np.ascontiguousarray(keys, np.int64)
+        flat = keys.reshape(-1)
+        rows, width = self._tables[table]
+        out = np.empty((flat.size, width), np.float32)
+        owners = flat % self.world
+        for r in range(self.world):
+            sel = np.nonzero(owners == r)[0]
+            if not sel.size:
+                continue
+            if r == self.rank:
+                out[sel] = self.local.pull(table, flat[sel] // self.world)
+            else:
+                raw = self._rpc(r, OP_PULL, table, flat[sel])
+                out[sel] = np.frombuffer(raw, np.float32).reshape(
+                    sel.size, width)
+        return out.reshape(keys.shape + (width,))
+
+    def push(self, table, keys, grads, lr=-1.0):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        rows, width = self._tables[table]
+        grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
+        owners = keys % self.world
+        for r in range(self.world):
+            sel = np.nonzero(owners == r)[0]
+            if not sel.size:
+                continue
+            if r == self.rank:
+                self.local.push(table, keys[sel] // self.world, grads[sel], lr)
+            else:
+                self._rpc(r, OP_PUSH, table, keys[sel],
+                          np.ascontiguousarray(grads[sel]).tobytes(),
+                          lr, width)
+
+    def push_pull(self, table, push_keys, grads, pull_keys, lr=-1.0):
+        self.push(table, push_keys, grads, lr)
+        return self.pull(table, pull_keys)
+
+    def versions(self, table, keys):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        out = np.empty(keys.size, np.int64)
+        owners = keys % self.world
+        for r in range(self.world):
+            sel = np.nonzero(owners == r)[0]
+            if not sel.size:
+                continue
+            if r == self.rank:
+                out[sel] = self.local.versions(table, keys[sel] // self.world)
+            else:
+                raw = self._rpc(r, OP_VERSIONS, table, keys[sel])
+                out[sel] = np.frombuffer(raw, np.int64)
+        return out
+
+    # -- ASP: bounded async push (reference asp prefetch path) -------------
+    def _async_worker(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            table, keys, grads, lr = item
+            self.push(table, keys, grads, lr)
+            self._queue.task_done()
+
+    def push_async(self, table, keys, grads, lr=-1.0):
+        """Enqueue a push; blocks only when ``async_queue`` is full
+        (bounded eventual consistency — ASP mode, ``bsp=-1``)."""
+        if self._async_thread is None:
+            self._async_thread = threading.Thread(target=self._async_worker,
+                                                  daemon=True)
+            self._async_thread.start()
+        self._queue.put((table, np.array(keys, np.int64, copy=True),
+                         np.array(grads, np.float32, copy=True), lr))
+
+    def flush(self):
+        """Barrier: wait until every queued async push has been applied."""
+        if self._async_thread is not None:
+            self._queue.join()
+
+    # -- SSP via rank 0 (the reference scheduler role) ---------------------
+    def ssp_init(self, n_workers):
+        self._rpc(0, OP_SSP_INIT, 0, np.asarray([n_workers], np.int64))
+
+    def clock(self, worker=None):
+        w = self.rank if worker is None else worker
+        self._rpc(0, OP_CLOCK, 0, np.asarray([w], np.int64))
+
+    def ssp_sync(self, worker=None, staleness=0, timeout_ms=0):
+        w = self.rank if worker is None else worker
+        raw = self._rpc(0, OP_SSP_SYNC, 0,
+                        np.asarray([w, staleness], np.int64),
+                        lr=timeout_ms / 1e3 if timeout_ms else -1.0)
+        return raw == b"\x01"
+
+    # -- shard persistence (reference per-server SaveParam) ----------------
+    def save(self, table, path):
+        self.local.save(table, f"{path}.shard{self.rank}")
+
+    def load(self, table, path):
+        self.local.load(table, f"{path}.shard{self.rank}")
+
+    def close(self):
+        self.flush()
+        if self._async_thread is not None:
+            self._queue.put(None)
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.server.stop()
+
+
+class DistCacheTable:
+    """HET bounded-staleness cache over a :class:`DistributedStore`
+    (cross-host variant of the native ``CacheSparseTable``; reference
+    ``src/hetu_cache/cache.h:21`` pull_bound_/push_bound_ semantics).
+
+    - ``pull_bound``: a cached row may serve at most this many lookups
+      before it must be re-pulled from its owner.
+    - ``push_bound``: local gradient updates accumulate per row and are
+      pushed to the owner once this many are pending (or on ``flush``).
+    - LRU eviction at ``limit`` rows; evicting a dirty row pushes it.
+    """
+
+    def __init__(self, store: DistributedStore, table, limit=1 << 16,
+                 pull_bound=100, push_bound=10, lr=-1.0):
+        self.store, self.table = store, table
+        self.width = store.width(table)
+        self.limit = limit
+        self.pull_bound, self.push_bound = pull_bound, push_bound
+        self.lr = lr
+        from collections import OrderedDict
+        self._rows = OrderedDict()  # key -> np row, LRU order (O(1) evict)
+        self._uses = {}     # key -> lookups since refresh
+        self._grad = {}     # key -> (accumulated grad, count)
+        self.stats = {"lookups": 0, "hits": 0, "evictions": 0, "pushes": 0,
+                      "fetches": 0}
+
+    def _evict_if_needed(self):
+        while len(self._rows) > self.limit:
+            victim, _ = self._rows.popitem(last=False)
+            self._push_key(victim)
+            self._uses.pop(victim, None)
+            self.stats["evictions"] += 1
+
+    def _push_key(self, key):
+        g = self._grad.pop(key, None)
+        if g is not None:
+            self.store.push(self.table, np.asarray([key]), g[0][None, :],
+                            self.lr)
+            self.stats["pushes"] += 1
+
+    def lookup(self, keys):
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        out = np.empty((keys.size, self.width), np.float32)
+        misses = []
+        for i, k in enumerate(keys):
+            k = int(k)
+            self.stats["lookups"] += 1
+            if k in self._rows and self._uses[k] < self.pull_bound:
+                out[i] = self._rows[k]
+                self._uses[k] += 1
+                self._rows.move_to_end(k)
+                self.stats["hits"] += 1
+            else:
+                misses.append((i, k))
+        if misses:
+            mk = np.asarray([k for _, k in misses], np.int64)
+            # a stale row may carry pending local grads — push them first so
+            # the refreshed value includes this worker's own updates
+            for _, k in misses:
+                self._push_key(k)
+            rows = self.store.pull(self.table, mk)
+            self.stats["fetches"] += len(misses)
+            for (i, k), row in zip(misses, rows):
+                out[i] = row
+                self._rows[k] = row.copy()
+                self._rows.move_to_end(k)
+                self._uses[k] = 1
+            self._evict_if_needed()
+        return out
+
+    def update(self, keys, grads):
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
+        for k, g in zip(keys, grads):
+            k = int(k)
+            acc, cnt = self._grad.get(k, (np.zeros(self.width, np.float32), 0))
+            acc = acc + g
+            cnt += 1
+            if cnt >= self.push_bound:
+                self.store.push(self.table, np.asarray([k]), acc[None, :],
+                                self.lr)
+                self.stats["pushes"] += 1
+                self._grad.pop(k, None)
+                # local cached copy is now stale relative to the server
+                self._uses[k] = self.pull_bound
+            else:
+                self._grad[k] = (acc, cnt)
+
+    def flush(self):
+        for k in list(self._grad):
+            self._push_key(k)

@@ -25,6 +25,7 @@ class _NumpyTable:
     per-row versions).  Used only when g++ is unavailable."""
 
     def __init__(self, rows, width, opt, lr, m1, m2, eps, seed, scale):
+        import threading
         rng = np.random.RandomState(seed & 0xFFFFFFFF)
         self.data = (rng.uniform(-scale, scale, (rows, width))
                      if scale else np.zeros((rows, width))).astype(np.float32)
@@ -33,11 +34,19 @@ class _NumpyTable:
         self.s0 = np.zeros_like(self.data) if opt in (1, 2, 3, 4) else None
         self.s1 = np.zeros_like(self.data) if opt == 4 else None
         self.t = np.zeros(rows, np.int32) if opt == 4 else None
+        # concurrent remote pushes arrive from StoreServer handler threads;
+        # the native table stripe-locks, this fallback must lock too
+        self._lock = threading.Lock()
 
     def pull(self, keys):
-        return self.data[keys]
+        with self._lock:
+            return self.data[keys].copy()
 
     def push(self, keys, grads, lr=-1.0):
+        with self._lock:
+            return self._push_locked(keys, grads, lr)
+
+    def _push_locked(self, keys, grads, lr=-1.0):
         elr = self.lr if lr <= 0 else lr
         uk, inv = np.unique(keys, return_inverse=True)
         acc = np.zeros((len(uk), self.data.shape[1]), np.float32)

@@ -1,0 +1,133 @@
+"""Multi-host PS tests: 2 real processes, TCP-routed key ownership
+(reference ``tests/pstests/test_apis.py:22`` pattern — multiprocessing
+spawn of server/worker roles, numeric push/pull checks)."""
+import multiprocessing as mp
+import traceback
+
+import numpy as np
+import pytest
+
+
+def _child(rank, ports, barrier, errq):
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from hetu_tpu.ps.dist_store import DistributedStore, DistCacheTable
+
+        world = 2
+        endpoints = [("127.0.0.1", p) for p in ports]
+        store = DistributedStore(rank, world, endpoints,
+                                 port=ports[rank])
+        tid = store.init_table(10, 4, opt="sgd", lr=1.0, init_scale=0)
+        barrier.wait()
+
+        # --- cross-process push: rank0 pushes keys owned by rank1 ---------
+        if rank == 0:
+            g = np.ones((2, 4), np.float32) * np.asarray([[1.0], [3.0]])
+            store.push(tid, np.asarray([1, 3]), g)   # 1,3 owned by rank1
+        barrier.wait()
+        if rank == 1:
+            rows = store.pull(tid, np.asarray([1, 3]))   # local pull
+            np.testing.assert_allclose(rows[0], -1.0 * np.ones(4))
+            np.testing.assert_allclose(rows[1], -3.0 * np.ones(4))
+        barrier.wait()
+
+        # --- cross-process pull: rank1 pulls keys owned by rank0 ----------
+        if rank == 1:
+            rows = store.pull(tid, np.asarray([0, 2]))
+            np.testing.assert_allclose(rows, 0.0)
+            store.push(tid, np.asarray([0]), np.full((1, 4), 2.0, np.float32))
+        barrier.wait()
+        if rank == 0:
+            row = store.pull(tid, np.asarray([0]))[0]
+            np.testing.assert_allclose(row, -2.0 * np.ones(4))
+            # versions: key 0 (local) updated once; key 1 (remote) once
+            v = store.versions(tid, np.asarray([0, 1]))
+            assert list(v) == [1, 1], v
+        barrier.wait()
+
+        # --- ASP async push with flush barrier ----------------------------
+        if rank == 0:
+            store.push_async(tid, np.asarray([5]),
+                             np.full((1, 4), 1.0, np.float32))  # 5 -> rank1
+            store.flush()
+        barrier.wait()
+        if rank == 1:
+            row = store.pull(tid, np.asarray([5]))[0]
+            np.testing.assert_allclose(row, -1.0 * np.ones(4))
+        barrier.wait()
+
+        # --- SSP clocks on rank 0 ------------------------------------------
+        store.ssp_init(2) if rank == 0 else None
+        barrier.wait()
+        store.clock()
+        assert store.ssp_sync(staleness=1, timeout_ms=5000)
+        barrier.wait()
+
+        # --- HET cache staleness across hosts ------------------------------
+        cache = DistCacheTable(store, tid, pull_bound=3, push_bound=2)
+        if rank == 0:
+            v0 = cache.lookup([7])[0].copy()        # 7 owned by rank1
+        barrier.wait()
+        if rank == 1:
+            store.push(tid, np.asarray([7]), np.full((1, 4), 4.0, np.float32))
+        barrier.wait()
+        if rank == 0:
+            # within pull_bound: stale value served from cache
+            v1 = cache.lookup([7])[0]
+            np.testing.assert_allclose(v1, v0)
+            assert cache.stats["hits"] >= 1
+            cache.lookup([7])                        # use #3 exhausts bound
+            v2 = cache.lookup([7])[0]                # forced refresh
+            np.testing.assert_allclose(v2, v0 - 4.0)
+            # push_bound: first update cached, second triggers the push
+            cache.update([7], np.full((1, 4), 0.5, np.float32))
+            before = store.pull(tid, np.asarray([7]))[0]
+            np.testing.assert_allclose(before, v2)   # not pushed yet
+            cache.update([7], np.full((1, 4), 0.5, np.float32))
+            after = store.pull(tid, np.asarray([7]))[0]
+            np.testing.assert_allclose(after, v2 - 1.0)
+        barrier.wait()
+        store.close()
+    except Exception:
+        errq.put(f"rank {rank}:\n{traceback.format_exc()}")
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.timeout(180)
+def test_two_process_routing():
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(2)
+    barrier = ctx.Barrier(2)
+    errq = ctx.Queue()
+    procs = [ctx.Process(target=_child, args=(r, ports, barrier, errq))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=150)
+    errors = []
+    while not errq.empty():
+        errors.append(errq.get())
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+            errors.append("child hung")
+    assert not errors, "\n".join(errors)
+    assert all(p.exitcode == 0 for p in procs)
