@@ -104,7 +104,8 @@ class SubExecutor:
         """Evaluate every non-grad node; returns (env, state_updates)."""
         import jax
         ctx = LowerCtx(self.training, key, self.ex.mesh,
-                       num_microbatches=self.ex.num_microbatches)
+                       num_microbatches=self.ex.num_microbatches,
+                       pipeline=self.ex.pipeline)
         env = {}
         for node in self.topo:
             if isinstance(node, GradientOp) or node in self.opt_ops:

@@ -45,7 +45,7 @@ class LowerCtx:
     """
 
     def __init__(self, training: bool, base_key=None, mesh=None,
-                 num_microbatches=None):
+                 num_microbatches=None, pipeline=None):
         self.training = training
         self._base_key = base_key
         self._rng_count = 0
@@ -54,6 +54,9 @@ class LowerCtx:
         # executor-level microbatch setting; pipeline_block inherits it
         # when its own n_microbatches is unset
         self.num_microbatches = num_microbatches
+        # executor-level schedule choice ('gpipe' | 'pipedream' | 'hetpipe');
+        # pipeline_block picks the 1F1B program for 'pipedream'
+        self.pipeline = pipeline
 
     def rng(self):
         if self._base_key is None:

@@ -236,7 +236,7 @@ def hetpipe_sync_steps(step, pp_nrank):
 # ---------------------------------------------------------------------------
 
 def pipeline_block(x, builder, n_stages, n_microbatches=None, remat=False,
-                   name="pipe"):
+                   schedule=None, name="pipe"):
     """Build an S-stage pipelined block in the define-then-run graph.
 
     ``builder(stage_in_node) -> out_node`` constructs ONE stage's subgraph
@@ -272,7 +272,7 @@ def pipeline_block(x, builder, n_stages, n_microbatches=None, remat=False,
                     for v in template_vars]
     return PipelineBlockOp(x, stacked_vars, stage_in, out_node, topo,
                            template_vars, n_stages, n_microbatches, remat,
-                           name=name)
+                           schedule, name=name)
 
 
 def _make_stacked_var(template, n_stages, prefix):
@@ -300,7 +300,8 @@ class PipelineBlockOp(Op):
     op_type = "PipelineBlock"
 
     def __init__(self, x, stacked_vars, stage_in, out_node, topo,
-                 template_vars, n_stages, n_microbatches, remat, name):
+                 template_vars, n_stages, n_microbatches, remat, schedule,
+                 name):
         super().__init__([x] + stacked_vars, name=name)
         self.stage_in = stage_in
         self.out_node = out_node
@@ -309,6 +310,7 @@ class PipelineBlockOp(Op):
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
         self.remat = remat
+        self.schedule = schedule  # None → executor's pipeline= setting
 
     def _stage_fn(self, ctx):
         def fn(params, xval, key):
@@ -341,6 +343,18 @@ class PipelineBlockOp(Op):
                 and mesh.shape["pp"] > 1:
             M = (self.n_microbatches or ctx.num_microbatches
                  or mesh.shape["pp"])
+            sched = self.schedule or getattr(ctx, "pipeline", None) \
+                or "gpipe"
+            if sched in ("pipedream", "1f1b"):
+                sched = "1f1b"
+            elif sched not in ("gpipe", "hetpipe"):
+                raise ValueError(
+                    f"unknown pipeline schedule {sched!r}; expected gpipe, "
+                    "pipedream/1f1b, or hetpipe")
+            if sched == "1f1b":
+                from .pipeline_1f1b import pipeline_apply_1f1b
+                return pipeline_apply_1f1b(fn, params, xval, M, mesh,
+                                           key=key)
             return pipeline_apply(fn, params, xval, M, mesh,
                                   remat=self.remat, key=key)
         return serial_apply(fn, params, xval, remat=self.remat,

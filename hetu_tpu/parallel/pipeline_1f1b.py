@@ -1,0 +1,266 @@
+"""True 1F1B (PipeDream-flush) pipeline schedule as one SPMD program.
+
+Round 1's ``pipeline='pipedream'`` was GPipe + remat; this module implements
+the real interleaved schedule (reference ``pipedream_subexecutor.py:25-48``
+scheduler, ``:130-147`` weight stashing): the backward pass is an explicitly
+scheduled ``lax.scan`` where every tick runs one forward-recompute slot and
+one backward slot per stage, with stage inputs kept in a VMEM/HBM **ring
+buffer of S slots** — so live activations are O(S) per stage instead of the
+O(M) that grad-of-GPipe-scan stores.
+
+Mechanics
+---------
+* The tick→(stage, microbatch, phase) assignment is event-simulated on the
+  host at trace time (:func:`compute_1f1b_tables`) from the reference's
+  per-stage 1F1B order, giving static (T, S) int tables; each rank picks its
+  column with ``lax.axis_index``.
+* Forward value pass = forward-only GPipe scan (custom_vjp saves just
+  (params, x)).  Backward = the scheduled scan: per tick, the fwd slot
+  recomputes one microbatch's stage activation into the ring (PipeDream's
+  weight *stash* is unnecessary: synchronous flush semantics mean exactly
+  one weight version per step — the recompute plays the stash's role), and
+  the bwd slot pulls the stage input from the ring, runs ``jax.vjp`` of the
+  stage, accumulates param grads, and ppermutes the input cotangent to the
+  previous stage.
+* Numerics are identical to GPipe (same per-(stage, microbatch) dropout
+  keys in both passes) — parity-tested in tests/test_pipeline.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_1f1b_tables(n_stages, n_microbatches):
+    """Event-simulate synchronous 1F1B; returns (fwd_tab, bwd_tab, T).
+
+    ``fwd_tab[t, s]`` = microbatch whose forward runs on stage s at tick t
+    (-1 = idle), likewise ``bwd_tab``.  One op per (tick, stage); an op
+    waits until its dependency finished on a *strictly earlier* tick (the
+    ppermute delivers between ticks).
+    """
+    from .pipeline import pipedream_schedule
+    S, M = n_stages, n_microbatches
+    order = pipedream_schedule(S, M)
+    pos = [0] * S
+    fwd_done, bwd_done = {}, {}
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(pos[s] < len(order[s]) for s in range(S)):
+        frow, brow = [-1] * S, [-1] * S
+        for s in range(S):
+            if pos[s] >= len(order[s]):
+                continue
+            phase, m = order[s][pos[s]]
+            if phase == "fwd":
+                ok = s == 0 or fwd_done.get((s - 1, m), t) < t
+            else:
+                ok = (s == S - 1 or bwd_done.get((s + 1, m), t) < t) \
+                    and fwd_done.get((s, m), t) < t
+            if ok:
+                if phase == "fwd":
+                    frow[s] = m
+                    fwd_done[(s, m)] = t
+                else:
+                    brow[s] = m
+                    bwd_done[(s, m)] = t
+                pos[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > 4 * (M + S) + 8:
+            raise RuntimeError("1F1B schedule failed to converge")
+    return (np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32),
+            len(fwd_rows))
+
+
+def max_live_activations(n_stages, n_microbatches):
+    """Peak in-flight (fwd done, bwd pending) microbatches on any stage —
+    the 1F1B memory claim (== n_stages, vs n_microbatches for GPipe)."""
+    fwd_tab, bwd_tab, T = compute_1f1b_tables(n_stages, n_microbatches)
+    peak = 0
+    live = [0] * n_stages
+    for t in range(T):
+        for s in range(n_stages):
+            if fwd_tab[t, s] >= 0:
+                live[s] += 1
+            if bwd_tab[t, s] >= 0:
+                live[s] -= 1
+        peak = max(peak, max(live))
+    return peak
+
+
+def pipeline_apply_1f1b(stage_fn, stacked_params, x, n_microbatches, mesh,
+                        axis_name="pp", batch_axis="dp", key=None):
+    """1F1B counterpart of :func:`hetu_tpu.parallel.pipeline.pipeline_apply`.
+
+    Same contract (stage_fn ``(params, x[, key]) -> y`` shape-preserving,
+    stacked params leading dim = n_stages, multiple of mesh pp size); the
+    value pass is forward-only, the cotangent pass is the scheduled 1F1B
+    scan with an S-slot activation ring per stage.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from .pipeline import _normalize_stage_fn
+    from .collectives import send_next, send_prev
+
+    stage_fn = _normalize_stage_fn(stage_fn)
+    S = mesh.shape[axis_name]
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages % S:
+        raise ValueError(f"{n_stages} stages not divisible over pp={S} ranks")
+    v = n_stages // S
+    M = int(n_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+
+    dp = batch_axis if (batch_axis in mesh.axis_names) else None
+    x_spec = P(None, dp, *([None] * (x.ndim - 1)))
+    p_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+
+    fwd_tab, bwd_tab, T = compute_1f1b_tables(S, M)
+    fwd_tab = jnp.asarray(fwd_tab)
+    bwd_tab = jnp.asarray(bwd_tab)
+
+    def rank_fn(params, h, m, s_rank):
+        """Apply this rank's v consecutive stages; dropout key is folded by
+        (global stage, microbatch) so forward and recompute agree exactly."""
+        if key is None:
+            def body(hh, xs):
+                p_i, g_idx = xs
+                return stage_fn(p_i, hh, None), None
+        else:
+            def body(hh, xs):
+                p_i, g_idx = xs
+                k = jax.random.fold_in(jax.random.fold_in(key, g_idx), m)
+                return stage_fn(p_i, hh, k), None
+        g_indices = s_rank * v + jnp.arange(v)
+        out, _ = lax.scan(body, h, (params, g_indices))
+        return out
+
+    # ---------------- forward-only pipeline (value pass) -----------------
+    def fwd_local(params, xm):
+        s = lax.axis_index(axis_name)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(s == 0, inject, state)
+            # stage s processes microbatch (t - s) at tick t; the key fold
+            # must use that microbatch index so the 1F1B recompute matches
+            m_proc = jnp.clip(t - s, 0, M - 1)
+            y = rank_fn(params, inp, m_proc, s)
+            out_t = t - (S - 1)
+            valid = jnp.logical_and(
+                s == S - 1, jnp.logical_and(out_t >= 0, out_t < M))
+            upd = lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(out_t, 0, M - 1), 0)
+            outputs = jnp.where(valid, upd, outputs)
+            state = send_next(y, axis_name, S)
+            return (state, outputs), None
+
+        state0 = jnp.zeros(xm.shape[1:], xm.dtype)
+        (state, outputs), _ = lax.scan(
+            tick, (state0, jnp.zeros_like(xm)), jnp.arange(M + S - 1))
+        del state
+        return lax.psum(outputs, axis_name)
+
+    # ---------------- scheduled 1F1B cotangent pass ----------------------
+    # Stages idle at different ticks (warmup/drain bubbles), so a received
+    # value can sit several ticks before its consumer slot runs: arrivals
+    # land in S-slot receive rings keyed by the SENDER's table entry
+    # (every rank can read its neighbour's column of the static tables).
+    # fwd_tab/bwd_tab are padded with a -1 row so row t reads "what was
+    # sent at tick t-1".
+    pad = jnp.full((1, S), -1, jnp.int32)
+    fwd_prev_tab = jnp.concatenate([pad, fwd_tab])
+    bwd_prev_tab = jnp.concatenate([pad, bwd_tab])
+
+    def bwd_local(params, xm, gm):
+        s = lax.axis_index(axis_name)
+        mb_shape = xm.shape[1:]
+
+        def ring_put(ring, val, m, active):
+            upd = lax.dynamic_update_index_in_dim(ring, val, m % S, 0)
+            return jnp.where(active, upd, ring)
+
+        def tick(carry, t):
+            (fwd_raw, bwd_raw, fwd_ring, bwd_ring, act_ring, dp_acc,
+             dx_mb) = carry
+
+            # file last tick's arrivals under the sender's microbatch
+            src_f = fwd_prev_tab[t, jnp.clip(s - 1, 0, S - 1)]
+            fwd_ring = ring_put(fwd_ring, fwd_raw, jnp.clip(src_f, 0, M - 1),
+                                jnp.logical_and(s > 0, src_f >= 0))
+            src_b = bwd_prev_tab[t, jnp.clip(s + 1, 0, S - 1)]
+            bwd_ring = ring_put(bwd_ring, bwd_raw, jnp.clip(src_b, 0, M - 1),
+                                jnp.logical_and(s < S - 1, src_b >= 0))
+
+            fm = fwd_tab[t, s]
+            bm = bwd_tab[t, s]
+
+            # forward-recompute slot: stage input into the S-slot ring
+            mf = jnp.clip(fm, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(xm, mf, 0, keepdims=False)
+            x_rcv = lax.dynamic_index_in_dim(fwd_ring, mf % S, 0,
+                                             keepdims=False)
+            x_in = jnp.where(s == 0, x0, x_rcv)
+            y = rank_fn(params, x_in, mf, s)
+            act_ring = ring_put(act_ring, x_in, mf, fm >= 0)
+
+            # backward slot: vjp of this rank's stages at the ringed input
+            mb = jnp.clip(bm, 0, M - 1)
+            g0 = lax.dynamic_index_in_dim(gm, mb, 0, keepdims=False)
+            g_rcv = lax.dynamic_index_in_dim(bwd_ring, mb % S, 0,
+                                             keepdims=False)
+            g_in = jnp.where(s == S - 1, g0, g_rcv)
+            x_saved = lax.dynamic_index_in_dim(act_ring, mb % S, 0,
+                                               keepdims=False)
+            _, vjp_fn = jax.vjp(
+                lambda p, xx: rank_fn(p, xx, mb, s), params, x_saved)
+            dp_m, dx_m = vjp_fn(g_in)
+            live = bm >= 0
+            dp_acc = jax.tree.map(
+                lambda a, d: a + jnp.where(live, d, 0), dp_acc, dp_m)
+            dx_upd = lax.dynamic_update_index_in_dim(dx_mb, dx_m, mb, 0)
+            dx_mb = jnp.where(jnp.logical_and(live, s == 0), dx_upd, dx_mb)
+
+            fwd_raw = send_next(y, axis_name, S)
+            bwd_raw = send_prev(dx_m, axis_name, S)
+            return (fwd_raw, bwd_raw, fwd_ring, bwd_ring, act_ring, dp_acc,
+                    dx_mb), None
+
+        zeros_mb = jnp.zeros(mb_shape, xm.dtype)
+        ring0 = jnp.zeros((S,) + mb_shape, xm.dtype)
+        dp0 = jax.tree.map(jnp.zeros_like, params)
+        carry0 = (zeros_mb, zeros_mb, ring0, ring0, ring0, dp0,
+                  jnp.zeros_like(xm))
+        (_, _, _, _, _, dp_acc, dx_mb), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        if dp is not None:
+            dp_acc = lax.psum(dp_acc, dp)   # params replicated over dp
+        dx_mb = lax.psum(dx_mb, axis_name)  # only stage 0 wrote
+        return dp_acc, dx_mb
+
+    @jax.custom_vjp
+    def run(params, xm):
+        return jax.shard_map(fwd_local, mesh=mesh, in_specs=(p_spec, x_spec),
+                             out_specs=x_spec, check_vma=False)(params, xm)
+
+    def run_fwd(params, xm):
+        return run(params, xm), (params, xm)
+
+    def run_bwd(res, gm):
+        params, xm = res
+        dparams, dxm = jax.shard_map(
+            bwd_local, mesh=mesh, in_specs=(p_spec, x_spec, x_spec),
+            out_specs=(p_spec, x_spec), check_vma=False)(params, xm, gm)
+        return dparams, dxm
+
+    run.defvjp(run_fwd, run_bwd)
+    y_mb = run(stacked_params, x_mb)
+    return y_mb.reshape((B,) + y_mb.shape[2:])

@@ -84,52 +84,70 @@ class StoreServer:
         try:
             while True:
                 body = _recv_frame(conn)
-                op, table, nkeys, lr, width = _HDR.unpack_from(body)
-                off = _HDR.size
-                keys = np.frombuffer(body, np.int64, nkeys, off)
-                off += nkeys * 8
-                if op == OP_PULL:
-                    local_keys = keys // self.world
-                    out = self.local.pull(table, local_keys)
-                    _send_frame(conn, np.ascontiguousarray(
-                        out, np.float32).tobytes())
-                elif op == OP_PUSH:
-                    grads = np.frombuffer(
-                        body, np.float32, nkeys * width, off
-                    ).reshape(nkeys, width)
-                    self.local.push(table, keys // self.world, grads, lr)
-                    _send_frame(conn, b"\x01")
-                elif op == OP_VERSIONS:
-                    v = self.local.versions(table, keys // self.world)
-                    _send_frame(conn, np.ascontiguousarray(
-                        v, np.int64).tobytes())
-                elif op == OP_SSP_INIT:
-                    with self._ssp_lock:
-                        self._clocks = np.zeros(int(keys[0]), np.int64)
-                    _send_frame(conn, b"\x01")
-                elif op == OP_CLOCK:
-                    with self._ssp_lock:
-                        self._clocks[int(keys[0])] += 1
-                        self._ssp_lock.notify_all()
-                    _send_frame(conn, b"\x01")
-                elif op == OP_SSP_SYNC:
-                    worker, staleness = int(keys[0]), int(keys[1])
-                    timeout = lr if lr > 0 else None
-                    ok = True
-                    with self._ssp_lock:
-                        while self._clocks[worker] - self._clocks.min() \
-                                > staleness:
-                            if not self._ssp_lock.wait(timeout):
-                                ok = False
-                                break
-                    _send_frame(conn, b"\x01" if ok else b"\x00")
-                elif op == OP_SHUTDOWN:
-                    _send_frame(conn, b"\x01")
+                try:
+                    stop = self._handle(conn, body)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # surface handler errors to the client
+                    _send_frame(conn, b"\x01",
+                                f"{type(e).__name__}: {e}".encode())
+                    continue
+                if stop:
                     break
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+
+    def _handle(self, conn, body):
+        op, table, nkeys, lr, width = _HDR.unpack_from(body)
+        off = _HDR.size
+        keys = np.frombuffer(body, np.int64, nkeys, off)
+        off += nkeys * 8
+        if op == OP_PULL:
+            out = self.local.pull(table, keys // self.world)
+            _send_frame(conn, b"\x00",
+                        np.ascontiguousarray(out, np.float32).tobytes())
+        elif op == OP_PUSH:
+            grads = np.frombuffer(body, np.float32, nkeys * width,
+                                  off).reshape(nkeys, width)
+            self.local.push(table, keys // self.world, grads, lr)
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_VERSIONS:
+            v = self.local.versions(table, keys // self.world)
+            _send_frame(conn, b"\x00",
+                        np.ascontiguousarray(v, np.int64).tobytes())
+        elif op == OP_SSP_INIT:
+            with self._ssp_lock:
+                self._clocks = np.zeros(int(keys[0]), np.int64)
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_CLOCK:
+            with self._ssp_lock:
+                if self._clocks is None:
+                    raise RuntimeError(
+                        "SSP not initialised: call ssp_init(n_workers) first")
+                self._clocks[int(keys[0])] += 1
+                self._ssp_lock.notify_all()
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_SSP_SYNC:
+            worker, staleness = int(keys[0]), int(keys[1])
+            timeout = lr if lr > 0 else None
+            ok = True
+            with self._ssp_lock:
+                if self._clocks is None:
+                    raise RuntimeError(
+                        "SSP not initialised: call ssp_init(n_workers) first")
+                while self._clocks[worker] - self._clocks.min() > staleness:
+                    if not self._ssp_lock.wait(timeout):
+                        ok = False
+                        break
+            _send_frame(conn, b"\x00", b"\x01" if ok else b"\x00")
+        elif op == OP_SHUTDOWN:
+            _send_frame(conn, b"\x00\x01")
+            return True
+        else:
+            raise ValueError(f"unknown opcode {op}")
+        return False
 
     def stop(self):
         self._stop = True
@@ -155,20 +173,24 @@ class DistributedStore:
         self.endpoints[rank] = (host, self.server.port)
         self._conns = {}
         self._conn_locks = {}
-        self._connect_lock = threading.Lock()  # guards first contact
+        self._connect_lock = threading.Lock()  # guards the conn dicts
+        self._pool = None                      # lazy RPC fan-out pool
         self._tables = {}
         self._queue = queue.Queue(maxsize=async_queue)
         self._async_thread = None
 
     # -- connections -------------------------------------------------------
     def _conn(self, peer):
+        # per-peer locks so a slow/unreachable peer cannot stall RPCs to
+        # healthy peers; the short global lock only guards the dicts
         with self._connect_lock:
+            lock = self._conn_locks.setdefault(peer, threading.Lock())
+        with lock:
             if peer not in self._conns:
                 s = socket.create_connection(self.endpoints[peer], timeout=30)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conn_locks[peer] = threading.Lock()
                 self._conns[peer] = s
-            return self._conns[peer], self._conn_locks[peer]
+            return self._conns[peer], lock
 
     def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0):
         sock, lock = self._conn(peer)
@@ -176,7 +198,24 @@ class DistributedStore:
         with lock:
             _send_frame(sock, _HDR.pack(op, table, keys.size, lr, width),
                         keys.tobytes(), payload)
-            return _recv_frame(sock)
+            resp = _recv_frame(sock)
+        if not resp or resp[:1] == b"\x01":
+            raise RuntimeError(
+                f"PS rank {peer} error: {resp[1:].decode(errors='replace')}")
+        return resp[1:]
+
+    def _fanout(self, jobs):
+        """Run per-peer jobs concurrently (one in-flight RPC per peer)."""
+        if len(jobs) <= 1:
+            for fn in jobs:
+                fn()
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=max(2, self.world))
+        futs = [self._pool.submit(fn) for fn in jobs]
+        for f in futs:
+            f.result()
 
     # -- tables ------------------------------------------------------------
     def _local_rows(self, rows):
@@ -197,16 +236,21 @@ class DistributedStore:
         rows, width = self._tables[table]
         out = np.empty((flat.size, width), np.float32)
         owners = flat % self.world
+        jobs = []
         for r in range(self.world):
             sel = np.nonzero(owners == r)[0]
             if not sel.size:
                 continue
             if r == self.rank:
-                out[sel] = self.local.pull(table, flat[sel] // self.world)
+                jobs.append(lambda sel=sel: out.__setitem__(
+                    sel, self.local.pull(table, flat[sel] // self.world)))
             else:
-                raw = self._rpc(r, OP_PULL, table, flat[sel])
-                out[sel] = np.frombuffer(raw, np.float32).reshape(
-                    sel.size, width)
+                def job(r=r, sel=sel):
+                    raw = self._rpc(r, OP_PULL, table, flat[sel])
+                    out[sel] = np.frombuffer(raw, np.float32).reshape(
+                        sel.size, width)
+                jobs.append(job)
+        self._fanout(jobs)
         return out.reshape(keys.shape + (width,))
 
     def push(self, table, keys, grads, lr=-1.0):
@@ -214,16 +258,19 @@ class DistributedStore:
         rows, width = self._tables[table]
         grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
         owners = keys % self.world
+        jobs = []
         for r in range(self.world):
             sel = np.nonzero(owners == r)[0]
             if not sel.size:
                 continue
             if r == self.rank:
-                self.local.push(table, keys[sel] // self.world, grads[sel], lr)
+                jobs.append(lambda sel=sel: self.local.push(
+                    table, keys[sel] // self.world, grads[sel], lr))
             else:
-                self._rpc(r, OP_PUSH, table, keys[sel],
-                          np.ascontiguousarray(grads[sel]).tobytes(),
-                          lr, width)
+                jobs.append(lambda r=r, sel=sel: self._rpc(
+                    r, OP_PUSH, table, keys[sel],
+                    np.ascontiguousarray(grads[sel]).tobytes(), lr, width))
+        self._fanout(jobs)
 
     def push_pull(self, table, push_keys, grads, pull_keys, lr=-1.0):
         self.push(table, push_keys, grads, lr)
@@ -295,11 +342,17 @@ class DistributedStore:
         self.flush()
         if self._async_thread is not None:
             self._queue.put(None)
-        for s in self._conns.values():
+        for peer in list(self._conns):
             try:
-                s.close()
+                self._rpc(peer, OP_SHUTDOWN, 0, np.zeros(0, np.int64))
+            except (OSError, RuntimeError, ConnectionError):
+                pass
+            try:
+                self._conns[peer].close()
             except OSError:
                 pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
         self.server.stop()
 
 
