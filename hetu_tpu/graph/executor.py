@@ -469,9 +469,17 @@ class Executor:
                 tp = {_key(v): self.var_values[v] for v in node.params}
                 self.opt_states[node] = node.optimizer.init_state(tp)
 
-        self.subexecutors = {
-            name: SubExecutor(name, fetches, self)
-            for name, fetches in self.eval_node_dict.items()}
+        # subgraphs whose ops carry ht.context placement run on the
+        # inter-op model-parallel path (per-device segment chain)
+        from .interop import detect_interop, InterOpSubExecutor
+        self.subexecutors = {}
+        for name, fetches in self.eval_node_dict.items():
+            topo = topo_sort([f for f in fetches if f is not None])
+            if self.mesh is None and detect_interop(topo):
+                self.subexecutors[name] = InterOpSubExecutor(
+                    name, fetches, self)
+            else:
+                self.subexecutors[name] = SubExecutor(name, fetches, self)
 
     # -- variable init ----------------------------------------------------
 
