@@ -9,3 +9,15 @@ from .vit import (ViTConfig, vit_model, vit_classify_graph,
                   synthetic_image_batch)
 from .transformer import (TransformerConfig, transformer_graph,
                           synthetic_copy_batch)
+from .bart import BartConfig, bart_seq2seq_graph
+from .longformer import (LongformerConfig, longformer_model,
+                         longformer_mlm_graph, longformer_attention_mask)
+from .reformer import (ReformerConfig, reformer_model, reformer_lm_graph,
+                       lsh_attention)
+from .transfoxl import TransfoXLConfig, transfoxl_model, transfoxl_lm_graph
+from .clip import CLIPConfig, clip_graph, clip_vision_tower, clip_text_tower
+from .mae import MAEConfig, mae_pretrain_graph, synthetic_mae_batch
+from .bigbird import (BigBirdConfig, bigbird_model, bigbird_mlm_graph,
+                      bigbird_attention_mask)
+from .xlnet import (XLNetConfig, xlnet_model, xlnet_plm_graph,
+                    perm_masks_from_order, synthetic_plm_batch)

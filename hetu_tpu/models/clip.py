@@ -1,0 +1,161 @@
+"""CLIP (reference ``examples/transformers/clip/``).
+
+TPU-native rewrite: ViT-style image tower (patchify = one MXU GEMM) and a
+causal text tower, projected to a shared space; the symmetric InfoNCE loss
+is one (B, B) logits matmul with a learnable temperature — entirely
+matmul-shaped for the MXU.  On a 'dp' mesh the logits matrix shards over
+batch and XLA inserts the gather of the other shard's embeddings.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from .. import initializers as init
+from ..graph.node import Variable, placeholder_op
+from ..layers.attention import MultiHeadAttention
+from ..layers.core import Linear, LayerNorm
+
+
+class CLIPConfig:
+    def __init__(self, vocab_size=49408, text_hidden=512, text_layers=12,
+                 text_heads=8, text_len=77, image_size=224, patch_size=32,
+                 vision_hidden=768, vision_layers=12, vision_heads=12,
+                 projection_dim=512, logit_scale_init=2.6592,
+                 layer_norm_eps=1e-5, batch_size=8):
+        self.vocab_size = vocab_size
+        self.text_hidden = text_hidden
+        self.text_layers = text_layers
+        self.text_heads = text_heads
+        self.text_len = text_len
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.vision_hidden = vision_hidden
+        self.vision_layers = vision_layers
+        self.vision_heads = vision_heads
+        self.projection_dim = projection_dim
+        self.logit_scale_init = logit_scale_init
+        self.layer_norm_eps = layer_norm_eps
+        self.batch_size = batch_size
+        self.num_patches = (image_size // patch_size) ** 2
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("text_hidden", 64)
+        kw.setdefault("text_layers", 2)
+        kw.setdefault("text_heads", 2)
+        kw.setdefault("text_len", 16)
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("vision_hidden", 64)
+        kw.setdefault("vision_layers", 2)
+        kw.setdefault("vision_heads", 2)
+        kw.setdefault("projection_dim", 32)
+        return cls(**kw)
+
+
+def _encoder_block(hidden, heads, seq, batch, eps, causal, name):
+    from .common import pre_ln_block
+    return pre_ln_block(hidden, heads, seq, batch, eps, name, causal=causal)
+
+
+def clip_vision_tower(cfg, images, name="clip.vision"):
+    """(B, C, H, W) → pooled (B, vision_hidden)."""
+    from .common import patchify
+    x = patchify(images, cfg.batch_size, 3, cfg.image_size, cfg.patch_size,
+                 cfg.vision_hidden, name + ".patch", bias=False)
+    pos = init.truncated_normal((cfg.num_patches, cfg.vision_hidden),
+                                0.0, 0.02, name=name + ".pos")
+    pos_ids = Variable(name + ".pos_ids",
+                       value=np.arange(cfg.num_patches, dtype=np.float32),
+                       trainable=False)
+    pe = ops.embedding_lookup_op(pos, pos_ids)
+    pe = ops.array_reshape_op(
+        pe, output_shape=(1, cfg.num_patches, cfg.vision_hidden))
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.vision_hidden))
+    x = x + ops.broadcastto_op(pe, x)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size * cfg.num_patches, cfg.vision_hidden))
+    x = LayerNorm(cfg.vision_hidden, cfg.layer_norm_eps, name + ".pre_ln")(x)
+    for i in range(cfg.vision_layers):
+        x = _encoder_block(cfg.vision_hidden, cfg.vision_heads,
+                           cfg.num_patches, cfg.batch_size,
+                           cfg.layer_norm_eps, False, f"{name}.layer{i}")(x)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.vision_hidden))
+    pooled = ops.reduce_mean_op(x, [1])
+    return LayerNorm(cfg.vision_hidden, cfg.layer_norm_eps,
+                     name + ".post_ln")(pooled)
+
+
+def clip_text_tower(cfg, input_ids, name="clip.text"):
+    """(B, L) ids → pooled (B, text_hidden) (last-token pooling ≈ EOS)."""
+    word = init.truncated_normal((cfg.vocab_size, cfg.text_hidden), 0.0, 0.02,
+                                 name=name + ".word")
+    pos = init.truncated_normal((cfg.text_len, cfg.text_hidden), 0.0, 0.01,
+                                name=name + ".pos")
+    pos_ids = Variable(name + ".pos_ids",
+                       value=np.arange(cfg.text_len, dtype=np.float32),
+                       trainable=False)
+    x = ops.embedding_lookup_op(word, input_ids) \
+        + ops.embedding_lookup_op(pos, pos_ids)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size * cfg.text_len, cfg.text_hidden))
+    for i in range(cfg.text_layers):
+        x = _encoder_block(cfg.text_hidden, cfg.text_heads, cfg.text_len,
+                           cfg.batch_size, cfg.layer_norm_eps, True,
+                           f"{name}.layer{i}")(x)
+    x = LayerNorm(cfg.text_hidden, cfg.layer_norm_eps, name + ".ln_f")(x)
+    x = ops.array_reshape_op(
+        x, output_shape=(cfg.batch_size, cfg.text_len, cfg.text_hidden))
+    # last-position pooling (fixed-length inputs; EOS sits at the end)
+    last = ops.slice_op(x, begin=(0, cfg.text_len - 1, 0),
+                        size=(cfg.batch_size, 1, cfg.text_hidden))
+    return ops.array_reshape_op(last, output_shape=(cfg.batch_size,
+                                                    cfg.text_hidden))
+
+
+def _l2_normalize(x, batch, dim):
+    sq = ops.reduce_sum_op(ops.mul_op(x, x), [1], keepdims=True)
+    return x / ops.broadcastto_op(ops.sqrt_op(sq + 1e-12), x)
+
+
+def clip_graph(cfg, name="clip"):
+    """Contrastive pretraining graph.
+
+    Returns (feeds dict, loss node, (img_emb, txt_emb) nodes).
+    """
+    images = placeholder_op("images",
+                            shape=(cfg.batch_size, 3, cfg.image_size,
+                                   cfg.image_size))
+    input_ids = placeholder_op("input_ids",
+                               shape=(cfg.batch_size, cfg.text_len),
+                               dtype=np.int32)
+    iv = clip_vision_tower(cfg, images, name + ".vision")
+    tv = clip_text_tower(cfg, input_ids, name + ".text")
+    img = Linear(cfg.vision_hidden, cfg.projection_dim, bias=False,
+                 name=name + ".visual_projection")(iv)
+    txt = Linear(cfg.text_hidden, cfg.projection_dim, bias=False,
+                 name=name + ".text_projection")(tv)
+    img = _l2_normalize(img, cfg.batch_size, cfg.projection_dim)
+    txt = _l2_normalize(txt, cfg.batch_size, cfg.projection_dim)
+    scale = Variable(name + ".logit_scale",
+                     value=np.asarray([cfg.logit_scale_init], np.float32))
+    logits = ops.matmul_op(img, txt, trans_B=True)        # (B, B)
+    logits = logits * ops.broadcastto_op(ops.exp_op(scale), logits)
+    targets = Variable(name + ".targets",
+                       value=np.arange(cfg.batch_size, dtype=np.float32),
+                       trainable=False)
+    li = ops.reduce_mean_op(
+        ops.softmaxcrossentropy_sparse_op(logits, targets), [0])
+    lt = ops.reduce_mean_op(
+        ops.softmaxcrossentropy_sparse_op(
+            ops.transpose_op(logits, perm=(1, 0)), targets), [0])
+    loss = (li + lt) * 0.5
+    return {"images": images, "input_ids": input_ids}, loss, (img, txt)

@@ -1,0 +1,200 @@
+"""Reformer (reference ``examples/transformers/reformer/``).
+
+TPU-native rewrite of LSH attention: random-rotation bucketing, a *sort* by
+bucket (XLA's bitonic sort — static shapes, no data-dependent control
+flow), chunked attention over the sorted order with one chunk of lookback,
+then un-sort.  The reference's reversible-residual trick exists to avoid
+storing activations; here ``jax.checkpoint``/1F1B recompute serves that
+role (SURVEY.md §7), so blocks keep plain residuals.  Shared-QK projection
+and per-layer fixed random rotations follow the paper.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from .. import initializers as init
+from ..graph.node import Variable, placeholder_op
+from ..layers.core import Linear, LayerNorm
+from ..ops.base import def_op
+
+
+class ReformerConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, num_buckets=32, chunk_length=64,
+                 max_position_embeddings=4096, hidden_dropout_prob=0.1,
+                 layer_norm_eps=1e-12, batch_size=2, seq_len=1024):
+        assert seq_len % chunk_length == 0
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.num_buckets = num_buckets
+        self.chunk_length = chunk_length
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 2)
+        kw.setdefault("intermediate_size", 256)
+        kw.setdefault("num_buckets", 4)
+        kw.setdefault("chunk_length", 16)
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("seq_len", 64)
+        return cls(**kw)
+
+
+def lsh_attention(qk, v, rotations, chunk_length, causal=True):
+    """Single-round LSH attention, (B, H, S, D) → (B, H, S, D).
+
+    ``rotations``: (D, n_buckets // 2) fixed random projections.
+    Sorted-bucket chunking with one chunk of lookback; self-attention is
+    down-weighted (-1e5) per the paper; causal masks future *original*
+    positions.
+    """
+    b, h, s, d = qk.shape
+    c = chunk_length
+    nc = s // c
+    # --- bucket by random rotation sign pattern
+    rot = jnp.einsum("bhsd,df->bhsf", qk, rotations)
+    buckets = jnp.argmax(jnp.concatenate([rot, -rot], -1), -1)  # (B,H,S)
+    pos = jnp.arange(s)[None, None, :]
+    # stable sort: bucket-major, position-minor
+    order = jnp.argsort(buckets * (s + 1) + pos, axis=-1)       # (B,H,S)
+    inv = jnp.argsort(order, axis=-1)
+
+    def take(x, idx):
+        return jnp.take_along_axis(x, idx[..., None], axis=2)
+
+    sq = take(qk, order)
+    sv = take(v, order)
+    spos = jnp.take_along_axis(pos * jnp.ones_like(buckets), order, axis=-1)
+    # chunk and attach one lookback chunk of keys/values
+    sq_c = sq.reshape(b, h, nc, c, d)
+    sk_c = sq_c / jnp.maximum(
+        jnp.linalg.norm(sq_c, axis=-1, keepdims=True), 1e-6)  # shared-QK norm
+    sv_c = sv.reshape(b, h, nc, c, d)
+    spos_c = spos.reshape(b, h, nc, c)
+
+    def with_prev(x):
+        prev = jnp.roll(x, 1, axis=2)
+        return jnp.concatenate([prev, x], axis=3)
+
+    keys = with_prev(sk_c)                                  # (B,H,nc,2c,D)
+    vals = with_prev(sv_c)
+    kpos = with_prev(spos_c[..., None])[..., 0]             # (B,H,nc,2c)
+
+    logits = jnp.einsum("bhncd,bhnkd->bhnck", sq_c, keys,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    qpos = spos_c[..., :, None]
+    if causal:
+        logits = jnp.where(kpos[..., None, :] > qpos, -1e30, logits)
+    # self-attention only as a last resort (paper: -1e5, not -inf)
+    logits = jnp.where(kpos[..., None, :] == qpos, -1e5, logits)
+    # chunk 0 has no real predecessor (roll wraps): mask its lookback half
+    first = jnp.arange(nc)[None, None, :, None, None] == 0
+    look = jnp.arange(2 * c)[None, None, None, None, :] < c
+    logits = jnp.where(first & look, -1e30, logits)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhnck,bhnkd->bhncd", probs.astype(vals.dtype), vals,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(b, h, s, d).astype(qk.dtype)
+    return take(out, inv)                                   # un-sort
+
+
+lsh_attention_op = def_op(
+    "LSHAttention",
+    lambda ctx, qk, v, rotations, chunk_length=64, causal=True:
+        lsh_attention(qk, v, rotations, chunk_length, causal))
+
+
+class ReformerSelfAttention:
+    def __init__(self, cfg, name, seed=0):
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.heads = cfg.num_attention_heads
+        self.dk = h // self.heads
+        self.qk = Linear(h, h, bias=False, name=name + ".qk")  # shared QK
+        self.v = Linear(h, h, bias=False, name=name + ".v")
+        self.o = Linear(h, h, name=name + ".o")
+        rng = np.random.RandomState(seed)
+        self.rot = Variable(
+            name + ".rotations",
+            value=rng.randn(self.dk, cfg.num_buckets // 2).astype(np.float32),
+            trainable=False)
+
+    def _split(self, x):
+        cfg = self.cfg
+        x = ops.array_reshape_op(
+            x, output_shape=(cfg.batch_size, cfg.seq_len, self.heads,
+                             self.dk))
+        return ops.transpose_op(x, perm=(0, 2, 1, 3))
+
+    def __call__(self, x):
+        cfg = self.cfg
+        qk = self._split(self.qk(x))
+        v = self._split(self.v(x))
+        o = lsh_attention_op(qk, v, self.rot,
+                             chunk_length=cfg.chunk_length, causal=True)
+        o = ops.transpose_op(o, perm=(0, 2, 1, 3))
+        o = ops.array_reshape_op(
+            o, output_shape=(cfg.batch_size * cfg.seq_len, cfg.hidden_size))
+        return self.o(o)
+
+
+def reformer_model(cfg, input_ids, name="reformer"):
+    tokens = cfg.batch_size * cfg.seq_len
+    word = init.truncated_normal((cfg.vocab_size, cfg.hidden_size), 0.0, 0.02,
+                                 name=name + ".word")
+    pos = init.truncated_normal(
+        (cfg.max_position_embeddings, cfg.hidden_size), 0.0, 0.02,
+        name=name + ".pos")
+    pos_ids = Variable(name + ".pos_ids",
+                       value=np.arange(cfg.seq_len, dtype=np.float32),
+                       trainable=False)
+    x = ops.embedding_lookup_op(word, input_ids) \
+        + ops.embedding_lookup_op(pos, pos_ids)
+    x = ops.array_reshape_op(x, output_shape=(tokens, cfg.hidden_size))
+    for i in range(cfg.num_hidden_layers):
+        ln = f"{name}.layer{i}"
+        h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln1")(x)
+        attn = ReformerSelfAttention(cfg, ln + ".attn", seed=i)
+        x = x + attn(h)
+        h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln2")(x)
+        h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
+                   initializer=init.GenTruncatedNormal(0.0, 0.02),
+                   name=ln + ".ffn1")(h)
+        h = Linear(cfg.intermediate_size, cfg.hidden_size,
+                   initializer=init.GenTruncatedNormal(0.0, 0.02),
+                   name=ln + ".ffn2")(h)
+        x = x + ops.dropout_op(h, 1.0 - cfg.hidden_dropout_prob)
+    return LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name + ".ln_f")(x)
+
+
+def reformer_lm_graph(cfg, name="reformer"):
+    """Causal LM graph. Returns (feeds dict, loss, logits)."""
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    labels = placeholder_op("labels", shape=shape, dtype=np.int32)
+    x = reformer_model(cfg, input_ids, name)
+    logits = Linear(cfg.hidden_size, cfg.vocab_size,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".lm_head")(x)
+    from .common import masked_lm_loss
+    loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.seq_len)
+    return {"input_ids": input_ids, "labels": labels}, loss, logits

@@ -32,6 +32,12 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
     if mask is not None:
         logits = jnp.where(mask.astype(bool), logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:
+        # a fully-masked query row yields ZERO output, not the uniform
+        # softmax fallback (which would leak every value vector — e.g. the
+        # XLNet query stream's first-in-permutation position)
+        row_any = jnp.any(mask.astype(bool), axis=-1, keepdims=True)
+        probs = jnp.where(row_any, probs, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
@@ -62,6 +68,16 @@ def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
 
 
 sdpa_bias_op = def_op("ScaledDotProductAttentionBias", _sdpa_bias)
+
+
+def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
+    """Masked attention with an additive bias (XLNet two-stream layers)."""
+    return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask,
+                          bias=bias)
+
+
+sdpa_masked_bias_op = def_op("ScaledDotProductAttentionMaskedBias",
+                             _sdpa_masked_bias)
 
 
 def _has_cp(mesh):

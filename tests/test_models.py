@@ -96,3 +96,195 @@ def test_gpt2_causality():
     l2 = l2.reshape(16, -1)
     np.testing.assert_allclose(l1[:10], l2[:10], rtol=1e-5, atol=1e-5)
     assert np.abs(l1[10:] - l2[10:]).max() > 1e-3
+
+
+def test_bart_tiny_trains():
+    cfg = models.BartConfig.tiny(batch_size=2, src_len=16, tgt_len=16)
+    feeds, loss, _ = models.bart_seq2seq_graph(cfg)
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tgt = rng.randint(0, cfg.vocab_size, (2, 17)).astype(np.int32)
+    losses = _train_steps(feeds, loss,
+                          {"input_ids": src, "decoder_input_ids": tgt[:, :-1],
+                           "labels": tgt[:, 1:]}, lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_longformer_tiny_trains():
+    cfg = models.LongformerConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.longformer_mlm_graph(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, cfg.seq_len)).astype(np.int32)
+    labels = np.where(rng.rand(2, cfg.seq_len) < 0.15, ids, -1).astype(np.int32)
+    losses = _train_steps(feeds, loss, {"input_ids": ids, "labels": labels},
+                          lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_longformer_mask_pattern():
+    m = models.longformer_attention_mask(16, 4, num_global=2)
+    assert m[10, 10] == 1 and m[10, 8] == 1 and m[10, 12] == 1
+    assert m[10, 3] == 0 and m[3, 12] == 0   # outside window
+    assert m[0].all() and m[:, 0].all()      # global token row+col
+    assert m[1].all() and m[:, 1].all()
+
+
+def test_reformer_tiny_trains():
+    cfg = models.ReformerConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.reformer_lm_graph(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (2, cfg.seq_len + 1)).astype(np.int32)
+    losses = _train_steps(feeds, loss, {"input_ids": ids[:, :-1],
+                                        "labels": ids[:, 1:]}, lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_reformer_lsh_close_to_full_when_one_bucket():
+    """With a single hash bucket and chunk == seq, LSH attention equals
+    full causal attention with self-masking semantics."""
+    import jax.numpy as jnp
+    import jax
+    rng = np.random.RandomState(1)
+    b, h, s, d = 1, 1, 8, 4
+    qk = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    rot = jnp.asarray(rng.randn(d, 1).astype(np.float32))
+    out = models.lsh_attention(qk, v, rot, chunk_length=s, causal=True)
+    # reference: full causal softmax(qk @ norm(qk)^T) with -1e5 self-logits
+    k = np.asarray(qk) / np.maximum(
+        np.linalg.norm(np.asarray(qk), axis=-1, keepdims=True), 1e-6)
+    logits = np.einsum("bhqd,bhkd->bhqk", np.asarray(qk), k) / np.sqrt(d)
+    i = np.arange(s)
+    logits = np.where(i[None, :] > i[:, None], -1e30, logits)
+    logits = np.where(np.eye(s, dtype=bool), -1e5, logits)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", probs, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transfoxl_tiny_trains_and_carries_memory():
+    cfg = models.TransfoXLConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.transfoxl_lm_graph(cfg)
+    opt = ht.optim.AdamOptimizer(3e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    mem_vars = [n for n in ex.var_values
+                if n.name.endswith(".mems")]
+    assert len(mem_vars) == cfg.n_layer
+    before = [np.asarray(ex.var_values[m]).copy() for m in mem_vars]
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (2, cfg.tgt_len + 1)).astype(np.int32)
+    fd = {feeds["input_ids"]: ids[:, :-1], feeds["labels"]: ids[:, 1:]}
+    losses = [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+              for _ in range(8)]
+    after = [np.asarray(ex.var_values[m]) for m in mem_vars]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    for b, a in zip(before, after):
+        assert np.abs(a - b).max() > 0, "memory state not updated"
+
+
+def test_clip_tiny_trains():
+    cfg = models.CLIPConfig.tiny(batch_size=4)
+    feeds, loss, _ = models.clip_graph(cfg)
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(4, 3, cfg.image_size, cfg.image_size).astype(np.float32)
+    ids = rng.randint(0, cfg.vocab_size, (4, cfg.text_len)).astype(np.int32)
+    losses = _train_steps(feeds, loss, {"images": imgs, "input_ids": ids},
+                          lr=3e-3)
+    assert losses[-1] < losses[0]
+    # symmetric InfoNCE over B=4 starts near ln(4)
+    assert abs(losses[0] - np.log(4)) < 1.0
+
+
+def test_mae_tiny_trains():
+    cfg = models.MAEConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.mae_pretrain_graph(cfg)
+    imgs, shuffle = models.synthetic_mae_batch(cfg)
+    losses = _train_steps(feeds, loss, {"images": imgs, "shuffle": shuffle},
+                          lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_bigbird_tiny_trains():
+    cfg = models.BigBirdConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.bigbird_mlm_graph(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, cfg.seq_len)).astype(np.int32)
+    labels = np.where(rng.rand(2, cfg.seq_len) < 0.15, ids, -1).astype(np.int32)
+    losses = _train_steps(feeds, loss, {"input_ids": ids, "labels": labels},
+                          lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_bigbird_mask_structure():
+    m = models.bigbird_attention_mask(32, 8, num_random_blocks=1,
+                                      num_global_blocks=1, seed=0)
+    assert m.shape == (32, 32)
+    assert m[:8].all() and m[:, :8].all()          # global block
+    assert m[16, 16] == 1 and m[16, 9] == 1 and m[16, 25] == 1  # window
+    nb_attended = (m.reshape(4, 8, 4, 8).max(axis=(1, 3)) > 0).sum(1)
+    assert (nb_attended <= 1 + 3 + 1).all()        # global+window+random
+
+
+def test_xlnet_tiny_trains():
+    cfg = models.XLNetConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.xlnet_plm_graph(cfg)
+    ids, cmask, qmask, labels = models.synthetic_plm_batch(cfg)
+    losses = _train_steps(feeds, loss,
+                          {"input_ids": ids, "labels": labels,
+                           "content_mask": cmask, "query_mask": qmask},
+                          lr=3e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_xlnet_perm_masks():
+    perm = np.asarray([[2, 0, 1]])
+    cmask, qmask = models.perm_masks_from_order(perm)
+    cm, qm = cmask[0, 0], qmask[0, 0]
+    # position 2 is first in factorization: sees only itself (content)
+    assert list(cm[2]) == [0, 0, 1]
+    assert list(qm[2]) == [0, 0, 0]   # query stream: nothing before it
+    # position 1 is last: content sees all, query sees the other two
+    assert list(cm[1]) == [1, 1, 1]
+    assert list(qm[1]) == [1, 0, 1]
+
+
+def test_mae_samples_are_isolated():
+    """Un-shuffle wiring: changing sample 1's image/shuffle must not change
+    sample 0's reconstruction (regression for the cross-sample scatter)."""
+    cfg = models.MAEConfig.tiny(batch_size=2)
+    feeds, loss, recon = models.mae_pretrain_graph(cfg)
+    ex = ht.Executor({"fwd": [recon]}, seed=0)
+    imgs, shuffle = models.synthetic_mae_batch(cfg)
+    r1 = np.asarray(ex.run("fwd", feed_dict={feeds["images"]: imgs,
+                                             feeds["shuffle"]: shuffle}
+                           )[0].asnumpy())
+    imgs2 = imgs.copy()
+    imgs2[1] = np.roll(imgs2[1], 3)
+    rng = np.random.RandomState(99)
+    shuffle2 = shuffle.copy()
+    shuffle2[1] = rng.permutation(cfg.num_patches)
+    r2 = np.asarray(ex.run("fwd", feed_dict={feeds["images"]: imgs2,
+                                             feeds["shuffle"]: shuffle2}
+                           )[0].asnumpy())
+    P = cfg.num_patches
+    np.testing.assert_allclose(r1[:P], r2[:P], rtol=1e-5, atol=1e-6)
+    assert np.abs(r1[P:] - r2[P:]).max() > 1e-4
+
+
+def test_masked_attention_fully_masked_row_is_zero():
+    """sdpa_reference with an all-zero mask row returns zeros for that
+    query (no uniform-softmax value leak)."""
+    from hetu_tpu.ops.attention import sdpa_reference
+    rng = np.random.RandomState(0)
+    q = rng.randn(1, 1, 4, 8).astype(np.float32)
+    k = rng.randn(1, 1, 4, 8).astype(np.float32)
+    v = rng.randn(1, 1, 4, 8).astype(np.float32)
+    mask = np.ones((1, 1, 4, 4), np.float32)
+    mask[0, 0, 2, :] = 0.0
+    out = np.asarray(sdpa_reference(q, k, v, mask=mask))
+    np.testing.assert_allclose(out[0, 0, 2], 0.0, atol=1e-7)
+    assert np.abs(out[0, 0, 0]).max() > 0
