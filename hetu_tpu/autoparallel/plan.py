@@ -84,25 +84,58 @@ class ParallelPlan:
                 "tp": s.tp,
                 "dp": s.dp,
                 "fsdp": s.fsdp,
-                "kernel_spec": P(None, "tp") if s.tp > 1 else P(),
-                "out_kernel_spec": P("tp", None) if s.tp > 1 else P(),
+                # fsdp composes with tp: the non-tp weight dim shards over
+                # 'dp' (Megatron+ZeRO layout), realizing the cost model's
+                # param/optimizer-state division by BOTH axes
+                "kernel_spec": (
+                    P("dp" if s.fsdp else None, "tp") if s.tp > 1
+                    else (P("dp") if s.fsdp else P())),
+                "out_kernel_spec": (
+                    P("tp", "dp" if s.fsdp else None) if s.tp > 1
+                    else (P("dp") if s.fsdp else P())),
                 "param_spec": (P("dp") if s.fsdp else P()),
             })
         return out
 
-    def apply(self, layers):
+    def apply(self, layers, strict=True):
         """Annotate model layers in place.
 
         ``layers``: sequence of objects exposing (any of) ``weight_var`` /
         ``in_kernels`` / ``out_kernels`` — e.g. our Linear / attention /
         FFN layers. Column-parallel specs go on ``in_kernels``,
-        row-parallel on ``out_kernels``.
+        row-parallel on ``out_kernels``; fsdp directives shard every layer
+        kernel over 'dp' (ZeRO-style param sharding — without this the
+        MemoryCostModel's feasibility verdict would not hold at runtime).
+
+        Stage ('pp') directives cannot restructure an already-built model:
+        they are realized by building with ``ht.pipeline_block``; with
+        ``strict=True`` (default) a plan that needs pp raises here instead
+        of silently executing un-pipelined.
         """
+        import warnings
         from ..parallel.dispatch import dispatch
         directives = self.layer_specs()
         if len(layers) != len(directives):
             raise ValueError(
                 f"plan has {len(directives)} layers, model has {len(layers)}")
+        pp = max(s.pp for s in self.strategies)
+        if pp > 1:
+            msg = (f"plan assigns {pp} pipeline stages, which apply() "
+                   "cannot retrofit onto a built model — construct the "
+                   "model with ht.pipeline_block(n_stages=%d) and pass "
+                   "the plan's stage assignment instead" % pp)
+            if strict:
+                raise ValueError(msg)
+            warnings.warn(msg)
+
+        def _kernels(layer):
+            ks = list(getattr(layer, "in_kernels", []) or []) \
+                + list(getattr(layer, "out_kernels", []) or [])
+            w = getattr(layer, "weight_var", None)
+            if w is not None and w not in ks:
+                ks.append(w)
+            return ks
+
         for layer, d in zip(layers, directives):
             if d["tp"] > 1:
                 for v in getattr(layer, "in_kernels", []):
@@ -112,6 +145,14 @@ class ParallelPlan:
                 w = getattr(layer, "weight_var", None)
                 if w is not None and not getattr(layer, "in_kernels", None):
                     dispatch(w, d["kernel_spec"])
+            if d["fsdp"]:
+                # ZeRO-style: params sharded over 'dp'; XLA inserts the
+                # all-gather before use. tp-sharded kernels already carry
+                # the combined (dp, tp) spec from the branch above; this
+                # covers the remaining (tp-unsharded) kernels
+                for v in _kernels(layer):
+                    if getattr(v, "sharding", None) is None:
+                        dispatch(v, d["param_spec"])
         return directives
 
     def describe(self):

@@ -366,7 +366,14 @@ class SubExecutor:
         for node in self.ps_nodes:
             g = updates.pop("psgrad:" + _key(node), None)
             if g is not None:
-                node.push(np.asarray(g))
+                if ex.bsp == -1:
+                    # ASP (reference bsp=-1, ParameterServerCommunicate
+                    # _compute_asp_prefetch:38): push on a background
+                    # thread with a bounded in-flight window so the next
+                    # step's dispatch overlaps the PS traffic
+                    ex._ps_async_push(node, np.asarray(g))
+                else:
+                    node.push(np.asarray(g))
         for n in self.trainable_vars:
             ex.var_values[n] = new_tparams[_key(n)]
         for n in self.state_vars:
@@ -434,6 +441,12 @@ class Executor:
         self.master_key = jax.random.key(self.seed)
         self.step_counter = 0
         self.comm_mode = comm_mode
+        # bsp: 0 = synchronous push (BSP, default); -1 = ASP async push;
+        # >0 = SSP staleness bound (enforced via ps store ssp_sync by the
+        # launcher/worker loop). Reference flag semantics (README ctr:33).
+        self.bsp = int(kwargs.pop("bsp", 0))
+        self._ps_futures = []
+        self._ps_pool = None
         if pipeline is None and getattr(dist_strategy, "schedule", None):
             pipeline = dist_strategy.schedule  # PipelineParallel(schedule=..)
         if pipeline is not None and pipeline not in (
@@ -645,9 +658,40 @@ class Executor:
     def config(self):
         return self
 
+    def _ps_async_push(self, node, grad):
+        from concurrent.futures import ThreadPoolExecutor
+        if self._ps_pool is None:
+            self._ps_pool = ThreadPoolExecutor(max_workers=1)
+        # bounded in-flight window: eventual consistency, bounded
+        # staleness; completed futures are RESULT-ed (not just dropped) so
+        # a failing background push raises at the next step instead of
+        # silently losing gradients
+        pending = []
+        for f in self._ps_futures:
+            if f.done():
+                f.result()
+            else:
+                pending.append(f)
+        self._ps_futures = pending
+        while len(self._ps_futures) >= 32:
+            self._ps_futures.pop(0).result()
+        self._ps_futures.append(self._ps_pool.submit(node.push, grad))
+
+    def ps_flush(self):
+        """Barrier: wait until every ASP async push has been applied."""
+        for f in self._ps_futures:
+            f.result()
+        self._ps_futures = []
+
+    def __del__(self):
+        pool = getattr(self, "_ps_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+
     def save(self, path, file=None):
         """Checkpoint params + optimizer state + step (reference save:461,
         which loses optimizer state — we keep it, cf. SURVEY.md §5.4)."""
+        self.ps_flush()  # ASP pushes must land before persisting
         import os
         import jax
         if os.path.isdir(path) or path.endswith("/"):

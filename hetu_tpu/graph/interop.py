@@ -59,6 +59,15 @@ class InterOpSubExecutor:
     variables, one loss, one optimizer, fetches.  The segment chain must be
     *linear* (every cross-segment edge goes forward), the same contract the
     reference's manual pipeline examples satisfy.
+
+    A ``DeviceGroup`` with SEVERAL devices gives that segment its own
+    data-parallel width — the reference's *heterogeneous-DP pipeline*
+    (``pipeline_subexecutor.py:83-106``): stage activations shard their
+    batch dim over the segment's private 1-D mesh, boundary transfers
+    reshard between differently-sized stages (subsuming the gcd-cycle
+    routing schedule — see ``parallel.pipeline.heterogeneous_dp_schedule``
+    for the reference's explicit order), and parameter grads come out
+    replicated within the group (XLA inserts the cross-replica psum).
     """
 
     def __init__(self, name, fetches, executor):
@@ -78,26 +87,28 @@ class InterOpSubExecutor:
             raise NotImplementedError("interop: one optimizer per subgraph")
 
         # ---- device assignment: explicit raw_ctx, else inherit from inputs
-        self.devices = []      # ordinal -> jax device
+        # each ordinal is a device GROUP: len 1 = plain placement, len k =
+        # this segment runs k-way data-parallel (heterogeneous-DP pipeline)
+        self.device_groups = []
         dev_key_to_ord = {}
         dev_of = {}
 
-        def ordinal(dctx):
-            dev = _resolve_device(dctx)
-            k = repr(dev)
+        def ordinal(raw_ctx):
+            devs = []
+            for c in raw_ctx.contexts:
+                for cc in (c if isinstance(c, tuple) else (c,)):
+                    devs.append(_resolve_device(cc))
+            k = tuple(repr(d) for d in devs)
             if k not in dev_key_to_ord:
-                dev_key_to_ord[k] = len(self.devices)
-                self.devices.append(dev)
+                dev_key_to_ord[k] = len(self.device_groups)
+                self.device_groups.append(devs)
             return dev_key_to_ord[k]
 
         for n in self.topo:
             if isinstance(n, (OptimizerOp, GradientOp)):
                 continue
             if n.raw_ctx is not None and not isinstance(n, PlaceholderOp):
-                first = n.raw_ctx.contexts[0]
-                if isinstance(first, tuple):
-                    first = first[0]
-                dev_of[n] = ordinal(first)
+                dev_of[n] = ordinal(n.raw_ctx)
             elif n.inputs:
                 ins = [dev_of[i] for i in n.inputs if i in dev_of]
                 dev_of[n] = max(ins) if ins else 0
@@ -121,7 +132,17 @@ class InterOpSubExecutor:
                         f"{a.name} (dev {dev_of[a]}) feeds {c.name} "
                         f"(dev {dev_of[c]})")
         self.dev_of = dev_of
-        self.n_segments = len(self.devices) or 1
+        self.n_segments = len(self.device_groups) or 1
+        if not self.device_groups:
+            self.device_groups = [[jax.devices()[0]]]
+        # per-segment 1-D meshes for dp>1 groups
+        self._seg_meshes = []
+        for devs in self.device_groups:
+            if len(devs) > 1:
+                from jax.sharding import Mesh
+                self._seg_meshes.append(Mesh(np.asarray(devs), ("dp",)))
+            else:
+                self._seg_meshes.append(None)
 
         # segment bodies hold compute ops only; feeds/variables enter as
         # segment parameters/external inputs
@@ -140,12 +161,30 @@ class InterOpSubExecutor:
         self.trainable = sorted({g.wrt for g in self.grad_ops},
                                 key=lambda n: n.id)
 
-        # commit each variable's value to its segment device
+        # commit each variable's value to its segment device(s)
         for n in self.topo:
             if isinstance(n, PlaceholderOp) and n.is_variable:
                 self.ex.var_values[n] = jax.device_put(
-                    self.ex.var_values[n], self.devices[dev_of[n]])
+                    self.ex.var_values[n], self._param_target(dev_of[n]))
         self._seg_fns = None
+
+    # ---- placement targets ----------------------------------------------
+    def _param_target(self, seg):
+        """Params/grads: replicated over the segment's group."""
+        if self._seg_meshes[seg] is None:
+            return self.device_groups[seg][0]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self._seg_meshes[seg], P())
+
+    def _act_target(self, seg, ndim):
+        """Activations: batch dim sharded over the segment's dp group."""
+        if self._seg_meshes[seg] is None:
+            return self.device_groups[seg][0]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if ndim == 0:
+            return NamedSharding(self._seg_meshes[seg], P())
+        return NamedSharding(self._seg_meshes[seg],
+                             P("dp", *([None] * (ndim - 1))))
 
     # ---- per-segment pure functions -------------------------------------
     def _build_segments(self):
@@ -208,19 +247,27 @@ class InterOpSubExecutor:
             else:
                 raise ValueError(f"missing feed for {node}")
             # shared placement logic (dtype adoption, float64 downcast,
-            # NDArray unwrap), then commit to the segment's device
+            # NDArray unwrap), then commit to the segment's device(s)
+            placed = ex._place_feed(node, val)
             env[node] = jax.device_put(
-                ex._place_feed(node, val), self.devices[self.dev_of[node]])
+                placed, self._act_target(self.dev_of[node],
+                                         np.ndim(placed)))
 
         key = jax.random.fold_in(ex.master_key, ex.step_counter)
         vjps = []
         for i, seg in enumerate(self._seg_fns):
             params = [ex.var_values[v] for v in seg["vars"]]
             # explicit cross-device transfer of boundary activations — the
-            # reference's PipelineSend/Recv edge (PipelineSend.py:5); a
-            # variable shared from another segment rides the same path
+            # reference's PipelineSend/Recv edge (PipelineSend.py:5); the
+            # reshard between differently-sized dp groups is the gcd-cycle
+            # routing, done by XLA resharding. Shared variables ride the
+            # replicated path
             ext_vals = [jax.device_put(
-                env[a] if a in env else ex.var_values[a], self.devices[i])
+                env[a] if a in env else ex.var_values[a],
+                self._param_target(i)
+                if (isinstance(a, PlaceholderOp) and a.is_variable)
+                else self._act_target(i, np.ndim(env[a] if a in env
+                                                 else ex.var_values[a])))
                 for a in seg["ext_in"]]
             k = jax.random.fold_in(key, i)
 
@@ -240,7 +287,8 @@ class InterOpSubExecutor:
                 seg = self._seg_fns[i]
                 d_outs = [cot.get(o, None) for o in seg["outs"]]
                 d_outs = [jax.numpy.zeros_like(env[o]) if d is None
-                          else jax.device_put(d, self.devices[i])
+                          else jax.device_put(
+                              d, self._act_target(i, np.ndim(d)))
                           for d, o in zip(d_outs, seg["outs"])]
                 d_params, d_ext = vjps[i](d_outs)
                 for v, g in zip(seg["vars"], d_params):
@@ -249,14 +297,15 @@ class InterOpSubExecutor:
                     if isinstance(a, PlaceholderOp):
                         if a.is_variable:
                             # variable shared across segments: its grad
-                            # accumulates on the home device
+                            # accumulates on the home device(s)
                             g = jax.device_put(
-                                g, self.devices[self.dev_of[a]])
+                                g, self._param_target(self.dev_of[a]))
                             grads[a] = grads[a] + g if a in grads else g
                         continue
                     # activation fan-out across segments: accumulate on the
                     # producer's device (committed arrays must agree)
-                    g = jax.device_put(g, self.devices[self.dev_of[a]])
+                    g = jax.device_put(
+                        g, self._act_target(self.dev_of[a], np.ndim(g)))
                     if a in cot:
                         cot[a] = cot[a] + g
                     else:

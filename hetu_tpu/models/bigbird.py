@@ -99,19 +99,17 @@ class _BigBirdLayer:
         self.mask = mask
 
     def _split(self, x):
+        from .common import split_heads
         cfg = self.cfg
-        x = ops.array_reshape_op(
-            x, output_shape=(cfg.batch_size, cfg.seq_len, self.heads,
-                             self.dk))
-        return ops.transpose_op(x, perm=(0, 2, 1, 3))
+        return split_heads(x, cfg.batch_size, cfg.seq_len, self.heads,
+                           self.dk)
 
     def __call__(self, x):
+        from .common import merge_heads
         cfg = self.cfg
         o = ops.sdpa_masked_op(self._split(self.q(x)), self._split(self.k(x)),
                                self._split(self.v(x)), self.mask)
-        o = ops.transpose_op(o, perm=(0, 2, 1, 3))
-        o = ops.array_reshape_op(
-            o, output_shape=(cfg.batch_size * cfg.seq_len, cfg.hidden_size))
+        o = merge_heads(o, cfg.batch_size, cfg.seq_len, cfg.hidden_size)
         return ops.dropout_op(self.o(o), 1.0 - cfg.hidden_dropout_prob)
 
 
@@ -136,21 +134,9 @@ def bigbird_model(cfg, input_ids, name="bigbird"):
     shared_mask = Variable(name + ".sparse_mask",
                            value=m.reshape(1, 1, cfg.seq_len, cfg.seq_len),
                            trainable=False)
-    for i in range(cfg.num_hidden_layers):
-        ln = f"{name}.layer{i}"
-        attn = _BigBirdLayer(cfg, ln + ".attn", mask=shared_mask)
-        x = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps,
-                      ln + ".ln1")(x + attn(x))
-        h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
-                   initializer=init.GenTruncatedNormal(0.0, 0.02),
-                   name=ln + ".ffn1")(x)
-        h = Linear(cfg.intermediate_size, cfg.hidden_size,
-                   initializer=init.GenTruncatedNormal(0.0, 0.02),
-                   name=ln + ".ffn2")(h)
-        h = ops.dropout_op(h, 1.0 - cfg.hidden_dropout_prob)
-        x = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps,
-                      ln + ".ln2")(x + h)
-    return x
+    from .common import post_ln_encoder_stack
+    return post_ln_encoder_stack(
+        x, cfg, lambda nm: _BigBirdLayer(cfg, nm, mask=shared_mask), name)
 
 
 def bigbird_mlm_graph(cfg, name="bigbird"):

@@ -13,7 +13,6 @@ import numpy as np
 from .. import ops
 from .. import initializers as init
 from ..graph.node import Variable, placeholder_op
-from ..layers.attention import MultiHeadAttention
 from ..layers.core import Linear, LayerNorm
 
 
@@ -59,11 +58,6 @@ class CLIPConfig:
         return cls(**kw)
 
 
-def _encoder_block(hidden, heads, seq, batch, eps, causal, name):
-    from .common import pre_ln_block
-    return pre_ln_block(hidden, heads, seq, batch, eps, name, causal=causal)
-
-
 def clip_vision_tower(cfg, images, name="clip.vision"):
     """(B, C, H, W) → pooled (B, vision_hidden)."""
     from .common import patchify
@@ -83,10 +77,11 @@ def clip_vision_tower(cfg, images, name="clip.vision"):
     x = ops.array_reshape_op(
         x, output_shape=(cfg.batch_size * cfg.num_patches, cfg.vision_hidden))
     x = LayerNorm(cfg.vision_hidden, cfg.layer_norm_eps, name + ".pre_ln")(x)
+    from .common import pre_ln_block
     for i in range(cfg.vision_layers):
-        x = _encoder_block(cfg.vision_hidden, cfg.vision_heads,
-                           cfg.num_patches, cfg.batch_size,
-                           cfg.layer_norm_eps, False, f"{name}.layer{i}")(x)
+        x = pre_ln_block(cfg.vision_hidden, cfg.vision_heads,
+                         cfg.num_patches, cfg.batch_size,
+                         cfg.layer_norm_eps, f"{name}.layer{i}")(x)
     x = ops.array_reshape_op(
         x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.vision_hidden))
     pooled = ops.reduce_mean_op(x, [1])
@@ -107,10 +102,11 @@ def clip_text_tower(cfg, input_ids, name="clip.text"):
         + ops.embedding_lookup_op(pos, pos_ids)
     x = ops.array_reshape_op(
         x, output_shape=(cfg.batch_size * cfg.text_len, cfg.text_hidden))
+    from .common import pre_ln_block
     for i in range(cfg.text_layers):
-        x = _encoder_block(cfg.text_hidden, cfg.text_heads, cfg.text_len,
-                           cfg.batch_size, cfg.layer_norm_eps, True,
-                           f"{name}.layer{i}")(x)
+        x = pre_ln_block(cfg.text_hidden, cfg.text_heads, cfg.text_len,
+                         cfg.batch_size, cfg.layer_norm_eps,
+                         f"{name}.layer{i}", causal=True)(x)
     x = LayerNorm(cfg.text_hidden, cfg.layer_norm_eps, name + ".ln_f")(x)
     x = ops.array_reshape_op(
         x, output_shape=(cfg.batch_size, cfg.text_len, cfg.text_hidden))
@@ -121,7 +117,7 @@ def clip_text_tower(cfg, input_ids, name="clip.text"):
                                                     cfg.text_hidden))
 
 
-def _l2_normalize(x, batch, dim):
+def _l2_normalize(x):
     sq = ops.reduce_sum_op(ops.mul_op(x, x), [1], keepdims=True)
     return x / ops.broadcastto_op(ops.sqrt_op(sq + 1e-12), x)
 
@@ -143,8 +139,8 @@ def clip_graph(cfg, name="clip"):
                  name=name + ".visual_projection")(iv)
     txt = Linear(cfg.text_hidden, cfg.projection_dim, bias=False,
                  name=name + ".text_projection")(tv)
-    img = _l2_normalize(img, cfg.batch_size, cfg.projection_dim)
-    txt = _l2_normalize(txt, cfg.batch_size, cfg.projection_dim)
+    img = _l2_normalize(img)
+    txt = _l2_normalize(txt)
     scale = Variable(name + ".logit_scale",
                      value=np.asarray([cfg.logit_scale_init], np.float32))
     logits = ops.matmul_op(img, txt, trans_B=True)        # (B, B)

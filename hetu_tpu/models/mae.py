@@ -14,7 +14,6 @@ import numpy as np
 from .. import ops
 from .. import initializers as init
 from ..graph.node import Variable, placeholder_op
-from ..layers.attention import MultiHeadAttention
 from ..layers.core import Linear, LayerNorm
 
 

@@ -380,7 +380,10 @@ def export(source, path, name="hetu_graph", feed_shapes=None, opset=20):
         names[node] = out
         ins = [names[i] for i in node.inputs]
         nodes.extend(handler(node, ins, out, ctx))
-    outputs = [ValueInfo(names[f], proto.FLOAT,
+    outputs = [ValueInfo(names[f],
+                         proto.NP2ONNX.get(
+                             np.dtype(getattr(f, "dtype", None)
+                                      or np.float32), proto.FLOAT),
                          list(getattr(f, "shape", None) or []))
                for f in fetches]
     graph = Graph(name=name, nodes=nodes, inputs=inputs, outputs=outputs,

@@ -152,8 +152,30 @@ def _layernorm(node, ins, env):
 
 @register_importer("BatchNormalization")
 def _batchnorm(node, ins, env):
-    return _ops.batch_normalization_op(
-        ins[0], ins[1], ins[2], eps=float(node.attrs.get("epsilon", 1e-5)))
+    # ONNX momentum m: running = m·running + (1−m)·batch; BatchNormOp's
+    # momentum is the batch weight, hence 1 − m
+    bn = _ops.batch_normalization_op(
+        ins[0], ins[1], ins[2],
+        momentum=1.0 - float(node.attrs.get("momentum", 0.9)),
+        eps=float(node.attrs.get("epsilon", 1e-5)))
+    # seed running stats from ONNX inputs 3/4 (trained mean/var) so the
+    # imported model normalizes correctly in inference mode; the stats may
+    # already be lifted to Variables (as_node), so read through either form
+    def _arr(name):
+        v = env.get(name)
+        if isinstance(v, np.ndarray):
+            return v
+        val = getattr(v, "_value", None)
+        return None if val is None else np.asarray(val)
+
+    if len(node.inputs) >= 5:
+        mean_v = _arr(node.inputs[3])
+        var_v = _arr(node.inputs[4])
+        if mean_v is not None:
+            bn.running_mean.set_value(np.asarray(mean_v, np.float32))
+        if var_v is not None:
+            bn.running_var.set_value(np.asarray(var_v, np.float32))
+    return bn
 
 
 @register_importer("Gather")
@@ -207,12 +229,42 @@ def _expand(node, ins, env):
         ins[0], output_shape=tuple(int(d) for d in shape))
 
 
+def _node_shape(n):
+    """Best-effort static shape via recursive infer_shape."""
+    sh = getattr(n, "shape", None)
+    if sh is not None:
+        return sh
+    ins = [_node_shape(i) for i in getattr(n, "inputs", [])]
+    if any(s is None for s in ins):
+        return None
+    try:
+        return n.infer_shape(ins)
+    except Exception:
+        return None
+
+
+def _input_rank(node_in):
+    shape = _node_shape(node_in)
+    return None if shape is None else len(shape)
+
+
 @register_importer("Unsqueeze")
 def _unsq(node, ins, env):
-    axes = node.attrs.get("axes")
-    if axes is None:
-        axes = list(_const_value(env, node.inputs[1]))
-    return _ops.unsqueeze_op(ins[0], axis=int(axes[0]))
+    axes = [int(a) for a in (node.attrs.get("axes")
+                             or _const_value(env, node.inputs[1]))]
+    if any(a < 0 for a in axes):
+        # ONNX: negative axes index the OUTPUT rank (input rank + len(axes))
+        r = _input_rank(ins[0])
+        if r is None:
+            raise NotImplementedError(
+                "Unsqueeze with negative axes needs a known input rank")
+        axes = [a if a >= 0 else a + r + len(axes) for a in axes]
+    out = ins[0]
+    # insert in ascending axis order: each ONNX axis indexes the FINAL
+    # shape, which ascending insertion reproduces incrementally
+    for a in sorted(axes):
+        out = _ops.unsqueeze_op(out, axis=a)
+    return out
 
 
 @register_importer("Squeeze")
@@ -220,7 +272,21 @@ def _sq(node, ins, env):
     axes = node.attrs.get("axes")
     if axes is None and len(node.inputs) > 1:
         axes = list(_const_value(env, node.inputs[1]))
-    return _ops.squeeze_op(ins[0], axis=int(axes[0]) if axes else None)
+    if not axes:
+        return _ops.squeeze_op(ins[0], axis=None)
+    axes = [int(a) for a in axes]
+    if any(a < 0 for a in axes):
+        r = _input_rank(ins[0])    # ONNX: negative axes index the input rank
+        if r is None:
+            raise NotImplementedError(
+                "Squeeze with negative axes needs a known input rank")
+        axes = [a if a >= 0 else a + r for a in axes]
+    out = ins[0]
+    # remove in descending order so earlier removals don't shift the
+    # remaining (input-relative) axis indices
+    for a in sorted(axes, reverse=True):
+        out = _ops.squeeze_op(out, axis=a)
+    return out
 
 
 @register_importer("Where")

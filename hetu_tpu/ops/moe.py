@@ -303,7 +303,26 @@ def _alltoall(c, x):
 
 
 alltoall_op = def_op("AllToAll", _alltoall)
-halltoall_op = def_op("HAllToAll", _alltoall)  # 2-level mesh handled by XLA
+
+
+def _halltoall(c, x):
+    """Hierarchical a2a (reference HAllToAll.cu + mpi_nccl dlarrayHAllToAll
+    :396).  Under a 2-D ('ep_outer','ep_inner') mesh the leading dim is
+    exchanged with the explicit intra-node → inter-node 2-phase schedule;
+    on a flat 'ep' mesh it degrades to the sharding-constraint alltoall."""
+    mesh = c.mesh
+    if mesh is not None and "ep_outer" in mesh.axis_names \
+            and "ep_inner" in mesh.axis_names:
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.collectives import hierarchical_all_to_all
+        spec = P(("ep_outer", "ep_inner"), *([None] * (x.ndim - 1)))
+        return jax.shard_map(
+            lambda v: hierarchical_all_to_all(v, "ep_outer", "ep_inner"),
+            mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)(x)
+    return _alltoall(c, x)
+
+
+halltoall_op = def_op("HAllToAll", _halltoall)
 
 
 # ---------------------------------------------------------------------------

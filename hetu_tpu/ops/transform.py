@@ -34,8 +34,24 @@ transpose_op = def_op(
     "Transpose", lambda c, a, perm=None: jnp.transpose(a, perm),
     lambda a, perm=None: tuple(np.empty(a).transpose(perm).shape))
 
-unsqueeze_op = def_op("Unsqueeze", lambda c, a, axis=0: jnp.expand_dims(a, axis))
-squeeze_op = def_op("Squeeze", lambda c, a, axis=None: jnp.squeeze(a, axis))
+def _unsqueeze_shape(a, axis=0):
+    s = list(a)
+    s.insert(axis if axis >= 0 else axis + len(a) + 1, 1)
+    return tuple(s)
+
+
+def _squeeze_shape(a, axis=None):
+    if axis is None:
+        return tuple(d for d in a if d != 1)
+    return tuple(d for i, d in enumerate(a)
+                 if i != (axis if axis >= 0 else axis + len(a)))
+
+
+unsqueeze_op = def_op("Unsqueeze",
+                      lambda c, a, axis=0: jnp.expand_dims(a, axis),
+                      _unsqueeze_shape)
+squeeze_op = def_op("Squeeze", lambda c, a, axis=None: jnp.squeeze(a, axis),
+                    _squeeze_shape)
 
 # -- concat / split ---------------------------------------------------------
 concat_op = def_op("Concat", lambda c, a, b, axis=0: jnp.concatenate([a, b], axis))

@@ -87,11 +87,27 @@ def send_prev(x, axis_name, n):
 
 def hierarchical_all_to_all(x, outer_axis, inner_axis):
     """2-level a2a (reference HAllToAll:396 + HA2AGather/Scatter: intra-node
-    gather → inter-node a2a).  On a 2-D (DCN, ICI) mesh: a2a over the inner
-    (fast) axis first, then over the outer axis — XLA overlaps both; kept as
-    an explicit schedule for DCN-bound MoE."""
-    x = all_to_all(x, inner_axis, 0, 0)
-    return all_to_all(x, outer_axis, 0, 0)
+    exchange → inter-node exchange).
+
+    Semantically IDENTICAL to a flat ``all_to_all`` over the combined
+    (outer-major) axis — parity-tested in tests/test_collectives.py — but
+    expressed as a fast-axis (ICI) exchange followed by a slow-axis (DCN)
+    exchange, the explicit schedule for DCN-bound MoE (SURVEY.md §5.8).
+
+    ``x``: (E·k, ...) per-device send buffer, chunk j destined for flat
+    rank j (j = o·inner + i).  Returns the received buffer in flat source
+    order, exactly like ``all_to_all(x, flat_axis)``.
+    """
+    O = jax.lax.psum(1, outer_axis)
+    I = jax.lax.psum(1, inner_axis)
+    k = x.shape[0] // (O * I)
+    rest = x.shape[1:]
+    y = x.reshape((O, I, k) + rest)
+    # phase 1 (fast axis): peer i' receives our slice [:, i', ...]
+    y = jax.lax.all_to_all(y, inner_axis, split_axis=1, concat_axis=1)
+    # phase 2 (slow axis): peer o' receives the regrouped slice [o', ...]
+    y = jax.lax.all_to_all(y, outer_axis, split_axis=0, concat_axis=0)
+    return y.reshape((O * I * k,) + rest)
 
 
 # -- group communicators (reference mpi_nccl_comm group concept) ------------

@@ -1,0 +1,112 @@
+"""HetPipe execution semantics — local optimizer steps + periodic sync.
+
+Reference (``pipedream_subexecutor.py:77-83, 317-328``): under
+``pipeline='hetpipe'`` each dp replica of a stage accumulates grads and
+applies its optimizer LOCALLY every batch, and the parameter server
+reconciles the replicas every ``pp_nrank`` batches — bounded-staleness
+data parallelism layered over the pipeline (the HetPipe paper's WSP).
+
+TPU-native design: there is no parameter server between synchronous SPMD
+replicas, so the WSP semantics are expressed functionally — each replica
+owns a diverging copy of the parameters (stacked leading 'dp' axis,
+sharded over the mesh), steps are per-replica ``shard_map`` programs with
+NO gradient collective, and the periodic PS reconciliation is a pmean over
+the replica axis every ``sync_every`` steps.  For SGD with sync_every=1
+this is exactly BSP data parallelism (mean-of-updates == update-of-mean),
+parity-tested; larger sync_every trades gradient freshness for zero
+per-step collectives — the reference's bounded-staleness knob.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class HetPipeTrainer:
+    """Local-update data parallelism with periodic parameter averaging.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> scalar`` for ONE replica's
+        microbatch (params: dict name → array).
+      params: dict name → initial value (replicated to every replica).
+      optimizer: a :mod:`hetu_tpu.optim` optimizer instance.
+      mesh: 1-D mesh whose ``axis`` dimension enumerates replicas.
+      sync_every: reconcile interval in steps (reference pp_nrank).
+    """
+
+    def __init__(self, loss_fn, params, optimizer, mesh, sync_every,
+                 axis="dp"):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.axis = axis
+        self.n = mesh.shape[axis]
+        self.sync_every = int(sync_every)
+        self.optimizer = optimizer
+        self.step_count = 0
+
+        stack = lambda v: jnp.broadcast_to(
+            jnp.asarray(v)[None], (self.n,) + np.shape(v))
+        sharded = NamedSharding(mesh, P(axis))
+        self.params = {k: jax.device_put(stack(v), sharded)
+                       for k, v in params.items()}
+        st = optimizer.init_state({k: np.asarray(v)
+                                   for k, v in params.items()})
+        self.opt_state = jax.tree.map(
+            lambda v: jax.device_put(stack(v), sharded), st)
+
+        p_spec = jax.tree.map(lambda _: P(axis), self.params)
+        st_spec = jax.tree.map(lambda _: P(axis), self.opt_state)
+        b_spec = P(axis)
+
+        def local_step(params, opt_state, batch, lr):
+            # leading stacked axis is 1 per replica inside shard_map
+            p = jax.tree.map(lambda v: v[0], params)
+            st = jax.tree.map(lambda v: v[0], opt_state)
+            b = jax.tree.map(lambda v: v, batch)
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            new_p, new_st = optimizer.apply(p, grads, st, lr)
+            expand = lambda t: jax.tree.map(lambda v: v[None], t)
+            return expand(new_p), expand(new_st), loss[None]
+
+        self._step = jax.jit(jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(p_spec, st_spec, b_spec, P()),
+            out_specs=(p_spec, st_spec, P(axis)), check_vma=False))
+
+        def sync(params):
+            from jax import lax
+            p = jax.tree.map(lambda v: v[0], params)
+            avg = jax.tree.map(lambda v: lax.pmean(v, axis), p)
+            return jax.tree.map(lambda v: v[None], avg)
+
+        self._sync = jax.jit(jax.shard_map(
+            sync, mesh=mesh, in_specs=(p_spec,), out_specs=p_spec,
+            check_vma=False))
+
+    def step(self, batch, lr=None):
+        """One local step per replica (batch leading dim shards over the
+        replica axis); returns per-replica losses.  Applies the periodic
+        reconciliation when due."""
+        import numpy as _np
+        lr = self.optimizer.host_lr(self.step_count) if lr is None else lr
+        self.params, self.opt_state, losses = self._step(
+            self.params, self.opt_state, batch, _np.float32(lr))
+        self.step_count += 1
+        if self.step_count % self.sync_every == 0:
+            self.params = self._sync(self.params)
+        return losses
+
+    def replica_params(self, r=0):
+        import jax
+        return {k: np.asarray(v)[r] for k, v in self.params.items()}
+
+    def max_divergence(self):
+        """Max abs difference of any parameter across replicas (0 right
+        after a sync)."""
+        worst = 0.0
+        for v in self.params.values():
+            a = np.asarray(v)
+            worst = max(worst, float(np.max(np.abs(a - a[0:1]))))
+        return worst

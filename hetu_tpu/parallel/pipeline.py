@@ -230,6 +230,23 @@ def hetpipe_sync_steps(step, pp_nrank):
     return (step + 1) % pp_nrank == 0
 
 
+def heterogeneous_dp_schedule(stage_dps, n_microbatches):
+    """Microbatch→replica routing for per-stage dp degrees (reference
+    ``get_schedule_for_different_dp``, pipeline_subexecutor.py:83-106, and
+    PipelineSend's round-robin targets :36-39).
+
+    Returns ``[{stage: replica}] * n_microbatches``: microbatch m runs on
+    replica ``m % dp[s]`` of stage s — the gcd-cycle pattern: the routing
+    between stages s and s+1 repeats with period lcm(dp[s], dp[s+1]).
+
+    In the SPMD executor this schedule is *subsumed* by resharding between
+    per-segment meshes (``graph.interop``); the generator documents the
+    reference order and drives tests.
+    """
+    return [{s: m % dp for s, dp in enumerate(stage_dps)}
+            for m in range(n_microbatches)]
+
+
 # ---------------------------------------------------------------------------
 # Graph-frontend op: ht.pipeline_block — define ONE stage as a subgraph,
 # replicate S× with stacked pp-sharded weights.

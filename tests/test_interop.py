@@ -165,3 +165,68 @@ def test_interop_residual_across_segments():
         l1 = float(np.asarray(ex1.run("train", feed_dict={x1: xv})[0].jax()))
         l2 = float(np.asarray(ex2.run("train", feed_dict={x2: xv})[0].jax()))
         np.testing.assert_allclose(l1, l2, rtol=1e-5, err_msg=f"step {step}")
+
+
+def test_interop_heterogeneous_dp_pipeline():
+    """Per-stage dp degrees (reference heterogeneous-DP pipeline,
+    pipeline_subexecutor.py:83-106): stage A dp=4 on devices 0-3, stage B
+    dp=2 on devices 4-5; numerics must match the single-device run."""
+    import jax
+    rng = np.random.RandomState(5)
+    xv = rng.randn(16, 8).astype(np.float32)
+    yv = rng.randn(16, 4).astype(np.float32)
+
+    def build(placed):
+        import contextlib
+        x = ht.placeholder_op("x", shape=(16, 8))
+        y = ht.placeholder_op("y", shape=(16, 4))
+        c0 = ht.context([ht.gpu(0), ht.gpu(1), ht.gpu(2), ht.gpu(3)]) \
+            if placed else contextlib.nullcontext()
+        c1 = ht.context([ht.gpu(4), ht.gpu(5)]) \
+            if placed else contextlib.nullcontext()
+        with c0:
+            h = ht.layers.Linear(8, 16, activation="relu", name="hd0")(x)
+        with c1:
+            o = ht.layers.Linear(16, 4, name="hd1")(h)
+            loss = ht.ops.reduce_mean_op(ht.ops.mul_op(o - y, o - y), [0, 1])
+        opt = ht.optim.MomentumOptimizer(0.05)
+        return x, y, ht.Executor({"train": [loss, opt.minimize(loss)]},
+                                 seed=11)
+
+    x1, y1, ex_p = build(True)
+    sub = ex_p.subexecutors["train"]
+    from hetu_tpu.graph.interop import InterOpSubExecutor
+    assert isinstance(sub, InterOpSubExecutor)
+    assert [len(g) for g in sub.device_groups] == [4, 2]
+    x2, y2, ex_s = build(False)
+    for step in range(4):
+        lp = float(np.asarray(
+            ex_p.run("train", feed_dict={x1: xv, y1: yv})[0].jax()))
+        ls = float(np.asarray(
+            ex_s.run("train", feed_dict={x2: xv, y2: yv})[0].jax()))
+        np.testing.assert_allclose(lp, ls, rtol=1e-5, err_msg=f"step {step}")
+    # stage-A weights live sharded/replicated over its 4-device group
+    wa = [v for v in ex_p.var_values if v.name.startswith("hd0")][0]
+    assert len(ex_p.var_values[wa].devices()) == 4
+
+
+def test_heterogeneous_dp_schedule_properties():
+    from hetu_tpu.parallel.pipeline import heterogeneous_dp_schedule
+    dps = [4, 2, 1]
+    M = 8
+    sched = heterogeneous_dp_schedule(dps, M)
+    assert len(sched) == M
+    # every stage serves every microbatch; per-replica load is balanced
+    for s, dp in enumerate(dps):
+        counts = {}
+        for m, route in enumerate(sched):
+            assert 0 <= route[s] < dp
+            counts[route[s]] = counts.get(route[s], 0) + 1
+        assert all(c == M // dp for c in counts.values())
+    # gcd-cycle: routing pattern between adjacent stages repeats with
+    # period lcm(dp_s, dp_{s+1})
+    import math
+    for s in range(len(dps) - 1):
+        period = math.lcm(dps[s], dps[s + 1])
+        pairs = [(r[s], r[s + 1]) for r in sched]
+        assert pairs[:M - period] == pairs[period:]

@@ -179,3 +179,67 @@ def test_elementwise_and_reduce_roundtrip(tmp_path):
     m = load(path)
     got = _run(m.outputs, {m.feeds["x"]: xv})[0]
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_import_restores_running_stats(tmp_path):
+    """hetu→onnx→hetu: imported BN must normalize with the TRAINED running
+    stats in inference mode, matching the source model's outputs."""
+    rng = np.random.RandomState(8)
+    x = ht.placeholder_op("x", shape=(8, 4, 5, 5), dtype=np.float32)
+    scale = ht.Variable("scale", value=np.ones(4, np.float32) * 1.5)
+    bias = ht.Variable("bias", value=np.full(4, 0.25, np.float32))
+    bn = ht.batch_normalization_op(x, scale, bias)
+    loss = ht.reduce_mean_op(ht.array_reshape_op(
+        bn, output_shape=(8 * 4 * 5 * 5,)), [0])
+    ex = ht.Executor({"train": [loss], "infer": [bn]}, seed=0)
+    xv = (rng.randn(8, 4, 5, 5) * 3 + 1).astype(np.float32)
+    for _ in range(5):
+        ex.run("train", feed_dict={x: xv})
+    x2 = (rng.randn(8, 4, 5, 5)).astype(np.float32)  # different batch!
+    want = np.asarray(ex.run("infer", feed_dict={x: x2})[0].asnumpy())
+    path = str(tmp_path / "bn_rt.onnx")
+    export(ex, path)
+    m = load(path)
+    # executor export carries every subgraph's fetches: [train loss, infer bn]
+    got = _run([m.outputs[1]], {m.feeds["x"]: x2})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_axis_squeeze_unsqueeze_import(tmp_path):
+    from hetu_tpu.onnx.proto import (Graph, Model as M, Node as N,
+                                     ValueInfo, FLOAT)
+    rng = np.random.RandomState(9)
+    a = rng.randn(2, 1, 3, 1).astype(np.float32)
+    g = Graph(name="g",
+              nodes=[N("Squeeze", ["a"], ["s"], name="sq", axes=[1, 3]),
+                     N("Unsqueeze", ["s"], ["u"], name="us", axes=[0, 2])],
+              inputs=[ValueInfo("a", FLOAT, [2, 1, 3, 1])],
+              outputs=[ValueInfo("u", FLOAT, [1, 2, 1, 3])],
+              initializers=[])
+    path = str(tmp_path / "sq.onnx")
+    M(g).save(path)
+    m = load(path)
+    got = _run(m.outputs, {m.feeds["a"]: a})[0]
+    np.testing.assert_allclose(got, a.reshape(2, 3).reshape(1, 2, 1, 3))
+
+
+def test_negative_axes_squeeze_unsqueeze_import(tmp_path):
+    from hetu_tpu.onnx.proto import (Graph, Model as M, Node as N,
+                                     ValueInfo, FLOAT)
+    rng = np.random.RandomState(10)
+    a = rng.randn(2, 3).astype(np.float32)
+    # Unsqueeze axes=[-1,-2] on rank 2 → (2, 3, 1, 1) per ONNX spec
+    g = Graph(name="g",
+              nodes=[N("Unsqueeze", ["a"], ["u"], name="us", axes=[-1, -2]),
+                     N("Squeeze", ["u"], ["s"], name="sq", axes=[-1, -2])],
+              inputs=[ValueInfo("a", FLOAT, [2, 3])],
+              outputs=[ValueInfo("u", FLOAT, [2, 3, 1, 1]),
+                       ValueInfo("s", FLOAT, [2, 3])],
+              initializers=[])
+    path = str(tmp_path / "negax.onnx")
+    M(g).save(path)
+    m = load(path)
+    u, s_out = _run(m.outputs, {m.feeds["a"]: a})
+    assert u.shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(u.reshape(2, 3), a)
+    np.testing.assert_allclose(s_out, a)

@@ -384,3 +384,67 @@ def test_1f1b_residual_memory_smaller_than_gpipe():
     jp_p = jax.make_jaxpr(jax.grad(loss_1f1b))(params).jaxpr
     bg, bp = max_bytes(jp_g), max_bytes(jp_p)
     assert bp * 2 <= bg, (bp, bg)
+
+
+# ---------------------------------------------------------------- HetPipe
+def test_hetpipe_sync1_sgd_equals_bsp():
+    """WSP with sync_every=1 under SGD == BSP data parallelism exactly
+    (mean of local updates == update with mean gradient)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.parallel.hetpipe import HetPipeTrainer
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(8, 4).astype(np.float32) * 0.3
+    xs = rng.randn(6, 32, 8).astype(np.float32)
+    ys = rng.randn(6, 32, 4).astype(np.float32)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    mesh = ht.make_mesh({"dp": 4}, jax.devices()[:4])
+    opt = ht.optim.SGDOptimizer(0.1)
+    tr = HetPipeTrainer(loss_fn, {"w": w0}, opt, mesh, sync_every=1)
+
+    # reference BSP: full-batch gradient step (mean over all samples)
+    w_ref = jnp.asarray(w0)
+    for t in range(6):
+        g = jax.grad(lambda w: loss_fn({"w": w}, (xs[t], ys[t])))(w_ref)
+        w_ref = w_ref - 0.1 * g
+        tr.step((xs[t], ys[t]))
+        assert tr.max_divergence() < 1e-6  # synced every step
+    np.testing.assert_allclose(tr.replica_params(0)["w"], np.asarray(w_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hetpipe_periodic_sync_diverges_then_reconciles():
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.parallel.hetpipe import HetPipeTrainer
+
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(8, 4).astype(np.float32) * 0.3
+    w_true = rng.randn(8, 4).astype(np.float32)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    mesh = ht.make_mesh({"dp": 4}, jax.devices()[:4])
+    tr = HetPipeTrainer(loss_fn, {"w": w0}, ht.optim.SGDOptimizer(0.05),
+                        mesh, sync_every=4)
+    diverged = False
+    for t in range(60):
+        tr.step((x, y))
+        if tr.step_count % 4 == 0:
+            assert tr.max_divergence() < 1e-6, "sync step must reconcile"
+        elif tr.max_divergence() > 1e-7:
+            diverged = True
+    assert diverged, "replicas should diverge between syncs"
+    final = float(jnp.mean((x @ tr.replica_params(0)["w"] - y) ** 2))
+    init = float(jnp.mean((x @ w0 - y) ** 2))
+    assert final < init * 0.2, (init, final)

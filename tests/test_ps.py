@@ -203,3 +203,47 @@ def test_ps_embedding_through_cache():
     after = cache.store.get_data(cache.table)[np.unique(ids_v)]
     assert losses[-1] < losses[0]
     assert np.abs(after - before).max() > 0
+
+
+def test_asp_async_push_eventual_consistency():
+    """Executor(bsp=-1): pushes ride a background thread; after ps_flush()
+    the table matches the synchronous (bsp=0) run exactly (reference ASP
+    path ParameterServerCommunicate._compute_asp_prefetch:38)."""
+    rng = np.random.RandomState(3)
+    vocab, dim, batch = 20, 8, 12
+    table0 = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    ids_v = rng.randint(0, vocab, batch)
+    y_v = np.eye(4, dtype=np.float32)[rng.randint(0, 4, batch)]
+    w0 = rng.randn(dim, 4).astype(np.float32) * 0.3
+
+    def run(bsp, flush_each_step=False):
+        st = EmbeddingStore()
+        t = st.init_table(vocab, dim, opt="sgd", lr=0.5, seed=0)
+        st.set_data(t, table0.copy())
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((st, t), ids, width=dim)
+        w = ht.Variable("w", value=w0.copy(), trainable=True)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+        opt = ht.optim.SGDOptimizer(0.5)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                         bsp=bsp)
+        for _ in range(4):
+            ex.run("train", feed_dict={ids: ids_v, y_: y_v})
+            if flush_each_step:
+                ex.ps_flush()
+        ex.ps_flush()
+        return st, t
+
+    # (a) every async push eventually lands: per-row version counts match
+    st_s, t_s = run(bsp=0)
+    st_a, t_a = run(bsp=-1)
+    uids = np.unique(ids_v)
+    np.testing.assert_array_equal(st_a.versions(t_a, uids),
+                                  st_s.versions(t_s, uids))
+    # (b) ASP with a flush barrier per step == BSP exactly (the only
+    # divergence is pull staleness, which the barrier removes)
+    st_f, t_f = run(bsp=-1, flush_each_step=True)
+    np.testing.assert_allclose(st_f.get_data(t_f), st_s.get_data(t_s),
+                               rtol=1e-5, atol=1e-6)
