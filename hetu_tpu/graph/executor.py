@@ -369,9 +369,10 @@ class SubExecutor:
                 if ex.bsp == -1:
                     # ASP (reference bsp=-1, ParameterServerCommunicate
                     # _compute_asp_prefetch:38): push on a background
-                    # thread with a bounded in-flight window so the next
-                    # step's dispatch overlaps the PS traffic
-                    ex._ps_async_push(node, np.asarray(g))
+                    # thread with a bounded in-flight window; the device→
+                    # host copy happens on the worker too so the main
+                    # thread never blocks on the grad transfer
+                    ex._ps_async_push(node, g)
                 else:
                     node.push(np.asarray(g))
         for n in self.trainable_vars:
@@ -675,7 +676,8 @@ class Executor:
         self._ps_futures = pending
         while len(self._ps_futures) >= 32:
             self._ps_futures.pop(0).result()
-        self._ps_futures.append(self._ps_pool.submit(node.push, grad))
+        self._ps_futures.append(self._ps_pool.submit(
+            lambda: node.push(np.asarray(grad))))
 
     def ps_flush(self):
         """Barrier: wait until every ASP async push has been applied."""

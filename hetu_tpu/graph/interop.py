@@ -96,8 +96,17 @@ class InterOpSubExecutor:
         def ordinal(raw_ctx):
             devs = []
             for c in raw_ctx.contexts:
-                for cc in (c if isinstance(c, tuple) else (c,)):
-                    devs.append(_resolve_device(cc))
+                if isinstance(c, tuple):
+                    # a tuple is ONE model-parallel unit (context.py:77-78);
+                    # intra-op splitting is the mesh/ht.dispatch path, not
+                    # the placement chain — refuse rather than silently
+                    # reinterpreting it as data parallelism
+                    raise NotImplementedError(
+                        "interop placement treats a DeviceGroup list as a "
+                        "data-parallel group; tuple (model-parallel unit) "
+                        "contexts are not supported here — use ht.dispatch "
+                        "with a mesh for intra-op parallelism")
+                devs.append(_resolve_device(c))
             k = tuple(repr(d) for d in devs)
             if k not in dev_key_to_ord:
                 dev_key_to_ord[k] = len(self.device_groups)
@@ -176,15 +185,18 @@ class InterOpSubExecutor:
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self._seg_meshes[seg], P())
 
-    def _act_target(self, seg, ndim):
-        """Activations: batch dim sharded over the segment's dp group."""
+    def _act_target(self, seg, val):
+        """Activations: batch dim sharded over the segment's dp group;
+        arrays whose leading dim does not divide (broadcast rows, masks,
+        ragged batches) replicate instead."""
         if self._seg_meshes[seg] is None:
             return self.device_groups[seg][0]
         from jax.sharding import NamedSharding, PartitionSpec as P
-        if ndim == 0:
+        shape = np.shape(val)
+        if not shape or shape[0] % len(self.device_groups[seg]):
             return NamedSharding(self._seg_meshes[seg], P())
         return NamedSharding(self._seg_meshes[seg],
-                             P("dp", *([None] * (ndim - 1))))
+                             P("dp", *([None] * (len(shape) - 1))))
 
     # ---- per-segment pure functions -------------------------------------
     def _build_segments(self):
@@ -250,8 +262,7 @@ class InterOpSubExecutor:
             # NDArray unwrap), then commit to the segment's device(s)
             placed = ex._place_feed(node, val)
             env[node] = jax.device_put(
-                placed, self._act_target(self.dev_of[node],
-                                         np.ndim(placed)))
+                placed, self._act_target(self.dev_of[node], placed))
 
         key = jax.random.fold_in(ex.master_key, ex.step_counter)
         vjps = []
@@ -266,8 +277,8 @@ class InterOpSubExecutor:
                 env[a] if a in env else ex.var_values[a],
                 self._param_target(i)
                 if (isinstance(a, PlaceholderOp) and a.is_variable)
-                else self._act_target(i, np.ndim(env[a] if a in env
-                                                 else ex.var_values[a])))
+                else self._act_target(i, env[a] if a in env
+                                      else ex.var_values[a]))
                 for a in seg["ext_in"]]
             k = jax.random.fold_in(key, i)
 
@@ -287,8 +298,7 @@ class InterOpSubExecutor:
                 seg = self._seg_fns[i]
                 d_outs = [cot.get(o, None) for o in seg["outs"]]
                 d_outs = [jax.numpy.zeros_like(env[o]) if d is None
-                          else jax.device_put(
-                              d, self._act_target(i, np.ndim(d)))
+                          else jax.device_put(d, self._act_target(i, d))
                           for d, o in zip(d_outs, seg["outs"])]
                 d_params, d_ext = vjps[i](d_outs)
                 for v, g in zip(seg["vars"], d_params):
@@ -305,7 +315,7 @@ class InterOpSubExecutor:
                     # activation fan-out across segments: accumulate on the
                     # producer's device (committed arrays must agree)
                     g = jax.device_put(
-                        g, self._act_target(self.dev_of[a], np.ndim(g)))
+                        g, self._act_target(self.dev_of[a], g))
                     if a in cot:
                         cot[a] = cot[a] + g
                     else:

@@ -252,8 +252,11 @@ def _layernorm(node, ins, out, ctx):
 def _batchnorm(node, ins, out, ctx):
     # inputs are (x, scale, bias, running_mean, running_var) — the trained
     # stats are real graph variables and export as initializers
+    # BatchNormOp's momentum weights the BATCH; ONNX momentum weights the
+    # running stat — emit the complement so re-import round-trips exactly
     return [Node("BatchNormalization", list(ins[:5]), [out],
-                 name=out, epsilon=float(node.attrs.get("eps", 1e-5)))]
+                 name=out, epsilon=float(node.attrs.get("eps", 1e-5)),
+                 momentum=1.0 - float(node.attrs.get("momentum", 0.1)))]
 
 
 @register_exporter("SoftmaxCrossEntropy")

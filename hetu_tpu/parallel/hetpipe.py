@@ -64,8 +64,7 @@ class HetPipeTrainer:
             # leading stacked axis is 1 per replica inside shard_map
             p = jax.tree.map(lambda v: v[0], params)
             st = jax.tree.map(lambda v: v[0], opt_state)
-            b = jax.tree.map(lambda v: v, batch)
-            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
             new_p, new_st = optimizer.apply(p, grads, st, lr)
             expand = lambda t: jax.tree.map(lambda v: v[None], t)
             return expand(new_p), expand(new_st), loss[None]
