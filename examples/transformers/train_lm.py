@@ -6,6 +6,8 @@ per-model scripts, e.g. bert/train_hetu_bert_dp.py:68-69).
     python examples/transformers/train_lm.py --model t5
     python examples/transformers/train_lm.py --model vit
     python examples/transformers/train_lm.py --model transformer
+    python examples/transformers/train_lm.py --model bart|longformer|
+        bigbird|reformer|transfoxl|xlnet|clip|mae   # full 13-family zoo
 """
 import argparse
 import os
@@ -16,6 +18,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import hetu_tpu as ht  # noqa: E402
 from hetu_tpu import models  # noqa: E402
 
@@ -47,6 +54,69 @@ def build(model, size, batch_size, seq_len):
         feeds, loss, logits = models.vit_classify_graph(cfg)
         imgs, y = models.synthetic_image_batch(cfg)
         vals = {"images": imgs, "labels": y}
+    elif model == "bart":
+        cfg = getattr(models.BartConfig, size)(batch_size=batch_size,
+                                               src_len=seq_len,
+                                               tgt_len=seq_len)
+        feeds, loss, logits = models.bart_seq2seq_graph(cfg)
+        rng = np.random.RandomState(0)
+        src = rng.randint(0, cfg.vocab_size,
+                          (batch_size, seq_len)).astype(np.int32)
+        tgt = rng.randint(0, cfg.vocab_size,
+                          (batch_size, seq_len + 1)).astype(np.int32)
+        vals = {"input_ids": src, "decoder_input_ids": tgt[:, :-1],
+                "labels": tgt[:, 1:]}
+    elif model in ("longformer", "bigbird"):
+        cls = models.LongformerConfig if model == "longformer" \
+            else models.BigBirdConfig
+        cfg = getattr(cls, size)(batch_size=batch_size, seq_len=seq_len)
+        graph = models.longformer_mlm_graph if model == "longformer" \
+            else models.bigbird_mlm_graph
+        feeds, loss, logits = graph(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (batch_size, cfg.seq_len)).astype(np.int32)
+        labels = np.where(rng.rand(batch_size, cfg.seq_len) < 0.15,
+                          ids, -1).astype(np.int32)
+        vals = {"input_ids": ids, "labels": labels}
+    elif model == "reformer":
+        cfg = getattr(models.ReformerConfig, size)(
+            batch_size=batch_size, seq_len=seq_len,
+            chunk_length=min(seq_len, 16 if size == "tiny" else 64))
+        feeds, loss, logits = models.reformer_lm_graph(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (batch_size, cfg.seq_len + 1)).astype(np.int32)
+        vals = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    elif model == "transfoxl":
+        cfg = getattr(models.TransfoXLConfig, size)(batch_size=batch_size,
+                                                    tgt_len=seq_len)
+        feeds, loss, logits = models.transfoxl_lm_graph(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (batch_size, seq_len + 1)).astype(np.int32)
+        vals = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    elif model == "xlnet":
+        cfg = getattr(models.XLNetConfig, size)(batch_size=batch_size,
+                                                seq_len=seq_len)
+        feeds, loss, logits = models.xlnet_plm_graph(cfg)
+        ids, cmask, qmask, labels = models.synthetic_plm_batch(cfg)
+        vals = {"input_ids": ids, "labels": labels,
+                "content_mask": cmask, "query_mask": qmask}
+    elif model == "clip":
+        cfg = getattr(models.CLIPConfig, size)(batch_size=batch_size)
+        feeds, loss, _ = models.clip_graph(cfg)
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(batch_size, 3, cfg.image_size,
+                        cfg.image_size).astype(np.float32)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (batch_size, cfg.text_len)).astype(np.int32)
+        vals = {"images": imgs, "input_ids": ids}
+    elif model == "mae":
+        cfg = getattr(models.MAEConfig, size)(batch_size=batch_size)
+        feeds, loss, _ = models.mae_pretrain_graph(cfg)
+        imgs, shuffle = models.synthetic_mae_batch(cfg)
+        vals = {"images": imgs, "shuffle": shuffle}
     else:
         cfg = getattr(models.TransformerConfig, size)(
             batch_size=batch_size, src_len=seq_len, tgt_len=seq_len)
@@ -59,7 +129,11 @@ def build(model, size, batch_size, seq_len):
 SIZES = {"bert": ["tiny", "base", "large"], "gpt2": ["tiny", "small",
                                                      "medium"],
          "t5": ["tiny", "small"], "vit": ["tiny", "base"],
-         "transformer": ["tiny"]}
+         "transformer": ["tiny"],
+         "bart": ["tiny", "base"], "longformer": ["tiny", "base"],
+         "bigbird": ["tiny", "base"], "reformer": ["tiny", "base"],
+         "transfoxl": ["tiny", "base"], "xlnet": ["tiny", "base"],
+         "clip": ["tiny", "base"], "mae": ["tiny", "base"]}
 
 
 def main():
@@ -73,6 +147,8 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (handled pre-import)")
     args = p.parse_args()
     if args.size not in SIZES[args.model]:
         p.error(f"--size {args.size!r} invalid for {args.model}; "

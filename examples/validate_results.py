@@ -1,0 +1,108 @@
+"""Numerical validation of parallel modes against the single-device run.
+
+Reference parity: ``examples/runner/parallel/validate_results.py`` +
+``all_mlp_tests.sh`` (SURVEY.md §4.9) — the reference saves single-GPU
+``std/*.npy`` weights and compares each mpirun configuration against them.
+Here the comparisons run in ONE process on a simulated 8-device mesh
+(``--xla_force_host_platform_device_count``), so the whole sweep is a
+single command:
+
+    python examples/validate_results.py            # all configs
+    python examples/validate_results.py --configs dp8 pp4
+
+Each config trains the same seeded MLP for a few steps and asserts the
+loss trajectory matches the single-device run.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import hetu_tpu as ht  # noqa: E402
+
+STEPS = 5
+RTOL = 1e-4
+
+
+def _build(strategy=None, mesh=None, pipeline=None, placed=False,
+           pp_block=False):
+    import contextlib
+    x = ht.placeholder_op("x", shape=(32, 16))
+    y = ht.placeholder_op("y", shape=(32, 8))
+    c0 = ht.context(ht.gpu(0)) if placed else contextlib.nullcontext()
+    c1 = ht.context(ht.gpu(1)) if placed else contextlib.nullcontext()
+    with c0:
+        h = ht.layers.Linear(16, 32, activation="relu", name="v0")(x)
+    with c1:
+        if pp_block:
+            h = ht.pipeline_block(
+                h, lambda s: ht.layers.Linear(32, 32, activation="tanh",
+                                              name="vp")(s),
+                n_stages=4, n_microbatches=4)
+        logits = ht.layers.Linear(32, 8, name="v1")(h)
+        loss = ht.ops.reduce_mean_op(
+            ht.ops.softmaxcrossentropy_op(logits, y), [0])
+    opt = ht.optim.MomentumOptimizer(0.05)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=42,
+                     dist_strategy=strategy, mesh=mesh, pipeline=pipeline)
+    return x, y, ex
+
+
+def _losses(build_kwargs):
+    rng = np.random.RandomState(7)
+    xv = rng.randn(32, 16).astype(np.float32)
+    yv = np.eye(8, dtype=np.float32)[rng.randint(0, 8, 32)]
+    x, y, ex = _build(**build_kwargs)
+    return [float(ex.run("train", feed_dict={x: xv, y: yv})[0].asnumpy())
+            for _ in range(STEPS)]
+
+
+CONFIGS = {
+    "dp8": dict(strategy=ht.dist.DataParallel()),
+    "pp4": dict(strategy=ht.parallel.PipelineParallel(pp=4), pp_block=True),
+    "pp4_1f1b": dict(strategy=ht.parallel.PipelineParallel(pp=4),
+                     pipeline="pipedream", pp_block=True),
+    "dp2xpp2": dict(strategy=ht.parallel.PipelineParallel(pp=2, dp=2),
+                    pp_block=True),
+    "interop2": dict(placed=True),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", nargs="*", default=list(CONFIGS))
+    args = p.parse_args()
+
+    print(f"devices: {len(jax.devices())} ({jax.default_backend()})")
+    failures = []
+    for name in args.configs:
+        kwargs = dict(CONFIGS[name])
+        pp_block = kwargs.pop("pp_block", False)
+        base = _losses(dict(pp_block=pp_block))
+        got = _losses(dict(kwargs, pp_block=pp_block))
+        ok = np.allclose(base, got, rtol=RTOL)
+        status = "PASS" if ok else "FAIL"
+        print(f"[{status}] {name:10s} single={['%.5f' % v for v in base]} "
+              f"parallel={['%.5f' % v for v in got]}")
+        if not ok:
+            failures.append(name)
+    if failures:
+        print(f"FAILED: {failures}")
+        sys.exit(1)
+    print(f"all {len(args.configs)} parallel configs match the "
+          "single-device run")
+
+
+if __name__ == "__main__":
+    main()

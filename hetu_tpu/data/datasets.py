@@ -67,3 +67,110 @@ def cifar10():
 
 def cifar100():
     return normalize_cifar(100)
+
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+class ImageNetFolder:
+    """Streaming ImageNet-layout loader (reference ``data.py`` ImageNet
+    path): a root with one subdirectory per class, JPEG/PNG files inside.
+
+    Decodes lazily with PIL batch-by-batch (the full dataset never fits in
+    RAM), resize-shorter-side→center-crop→normalize, NCHW float32.  When
+    the directory is absent, yields a deterministic synthetic stream with
+    identical shapes so examples run hermetically.
+    """
+
+    def __init__(self, root=None, split="train", image_size=224,
+                 num_classes=1000, synthetic_batches=8, batch_size=32,
+                 shuffle=True, seed=0):
+        self.image_size = image_size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        explicit_root = root is not None
+        root = root or os.path.join(DATA_DIR, "imagenet", split)
+        self.samples = []      # (path, class_index)
+        self.classes = []
+        if os.path.isdir(root):
+            self.classes = sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d)))
+            for ci, cname in enumerate(self.classes):
+                cdir = os.path.join(root, cname)
+                for f in sorted(os.listdir(cdir)):
+                    if f.lower().endswith((".jpeg", ".jpg", ".png")):
+                        self.samples.append((os.path.join(cdir, f), ci))
+            if explicit_root and not self.samples:
+                raise ValueError(
+                    f"{root} exists but holds no class-dir/JPEG-or-PNG "
+                    "layout images — refusing to silently substitute "
+                    "synthetic data for an explicit root")
+            if self.samples and len(self.samples) < batch_size:
+                raise ValueError(
+                    f"{len(self.samples)} images < batch_size {batch_size}:"
+                    " the drop-remainder loader would yield zero batches")
+        self.num_classes = len(self.classes) or num_classes
+        self._synthetic_batches = synthetic_batches
+
+    def __len__(self):
+        if self.samples:
+            return len(self.samples) // self.batch_size
+        return self._synthetic_batches
+
+    def _decode(self, path):
+        from PIL import Image
+        s = self.image_size
+        img = Image.open(path).convert("RGB")
+        w, h = img.size
+        scale = s / min(w, h)
+        img = img.resize((max(s, round(w * scale)),
+                          max(s, round(h * scale))), Image.BILINEAR)
+        w, h = img.size
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+        x = np.asarray(img, np.float32) / 255.0          # (H, W, C)
+        x = (x - IMAGENET_MEAN) / IMAGENET_STD
+        return x.transpose(2, 0, 1)                      # (C, H, W)
+
+    def __iter__(self):
+        """Yields (images (B, 3, S, S) float32, labels (B,) int32)."""
+        s = self.image_size
+        if not self.samples:
+            rng = np.random.RandomState(self.seed)
+            for _ in range(self._synthetic_batches):
+                x = rng.rand(self.batch_size, 3, s, s).astype(np.float32)
+                y = rng.randint(0, self.num_classes,
+                                self.batch_size).astype(np.int32)
+                yield x, y
+            return
+        order = np.arange(len(self.samples))
+        if self.shuffle:
+            # fold the epoch counter in so every pass reshuffles
+            np.random.RandomState(self.seed + self._epoch).shuffle(order)
+        self._epoch += 1
+        for b in range(len(self)):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            xs = np.stack([self._decode(self.samples[i][0]) for i in idx])
+            ys = np.asarray([self.samples[i][1] for i in idx], np.int32)
+            yield xs, ys
+
+
+def imagenet(root=None, image_size=224, batch_size=32, **kw):
+    """(train_iter, val_iter) ImageNet loaders (see :class:`ImageNetFolder`).
+
+    ``shuffle`` (if given) applies to the train split; val never shuffles.
+    """
+    kw.pop("split", None)
+    train_shuffle = kw.pop("shuffle", True)
+    # an explicit root is the dataset PARENT (containing train/ and val/)
+    tr = os.path.join(root, "train") if root else None
+    va = os.path.join(root, "val") if root else None
+    return (ImageNetFolder(tr, "train", image_size,
+                           batch_size=batch_size, shuffle=train_shuffle,
+                           **kw),
+            ImageNetFolder(va, "val", image_size, batch_size=batch_size,
+                           shuffle=False, **kw))
