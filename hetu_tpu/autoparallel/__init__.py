@@ -17,7 +17,9 @@ from .search import DPAlg, candidate_strategies, search
 from .plan import ParallelPlan
 
 
-def calibrate_hardware(mesh=None, mem_bytes=None):
+def calibrate_hardware(mesh=None, mem_bytes=None,
+                       matmul_dim=4096, chain=64,
+                       probe_bytes=1 << 22, **overrides):
     """Measure a HardwareSpec from the live devices (profile step of the
     Galvatron workflow): matmul-probe flops + collective bandwidth."""
     import time
@@ -27,7 +29,7 @@ def calibrate_hardware(mesh=None, mem_bytes=None):
 
     from ..profiler import CollectiveProfiler
 
-    n, chain = 4096, 64
+    n = matmul_dim
 
     def probe(a, length):
         # data-dependent matmul chain returning a SCALAR: remote platforms
@@ -39,23 +41,36 @@ def calibrate_hardware(mesh=None, mem_bytes=None):
         y, _ = jax.lax.scan(body, a, None, length=length)
         return jnp.float32(jnp.sum(y))
 
+    if chain < 2:
+        raise ValueError("calibrate_hardware needs chain >= 2 (the probe "
+                         "subtracts a 1-matmul latency baseline)")
     x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16) * 0.01
     f = jax.jit(probe, static_argnums=1)
     float(f(x, chain))  # warm both lengths
     float(f(x, 1))
-    t0 = time.perf_counter()
-    float(f(x, 1))
-    lat = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(f(x, chain))
-    dt = time.perf_counter() - t0
-    per_matmul = max((dt - lat) / (chain - 1), 1e-9)
+    reps = 3
+
+    def timed(length):
+        # best-of-reps suppresses scheduler noise (a single noisy sample
+        # can otherwise make dt < lat and nonsense flops)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(f(x, length))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lat = timed(1)
+    dt = timed(chain)
+    per_matmul = (dt - lat) / (chain - 1)
+    if per_matmul <= 0:  # noise floor: fall back to the un-baselined rate
+        per_matmul = dt / chain
     flops = 2 * n ** 3 / per_matmul
     prof = CollectiveProfiler(mesh=mesh, repeats=3)
     width = prof.mesh.shape[prof.axis]
     if width > 1:
-        ar = prof.profile_allreduce(1 << 22)
-        ici_bw = ((1 << 22) * 2 * (width - 1) / width / ar) if ar > 0 \
+        ar = prof.profile_allreduce(probe_bytes)
+        ici_bw = (probe_bytes * 2 * (width - 1) / width / ar) if ar > 0 \
             else HardwareSpec.ici_bw
     else:  # bandwidth unmeasurable on a 1-wide axis; keep the default
         ici_bw = HardwareSpec.ici_bw
@@ -63,8 +78,10 @@ def calibrate_hardware(mesh=None, mem_bytes=None):
     if mem_bytes is None:
         stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
         mem_bytes = (stats or {}).get("bytes_limit", 16e9)
-    return HardwareSpec(flops=flops, mem_bytes=float(mem_bytes),
-                        ici_bw=float(ici_bw))
+    kw = dict(flops=flops, mem_bytes=float(mem_bytes),
+              ici_bw=float(ici_bw))
+    kw.update(overrides)
+    return HardwareSpec(**kw)
 
 
 __all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",

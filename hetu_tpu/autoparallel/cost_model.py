@@ -74,33 +74,13 @@ class HardwareSpec:
     @classmethod
     def measure(cls, mesh=None, probe_bytes=1 << 22, matmul_dim=1024,
                 **overrides):
-        """Calibrated spec from THIS machine (closes the reference's
-        profile→search loop, ``tools/Galvatron/test_env/``): sustained
-        matmul FLOP/s from a timed GEMM probe, allreduce algo-bandwidth
-        from :class:`hetu_tpu.profiler.CollectiveProfiler`."""
-        import time
-        import jax
-        import jax.numpy as jnp
-        from ..profiler import CollectiveProfiler
-
-        d = matmul_dim
-        a = jnp.ones((d, d), jnp.bfloat16)
-        f = jax.jit(lambda a: a @ a)
-        jax.block_until_ready(f(a))
-        t0 = time.perf_counter()
-        reps = 10
-        for _ in range(reps):
-            out = f(a)
-        jax.block_until_ready(out)
-        flops = 2 * d ** 3 * reps / max(time.perf_counter() - t0, 1e-9)
-
-        kw = {"flops": flops}
-        prof = CollectiveProfiler(mesh=mesh)
-        if prof.mesh.devices.size > 1:
-            dt = prof.profile_allreduce(probe_bytes)
-            kw["ici_bw"] = probe_bytes / max(dt, 1e-9)
-        kw.update(overrides)
-        return cls(**kw)
+        """Calibrated spec from THIS machine — delegates to
+        :func:`hetu_tpu.autoparallel.calibrate_hardware` (the profile step
+        of the Galvatron workflow) with test-friendly probe sizes."""
+        from . import calibrate_hardware
+        return calibrate_hardware(mesh=mesh, matmul_dim=matmul_dim,
+                                  chain=8, probe_bytes=probe_bytes,
+                                  **overrides)
 
 
 OPT_STATE_MULT = 3.0   # param + adam m + v, fp32 master (bytes ×3 of fp32)
