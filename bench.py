@@ -17,6 +17,14 @@ import subprocess
 import sys
 import time
 
+if os.environ.get("_HETU_BENCH_FORCE_CPU"):
+    # fallback attempt after a wedged TPU backend: the sitecustomize pins
+    # JAX_PLATFORMS, so the backend must be forced via jax.config BEFORE
+    # anything imports hetu_tpu/jax-consumers
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 CHILD_ENV_FLAG = "_HETU_BENCH_CHILD"
@@ -131,11 +139,22 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
 
 
 def _child_main(args):
+    cpu_fallback = bool(os.environ.get("_HETU_BENCH_FORCE_CPU"))
     if args.config == "bert":
-        res = bench_bert(batch_size=args.batch_size or 192, steps=args.steps)
+        bs = args.batch_size or (4 if cpu_fallback else 192)
+        steps = min(args.steps, 1) if cpu_fallback else args.steps
+        res = bench_bert(batch_size=bs, steps=steps,
+                         warmup=1 if cpu_fallback else 3)
     else:
-        res = bench_resnet18(batch_size=args.batch_size or 128,
-                             steps=args.steps)
+        bs = args.batch_size or (16 if cpu_fallback else 128)
+        steps = min(args.steps, 2) if cpu_fallback else args.steps
+        res = bench_resnet18(batch_size=bs, steps=steps,
+                             warmup=1 if cpu_fallback else 3)
+    if cpu_fallback:
+        # an honest artifact: the number exists but is NOT the TPU metric
+        import jax
+        res["error"] = (f"TPU backend unavailable; measured on the "
+                        f"{jax.default_backend()} backend at reduced size")
     print(json.dumps(res))
 
 
@@ -151,16 +170,24 @@ def _parent_main(args):
     """Run the bench in a child process with retries + a hard time budget."""
     deadline = time.monotonic() + TOTAL_BUDGET_S
     last_err = "no attempts made"
+    hung = False
     for attempt in range(MAX_ATTEMPTS):
         remaining = deadline - time.monotonic()
         if remaining <= 10:
             last_err += " | total time budget exhausted"
             break
         env = dict(os.environ, **{CHILD_ENV_FLAG: "1"})
-        if attempt > 0:
-            # flaky-backend fallback: let jax pick any available backend
-            env["JAX_PLATFORMS"] = ""
-            time.sleep(min(10.0 * attempt, remaining / 10))
+        if attempt > 0 and hung:
+            # a wall-clock hang means the TPU backend is wedged (init never
+            # returns) — retrying it would eat the whole budget, so go
+            # straight to the reduced-size CPU-backend attempt (forced via
+            # jax.config in the child; env alone is pinned by the site
+            # customization), marked with an error field
+            env["_HETU_BENCH_FORCE_CPU"] = "1"
+        elif attempt == 1:
+            time.sleep(min(10.0, remaining / 10))  # transient rc failure
+        elif attempt >= 2:
+            env["_HETU_BENCH_FORCE_CPU"] = "1"
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
@@ -169,6 +196,7 @@ def _parent_main(args):
         except subprocess.TimeoutExpired:
             last_err = f"attempt {attempt}: child exceeded " \
                        f"{min(CHILD_TIMEOUT_S, remaining):.0f}s wall clock"
+            hung = True
             continue
         for line in reversed(proc.stdout.strip().splitlines()):
             line = line.strip()

@@ -20,10 +20,13 @@ deadlock avoidance; here the whole schedule is ONE scanned SPMD program:
   no weight stashing (sync SPMD training has exactly one weight version,
   removing PipeDream's staleness machinery by construction);
 * memory: ``remat=True`` recomputes each stage in backward
-  (``jax.checkpoint``), matching 1F1B's activation footprint;
-* HetPipe's local-accumulate-then-sync is subsumed by microbatch gradient
-  accumulation (:class:`hetu_tpu.graph.executor` ``pipeline=`` mode) — under
-  synchronous SPMD there is no parameter server to defer syncs against.
+  (``jax.checkpoint``); ``pipeline='pipedream'`` instead runs the TRUE
+  1F1B schedule (:mod:`hetu_tpu.parallel.pipeline_1f1b`) whose explicit
+  tick program keeps only S live microbatch activations;
+* HetPipe's local-update + periodic-PS-sync (WSP) semantics live in
+  :class:`hetu_tpu.parallel.hetpipe.HetPipeTrainer` — per-replica
+  diverging parameters with a pmean reconciliation every ``sync_every``
+  steps; ``pipeline='hetpipe'`` at block level schedules like GPipe.
 
 Stage functions must be shape-homogeneous (input shape == output shape),
 the standard contract for transformer-stack pipelining.

@@ -213,6 +213,21 @@ class HetuProfiler:
                 out[str(d)] = {k: int(v) for k, v in st.items()}
         return out
 
+    def trace(self, feed_dict, log_dir, steps=3):
+        """Capture a hardware trace of real steps into ``log_dir``
+        (TensorBoard/XProf format via ``jax.profiler`` — the TPU-native
+        replacement for the reference's per-op CUDA-event timeline;
+        SURVEY.md §5.1).  Returns the directory for convenience."""
+        import jax
+        if steps < 1:
+            raise ValueError("trace needs steps >= 1")
+        self._sync(self.sub.run(feed_dict))  # compile+warm OUTSIDE the trace
+        with jax.profiler.trace(str(log_dir)):
+            for _ in range(steps):
+                out = self.sub.run(feed_dict)
+            self._sync(out)
+        return str(log_dir)
+
 
 class CollectiveProfiler:
     """Collective latency/bandwidth over mesh axes (reference NCCLProfiler).

@@ -81,3 +81,18 @@ def test_memory_stats_shape():
     prof = ht.HetuProfiler(ex, "train")
     stats = prof.memory_stats()  # may be empty on some backends
     assert isinstance(stats, dict)
+
+
+def test_trace_writes_profile(tmp_path):
+    """jax.profiler trace capture around real executor steps."""
+    import os
+    x = ht.placeholder_op("x", shape=(8, 4))
+    w = ht.Variable("w", value=np.ones((4, 4), np.float32))
+    loss = ht.ops.reduce_mean_op(ht.ops.matmul_op(x, w), [0, 1])
+    ex = ht.Executor({"train": [loss]}, seed=0)
+    prof = ht.HetuProfiler(ex, "train")
+    rng = np.random.RandomState(0)
+    out_dir = prof.trace({x: rng.randn(8, 4).astype(np.float32)},
+                         tmp_path / "trace")
+    found = [f for _, _, fs in os.walk(out_dir) for f in fs]
+    assert found, "trace produced no files"
