@@ -42,11 +42,16 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _sdpa(c, q, k, v, causal=False, scale=None):
+def _use_flash(q, k):
+    """One dispatch rule for every flash-capable op (keeps the varlen and
+    dense paths from drifting apart)."""
     s_q, s_kv = q.shape[-2], k.shape[-2]
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and s_q >= _FLASH_MIN_LEN and s_q % 128 == 0 \
-            and s_kv % 128 == 0:
+    return (jax.default_backend() == "tpu" and s_q >= _FLASH_MIN_LEN
+            and s_q % 128 == 0 and s_kv % 128 == 0)
+
+
+def _sdpa(c, q, k, v, causal=False, scale=None):
+    if _use_flash(q, k):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return sdpa_reference(q, k, v, causal=causal, scale=scale)
@@ -78,6 +83,25 @@ def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
 
 sdpa_masked_bias_op = def_op("ScaledDotProductAttentionMaskedBias",
                              _sdpa_masked_bias)
+
+
+def _sdpa_varlen(c, q, k, v, lengths, causal=False, scale=None):
+    """Padding-masked attention: keys >= lengths[b] are invisible.
+
+    TPU + aligned shapes → the Pallas flash kernel's ragged path (no
+    FLOPs spent on fully-masked key blocks); otherwise the jnp reference
+    with a built column mask."""
+    if _use_flash(q, k):
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               lengths=lengths)
+    s_kv = k.shape[-2]
+    cols = jnp.arange(s_kv)[None, None, None, :]
+    mask = cols < lengths.astype(jnp.int32)[:, None, None, None]
+    return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
+
+
+sdpa_varlen_op = def_op("ScaledDotProductAttentionVarlen", _sdpa_varlen)
 
 
 def _has_cp(mesh):

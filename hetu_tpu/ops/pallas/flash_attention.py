@@ -28,9 +28,45 @@ DEFAULT_BLOCK_K = 128
 
 
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                num_kv, kv_off):
+def _mask_and_live(qi, ki, len_ref, *, causal, has_lengths, block_q,
+                   block_k, kv_off):
+    """(live predicate, mask fn) for one (qi, ki) block.
+
+    ``has_lengths`` is a STATIC trace-time flag: the dense path keeps the
+    original straight-line code (static ``live`` when non-causal, no
+    per-block iota/where), so varlen support costs the hot path nothing.
+    The length scalar itself lives in SMEM (the supported scalar pattern).
+    """
+    causal_live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
+        if causal else True
+    if has_lengths:
+        kvlen = len_ref[0, 0]
+        live = jnp.logical_and(causal_live, ki * block_k < kvlen)
+    else:
+        kvlen = None
+        live = causal_live
+
+    def mask(s):
+        valid = None
+        if has_lengths:
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ki * block_k
+            valid = cols < kvlen                       # padding mask
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + kv_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ki * block_k
+            c = rows >= cols
+            valid = c if valid is None else jnp.logical_and(valid, c)
+        return s if valid is None else jnp.where(valid, s, NEG_INF)
+
+    return live, mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, has_lengths,
+                block_q, block_k, num_kv, kv_off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -40,24 +76,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: block (qi, ki) contributes iff some q row >= some k col
-    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
-        if causal else True
+    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
+                                has_lengths=has_lengths, block_q=block_q,
+                                block_k=block_k, kv_off=kv_off)
 
     @pl.when(live)
     def _block():
         q = q_ref[0]                                   # (bq, d)
         k = k_ref[0]                                   # (bk, d)
         v = v_ref[0]                                   # (bk, d)
-        s = jax.lax.dot_general(
+        s = mask(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + qi * block_q + kv_off
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            preferred_element_type=jnp.float32) * scale)  # (bq, bk)
         m_prev = m_scr[:, :1]                          # (bq, 1)
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -79,15 +109,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _len_spec():
+    """(1,1) per-bh scalar in SMEM — the supported scalar-input pattern."""
+    return pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k,
+               interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
     grid = (bh, num_q, num_kv)
+    has_lengths = lengths is not None
+    if not has_lengths:  # dummy scalar keeps the kernel arity uniform
+        lengths = jnp.zeros((bh, 1), jnp.int32)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, has_lengths=has_lengths,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
         kv_off=s_kv - s_q)
     out, lse = pl.pallas_call(
@@ -97,6 +137,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _len_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -112,14 +153,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, lengths)
     return out, lse
 
 
 # ---------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k, num_kv,
-               kv_off):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
+               dq_ref, dq_scr, *, scale, causal, has_lengths, block_q,
+               block_k, num_kv, kv_off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -127,8 +168,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
-        if causal else True
+    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
+                                has_lengths=has_lengths, block_q=block_q,
+                                block_k=block_k, kv_off=kv_off)
 
     @pl.when(live)
     def _block():
@@ -138,15 +180,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]                                  # (bq, d)
         lse = lse_ref[0][:, None]                       # (bq, 1)
         delta = delta_ref[0][:, None]                   # (bq, 1)
-        s = jax.lax.dot_general(
+        s = mask(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + qi * block_q + kv_off
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            preferred_element_type=jnp.float32) * scale)
         p = jnp.exp(s - lse)                            # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -161,9 +197,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k, num_q, kv_off):
+                has_lengths, block_q, block_k, num_q, kv_off):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -172,8 +208,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
-        if causal else True
+    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
+                                has_lengths=has_lengths, block_q=block_q,
+                                block_k=block_k, kv_off=kv_off)
 
     @pl.when(live)
     def _block():
@@ -183,15 +220,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0][:, None]
         delta = delta_ref[0][:, None]
-        s = jax.lax.dot_general(
+        s = mask(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bk)
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + qi * block_q + kv_off
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            preferred_element_type=jnp.float32) * scale)  # (bq, bk)
         p = jnp.exp(s - lse)                             # (bq, bk)
         # dV += P^T @ dO
         dv_scr[:] += jax.lax.dot_general(
@@ -212,18 +243,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
-               interpret):
+def _flash_bwd(q, k, v, lengths, out, lse, do, scale, causal, block_q,
+               block_k, interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
+    has_lengths = lengths is not None
+    if not has_lengths:
+        lengths = jnp.zeros((bh, 1), jnp.int32)
     # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                              # (bh, s_q)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          has_lengths=has_lengths,
                           block_q=block_q, block_k=block_k, num_kv=num_kv,
                           kv_off=s_kv - s_q),
         grid=(bh, num_q, num_kv),
@@ -234,15 +269,17 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            _len_spec(),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, lengths)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          has_lengths=has_lengths,
                           block_q=block_q, block_k=block_k, num_q=num_q,
                           kv_off=s_kv - s_q),
         grid=(bh, num_kv, num_q),
@@ -253,6 +290,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -267,41 +306,54 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, lengths)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------- public op
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
-                        interpret)
+def _f0(x):
+    import numpy as _np
+    from jax import dtypes as _jd
+    return _np.zeros(x.shape, _jd.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q3, k3, v3, lengths, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q,
+                        block_k, interpret)
     return out
 
 
-def _flash_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q3, k3, v3, out, lse)
+def _flash_vjp_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k,
+                   interpret):
+    out, lse = _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q,
+                          block_k, interpret)
+    return out, (q3, k3, v3, lengths, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q3, k3, v3, out, lse = res
-    dq, dk, dv = _flash_bwd(q3, k3, v3, out, lse, do, scale, causal,
-                            block_q, block_k, interpret)
-    return dq, dk, dv
+    q3, k3, v3, lengths, out, lse = res
+    dq, dk, dv = _flash_bwd(q3, k3, v3, lengths, out, lse, do, scale,
+                            causal, block_q, block_k, interpret)
+    return (dq, dk, dv, None if lengths is None else _f0(lengths))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None,
+def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
                     block_q=None, block_k=None, interpret=False):
     """Blockwise flash attention for (B, H, S, D) inputs.
 
-    Requires S divisible by the block size (the ``sdpa_op`` dispatcher
-    falls back to the XLA-composed reference otherwise).  ``interpret=True``
-    runs the Pallas interpreter so CPU CI exercises the same kernel code.
+    ``lengths``: optional (B,) int32 valid-KEY counts per sequence — keys
+    at positions >= lengths[b] are masked out (padding mask); fully masked
+    key blocks spend no FLOPs (the block body is predicated off; the
+    block's K/V DMA still occurs — true block pruning would need
+    scalar-prefetch grid shrinking).  With ``lengths=None`` the kernels
+    compile the original dense code with zero masking overhead.  Requires S divisible by the block size (the ``sdpa_op``
+    dispatcher falls back to the XLA-composed reference otherwise).
+    ``interpret=True`` runs the Pallas interpreter so CPU CI exercises the
+    same kernel code.
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
@@ -319,5 +371,12 @@ def flash_attention(q, k, v, causal=False, scale=None,
     q3 = q.reshape(b * h, s_q, d)
     k3 = k.reshape(b * h, s_kv, d)
     v3 = v.reshape(b * h, s_kv, d)
-    out = _flash(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    if lengths is None:
+        len3 = None    # static: kernels compile the dense straight-line path
+    else:
+        len3 = jnp.broadcast_to(
+            jnp.asarray(lengths, jnp.int32).reshape(b, 1), (b, h)
+        ).reshape(b * h, 1)
+    out = _flash(q3, k3, v3, len3, scale, causal, block_q, block_k,
+                 interpret)
     return out.reshape(b, h, s_q, d)

@@ -226,3 +226,60 @@ def test_sparse_moe_layer_trains():
     losses = [float(np.asarray(ex.run("train", feed_dict={x: xv})[0].jax()))
               for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_varlen_padding_mask(causal):
+    """lengths argument == reference column mask, fwd and grads."""
+    b, h, s, d = 3, 2, 256, 32
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+    lengths = jnp.asarray([256, 100, 17], jnp.int32)
+    cols = np.arange(s)[None, None, None, :]
+    mask = (cols < np.asarray(lengths)[:, None, None, None])
+
+    out = flash_attention(q, k, v, causal=causal, lengths=lengths,
+                          interpret=True)
+    ref = sdpa_reference(q, k, v, causal=causal,
+                         mask=jnp.asarray(mask, jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       lengths=lengths,
+                                       interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(sdpa_reference(
+            q, k, v, causal=causal,
+            mask=jnp.asarray(mask, jnp.float32)) ** 2)
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+    # grads w.r.t. fully-padded keys must be zero
+    dk = np.asarray(gf[1])
+    assert np.abs(dk[2, :, 17:]).max() == 0.0
+
+
+def test_sdpa_varlen_op_graph():
+    import hetu_tpu as ht
+    b, h, s, d = 2, 2, 32, 16
+    rng = np.random.RandomState(12)
+    q = ht.placeholder_op("q", shape=(b, h, s, d))
+    lens = ht.placeholder_op("lens", shape=(b,), dtype=np.int32)
+    out = ht.ops.sdpa_varlen_op(q, q, q, lens, causal=False)
+    ex = ht.Executor({"fwd": [out]})
+    qv = rng.randn(b, h, s, d).astype(np.float32)
+    lv = np.asarray([32, 9], np.int32)
+    got = np.asarray(ex.run("fwd", feed_dict={q: qv, lens: lv})[0].asnumpy())
+    cols = np.arange(s)[None, None, None, :]
+    ref = sdpa_reference(jnp.asarray(qv), jnp.asarray(qv), jnp.asarray(qv),
+                         mask=jnp.asarray(cols < lv[:, None, None, None],
+                                          jnp.float32))
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-5, atol=2e-5)
