@@ -46,6 +46,20 @@ def _sync(outs):
         np.asarray(arr)
 
 
+def _timed(run_step, steps, warmup):
+    """Shared timing harness: warmup, sync, timed loop, sync → s/step.
+    ONE copy of the remote-platform sync discipline (see _sync)."""
+    out = None
+    for i in range(warmup):
+        out = run_step(i)
+    _sync(out)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = run_step(i)
+    _sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
 def _params_count(ex):
     return int(sum(np.prod(v.shape) for n, v in ex.var_values.items()
                    if n.trainable))
@@ -69,14 +83,8 @@ def bench_bert(batch_size=192, seq_len=128, steps=20, warmup=3):
           feeds["token_type_ids"]: jax.device_put(np.asarray(tt, np.int32)),
           feeds["masked_lm_labels"]: jax.device_put(np.asarray(labels, np.int32))}
 
-    for _ in range(warmup):
-        out = ex.run("train", feed_dict=fd)
-    _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = ex.run("train", feed_dict=fd)
-    _sync(out)
-    dt = (time.perf_counter() - t0) / steps
+    dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
+    out = ex.run("train", feed_dict=fd)
 
     n_params = _params_count(ex)
     tokens = batch_size * seq_len
@@ -120,14 +128,7 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
     xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
     yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
     fd = {x: jax.device_put(xv), y_: jax.device_put(yv)}  # on-device feeds
-    for _ in range(warmup):
-        out = ex.run("train", feed_dict=fd)
-    _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = ex.run("train", feed_dict=fd)
-    _sync(out)
-    dt = (time.perf_counter() - t0) / steps
+    dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
     return {
         "metric": "resnet18_cifar10_step_time",
         "value": round(dt * 1e3, 2),
@@ -145,6 +146,16 @@ def _child_main(args):
         steps = min(args.steps, 1) if cpu_fallback else args.steps
         res = bench_bert(batch_size=bs, steps=steps,
                          warmup=1 if cpu_fallback else 3)
+    elif args.config == "wdl":
+        bs = args.batch_size or (256 if cpu_fallback else 2048)
+        steps = min(args.steps, 3) if cpu_fallback else args.steps
+        res = bench_wdl(batch_size=bs, steps=steps,
+                        warmup=1 if cpu_fallback else 3)
+    elif args.config == "moe":
+        bs = args.batch_size or (1024 if cpu_fallback else 8192)
+        steps = min(args.steps, 3) if cpu_fallback else args.steps
+        res = bench_moe(batch_tokens=bs, steps=steps,
+                        warmup=1 if cpu_fallback else 3)
     else:
         bs = args.batch_size or (16 if cpu_fallback else 128)
         steps = min(args.steps, 2) if cpu_fallback else args.steps
@@ -159,9 +170,12 @@ def _child_main(args):
 
 
 def _error_result(args, msg):
-    metric = ("bert_base_pretrain_samples_per_sec_per_chip"
-              if args.config == "bert" else "resnet18_cifar10_step_time")
-    unit = "samples/s/chip" if args.config == "bert" else "ms/step"
+    names = {"bert": ("bert_base_pretrain_samples_per_sec_per_chip",
+                      "samples/s/chip"),
+             "resnet18": ("resnet18_cifar10_step_time", "ms/step"),
+             "wdl": ("wdl_criteo_cache_samples_per_sec", "samples/s"),
+             "moe": ("moe_ep_tokens_per_sec", "tokens/s")}
+    metric, unit = names[args.config]
     return {"metric": metric, "value": 0.0, "unit": unit,
             "vs_baseline": 0.0, "error": msg[-2000:]}
 
@@ -215,9 +229,83 @@ def _parent_main(args):
     print(json.dumps(_error_result(args, last_err)))
 
 
+def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
+    """BASELINE config 4: Wide&Deep CTR with the HET embedding cache —
+    rows pulled through the bounded-staleness cache around each jitted
+    step (reference run_hetu.py:121-126 cache flags)."""
+    import jax
+    import hetu_tpu as ht
+    sys.path.insert(0, "examples/ctr")
+    import models as ctr
+
+    dense = ht.placeholder_op("dense")
+    # ids must stay integral: float32 is exact only below 2^24, real
+    # Criteo vocabs exceed it (the bench_bert int32-feed lesson)
+    sparse = ht.placeholder_op("sparse", dtype=np.int64)
+    y_ = ht.placeholder_op("y")
+    loss, prob = ctr.wdl_criteo(dense, sparse, y_, batch_size,
+                                vocab=100000, dim=16, embed_mode=policy,
+                                lr=0.01)
+    opt = ht.optim.SGDOptimizer(0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    batches = [ctr.synthetic_criteo(batch_size, seed=i)
+               for i in range(8)]
+
+    def run_step(i):
+        dv, sv, yv = batches[i % len(batches)]
+        return ex.run("train", feed_dict={dense: dv, sparse: sv, y_: yv})
+
+    dt = _timed(run_step, steps, warmup)
+    return {
+        "metric": "wdl_criteo_cache_samples_per_sec",
+        "value": round(batch_size / dt, 1),
+        "unit": "samples/s",
+        "vs_baseline": 0.0,
+        "extra": {"batch_size": batch_size, "cache": policy,
+                  "step_time_ms": round(dt * 1e3, 2),
+                  "backend": jax.default_backend()},
+    }
+
+
+def bench_moe(batch_tokens=8192, steps=20, warmup=3):
+    """BASELINE config 5: MoE transformer expert-parallel step (GShard
+    top-2 gate, 16 experts; on one chip the a2a is local, on an 'ep'
+    mesh XLA shards the expert dim)."""
+    import jax
+    import hetu_tpu as ht
+
+    d, experts = 512, 16
+    x = ht.placeholder_op("x", shape=(batch_tokens, d))
+    y_ = ht.placeholder_op("y", shape=(batch_tokens, d))
+    gate = ht.layers.TopKGate(d, batch_tokens, experts, k=2,
+                              capacity_factor=1.25)
+    moe = ht.layers.MoELayer(gate, ht.layers.Expert(experts, d, 4 * d))
+    h, aux = moe(x)
+    loss = ht.reduce_mean_op(ht.ops.mul_op(h - y_, h - y_), [0, 1]) \
+        + aux * 0.01
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    xv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
+    yv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
+    fd = {x: xv, y_: yv}
+    dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
+    return {
+        "metric": "moe_ep_tokens_per_sec",
+        "value": round(batch_tokens / dt, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {"tokens": batch_tokens, "experts": experts,
+                  "step_time_ms": round(dt * 1e3, 2),
+                  "backend": jax.default_backend()},
+    }
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
-    p.add_argument("--config", default="bert", choices=["bert", "resnet18"])
+    p.add_argument("--config", default="bert",
+                   choices=["bert", "resnet18", "wdl", "moe"])
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args()
