@@ -30,7 +30,14 @@ import numpy as np
 CHILD_ENV_FLAG = "_HETU_BENCH_CHILD"
 CHILD_TIMEOUT_S = int(os.environ.get("HETU_BENCH_CHILD_TIMEOUT", "420"))
 TOTAL_BUDGET_S = int(os.environ.get("HETU_BENCH_BUDGET", "900"))
-MAX_ATTEMPTS = 3
+# a wedged axon tunnel hangs INSIDE jax.devices(), so backend liveness is
+# probed in a disposable child with a short timeout before committing a
+# full measurement attempt to it (the tunnel wedges and recovers on a
+# scale of minutes — observed during rounds 1 and 2)
+PROBE_TIMEOUT_S = int(os.environ.get("HETU_BENCH_PROBE_TIMEOUT", "90"))
+# wall clock reserved at the end of the budget for the reduced-size CPU
+# fallback measurement (an honest artifact beats no artifact)
+CPU_RESERVE_S = int(os.environ.get("HETU_BENCH_CPU_RESERVE", "300"))
 
 
 def _sync(outs):
@@ -180,52 +187,99 @@ def _error_result(args, msg):
             "vs_baseline": 0.0, "error": msg[-2000:]}
 
 
+def _parse_child_json(stdout, attempt):
+    """Last valid {"metric": ...} JSON line from a child's stdout, or None."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed:
+                if attempt > 0:
+                    parsed.setdefault("extra", {})["attempt"] = attempt
+                return parsed
+    return None
+
+
+def _probe_backend(timeout_s):
+    """True iff jax backend init answers within timeout_s (disposable child,
+    so a hang inside jax.devices() cannot wedge the parent)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print('LIVE', d[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return proc.returncode == 0 and "LIVE" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _parent_main(args):
-    """Run the bench in a child process with retries + a hard time budget."""
+    """Run the bench in a child process under a hard time budget.
+
+    Probe-first: a wedged tunnel is detected in ~PROBE_TIMEOUT_S, not by
+    burning a CHILD_TIMEOUT_S measurement attempt; the probe retries across
+    the budget window (the tunnel recovers on a minutes scale) with
+    CPU_RESERVE_S always kept for the reduced-size CPU fallback."""
     deadline = time.monotonic() + TOTAL_BUDGET_S
     last_err = "no attempts made"
-    hung = False
-    for attempt in range(MAX_ATTEMPTS):
+    attempt = 0
+    while True:
         remaining = deadline - time.monotonic()
-        if remaining <= 10:
-            last_err += " | total time budget exhausted"
+        if remaining <= CPU_RESERVE_S + 30:
+            break
+        if not _probe_backend(min(PROBE_TIMEOUT_S,
+                                  remaining - CPU_RESERVE_S)):
+            last_err = (f"attempt {attempt}: backend probe timed out "
+                        f"(tunnel wedged)")
+            attempt += 1
+            time.sleep(15)  # give the tunnel a chance to recover
+            continue
+        remaining = deadline - time.monotonic()
+        if remaining <= CPU_RESERVE_S + 30:
             break
         env = dict(os.environ, **{CHILD_ENV_FLAG: "1"})
-        if attempt > 0 and hung:
-            # a wall-clock hang means the TPU backend is wedged (init never
-            # returns) — retrying it would eat the whole budget, so go
-            # straight to the reduced-size CPU-backend attempt (forced via
-            # jax.config in the child; env alone is pinned by the site
-            # customization), marked with an error field
-            env["_HETU_BENCH_FORCE_CPU"] = "1"
-        elif attempt == 1:
-            time.sleep(min(10.0, remaining / 10))  # transient rc failure
-        elif attempt >= 2:
-            env["_HETU_BENCH_FORCE_CPU"] = "1"
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
                 env=env, capture_output=True, text=True,
-                timeout=min(CHILD_TIMEOUT_S, remaining))
+                timeout=min(CHILD_TIMEOUT_S, remaining - CPU_RESERVE_S))
         except subprocess.TimeoutExpired:
-            last_err = f"attempt {attempt}: child exceeded " \
-                       f"{min(CHILD_TIMEOUT_S, remaining):.0f}s wall clock"
-            hung = True
+            last_err = f"attempt {attempt}: child exceeded wall clock " \
+                       f"(backend wedged mid-run)"
+            attempt += 1
             continue
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{") and line.endswith("}"):
-                try:
-                    parsed = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "metric" in parsed:
-                    if attempt > 0:
-                        parsed.setdefault("extra", {})["attempt"] = attempt
-                    print(json.dumps(parsed))
-                    return
+        parsed = _parse_child_json(proc.stdout, attempt)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return
         last_err = f"attempt {attempt}: rc={proc.returncode} " \
                    f"stderr: {proc.stderr[-1500:]}"
+        attempt += 1
+        time.sleep(min(10.0, max(0.0, deadline - time.monotonic()) / 10))
+    # reduced-size CPU fallback (forced via jax.config in the child; env
+    # alone is pinned by the site customization), marked with an error field
+    remaining = deadline - time.monotonic()
+    if remaining > 30:
+        env = dict(os.environ, **{CHILD_ENV_FLAG: "1",
+                                  "_HETU_BENCH_FORCE_CPU": "1"})
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env, capture_output=True, text=True,
+                timeout=remaining - 10)
+            parsed = _parse_child_json(proc.stdout, attempt)
+            if parsed is not None:
+                parsed.setdefault("error", "TPU backend unavailable")
+                parsed["error"] += f" | last TPU {last_err}"
+                print(json.dumps(parsed))
+                return
+            last_err += f" | cpu fallback rc={proc.returncode} " \
+                        f"stderr: {proc.stderr[-500:]}"
+        except subprocess.TimeoutExpired:
+            last_err += " | cpu fallback exceeded wall clock"
     print(json.dumps(_error_result(args, last_err)))
 
 
