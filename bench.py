@@ -204,16 +204,58 @@ def _parse_child_json(stdout, attempt):
 
 
 def _probe_backend(timeout_s):
-    """True iff jax backend init answers within timeout_s (disposable child,
-    so a hang inside jax.devices() cannot wedge the parent)."""
+    """(ok, err) — ok iff jax backend init answers within timeout_s AND the
+    default backend is an accelerator (a disposable child, so a hang inside
+    jax.devices() cannot wedge the parent).  ``err`` carries the real cause
+    (timeout vs init failure vs silent-CPU) for the final JSON artifact."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; d = jax.devices(); print('LIVE', d[0].device_kind)"],
+             "import jax; d = jax.devices(); "
+             "print('LIVE', jax.default_backend(), d[0].device_kind)"],
             capture_output=True, text=True, timeout=timeout_s)
-        return proc.returncode == 0 and "LIVE" in proc.stdout
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"probe timed out after {timeout_s:.0f}s (tunnel wedged)"
+    if proc.returncode != 0:
+        return False, f"probe rc={proc.returncode}: {proc.stderr[-400:]}"
+    fields = proc.stdout.split()
+    if "LIVE" not in fields:
+        return False, f"probe produced no LIVE line: {proc.stdout[-200:]}"
+    platform = fields[fields.index("LIVE") + 1] if \
+        len(fields) > fields.index("LIVE") + 1 else "?"
+    # JAX_PLATFORMS is normally pinned to the TPU tunnel by sitecustomize;
+    # if that pin is absent a healthy-looking probe may be a silent CPU
+    # fallback — each full-size attempt would then burn the whole child
+    # timeout on CPU, so refuse it here
+    if platform == "cpu" and not os.environ.get("_HETU_BENCH_ALLOW_CPU"):
+        return False, f"probe found only the cpu backend ({proc.stdout!r})"
+    return True, None
+
+
+# a measurement child needs compile + warmup + timed steps; spawning one
+# with less runway than this guarantees a wasted attempt
+MIN_MEASURE_S = int(os.environ.get("HETU_BENCH_MIN_MEASURE", "120"))
+# identical deterministic child failures (rc!=0, e.g. an OOM or a model
+# bug) are not worth retrying across the whole budget window
+MAX_RC_FAILURES = 3
+
+TPU_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_TPU_LATEST.json")
+
+
+def _cached_tpu_result(config):
+    """Last known-good on-TPU measurement for ``config`` persisted by
+    tools/tpu_watch.py while the tunnel was healthy (it wedges for hours at
+    a time — a dated real-TPU artifact beats a live CPU fallback)."""
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            cache = json.load(f)
+        res = cache["configs"][config]
+    except (OSError, KeyError, json.JSONDecodeError):
+        return None
+    if res.get("extra", {}).get("backend") != "tpu" or "error" in res:
+        return None
+    return res
 
 
 def _parent_main(args):
@@ -222,24 +264,29 @@ def _parent_main(args):
     Probe-first: a wedged tunnel is detected in ~PROBE_TIMEOUT_S, not by
     burning a CHILD_TIMEOUT_S measurement attempt; the probe retries across
     the budget window (the tunnel recovers on a minutes scale) with
-    CPU_RESERVE_S always kept for the reduced-size CPU fallback."""
+    CPU_RESERVE_S always kept for the fallback path (cached TPU artifact if
+    one exists, else a reduced-size CPU measurement)."""
     deadline = time.monotonic() + TOTAL_BUDGET_S
     last_err = "no attempts made"
     attempt = 0
+    rc_failures = 0
     while True:
         remaining = deadline - time.monotonic()
-        if remaining <= CPU_RESERVE_S + 30:
+        if remaining - CPU_RESERVE_S <= MIN_MEASURE_S:
+            # too little runway for compile+warmup+steps: probing further
+            # only delays the fallback artifact
+            last_err += " | stopped (insufficient runway for a measurement)"
             break
-        if not _probe_backend(min(PROBE_TIMEOUT_S,
-                                  remaining - CPU_RESERVE_S)):
-            last_err = (f"attempt {attempt}: backend probe timed out "
-                        f"(tunnel wedged)")
+        ok, probe_err = _probe_backend(min(PROBE_TIMEOUT_S,
+                                           remaining - CPU_RESERVE_S))
+        if not ok:
+            last_err = f"attempt {attempt}: {probe_err}"
             attempt += 1
             time.sleep(15)  # give the tunnel a chance to recover
             continue
         remaining = deadline - time.monotonic()
-        if remaining <= CPU_RESERVE_S + 30:
-            break
+        if remaining - CPU_RESERVE_S <= MIN_MEASURE_S:
+            continue    # probe ate the runway; top-of-loop break explains
         env = dict(os.environ, **{CHILD_ENV_FLAG: "1"})
         try:
             proc = subprocess.run(
@@ -258,9 +305,25 @@ def _parent_main(args):
         last_err = f"attempt {attempt}: rc={proc.returncode} " \
                    f"stderr: {proc.stderr[-1500:]}"
         attempt += 1
+        rc_failures += 1
+        if rc_failures >= MAX_RC_FAILURES:
+            last_err += f" | giving up after {rc_failures} child failures"
+            break
         time.sleep(min(10.0, max(0.0, deadline - time.monotonic()) / 10))
-    # reduced-size CPU fallback (forced via jax.config in the child; env
-    # alone is pinned by the site customization), marked with an error field
+    # fallback 1: a persisted on-TPU artifact from tools/tpu_watch.py —
+    # the real metric, measured earlier in the round while the tunnel was up
+    cached = _cached_tpu_result(args.config)
+    if cached is not None:
+        # top-level marker: a real on-TPU number, but NOT measured by this
+        # invocation — consumers must not read it as a live success
+        cached["stale"] = True
+        cached.setdefault("extra", {})["cached"] = True
+        cached["extra"]["live_attempt_err"] = last_err[-500:]
+        print(json.dumps(cached))
+        return
+    # fallback 2: reduced-size CPU measurement (forced via jax.config in
+    # the child; env alone is pinned by the site customization), marked
+    # with an error field — an honest artifact beats no artifact
     remaining = deadline - time.monotonic()
     if remaining > 30:
         env = dict(os.environ, **{CHILD_ENV_FLAG: "1",

@@ -8,13 +8,38 @@ with a reference jnp lowering for CPU tests; ring/blockwise variants live in
 :mod:`hetu_tpu.parallel.ring_attention`.
 """
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
 
 from .base import def_op
 
-_FLASH_MIN_LEN = 256  # below this, XLA's fused softmax-matmul is fine
+
+def _load_flash_gate(default=256):
+    """Empirical flash-vs-XLA dispatch threshold.
+
+    ``tools/flash_ab.py`` measures both paths on the real chip and commits
+    the winner table to ``artifacts/flash_ab.json``; the gate comes from
+    data when that artifact exists (round-2 verdict: a guessed gate meant
+    the kernel was never in the measured hot path)."""
+    env = os.environ.get("HETU_FLASH_MIN_LEN")
+    if env:
+        return int(env)
+    path = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "artifacts", "flash_ab.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("backend") == "tpu":
+            return int(data["flash_min_len"])
+    except (OSError, ValueError, KeyError):
+        pass
+    return default
+
+
+_FLASH_MIN_LEN = _load_flash_gate()  # below this, XLA's fusion is fine
 
 
 def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
@@ -25,18 +50,22 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
                         preferred_element_type=jnp.float32) * scale
     if bias is not None:  # additive position bias (T5-style), broadcastable
         logits = logits + bias
+    valid = None
     if causal:
         s_q, s_k = logits.shape[-2:]
-        cmask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
-        logits = jnp.where(cmask, logits, -1e30)
+        valid = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
     if mask is not None:
-        logits = jnp.where(mask.astype(bool), logits, -1e30)
+        m = mask.astype(bool)
+        valid = m if valid is None else jnp.logical_and(valid, m)
+    if valid is not None:
+        logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    if mask is not None:
-        # a fully-masked query row yields ZERO output, not the uniform
-        # softmax fallback (which would leak every value vector — e.g. the
-        # XLNet query stream's first-in-permutation position)
-        row_any = jnp.any(mask.astype(bool), axis=-1, keepdims=True)
+    if valid is not None:
+        # a query row with NO valid key (under the COMBINED causal∧mask
+        # validity) yields ZERO output, not the uniform softmax fallback
+        # (which would leak every value vector — e.g. the XLNet query
+        # stream's first-in-permutation position)
+        row_any = jnp.any(valid, axis=-1, keepdims=True)
         probs = jnp.where(row_any, probs, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
@@ -60,7 +89,39 @@ def _sdpa(c, q, k, v, causal=False, scale=None):
 sdpa_op = def_op("ScaledDotProductAttention", _sdpa)
 
 
+def _split_mask_kinds(mask, q):
+    """Route a broadcastable mask to the cheap kernel path.
+
+    (B|1, 1, 1, S_kv) masks are pure key-padding masks — O(S) memory as the
+    kernel's ``key_mask`` column strips; anything else rides the blockwise
+    full-mask path.  Returns (key_mask, full_mask) with exactly one set."""
+    b, h, s_q, _ = q.shape
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        km = mask.reshape(mask.shape[0], mask.shape[-1])
+        if km.shape[0] == 1:
+            km = jnp.broadcast_to(km, (b, km.shape[-1]))
+        return km, None
+    return None, mask
+
+
+def _flash_maskable(q, k, mask):
+    """Mask shapes the kernel's broadcast-group loader supports."""
+    if not _use_flash(q, k):
+        return False
+    if mask is None:
+        return True
+    b, h = q.shape[:2]
+    return mask.ndim == 4 and mask.shape[0] in (1, b) \
+        and mask.shape[1] in (1, h) \
+        and mask.shape[2] in (1, q.shape[2]) and mask.shape[3] == k.shape[2]
+
+
 def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
+    if _flash_maskable(q, k, mask):
+        from .pallas.flash_attention import flash_attention
+        km, fm = _split_mask_kinds(mask, q)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               key_mask=km, mask=fm)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
 
 
@@ -69,6 +130,10 @@ sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)
 
 def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
     """Attention with an additive logit bias (T5 relative position bias)."""
+    if _flash_maskable(q, k, bias):
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               bias=bias)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, bias=bias)
 
 
@@ -77,6 +142,11 @@ sdpa_bias_op = def_op("ScaledDotProductAttentionBias", _sdpa_bias)
 
 def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
     """Masked attention with an additive bias (XLNet two-stream layers)."""
+    if _flash_maskable(q, k, mask) and _flash_maskable(q, k, bias):
+        from .pallas.flash_attention import flash_attention
+        km, fm = _split_mask_kinds(mask, q)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               key_mask=km, mask=fm, bias=bias)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask,
                           bias=bias)
 

@@ -2,18 +2,38 @@
 
 The reference has no attention kernel at all (SURVEY.md §5.7): its
 transformers compose batch_matmul + softmax ops, materialising the (S, S)
-score matrix in HBM.  This kernel is the TPU-native replacement: blockwise
-online-softmax attention that keeps scores in VMEM, with a custom VJP whose
-backward recomputes scores per block (flash-attention-2 style), so memory is
-O(S·D) instead of O(S²).
+score matrix in HBM (and its BERT composes attention with explicit additive
+masks — ``examples/transformers/bert/hetu_bert.py``).  This kernel is the
+TPU-native replacement: blockwise online-softmax attention that keeps scores
+in VMEM, with a custom VJP whose backward recomputes scores per block
+(flash-attention-2 style), so memory is O(S·D) instead of O(S²).
 
 Layout: inputs are (B, H, S, D); the kernel runs on (B·H, S, D) with a
 sequential TPU grid (bh, q_block, kv_block) — accumulators live in VMEM
 scratch and persist across the minor-most kv grid steps; outputs are written
 once on the final kv step (standard TPU revisiting-grid pattern).
 
-Causal masking prunes fully-masked blocks via ``pl.when`` (no FLOPs spent
-above the diagonal) and masks the diagonal blocks with -1e30 logits.
+Masking/bias menu (every combination is a STATIC trace-time specialization,
+so the dense hot path compiles the original straight-line code):
+
+* ``causal``        — diagonal blocks masked, above-diagonal blocks pruned
+                      via ``pl.when`` (no FLOPs);
+* ``lengths``       — per-sequence valid-KEY counts (padding), SMEM scalar,
+                      fully-padded key blocks pruned;
+* ``key_mask``      — arbitrary per-key boolean mask (B, S_kv), loaded as
+                      (1, block_k) column strips — O(S) memory, the BERT
+                      padded-pretraining path;
+* ``mask``          — full boolean mask broadcast as (1|B, 1|H, S_q, S_kv)
+                      (XLNet two-stream perms), loaded blockwise without
+                      materialising the broadcast;
+* ``bias``          — additive logit bias broadcast likewise (T5 relative
+                      position bias), differentiable: backward emits per-
+                      block dbias tiles (dbias is inherently O(S²) — same
+                      footprint as the bias itself).
+
+Fully-masked rows/blocks produce ZERO output (not a uniform-softmax leak):
+probabilities are multiplied by the block validity mask, so an all-masked
+block contributes nothing even though exp(s - m) == 1 there.
 """
 import functools
 
@@ -27,46 +47,123 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-# ---------------------------------------------------------------- forward
-def _mask_and_live(qi, ki, len_ref, *, causal, has_lengths, block_q,
-                   block_k, kv_off):
-    """(live predicate, mask fn) for one (qi, ki) block.
+# ------------------------------------------------------------- index maps
+def _g_index(gmode, heads):
+    """Map the flattened (b·h) grid index to a broadcast-group row for a
+    mask/bias stored un-broadcast as (G, S_q, S_kv):
+    'one' G=1, 'h' G=H (shared over batch), 'b' G=B (shared over heads),
+    'bh' G=B·H (full)."""
+    return {
+        "one": lambda bh: 0,
+        "h": lambda bh: bh % heads,
+        "b": lambda bh: bh // heads,
+        "bh": lambda bh: bh,
+    }[gmode]
 
-    ``has_lengths`` is a STATIC trace-time flag: the dense path keeps the
-    original straight-line code (static ``live`` when non-causal, no
-    per-block iota/where), so varlen support costs the hot path nothing.
-    The length scalar itself lives in SMEM (the supported scalar pattern).
-    """
-    causal_live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
-        if causal else True
+
+def _extra_specs(order, heads, gmode_mask, gmode_bias, block_q, block_k,
+                 *, has_lengths, has_kmask, has_fmask, has_bias):
+    """BlockSpecs for the optional inputs, in kernel-argument order.
+    ``order`` maps grid indices to (bh, qi, ki) — the dkv kernel iterates
+    (bh, ki, qi)."""
+    specs = []
     if has_lengths:
-        kvlen = len_ref[0, 0]
-        live = jnp.logical_and(causal_live, ki * block_k < kvlen)
-    else:
-        kvlen = None
-        live = causal_live
-
-    def mask(s):
-        valid = None
-        if has_lengths:
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ki * block_k
-            valid = cols < kvlen                       # padding mask
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + qi * block_q + kv_off
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ki * block_k
-            c = rows >= cols
-            valid = c if valid is None else jnp.logical_and(valid, c)
-        return s if valid is None else jnp.where(valid, s, NEG_INF)
-
-    return live, mask
+        specs.append(pl.BlockSpec(
+            (1, 1), lambda *g: (order(*g)[0], 0),
+            memory_space=pltpu.SMEM))
+    if has_kmask:
+        specs.append(pl.BlockSpec(
+            (1, block_k), lambda *g: (order(*g)[0] // heads, order(*g)[2])))
+    if has_fmask:
+        gm = _g_index(gmode_mask, heads)
+        specs.append(pl.BlockSpec(
+            (1, block_q, block_k),
+            lambda *g: (gm(order(*g)[0]), order(*g)[1], order(*g)[2])))
+    if has_bias:
+        gb = _g_index(gmode_bias, heads)
+        specs.append(pl.BlockSpec(
+            (1, block_q, block_k),
+            lambda *g: (gb(order(*g)[0]), order(*g)[1], order(*g)[2])))
+    return specs
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, has_lengths,
-                block_q, block_k, num_kv, kv_off):
+# ---------------------------------------------------------------- masking
+def _block_logits(qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref, *,
+                  scale, causal, block_q, block_k, kv_off):
+    """Masked+biased logits for one (qi, ki) block → (s, valid).
+
+    ``valid`` is None on the pure-dense path (no masking of any kind) so
+    the hot path keeps the original straight-line code; otherwise it is the
+    boolean validity of every score — callers MUST multiply probabilities
+    by it (exp(s - m) == 1 on an all-masked block, which would otherwise
+    leak a uniform average of the value vectors)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # (bq, bk)
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    valid = None
+
+    def _and(a, b):
+        return b if a is None else jnp.logical_and(a, b)
+
+    if len_ref is not None:
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + ki * block_k
+        valid = _and(valid, cols < len_ref[0, 0])
+    if kmask_ref is not None:
+        # keep the load 2-D — (1, block_k) broadcasts over query rows
+        valid = _and(valid, kmask_ref[:] != 0)
+    if fmask_ref is not None:
+        valid = _and(valid, fmask_ref[0] != 0)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + qi * block_q + kv_off
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + ki * block_k
+        valid = _and(valid, rows >= cols)
+    if valid is not None:
+        s = jnp.where(valid, s, NEG_INF)
+    return s, valid
+
+
+def _live(qi, ki, len_ref, *, causal, block_q, block_k, kv_off):
+    """Block-prune predicate: blocks entirely above the causal diagonal or
+    entirely past the valid-key count are skipped (no FLOPs).  key_mask /
+    full-mask blocks are never pruned (their validity is vector data)."""
+    live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
+        if causal else True
+    if len_ref is not None:
+        cond = ki * block_k < len_ref[0, 0]
+        live = cond if live is True else jnp.logical_and(live, cond)
+    return live
+
+
+def _unpack(refs, *, has_lengths, has_kmask, has_fmask, has_bias):
+    """Split the flat pallas ref list into (fixed-ins, extras, outs+scratch).
+    Optional inputs are present only when their static flag is set, keeping
+    the kernel arity minimal per specialization."""
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    len_ref = kmask_ref = fmask_ref = bias_ref = None
+    if has_lengths:
+        len_ref = refs[i]; i += 1                       # noqa: E702
+    if has_kmask:
+        kmask_ref = refs[i]; i += 1                     # noqa: E702
+    if has_fmask:
+        fmask_ref = refs[i]; i += 1                     # noqa: E702
+    if has_bias:
+        bias_ref = refs[i]; i += 1                      # noqa: E702
+    return (q_ref, k_ref, v_ref), \
+        (len_ref, kmask_ref, fmask_ref, bias_ref), refs[i:]
+
+
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(*refs, scale, causal, flags, block_q, block_k, num_kv,
+                kv_off):
+    (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
+    len_ref, kmask_ref, fmask_ref, bias_ref = extras
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -76,24 +173,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
-                                has_lengths=has_lengths, block_q=block_q,
-                                block_k=block_k, kv_off=kv_off)
+    live = _live(qi, ki, len_ref, causal=causal, block_q=block_q,
+                 block_k=block_k, kv_off=kv_off)
 
     @pl.when(live)
     def _block():
         q = q_ref[0]                                   # (bq, d)
         k = k_ref[0]                                   # (bk, d)
         v = v_ref[0]                                   # (bk, d)
-        s = mask(jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale)  # (bq, bk)
+        s, valid = _block_logits(
+            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            kv_off=kv_off)
         m_prev = m_scr[:, :1]                          # (bq, 1)
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                          # (bq, bk)
+        if valid is not None:
+            p = p * valid                               # no all-masked leak
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -109,25 +208,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref,
         lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
 
 
-def _len_spec():
-    """(1,1) per-bh scalar in SMEM — the supported scalar-input pattern."""
-    return pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
-                        memory_space=pltpu.SMEM)
-
-
-def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k,
-               interpret):
+def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
+               gmode_mask, gmode_bias, heads, block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
     grid = (bh, num_q, num_kv)
-    has_lengths = lengths is not None
-    if not has_lengths:  # dummy scalar keeps the kernel arity uniform
-        lengths = jnp.zeros((bh, 1), jnp.int32)
+    flags = dict(has_lengths=lengths is not None, has_kmask=kmask is not None,
+                 has_fmask=fmask is not None, has_bias=bias is not None)
+    inputs = [q, k, v] + [x for x in (lengths, kmask, fmask, bias)
+                          if x is not None]
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, has_lengths=has_lengths,
+        _fwd_kernel, scale=scale, causal=causal, flags=flags,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
         kv_off=s_kv - s_q)
     out, lse = pl.pallas_call(
@@ -137,8 +231,8 @@ def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            _len_spec(),
-        ],
+        ] + _extra_specs(lambda b, i, j: (b, i, j), heads, gmode_mask,
+                         gmode_bias, block_q, block_k, **flags),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -153,14 +247,22 @@ def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v, lengths)
+    )(*inputs)
     return out, lse
 
 
 # ---------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
-               dq_ref, dq_scr, *, scale, causal, has_lengths, block_q,
-               block_k, num_kv, kv_off):
+def _dq_kernel(*refs, scale, causal, flags, emit_dbias, block_q, block_k,
+               num_kv, kv_off):
+    (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
+    len_ref, kmask_ref, fmask_ref, bias_ref = extras
+    do_ref, lse_ref, delta_ref = rest[:3]
+    rest = rest[3:]
+    if emit_dbias:
+        dq_ref, dbias_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        dbias_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -168,38 +270,57 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
-                                has_lengths=has_lengths, block_q=block_q,
-                                block_k=block_k, kv_off=kv_off)
+    live = _live(qi, ki, len_ref, causal=causal, block_q=block_q,
+                 block_k=block_k, kv_off=kv_off)
+    live_static = live is True
 
-    @pl.when(live)
-    def _block():
+    def _body(write_dbias):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]                                  # (bq, d)
         lse = lse_ref[0][:, None]                       # (bq, 1)
         delta = delta_ref[0][:, None]                   # (bq, 1)
-        s = mask(jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale)
+        s, valid = _block_logits(
+            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            kv_off=kv_off)
         p = jnp.exp(s - lse)                            # (bq, bk)
+        if valid is not None:
+            p = p * valid
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bq, bk)
-        ds = p * (dp - delta) * scale
+        t = p * (dp - delta)       # = dL/d(logits) block (pre-scale)
+        if write_dbias:
+            dbias_ref[0] = t.astype(dbias_ref.dtype)
+        ds = t * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if live_static:
+        _body(emit_dbias)
+    else:
+        @pl.when(live)
+        def _b():
+            _body(emit_dbias)
+        if emit_dbias:
+            # pruned blocks must still define their dbias tile
+            @pl.when(jnp.logical_not(live))
+            def _z():
+                dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
 
     @pl.when(ki == num_kv - 1)
     def _finish():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                has_lengths, block_q, block_k, num_q, kv_off):
+def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
+                kv_off):
+    (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
+    len_ref, kmask_ref, fmask_ref, bias_ref = extras
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -208,9 +329,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live, mask = _mask_and_live(qi, ki, len_ref, causal=causal,
-                                has_lengths=has_lengths, block_q=block_q,
-                                block_k=block_k, kv_off=kv_off)
+    live = _live(qi, ki, len_ref, causal=causal, block_q=block_q,
+                 block_k=block_k, kv_off=kv_off)
 
     @pl.when(live)
     def _block():
@@ -220,10 +340,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
         do = do_ref[0]
         lse = lse_ref[0][:, None]
         delta = delta_ref[0][:, None]
-        s = mask(jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale)  # (bq, bk)
+        s, valid = _block_logits(
+            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            kv_off=kv_off)
         p = jnp.exp(s - lse)                             # (bq, bk)
+        if valid is not None:
+            p = p * valid
         # dV += P^T @ dO
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -243,55 +366,69 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, lengths, out, lse, do, scale, causal, block_q,
-               block_k, interpret):
+def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
+               causal, gmode_mask, gmode_bias, heads, block_q, block_k,
+               interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
-    has_lengths = lengths is not None
-    if not has_lengths:
-        lengths = jnp.zeros((bh, 1), jnp.int32)
+    flags = dict(has_lengths=lengths is not None, has_kmask=kmask is not None,
+                 has_fmask=fmask is not None, has_bias=bias is not None)
+    emit_dbias = bias is not None
+    extras = [x for x in (lengths, kmask, fmask, bias) if x is not None]
     # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                              # (bh, s_q)
 
-    dq = pl.pallas_call(
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    dq_outs = [qspec]
+    dq_shapes = [jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)]
+    if emit_dbias:
+        # dbias is dense — O(B·H·S²) like the score matrix; unavoidable,
+        # the bias gradient has that shape before broadcast-reduction
+        dq_outs.append(pl.BlockSpec((1, block_q, block_k),
+                                    lambda b, i, j: (b, i, j)))
+        dq_shapes.append(jax.ShapeDtypeStruct((bh, s_q, s_kv), jnp.float32))
+    res = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          has_lengths=has_lengths,
+                          flags=flags, emit_dbias=emit_dbias,
                           block_q=block_q, block_k=block_k, num_kv=num_kv,
                           kv_off=s_kv - s_q),
         grid=(bh, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            _len_spec(),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        in_specs=[qspec, kspec, kspec]
+        + _extra_specs(lambda b, i, j: (b, i, j), heads, gmode_mask,
+                       gmode_bias, block_q, block_k, **flags)
+        + [qspec, rowspec, rowspec],
+        out_specs=dq_outs if emit_dbias else dq_outs[0],
+        out_shape=dq_shapes if emit_dbias else dq_shapes[0],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, lengths)
+    )(q, k, v, *extras, do, lse, delta)
+    if emit_dbias:
+        dq, dbias = res
+    else:
+        dq, dbias = res, None
 
+    # dkv iterates (bh, kv_block, q_block): remap grid→(bh, qi, ki)
+    order = lambda b, j, i: (b, i, j)                    # noqa: E731
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          has_lengths=has_lengths,
-                          block_q=block_q, block_k=block_k, num_q=num_q,
-                          kv_off=s_kv - s_q),
+                          flags=flags, block_q=block_q, block_k=block_k,
+                          num_q=num_q, kv_off=s_kv - s_q),
         grid=(bh, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ] + _extra_specs(order, heads, gmode_mask, gmode_bias, block_q,
+                         block_k, **flags)
+        + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
-                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -306,8 +443,8 @@ def _flash_bwd(q, k, v, lengths, out, lse, do, scale, causal, block_q,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, lengths)
-    return dq, dk, dv
+    )(q, k, v, *extras, do, lse, delta)
+    return dq, dk, dv, dbias
 
 
 # ---------------------------------------------------------------- public op
@@ -317,31 +454,83 @@ def _f0(x):
     return _np.zeros(x.shape, _jd.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q3, k3, v3, lengths, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q,
+_STATIC = (7, 8, 9, 10, 11, 12, 13, 14)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=_STATIC)
+def _flash(q3, k3, v3, lengths, kmask, fmask, bias, scale, causal,
+           gmode_mask, gmode_bias, heads, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale,
+                        causal, gmode_mask, gmode_bias, heads, block_q,
                         block_k, interpret)
     return out
 
 
-def _flash_vjp_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k,
+def _flash_vjp_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale, causal,
+                   gmode_mask, gmode_bias, heads, block_q, block_k,
                    interpret):
-    out, lse = _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q,
+    out, lse = _flash_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale,
+                          causal, gmode_mask, gmode_bias, heads, block_q,
                           block_k, interpret)
-    return out, (q3, k3, v3, lengths, out, lse)
+    return out, (q3, k3, v3, lengths, kmask, fmask, bias, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q3, k3, v3, lengths, out, lse = res
-    dq, dk, dv = _flash_bwd(q3, k3, v3, lengths, out, lse, do, scale,
-                            causal, block_q, block_k, interpret)
-    return (dq, dk, dv, None if lengths is None else _f0(lengths))
+def _flash_vjp_bwd(scale, causal, gmode_mask, gmode_bias, heads, block_q,
+                   block_k, interpret, res, do):
+    q3, k3, v3, lengths, kmask, fmask, bias, out, lse = res
+    dq, dk, dv, dbias = _flash_bwd(
+        q3, k3, v3, lengths, kmask, fmask, bias, out, lse, do, scale,
+        causal, gmode_mask, gmode_bias, heads, block_q, block_k, interpret)
+    if bias is not None:
+        # reduce the dense (B·H, S, S) tile grads over the broadcast group
+        b = q3.shape[0] // heads
+        g = dbias.reshape(b, heads, *dbias.shape[1:])
+        if gmode_bias == "one":
+            dbias = g.sum(axis=(0, 1))[None]
+        elif gmode_bias == "h":
+            dbias = g.sum(axis=0)
+        elif gmode_bias == "b":
+            dbias = g.sum(axis=1)
+        else:                                            # 'bh'
+            dbias = dbias
+        dbias = dbias.reshape(bias.shape).astype(bias.dtype)
+    return (dq, dk, dv,
+            None if lengths is None else _f0(lengths),
+            None if kmask is None else _f0(kmask),
+            None if fmask is None else _f0(fmask),
+            None if bias is None else dbias)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _broadcast_group(x, b, h, s_q, s_kv, name):
+    """Classify a (1|B, 1|H, S_q|1, S_kv)-broadcastable tensor into its
+    un-broadcast (G, S_q, S_kv) storage + gmode — no materialisation of
+    the broadcast."""
+    if x.ndim != 4:
+        raise ValueError(f"{name} must be rank-4 broadcastable, "
+                         f"got {x.shape}")
+    xb, xh, xq, xk = x.shape
+    if xk != s_kv or xq not in (1, s_q) or xb not in (1, b) \
+            or xh not in (1, h):
+        raise ValueError(f"{name} shape {x.shape} not broadcastable to "
+                         f"({b}, {h}, {s_q}, {s_kv})")
+    if xq == 1 and s_q != 1:
+        x = jnp.broadcast_to(x, (xb, xh, s_q, s_kv))  # rows only: O(S²/Sq)
+    if xb == 1 and xh == 1:
+        gmode = "one"
+    elif xb == 1:
+        gmode = "h"
+    elif xh == 1:
+        gmode = "b"
+    else:
+        gmode = "bh"
+    return x.reshape(-1, s_q, s_kv), gmode
+
+
 def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
+                    key_mask=None, mask=None, bias=None,
                     block_q=None, block_k=None, interpret=False):
     """Blockwise flash attention for (B, H, S, D) inputs.
 
@@ -349,11 +538,19 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
     at positions >= lengths[b] are masked out (padding mask); fully masked
     key blocks spend no FLOPs (the block body is predicated off; the
     block's K/V DMA still occurs — true block pruning would need
-    scalar-prefetch grid shrinking).  With ``lengths=None`` the kernels
-    compile the original dense code with zero masking overhead.  Requires S divisible by the block size (the ``sdpa_op``
-    dispatcher falls back to the XLA-composed reference otherwise).
-    ``interpret=True`` runs the Pallas interpreter so CPU CI exercises the
-    same kernel code.
+    scalar-prefetch grid shrinking).
+    ``key_mask``: optional (B, S_kv) (or (B, 1, 1, S_kv)) boolean per-key
+    mask — the general padding-mask form when validity is not a prefix.
+    ``mask``: optional full boolean mask, broadcastable
+    (1|B, 1|H, 1|S_q, S_kv); loaded blockwise without materialising the
+    broadcast.
+    ``bias``: optional additive logit bias, same broadcast menu,
+    differentiable (T5 relative position bias).
+    With none of these the kernels compile the original dense
+    straight-line code with zero masking overhead.  Requires S divisible
+    by the block size (the ``sdpa_op`` dispatcher falls back to the
+    XLA-composed reference otherwise).  ``interpret=True`` runs the Pallas
+    interpreter so CPU CI exercises the same kernel code.
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
@@ -377,6 +574,22 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
         len3 = jnp.broadcast_to(
             jnp.asarray(lengths, jnp.int32).reshape(b, 1), (b, h)
         ).reshape(b * h, 1)
-    out = _flash(q3, k3, v3, len3, scale, causal, block_q, block_k,
-                 interpret)
+    gmode_mask = gmode_bias = "one"
+    kmask2 = fmask3 = bias3 = None
+    if key_mask is not None:
+        km = jnp.asarray(key_mask)
+        if km.ndim == 4:     # (B, 1, 1, S_kv) attention-mask convention
+            km = km.reshape(km.shape[0], km.shape[-1])
+        if km.shape != (b, s_kv):
+            raise ValueError(f"key_mask must be (B, S_kv), got "
+                            f"{key_mask.shape}")
+        kmask2 = km.astype(jnp.int32)
+    if mask is not None:
+        fmask3, gmode_mask = _broadcast_group(
+            jnp.asarray(mask).astype(jnp.int32), b, h, s_q, s_kv, "mask")
+    if bias is not None:
+        bias3, gmode_bias = _broadcast_group(
+            jnp.asarray(bias, jnp.float32), b, h, s_q, s_kv, "bias")
+    out = _flash(q3, k3, v3, len3, kmask2, fmask3, bias3, scale, causal,
+                 gmode_mask, gmode_bias, h, block_q, block_k, interpret)
     return out.reshape(b, h, s_q, d)

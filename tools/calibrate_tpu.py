@@ -1,0 +1,46 @@
+"""Measure a HardwareSpec on the live TPU chip and persist it.
+
+The autoparallel search (Galvatron-parity; reference
+``tools/Galvatron/README.md:15-100`` profile→search→train workflow) consumes
+a calibrated :class:`hetu_tpu.autoparallel.HardwareSpec`.  CPU CI calibrates
+against the host; this script records the real-chip numbers as a committed
+artifact (``artifacts/tpu_calibration.json``) so searches are grounded in
+measured hardware even when the tunnel is wedged.
+
+Run by tools/tpu_watch.py when the tunnel is healthy.
+"""
+import dataclasses
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    import jax
+
+    from hetu_tpu.autoparallel import calibrate_hardware
+
+    backend = jax.default_backend()
+    if backend == "cpu" and not os.environ.get("_HETU_CAL_ALLOW_CPU"):
+        print("refusing to calibrate on cpu (set _HETU_CAL_ALLOW_CPU=1)",
+              file=sys.stderr)
+        return 1
+    spec = calibrate_hardware()
+    out = {
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "spec": dataclasses.asdict(spec),
+    }
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    path = os.path.join(ROOT, "artifacts", "tpu_calibration.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""On-chip A/B: Pallas flash attention vs XLA-composed attention.
+
+Round-2 verdict: the flash dispatch gate (``_FLASH_MIN_LEN``) was a guess,
+so there was no evidence the kernel beats XLA at any length — and the
+flagship BERT bench (seq=128) never reached it.  This microbench times
+fwd+bwd of both paths at BERT-base head geometry across sequence lengths
+and persists the winner table to ``artifacts/flash_ab.json``;
+``hetu_tpu/ops/attention.py`` reads that artifact to set the gate
+empirically.
+
+Run by tools/tpu_watch.py when the tunnel is healthy.
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+HEADS, HEAD_DIM = 12, 64        # BERT-base geometry
+TOKEN_BUDGET = 16384            # per-step tokens, constant across seqs
+SEQS = (128, 256, 512, 1024)
+REPS, INNER = 3, 10
+
+
+def _timed_grad_step(fn, q, k, v):
+    """Best-of-REPS time for INNER fwd+bwd steps of ``fn`` (scalar-read
+    sync: the axon tunnel does not honor block_until_ready)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(q, k, v):
+        out = fn(q, k, v)
+        return jnp.sum(out.astype(jnp.float32))
+
+    @jax.jit
+    def step(q, k, v):
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l + sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+    float(step(q, k, v))        # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        s = 0.0
+        for _ in range(INNER):
+            s = step(q, k, v)
+        float(s)
+        best = min(best, (time.perf_counter() - t0) / INNER)
+    return best * 1e3           # ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.ops.attention import sdpa_reference
+    from hetu_tpu.ops.pallas.flash_attention import flash_attention
+
+    backend = jax.default_backend()
+    if backend == "cpu" and not os.environ.get("_HETU_AB_ALLOW_CPU"):
+        print("refusing flash A/B on cpu (set _HETU_AB_ALLOW_CPU=1)",
+              file=sys.stderr)
+        return 1
+    interpret = backend != "tpu"
+    rows = {}
+    for seq in SEQS:
+        b = max(1, TOKEN_BUDGET // seq)
+        key = jax.random.PRNGKey(seq)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (b, HEADS, seq, HEAD_DIM)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        row = {"batch": b}
+        for causal in (False, True):
+            fl = _timed_grad_step(
+                functools.partial(flash_attention, causal=causal,
+                                  interpret=interpret), q, k, v)
+            xl = _timed_grad_step(
+                functools.partial(sdpa_reference, causal=causal), q, k, v)
+            tag = "causal" if causal else "dense"
+            row[f"flash_ms_{tag}"] = round(fl, 3)
+            row[f"xla_ms_{tag}"] = round(xl, 3)
+            row[f"winner_{tag}"] = "flash" if fl < xl else "xla"
+        rows[str(seq)] = row
+        print(f"seq {seq}: {row}", flush=True)
+
+    # gate rule: the smallest seq from which flash wins the DENSE case at
+    # every measured length >= it (dense is the BERT-flagship path)
+    flash_min_len = None
+    for i, seq in enumerate(SEQS):
+        if all(rows[str(s)]["winner_dense"] == "flash" for s in SEQS[i:]):
+            flash_min_len = seq
+            break
+    out = {
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "heads": HEADS, "head_dim": HEAD_DIM,
+        "token_budget": TOKEN_BUDGET,
+        "rows": rows,
+        # never-wins sentinel: gate above the largest measured length
+        "flash_min_len": flash_min_len if flash_min_len is not None
+        else SEQS[-1] * 2,
+    }
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    path = os.path.join(ROOT, "artifacts", "flash_ab.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:   # atomic: a killed child can't truncate it
+        json.dump(out, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps({"flash_min_len": out["flash_min_len"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
