@@ -312,7 +312,11 @@ def _parent_main(args):
         time.sleep(min(10.0, max(0.0, deadline - time.monotonic()) / 10))
     # fallback 1: a persisted on-TPU artifact from tools/tpu_watch.py —
     # the real metric, measured earlier in the round while the tunnel was up
-    cached = _cached_tpu_result(args.config)
+    # the watcher cache is measured at each config's DEFAULT workload size;
+    # serving it for an overridden --batch-size/--steps would mislabel a
+    # different workload as this invocation's result
+    cached = _cached_tpu_result(args.config) \
+        if args.batch_size is None and args.steps == 20 else None
     if cached is not None:
         # top-level marker: a real on-TPU number, but NOT measured by this
         # invocation — consumers must not read it as a live success

@@ -117,6 +117,9 @@ class DataloaderOp(PlaceholderOp):
     def get_arr(self, name):
         return self.dataloaders[name].get_arr()
 
+    def get_next_arr(self, name):
+        return self.dataloaders[name].get_next_arr()
+
     def get_cur_shape(self, name):
         return self.dataloaders[name].get_cur_shape()
 

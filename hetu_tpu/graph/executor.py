@@ -68,6 +68,9 @@ class SubExecutor:
         # (reference EmbeddingLookUp PS path, SURVEY.md §3.3)
         self.ps_nodes = [n for n in self.topo
                          if getattr(n, "is_ps", False)]
+        # node -> (ids, Future[rows]): lookahead pulls in flight
+        self._prefetched = {}
+        self._prefetch_pool = None
         self.feed_nodes = [n for n in self.topo
                            if isinstance(n, PlaceholderOp) and not n.is_variable
                            and not getattr(n, "is_ps", False)]
@@ -328,7 +331,10 @@ class SubExecutor:
 
         # PS pulls: resolve the ids batch host-side, pull rows (through the
         # HET cache if configured), feed them as leaf params so jax computes
-        # their gradient alongside the model's
+        # their gradient alongside the model's.  A lookahead prefetch issued
+        # at the end of the PREVIOUS run (reference dataloader-lookahead
+        # overlap, ParameterServerCommunicate.py:69-77) is consumed here
+        # when its ids match — the pull then overlapped the prior step.
         ps_vals = {}
         for node in self.ps_nodes:
             idn = node.ids_node
@@ -340,7 +346,18 @@ class SubExecutor:
                 ids = np.asarray(idn.get_arr(self.name))
             else:
                 raise ValueError(f"cannot resolve ids for PS embedding {node}")
-            ps_vals[_key(node)] = ex._place_feed(node, node.pull(ids))
+            rows = None
+            pre = self._prefetched.pop(node, None)
+            if pre is not None:
+                pre_ids, fut = pre
+                # compare ids BEFORE joining: a mismatched prefetch would
+                # otherwise cost a full pull wait just to be discarded
+                if np.array_equal(pre_ids, np.asarray(ids, np.int64)):
+                    rows = fut.result()
+                    node._last_ids = pre_ids
+            if rows is None:
+                rows = node.pull(ids)
+            ps_vals[_key(node)] = ex._place_feed(node, rows)
 
         tparams = {_key(n): ex.var_values[n] for n in self.trainable_vars}
         sparams = {_key(n): ex.var_values[n] for n in self.state_vars}
@@ -363,6 +380,10 @@ class SubExecutor:
         outs, new_tparams, updates, new_opt_states = self._jit(
             tparams, sparams, opt_states, feeds, key, lrs)
 
+        if ex.bsp == -1 and ex.prefetch:
+            # ASP: next-batch pull may overlap the in-flight step AND the
+            # async push (bounded-staleness semantics already allow it)
+            self._start_ps_prefetch()
         for node in self.ps_nodes:
             g = updates.pop("psgrad:" + _key(node), None)
             if g is not None:
@@ -375,6 +396,14 @@ class SubExecutor:
                     ex._ps_async_push(node, g)
                 else:
                     node.push(np.asarray(g))
+        if ex.bsp != -1 and ex.prefetch:
+            # BSP: the prefetch pull must observe this step's push (the
+            # reference's _compute_bsp_prefetch barriers for the same
+            # reason), so it starts after it — overlapping the pull with
+            # the step's remaining device work (dense param updates are
+            # still in flight: np.asarray above only synced the grad) and
+            # host-side inter-step time
+            self._start_ps_prefetch()
         for n in self.trainable_vars:
             ex.var_values[n] = new_tparams[_key(n)]
         for n in self.state_vars:
@@ -397,6 +426,31 @@ class SubExecutor:
             else:
                 results.append(NDArray(v))
         return results
+
+    def _start_ps_prefetch(self):
+        """Issue next-batch row pulls on a background thread for every PS
+        embedding whose ids come from a Dataloader (the only source whose
+        next batch is knowable — reference lookahead, ``dl_node.
+        get_next_arr``).  Consumed by the next ``run`` when ids match."""
+        from ..data.dataloader import DataloaderOp
+        for node in self.ps_nodes:
+            if node in self._prefetched:
+                continue
+            idn = node.ids_node
+            if not isinstance(idn, DataloaderOp):
+                continue
+            try:
+                next_ids = np.asarray(idn.get_next_arr(self.name), np.int64)
+            except KeyError:       # no dataloader registered for this split
+                continue
+            if self._prefetch_pool is None:
+                import concurrent.futures
+                self._prefetch_pool = \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix=f"ps-prefetch-{self.name}")
+            fut = self._prefetch_pool.submit(node.pull_rows, next_ids)
+            self._prefetched[node] = (next_ids, fut)
 
     def profile(self, feed_dict, log_file=None):
         """Per-step timing via real execution (reference SubExecutor.profile:686).
@@ -446,6 +500,11 @@ class Executor:
         # >0 = SSP staleness bound (enforced via ps store ssp_sync by the
         # launcher/worker loop). Reference flag semantics (README ctr:33).
         self.bsp = int(kwargs.pop("bsp", 0))
+        # prefetch: overlap next-batch PS row pulls with the in-flight step
+        # (reference HetuConfig(prefetch=True) default); pulls start after
+        # the push under BSP (read-after-write preserved) and immediately
+        # under ASP
+        self.prefetch = bool(kwargs.pop("prefetch", True))
         self._ps_futures = []
         self._ps_pool = None
         if pipeline is None and getattr(dist_strategy, "schedule", None):
@@ -676,8 +735,13 @@ class Executor:
         self._ps_futures = pending
         while len(self._ps_futures) >= 32:
             self._ps_futures.pop(0).result()
+        # ids are captured NOW: by the time the worker runs, the next step
+        # may already have overwritten node._last_ids (via pull or prefetch
+        # consumption) — a deferred read would push step-N grads onto
+        # step-N+1's rows
+        ids = node._last_ids
         self._ps_futures.append(self._ps_pool.submit(
-            lambda: node.push(np.asarray(grad))))
+            lambda: node.push_to(ids, np.asarray(grad))))
 
     def ps_flush(self):
         """Barrier: wait until every ASP async push has been applied."""
@@ -689,28 +753,141 @@ class Executor:
         pool = getattr(self, "_ps_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
+        for se in getattr(self, "subexecutors", {}).values():
+            pp = getattr(se, "_prefetch_pool", None)
+            if pp is not None:
+                pp.shutdown(wait=False)
+
+    def _opt_rename_maps(self, op):
+        """(nodekey→param-name, param-name→nodekey) for one optimizer op —
+        node keys ('n<id>') are process-local; param names are the stable
+        checkpoint identity."""
+        fwd = {_key(p): self.var_names[p] for p in op.params}
+        return fwd, {v: k for k, v in fwd.items()}
+
+    @staticmethod
+    def _rename_dict_keys(tree, ren):
+        if isinstance(tree, dict):
+            return {ren.get(k, k): Executor._rename_dict_keys(v, ren)
+                    for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(Executor._rename_dict_keys(v, ren)
+                              for v in tree)
+        return tree
+
+    def _named_opt_state(self, op, st):
+        return self._rename_dict_keys(st, self._opt_rename_maps(op)[0])
+
+    def _unname_opt_state(self, op, st):
+        return self._rename_dict_keys(st, self._opt_rename_maps(op)[1])
+
+    def _ps_table_sites(self):
+        """Distinct (store, table) pairs across all subgraphs, in a stable
+        graph order — the ordinal is the checkpoint identity of a table."""
+        seen, sites = set(), []
+        for name in sorted(self.subexecutors):
+            for node in getattr(self.subexecutors[name], "ps_nodes", []):
+                key = (id(node.store), node.table)
+                if key not in seen:
+                    seen.add(key)
+                    sites.append(node)
+        return sites
 
     def save(self, path, file=None):
-        """Checkpoint params + optimizer state + step (reference save:461,
-        which loses optimizer state — we keep it, cf. SURVEY.md §5.4)."""
+        """Checkpoint params + optimizer state + PS tables + step.
+
+        Default format is a DIRECTORY with one .npy per tensor (streamed —
+        at no point is the whole state in host memory at once) and PS
+        tables persisted server-side by their own store (per-host shard
+        files under a DistributedStore — reference per-server SaveParam,
+        ``ps-lite/src/python_binding.cc:111-118``).  The reference's save
+        (:461) loses optimizer state; we keep it (SURVEY.md §5.4).
+        ``file=`` selects the legacy single-pickle blob instead."""
         self.ps_flush()  # ASP pushes must land before persisting
+        import json
         import os
         import jax
-        if os.path.isdir(path) or path.endswith("/"):
+        if file is not None:    # legacy single-file blob
             os.makedirs(path, exist_ok=True)
-            path = os.path.join(path, file or "checkpoint.hetu")
-        blob = {
-            "params": {self.var_names[n]: np.asarray(v)
-                       for n, v in self.var_values.items()},
-            "opt_states": {op.name: jax.tree.map(np.asarray, st)
-                           for op, st in self.opt_states.items()},
-            "step": self.step_counter,
-        }
-        with open(path, "wb") as f:
-            pickle.dump(blob, f)
+            blob = {
+                "params": {self.var_names[n]: np.asarray(v)
+                           for n, v in self.var_values.items()},
+                "opt_states": {op.name: jax.tree.map(np.asarray, st)
+                               for op, st in self.opt_states.items()},
+                "step": self.step_counter,
+            }
+            with open(os.path.join(path, file), "wb") as f:
+                pickle.dump(blob, f)
+            return
+        os.makedirs(os.path.join(path, "params"), exist_ok=True)
+        os.makedirs(os.path.join(path, "opt"), exist_ok=True)
+        meta = {"format": "hetu_tpu.ckpt.v1", "step": self.step_counter,
+                "seed": self.seed, "params": {}, "opt": {},
+                "ps_tables": []}
+        for i, (n, v) in enumerate(self.var_values.items()):
+            fn = f"p{i}.npy"
+            np.save(os.path.join(path, "params", fn), np.asarray(v))
+            meta["params"][self.var_names[n]] = fn
+        meta["opt"] = []
+        for k, (op, st) in enumerate(self.opt_states.items()):
+            named = self._named_opt_state(op, st)
+            leaves = {}
+            for j, (kpath, leaf) in enumerate(
+                    jax.tree_util.tree_flatten_with_path(named)[0]):
+                fn = f"o{k}_{j}.npy"
+                np.save(os.path.join(path, "opt", fn), np.asarray(leaf))
+                leaves[jax.tree_util.keystr(kpath)] = fn
+            meta["opt"].append({"name": op.name, "leaves": leaves})
+        for i, node in enumerate(self._ps_table_sites()):
+            if not hasattr(node.store, "save"):
+                continue
+            fn = f"ps{i}.bin"
+            node.store.save(node.table, os.path.join(path, fn))
+            meta["ps_tables"].append({"file": fn, "node": node.name})
+        tmp = os.path.join(path, "meta.json.tmp")
+        with open(tmp, "w") as f:    # meta last + atomic: marks a complete
+            json.dump(meta, f, indent=1)     # checkpoint
+        os.replace(tmp, os.path.join(path, "meta.json"))
 
     def load(self, path, file=None, consider_splits=False):
+        import json
         import os
+        import jax
+        meta_path = os.path.join(path, "meta.json") \
+            if os.path.isdir(path) else None
+        if file is None and meta_path and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            by_name = {self.var_names[n]: n for n in self.var_values}
+            for name, fn in meta["params"].items():
+                node = by_name.get(name)
+                if node is not None:    # streamed: one tensor at a time
+                    self.var_values[node] = self._place_param(
+                        np.load(os.path.join(path, "params", fn)), node)
+            # optimizer states match by ORDINAL (graph order is the stable
+            # identity; auto-generated op names are not) and leaves match
+            # by param-name-translated tree path (raw paths embed node-id
+            # keys, which differ across processes)
+            for entry, (op, live) in zip(meta["opt"],
+                                         list(self.opt_states.items())):
+                named_live = self._named_opt_state(op, live)
+                paths, treedef = jax.tree_util.tree_flatten_with_path(
+                    named_live)
+                leaves = []
+                for kpath, old_leaf in paths:
+                    fn = entry["leaves"].get(jax.tree_util.keystr(kpath))
+                    leaves.append(
+                        old_leaf if fn is None else self._place_param(
+                            np.load(os.path.join(path, "opt", fn))))
+                self.opt_states[op] = self._unname_opt_state(
+                    op, jax.tree.unflatten(treedef, leaves))
+            entries = {e["file"] for e in meta["ps_tables"]}
+            for i, node in enumerate(self._ps_table_sites()):
+                fn = f"ps{i}.bin"
+                if fn in entries and hasattr(node.store, "load"):
+                    node.store.load(node.table, os.path.join(path, fn))
+            self.step_counter = meta.get("step", 0)
+            return
         if os.path.isdir(path):
             path = os.path.join(path, file or "checkpoint.hetu")
         with open(path, "rb") as f:
@@ -719,7 +896,6 @@ class Executor:
         by_name = {op.name: op for op in self.opt_states}
         for name, st in blob.get("opt_states", {}).items():
             if name in by_name:
-                import jax
                 # optimizer state shards like its params; without per-leaf
                 # node info, restore replicated-or-sharded via the param map
                 # below after params are placed (leaves follow params in the

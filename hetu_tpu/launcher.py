@@ -52,7 +52,8 @@ def _host_env(config, rank, coordinator_port=8476):
     return env
 
 
-def launch(config, script, script_args=(), local_devices=None, ssh=True):
+def launch(config, script, script_args=(), local_devices=None, ssh=True,
+           coordinator_port=8476):
     """Run ``script`` on every host in the cluster config.
 
     Local host runs in-process-group (inherits stdio); remote hosts via
@@ -62,7 +63,7 @@ def launch(config, script, script_args=(), local_devices=None, ssh=True):
     """
     procs = []
     for rank, host in enumerate(config.hosts):
-        env = _host_env(config, rank)
+        env = _host_env(config, rank, coordinator_port=coordinator_port)
         if local_devices:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count="

@@ -47,21 +47,33 @@ class PSEmbeddingLookupOp(PlaceholderOp):
             self.width = width
 
     # host-side pull/push used by the executor around the jitted step
-    def pull(self, ids):
+    def pull_rows(self, ids):
+        """Stateless row pull — safe on a background prefetch thread (does
+        NOT touch ``_last_ids``, which the in-flight step's push needs)."""
         ids = np.asarray(ids, np.int64)
-        self._last_ids = ids
         if self.cache is not None:
             dest = np.empty(ids.shape + (self.cache.width,), np.float32)
             return self.cache._lookup_sync(ids, dest)
         return self.store.pull(self.table, ids)
 
-    def push(self, grads):
-        if self._last_ids is None:
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64)
+        self._last_ids = ids
+        return self.pull_rows(ids)
+
+    def push_to(self, ids, grads):
+        """Push grads onto explicit ids — safe for deferred (async) pushes,
+        which must not read ``_last_ids`` at execution time (the next step
+        may have overwritten it by then)."""
+        if ids is None:
             return
         if self.cache is not None:
-            self.cache._update_sync(self._last_ids, grads)
+            self.cache._update_sync(ids, grads)
         else:
-            self.store.push(self.table, self._last_ids, grads)
+            self.store.push(self.table, ids, grads)
+
+    def push(self, grads):
+        self.push_to(self._last_ids, grads)
 
 
 def ps_embedding_lookup_op(table, ids_node, width=None, name=None):

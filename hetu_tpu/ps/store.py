@@ -227,7 +227,10 @@ class EmbeddingStore:
             if rc:
                 raise IOError(f"ps save failed rc={rc}")
         else:
-            np.save(path, self._np_tables[table].data)
+            # write through a handle: np.save(str) appends '.npy' to
+            # extension-less names, breaking the caller's path contract
+            with open(path, "wb") as f:
+                np.save(f, self._np_tables[table].data)
 
     def load(self, table, path):
         if self._lib:

@@ -217,3 +217,60 @@ def test_imagenet_folder_loader(tmp_path):
                          batch_size=2, synthetic_batches=3, num_classes=5)
     bs = list(ds2)
     assert len(bs) == 3 and bs[0][0].shape == (2, 3, 16, 16)
+
+
+def test_streamed_checkpoint_full_resume(tmp_path):
+    """Train 3 steps -> save -> fresh executor -> load -> step 4 is
+    BITWISE identical to the uninterrupted run (params + optimizer state +
+    PS table + step counter all round-trip), on the dp8 mesh."""
+    from hetu_tpu.ps import EmbeddingStore
+
+    rng = np.random.RandomState(0)
+    vocab, dim, batch = 32, 8, 16
+    table0 = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    ids_v = rng.randint(0, vocab, batch)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, batch)]
+    w0 = rng.randn(dim, 4).astype(np.float32) * 0.3
+
+    def build(store, table):
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((store, table), ids, width=dim)
+        w = ht.Variable("w", value=w0.copy(), trainable=True)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+        opt = ht.optim.AdamOptimizer(0.01)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=5,
+                         dist_strategy=ht.dist.DataParallel())
+        return ex, ids, y_, w
+
+    def steps(ex, ids, y_, n):
+        return [float(ex.run("train", feed_dict={ids: ids_v, y_: yv}
+                             )[0].asnumpy()) for _ in range(n)]
+
+    # uninterrupted 4-step run
+    st_a = EmbeddingStore()
+    t_a = st_a.init_table(vocab, dim, opt="sgd", lr=0.1, seed=0)
+    st_a.set_data(t_a, table0.copy())
+    ex_a, ids_a, y_a, w_a = build(st_a, t_a)
+    losses_a = steps(ex_a, ids_a, y_a, 4)
+
+    # interrupted: 3 steps, checkpoint, resume in a FRESH executor+store
+    st_b = EmbeddingStore()
+    t_b = st_b.init_table(vocab, dim, opt="sgd", lr=0.1, seed=0)
+    st_b.set_data(t_b, table0.copy())
+    ex_b, ids_b, y_b, w_b = build(st_b, t_b)
+    steps(ex_b, ids_b, y_b, 3)
+    ckpt = str(tmp_path / "ckpt")
+    ex_b.save(ckpt)
+
+    st_c = EmbeddingStore()
+    t_c = st_c.init_table(vocab, dim, opt="sgd", lr=0.1, seed=99)  # junk init
+    ex_c, ids_c, y_c, w_c = build(st_c, t_c)
+    ex_c.load(ckpt)
+    assert ex_c.step_counter == 3
+    np.testing.assert_array_equal(st_c.get_data(t_c), st_b.get_data(t_b))
+    loss4 = steps(ex_c, ids_c, y_c, 1)[0]
+    assert loss4 == losses_a[3], (loss4, losses_a[3])
+    np.testing.assert_array_equal(np.asarray(ex_c.var_values[w_c]),
+                                  np.asarray(ex_a.var_values[w_a]))

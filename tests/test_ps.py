@@ -247,3 +247,96 @@ def test_asp_async_push_eventual_consistency():
     st_f, t_f = run(bsp=-1, flush_each_step=True)
     np.testing.assert_allclose(st_f.get_data(t_f), st_s.get_data(t_s),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------- lookahead prefetch
+# (reference ParameterServerCommunicate.py:69-77: next-batch SparsePull
+# overlapped with compute via the dataloader lookahead)
+
+class _RecordingStore:
+    """Store proxy that records which thread served each pull and can
+    slow pulls down to make overlap measurable."""
+
+    def __init__(self, store, delay=0.0):
+        self._store = store
+        self.delay = delay
+        self.pull_threads = []
+
+    def pull(self, table, keys):
+        self.pull_threads.append(threading.current_thread().name)
+        if self.delay:
+            import time
+            time.sleep(self.delay)
+        return self._store.pull(table, keys)
+
+    def push(self, table, keys, grads, lr=-1.0):
+        return self._store.push(table, keys, grads, lr)
+
+
+def _prefetch_graph(store_proxy, t, vocab, dim, batches, prefetch):
+    from hetu_tpu.data.dataloader import Dataloader, DataloaderOp
+    # flat id stream, one (batch,) slice per step, in order
+    dl = DataloaderOp([Dataloader(batches.reshape(-1), batches.shape[1],
+                                  "train", shuffle=False)], name="ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((store_proxy, t), dl, width=dim)
+    w = ht.Variable("w", value=np.full((dim, 2), 0.3, np.float32),
+                    trainable=True)
+    h2 = ht.array_reshape_op(h, output_shape=(-1, dim))
+    logits = ht.matmul_op(h2, w)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    opt = ht.optim.SGDOptimizer(0.1)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     prefetch=prefetch)
+    return ex, dl, y_, loss
+
+
+def _run_prefetch(prefetch, delay=0.0, steps=4, host_work=0.0):
+    import time
+    rng = np.random.RandomState(7)
+    vocab, dim, batch = 40, 8, 8
+    table0 = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    st = EmbeddingStore()
+    t = st.init_table(vocab, dim, opt="sgd", lr=0.2, seed=0)
+    st.set_data(t, table0.copy())
+    proxy = _RecordingStore(st, delay=delay)
+    batches = rng.randint(0, vocab, (steps, batch)).astype(np.int64)
+    ex, dl, y_, loss = _prefetch_graph(proxy, t, vocab, dim, batches,
+                                       prefetch)
+    yv = np.eye(2, dtype=np.float32)[rng.randint(0, 2, batch)]
+    losses = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = ex.run("train", feed_dict={y_: yv})
+        losses.append(float(out[0].asnumpy()))
+        if host_work:
+            time.sleep(host_work)     # simulated inter-step host pipeline
+    dt = time.perf_counter() - t0
+    return losses, st.get_data(t), proxy, dt
+
+
+def test_ps_prefetch_parity_and_mechanism():
+    # BSP: identical training trajectory with prefetch on/off, and the
+    # lookahead pulls actually run on the background prefetch thread
+    l_off, tab_off, proxy_off, _ = _run_prefetch(prefetch=False)
+    l_on, tab_on, proxy_on, _ = _run_prefetch(prefetch=True)
+    np.testing.assert_allclose(l_off, l_on, rtol=1e-6)
+    np.testing.assert_allclose(tab_off, tab_on, rtol=1e-6)
+    assert all(th.startswith("MainThread") for th in proxy_off.pull_threads)
+    main_pulls = [th for th in proxy_on.pull_threads
+                  if th.startswith("MainThread")]
+    bg_pulls = [th for th in proxy_on.pull_threads
+                if th.startswith("ps-prefetch")]
+    # step 0 pulls synchronously; every later step consumes a lookahead
+    assert len(main_pulls) == 1, proxy_on.pull_threads
+    assert len(bg_pulls) >= 3, proxy_on.pull_threads
+
+
+def test_ps_prefetch_overlaps_host_time():
+    # with a slowed store and inter-step host work, the pull overlaps the
+    # host work: total ≈ n*max(pull, host) rather than n*(pull + host)
+    _, _, _, dt_off = _run_prefetch(prefetch=False, delay=0.15,
+                                    host_work=0.12)
+    _, _, _, dt_on = _run_prefetch(prefetch=True, delay=0.15,
+                                   host_work=0.12)
+    assert dt_on < dt_off - 0.2, (dt_on, dt_off)
