@@ -28,6 +28,7 @@ if os.environ.get("_HETU_BENCH_FORCE_CPU"):
 import numpy as np
 
 CHILD_ENV_FLAG = "_HETU_BENCH_CHILD"
+DEFAULT_STEPS = 20
 CHILD_TIMEOUT_S = int(os.environ.get("HETU_BENCH_CHILD_TIMEOUT", "420"))
 TOTAL_BUDGET_S = int(os.environ.get("HETU_BENCH_BUDGET", "900"))
 # a wedged axon tunnel hangs INSIDE jax.devices(), so backend liveness is
@@ -316,7 +317,7 @@ def _parent_main(args):
     # serving it for an overridden --batch-size/--steps would mislabel a
     # different workload as this invocation's result
     cached = _cached_tpu_result(args.config) \
-        if args.batch_size is None and args.steps == 20 else None
+        if args.batch_size is None and args.steps == DEFAULT_STEPS else None
     if cached is not None:
         # top-level marker: a real on-TPU number, but NOT measured by this
         # invocation — consumers must not read it as a live success
@@ -428,7 +429,7 @@ if __name__ == "__main__":
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe"])
     p.add_argument("--batch-size", type=int, default=None)
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=DEFAULT_STEPS)
     args = p.parse_args()
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)

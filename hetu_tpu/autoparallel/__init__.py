@@ -12,7 +12,9 @@ Workflow (reference ``tools/Galvatron/README.md:15-100``):
    hand the result to the executor as a mesh + GSPMD sharding annotations.
 """
 from .cost_model import (HardwareSpec, LayerSpec, MemoryCostModel, Strategy,
-                         TimeCostModel, transformer_layer_spec)
+                         TimeCostModel, transformer_layer_spec,
+                         attention_layer_spec, mlp_layer_spec,
+                         embedding_layer_spec, model_layer_specs)
 from .search import DPAlg, candidate_strategies, search
 from .plan import ParallelPlan
 
@@ -85,6 +87,7 @@ def calibrate_hardware(mesh=None, mem_bytes=None,
 
 
 __all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
-           "Strategy", "transformer_layer_spec", "DPAlg",
-           "candidate_strategies", "search", "ParallelPlan",
+           "Strategy", "transformer_layer_spec", "attention_layer_spec",
+           "mlp_layer_spec", "embedding_layer_spec", "model_layer_specs",
+           "DPAlg", "candidate_strategies", "search", "ParallelPlan",
            "calibrate_hardware"]

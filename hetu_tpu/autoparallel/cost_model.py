@@ -72,6 +72,32 @@ class HardwareSpec:
         return self.ici_bw if width <= 8 else self.dcn_bw
 
     @classmethod
+    def from_artifact(cls, path=None, **overrides):
+        """The committed on-chip calibration (tools/calibrate_tpu.py →
+        ``artifacts/tpu_calibration.json``), or None when absent/invalid —
+        so searches are grounded in MEASURED hardware even when the TPU
+        tunnel is unreachable at search time."""
+        import json
+        import os
+        if path is None:
+            path = os.path.join(os.path.dirname(__file__), os.pardir,
+                                os.pardir, "artifacts",
+                                "tpu_calibration.json")
+        import dataclasses
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            kw = dict(data["spec"])
+        except (OSError, KeyError, ValueError):
+            return None
+        kw.update(overrides)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        try:   # tolerate unknown/extra keys — invalid artifact means None
+            return cls(**{k: v for k, v in kw.items() if k in fields})
+        except (TypeError, ValueError):
+            return None
+
+    @classmethod
     def measure(cls, mesh=None, probe_bytes=1 << 22, matmul_dim=1024,
                 **overrides):
         """Calibrated spec from THIS machine — delegates to
@@ -179,5 +205,57 @@ def transformer_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,
     return LayerSpec(name, float(params), float(flops), float(acts), count)
 
 
+# -- per-type specs (Galvatron multi-layer-type DP, dp_utils.py:259) --------
+
+def attention_layer_spec(hidden, seq, batch, dtype_bytes=2, name="attn",
+                         count=1):
+    """Self-attention sublayer: 4 h×h projections + the s² score term."""
+    tokens = batch * seq
+    params = 4 * hidden * hidden * dtype_bytes
+    flops = 2 * tokens * 4 * hidden * hidden \
+        + 2 * 2 * batch * seq * seq * hidden
+    acts = tokens * hidden * dtype_bytes * 6
+    return LayerSpec(name, float(params), float(flops), float(acts), count)
+
+
+def mlp_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,
+                   name="mlp", count=1):
+    """FFN sublayer: up/down projections."""
+    tokens = batch * seq
+    params = 2 * ffn_mult * hidden * hidden * dtype_bytes
+    flops = 2 * tokens * 2 * ffn_mult * hidden * hidden
+    acts = tokens * hidden * dtype_bytes * (2 + ffn_mult)
+    return LayerSpec(name, float(params), float(flops), float(acts), count)
+
+
+def embedding_layer_spec(vocab, hidden, seq, batch, dtype_bytes=2,
+                         name="embed", tied_head=True, count=1):
+    """Token embedding (+ tied LM head): parameter-dominated, nearly
+    FLOP-free on lookup; the head matmul carries the vocab FLOPs."""
+    tokens = batch * seq
+    params = vocab * hidden * dtype_bytes
+    flops = (2 * tokens * vocab * hidden) if tied_head else tokens * hidden
+    acts = tokens * max(hidden, vocab if tied_head else hidden) \
+        * dtype_bytes
+    return LayerSpec(name, float(params), float(flops), float(acts), count)
+
+
+def model_layer_specs(n_layers, hidden, seq, batch, vocab, ffn_mult=4,
+                      dtype_bytes=2):
+    """Interleaved multi-type chain for the joint DP search: embedding,
+    then (attention, mlp) per block — the reference searches these types
+    JOINTLY rather than one uniform per-block spec
+    (``tools/Galvatron/utils/dp_utils.py:259`` multi-layer-type)."""
+    specs = [embedding_layer_spec(vocab, hidden, seq, batch, dtype_bytes)]
+    for i in range(n_layers):
+        specs.append(attention_layer_spec(hidden, seq, batch, dtype_bytes,
+                                          name=f"attn{i}"))
+        specs.append(mlp_layer_spec(hidden, seq, batch, ffn_mult,
+                                    dtype_bytes, name=f"mlp{i}"))
+    return specs
+
+
 __all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
-           "TimeCostModel", "transformer_layer_spec"]
+           "TimeCostModel", "transformer_layer_spec",
+           "attention_layer_spec", "mlp_layer_spec",
+           "embedding_layer_spec", "model_layer_specs"]

@@ -58,7 +58,9 @@ class DPAlg:
     def __init__(self, specs, n_devices, hw=None, microbatches=1,
                  remat=False, allow_pp=True, allow_fsdp=True, max_tp=None):
         self.specs = list(specs)
-        self.hw = hw or HardwareSpec()
+        # unspecified hardware: prefer the committed on-chip calibration
+        # artifact over the built-in defaults (profile→search workflow)
+        self.hw = hw or HardwareSpec.from_artifact() or HardwareSpec()
         self.mem = MemoryCostModel(self.hw, microbatches, remat)
         self.time = TimeCostModel(self.hw, microbatches)
         self.cands = candidate_strategies(n_devices, allow_pp, allow_fsdp,

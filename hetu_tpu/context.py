@@ -178,11 +178,24 @@ def context(ctx):
 MESH_AXES = ("dp", "pp", "tp", "ep", "cp")
 
 
-def make_mesh(axis_sizes=None, devices=None):
+def make_mesh(axis_sizes=None, devices=None, dcn_axes=None):
     """Build a ``jax.sharding.Mesh`` with named axes.
 
     ``axis_sizes``: dict like {'dp': 4, 'tp': 2}; unmentioned axes get size 1
     and are dropped. If None, all devices go on 'dp'.
+
+    ``dcn_axes``: DCN-aware hybrid placement for multi-slice topologies
+    (SURVEY.md §5.8; reference analogue: the HAllToAll intra/inter-node
+    split, ``mpi_nccl_communication.cu:396``).  A dict ``{axis: n_slices}``
+    declaring how much of each axis spans the slow (DCN) interconnect; the
+    remaining factor of that axis stays on ICI.  E.g. 16 devices over 2
+    slices with ``{'dp': 4, 'tp': 4}, dcn_axes={'dp': 2}`` puts the tp
+    groups and half of dp inside each slice and crosses DCN only along the
+    outer dp dimension — gradient allreduce hierarchically decomposes so
+    only 1/4 of its traffic rides DCN.  On real multi-slice TPU the device
+    assignment comes from ``mesh_utils.create_hybrid_device_mesh``; on flat
+    (single-slice / CPU-simulated) topologies contiguous device blocks act
+    as virtual slices so the SAME program shape is testable anywhere.
     """
     import jax
     from jax.sharding import Mesh
@@ -212,8 +225,39 @@ def make_mesh(axis_sizes=None, devices=None):
             f"mesh axes {dict(zip(names, sizes))} use {total} of {n} "
             f"devices; {n - total} devices are left idle")
         devices = list(devices)[:total]
+    if dcn_axes:
+        dev_array = _hybrid_device_array(names, sizes, dict(dcn_axes),
+                                         list(devices))
+        return Mesh(dev_array, tuple(names))
     dev_array = np.asarray(devices).reshape(sizes if sizes else (1,))
     return Mesh(dev_array, tuple(names) if names else ("dp",))
+
+
+def _hybrid_device_array(names, sizes, dcn_axes, devices):
+    """Device array for a 2-level (ICI x DCN) mesh — see ``make_mesh``."""
+    unknown = set(dcn_axes) - set(names)
+    if unknown:
+        raise ValueError(f"dcn_axes {sorted(unknown)} not in mesh axes "
+                         f"{names}")
+    dcn_sizes = [int(dcn_axes.get(ax, 1)) for ax in names]
+    for ax, sz, d in zip(names, sizes, dcn_sizes):
+        if d < 1 or sz % d:
+            raise ValueError(
+                f"dcn factor {d} must divide axis {ax!r} size {sz}")
+    ici_sizes = [sz // d for sz, d in zip(sizes, dcn_sizes)]
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if len(slice_ids) > 1 and None not in slice_ids:
+        # real multi-slice topology: let jax match slices to DCN dims
+        from jax.experimental import mesh_utils
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices, allow_split_physical_axes=True)
+    # flat topology (one slice, or the CPU-simulated mesh): contiguous
+    # device blocks play the role of slices, so each ICI group is a
+    # contiguous run — the layout multi-process CPU meshes give per host
+    k = len(names)
+    arr = np.asarray(devices).reshape(tuple(dcn_sizes) + tuple(ici_sizes))
+    perm = [i for j in range(k) for i in (j, j + k)]   # d1,s1,d2,s2,...
+    return arr.transpose(perm).reshape(sizes)
 
 
 class DistConfig:

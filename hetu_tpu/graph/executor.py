@@ -433,8 +433,16 @@ class SubExecutor:
         next batch is knowable — reference lookahead, ``dl_node.
         get_next_arr``).  Consumed by the next ``run`` when ids match."""
         from ..data.dataloader import DataloaderOp
+        from ..ps.dist_store import DistributedStore
         for node in self.ps_nodes:
             if node in self._prefetched:
+                continue
+            if self.ex.bsp != -1 and isinstance(node.store,
+                                                DistributedStore):
+                # synchronous (BSP/SSP) multi-worker training: a lookahead
+                # pull issued after only the LOCAL push would miss other
+                # workers' same-step gradients — one step of hidden
+                # staleness. ASP tolerates it by definition; BSP must not.
                 continue
             idn = node.ids_node
             if not isinstance(idn, DataloaderOp):
@@ -822,13 +830,12 @@ class Executor:
         os.makedirs(os.path.join(path, "params"), exist_ok=True)
         os.makedirs(os.path.join(path, "opt"), exist_ok=True)
         meta = {"format": "hetu_tpu.ckpt.v1", "step": self.step_counter,
-                "seed": self.seed, "params": {}, "opt": {},
+                "seed": self.seed, "params": {}, "opt": [],
                 "ps_tables": []}
         for i, (n, v) in enumerate(self.var_values.items()):
             fn = f"p{i}.npy"
             np.save(os.path.join(path, "params", fn), np.asarray(v))
             meta["params"][self.var_names[n]] = fn
-        meta["opt"] = []
         for k, (op, st) in enumerate(self.opt_states.items()):
             named = self._named_opt_state(op, st)
             leaves = {}

@@ -29,9 +29,9 @@ class PartialReduce:
     """Controller + SPMD helpers for dynamic-group gradient averaging.
 
     ``get_partner(rank, step)`` mirrors the reference API: returns the
-    active-worker mask for this step. Arrival bookkeeping lives host-side
-    (here: a pluggable ``arrival_fn``; in a multi-host deployment the PS
-    store's SSP clocks supply it).
+    active-worker mask for this step. Arrival bookkeeping lives host-side:
+    a pluggable ``arrival_fn`` in-process, or the distributed store's SSP
+    clocks across processes (:class:`DistPartialReduce`).
     """
 
     def __init__(self, n_workers, max_wait_ms=100.0, min_workers=2,
@@ -88,9 +88,53 @@ class PartialReduce:
         return jax.tree.map(lambda v: v / den, num)
 
 
+class DistPartialReduce(PartialReduce):
+    """Multi-process group formation backed by the distributed store's SSP
+    clocks (reference ``preduce_get_partner`` asks the PS the same way,
+    ``ps-lite preduce_handler.h``; clocks live on rank 0 — the scheduler
+    role).
+
+    Protocol per step: a worker announces arrival by ticking its clock,
+    then polls the global clock vector for up to ``max_wait_ms``; workers
+    whose clock has reached this worker's own tick count are in the mask.
+    Stragglers that miss the window contribute ``mask=0`` for the step —
+    the compiled collective stays lockstep, only the averaging weights
+    change (see module docstring).
+    """
+
+    def __init__(self, store, n_workers=None, max_wait_ms=100.0,
+                 min_workers=2, poll_ms=5.0):
+        super().__init__(n_workers or store.world,
+                         max_wait_ms=max_wait_ms, min_workers=min_workers)
+        self.store = store
+        self.poll_ms = poll_ms
+
+    def report_arrival(self, rank, step, t=None):
+        self.store.clock(rank)
+
+    def get_partner(self, rank, step):
+        """Active mask for this step from the shared clock vector.
+
+        Assumes one ``report_arrival`` per worker per step, so arrival at
+        step s ⇔ clock >= s+1 (every caller's own clock satisfies this
+        the moment it reports)."""
+        target = step + 1
+        deadline = time.monotonic() + self.max_wait_ms / 1e3
+        while True:
+            clocks = self.store.clocks()
+            mask = (clocks[:self.n_workers] >= target).astype(np.float32)
+            if mask.sum() >= self.n_workers or time.monotonic() >= deadline:
+                break
+            time.sleep(self.poll_ms / 1e3)
+        mask[rank] = 1.0
+        if mask.sum() < self.min_workers:
+            mask = np.ones(self.n_workers, np.float32)
+        return mask
+
+
 def preduce_mean(grad, mask, axis_name="dp"):
     """Functional alias of :meth:`PartialReduce.preduce`."""
     return PartialReduce.preduce(grad, mask, axis_name)
 
 
-__all__ = ["PartialReduce", "preduce_mean"]
+__all__ = ["PartialReduce", "DistPartialReduce", "preduce_mean"]

@@ -27,7 +27,7 @@ import numpy as np
 from .store import EmbeddingStore
 
 OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
-    OP_SHUTDOWN = range(1, 8)
+    OP_SHUTDOWN, OP_CLOCKS = range(1, 9)
 
 _HDR = struct.Struct("<BiqdI")  # op, table, nkeys, lr, payload_width
 
@@ -142,6 +142,13 @@ class StoreServer:
                         ok = False
                         break
             _send_frame(conn, b"\x00", b"\x01" if ok else b"\x00")
+        elif op == OP_CLOCKS:
+            with self._ssp_lock:
+                if self._clocks is None:
+                    raise RuntimeError(
+                        "SSP not initialised: call ssp_init(n_workers) first")
+                v = self._clocks.copy()
+            _send_frame(conn, b"\x00", v.tobytes())
         elif op == OP_SHUTDOWN:
             _send_frame(conn, b"\x00\x01")
             return True
@@ -323,6 +330,12 @@ class DistributedStore:
     def clock(self, worker=None):
         w = self.rank if worker is None else worker
         self._rpc(0, OP_CLOCK, 0, np.asarray([w], np.int64))
+
+    def clocks(self):
+        """Every worker's clock value (rank-0 authoritative copy) — the
+        arrival feed for partial-reduce group formation."""
+        raw = self._rpc(0, OP_CLOCKS, 0, np.zeros(0, np.int64))
+        return np.frombuffer(raw, np.int64).copy()
 
     def ssp_sync(self, worker=None, staleness=0, timeout_ms=0):
         w = self.rank if worker is None else worker

@@ -274,13 +274,28 @@ void hetu_ps_versions(void *s_, int64_t table, const key_t_ *keys, int64_t n,
   for (int64_t i = 0; i < n; ++i) out[i] = t->version[keys[i]];
 }
 
+// v2 checkpoint format: full table state — data + optimizer slots + per-row
+// step counters + versions.  Without the slots a resumed Adam/momentum table
+// restarts its moments at zero and silently diverges; without versions the
+// HET cache staleness accounting resets (reference SaveParam persists server
+// state server-side, ps-lite python_binding.cc:111-118).
+static const int64_t kSaveMagic = -0x48505332;  // 'HPS2', impossible as rows
+
 int hetu_ps_save(void *s_, int64_t table, const char *path) {
   Table *t = ((Store *)s_)->tables[table];
   FILE *f = fopen(path, "wb");
   if (!f) return -1;
-  int64_t hdr[2] = {t->rows, t->width};
+  int64_t hdr[4] = {kSaveMagic, 2, t->rows, t->width};
+  int64_t flags[3] = {(int64_t)!t->slot0.empty(), (int64_t)!t->slot1.empty(),
+                      (int64_t)!t->rowstep.empty()};
   fwrite(hdr, sizeof(hdr), 1, f);
+  fwrite(flags, sizeof(flags), 1, f);
   fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  if (flags[0]) fwrite(t->slot0.data(), sizeof(float), t->slot0.size(), f);
+  if (flags[1]) fwrite(t->slot1.data(), sizeof(float), t->slot1.size(), f);
+  if (flags[2])
+    fwrite(t->rowstep.data(), sizeof(int32_t), t->rowstep.size(), f);
+  fwrite(t->version.data(), sizeof(int64_t), t->version.size(), f);
   fclose(f);
   return 0;
 }
@@ -289,15 +304,51 @@ int hetu_ps_load(void *s_, int64_t table, const char *path) {
   Table *t = ((Store *)s_)->tables[table];
   FILE *f = fopen(path, "rb");
   if (!f) return -1;
-  int64_t hdr[2];
-  if (fread(hdr, sizeof(hdr), 1, f) != 1 || hdr[0] != t->rows ||
-      hdr[1] != t->width) {
+  int64_t first;
+  if (fread(&first, sizeof(first), 1, f) != 1) {
     fclose(f);
     return -2;
   }
-  size_t nread = fread(t->data.data(), sizeof(float), t->data.size(), f);
+  if (first >= 0) {  // v1 file: {rows, width, data} — data only
+    int64_t width;
+    if (fread(&width, sizeof(width), 1, f) != 1 || first != t->rows ||
+        width != t->width) {
+      fclose(f);
+      return -2;
+    }
+    size_t nread = fread(t->data.data(), sizeof(float), t->data.size(), f);
+    fclose(f);
+    return nread == t->data.size() ? 0 : -3;
+  }
+  int64_t rest[3];  // version, rows, width
+  int64_t flags[3];
+  if (first != kSaveMagic || fread(rest, sizeof(rest), 1, f) != 1 ||
+      rest[0] != 2 || rest[1] != t->rows || rest[2] != t->width ||
+      fread(flags, sizeof(flags), 1, f) != 1) {
+    fclose(f);
+    return -2;
+  }
+  bool ok = fread(t->data.data(), sizeof(float), t->data.size(), f) ==
+            t->data.size();
+  if (flags[0]) {
+    if (t->slot0.empty()) t->slot0.assign(t->data.size(), 0.f);
+    ok = ok && fread(t->slot0.data(), sizeof(float), t->slot0.size(), f) ==
+                   t->slot0.size();
+  }
+  if (flags[1]) {
+    if (t->slot1.empty()) t->slot1.assign(t->data.size(), 0.f);
+    ok = ok && fread(t->slot1.data(), sizeof(float), t->slot1.size(), f) ==
+                   t->slot1.size();
+  }
+  if (flags[2]) {
+    if (t->rowstep.empty()) t->rowstep.assign(t->rows, 0);
+    ok = ok && fread(t->rowstep.data(), sizeof(int32_t),
+                     t->rowstep.size(), f) == t->rowstep.size();
+  }
+  ok = ok && fread(t->version.data(), sizeof(int64_t), t->version.size(),
+                   f) == t->version.size();
   fclose(f);
-  return nread == t->data.size() ? 0 : -3;
+  return ok ? 0 : -3;
 }
 
 // --------------------------- SSP clocks ------------------------------------

@@ -222,15 +222,22 @@ class EmbeddingStore:
 
     # -- persistence (SaveParam/LoadParam parity) --------------------------
     def save(self, table, path):
+        """Full table state: data + optimizer slots + versions (a resumed
+        Adam table with zeroed moments silently diverges)."""
         if self._lib:
             rc = self._lib.hetu_ps_save(self._h, table, path.encode())
             if rc:
                 raise IOError(f"ps save failed rc={rc}")
         else:
-            # write through a handle: np.save(str) appends '.npy' to
+            t = self._np_tables[table]
+            blobs = {"data": t.data, "version": t.version}
+            for name in ("s0", "s1", "t"):
+                if getattr(t, name) is not None:
+                    blobs[name] = getattr(t, name)
+            # write through a handle: np.save*(str) appends a suffix to
             # extension-less names, breaking the caller's path contract
             with open(path, "wb") as f:
-                np.save(f, self._np_tables[table].data)
+                np.savez(f, **blobs)
 
     def load(self, table, path):
         if self._lib:
@@ -238,7 +245,18 @@ class EmbeddingStore:
             if rc:
                 raise IOError(f"ps load failed rc={rc}")
         else:
-            self._np_tables[table].data[:] = np.load(path)
+            t = self._np_tables[table]
+            with open(path, "rb") as f:
+                head = f.read(2)
+            if head == b"PK":      # npz archive: v2 full state
+                blobs = np.load(path)
+                t.data[:] = blobs["data"]
+                t.version[:] = blobs["version"]
+                for name in ("s0", "s1", "t"):
+                    if name in blobs and getattr(t, name) is not None:
+                        getattr(t, name)[:] = blobs[name]
+            else:                  # v1 file: bare .npy of the data
+                t.data[:] = np.load(path)
 
     # -- SSP (bounded staleness barrier) ----------------------------------
     def ssp_init(self, n_workers):

@@ -214,3 +214,109 @@ def test_search_to_execution_end_to_end():
     assert any(getattr(b.fc1.weight_var, "sharding", None) is not None
                or getattr(b.fc2.weight_var, "sharding", None) is not None
                for b in blocks)
+
+
+# ------------------------------------- multi-layer-type joint search
+# (reference tools/Galvatron/utils/dp_utils.py:259 multi-layer-type DP)
+
+def test_model_layer_specs_builds_interleaved_types():
+    from hetu_tpu.autoparallel import model_layer_specs
+    specs = model_layer_specs(3, hidden=256, seq=64, batch=8, vocab=50000)
+    names = [s.name for s in specs]
+    assert names == ["embed", "attn0", "mlp0", "attn1", "mlp1", "attn2",
+                     "mlp2"]
+    # embedding is parameter-dominated; sublayers are FLOP-dominated
+    assert specs[0].param_bytes > 10 * specs[1].param_bytes
+    assert specs[2].fwd_flops > 0
+
+
+def test_multi_layer_type_search_differentiates_types():
+    """The joint DP assigns DIFFERENT strategies to different layer types
+    when their cost structures demand it: a huge embedding only fits
+    sharded (fsdp), while the small compute layers stay unsharded (fsdp
+    would cost them allgather time for no memory benefit)."""
+    from hetu_tpu.autoparallel import model_layer_specs
+    specs = model_layer_specs(2, hidden=256, seq=64, batch=8, vocab=2_000_000)
+    hw = HardwareSpec(flops=1e14, ici_bw=4e10, mem_bytes=2.5e9)
+    emb_full = MemoryCostModel(hw).layer_bytes(
+        specs[0], Strategy(1, 1, 8, False))
+    assert emb_full > hw.mem_bytes          # replicated embedding can't fit
+    alg = DPAlg(specs, 8, hw=hw, allow_pp=False)
+    t, strategies = alg.fit()
+    assert strategies is not None and np.isfinite(t)
+    by_name = dict(zip([s.name for s in specs], strategies))
+    assert by_name["embed"].fsdp            # embedding must shard params
+    # at least one compute sublayer chose a different strategy than the
+    # embedding (the chain is NOT uniform — types are searched jointly)
+    assert any(by_name[n] != by_name["embed"]
+               for n in ("attn0", "mlp0", "attn1", "mlp1"))
+
+
+def test_multi_layer_type_search_to_execution():
+    """e2e with 2 layer types: search a heterogeneous (attn-spec, mlp-spec)
+    chain, emit the mesh + per-layer directives, run a training step."""
+    from hetu_tpu.autoparallel import attention_layer_spec, mlp_layer_spec
+    d_model, seq, batch = 64, 16, 16
+    specs = [attention_layer_spec(d_model, seq, batch, name="attn0"),
+             mlp_layer_spec(d_model, seq, batch, name="mlp0")]
+    hw = HardwareSpec.measure(matmul_dim=256, probe_bytes=1 << 16)
+    full = max(MemoryCostModel(hw).layer_bytes(s, Strategy(1, 1, 8, False))
+               for s in specs)
+    hw = HardwareSpec(flops=hw.flops, ici_bw=hw.ici_bw,
+                      mem_bytes=full * len(specs) * 0.6)
+    plan = search(specs, 8, hw=hw, allow_pp=False)
+    assert any(s.fsdp or s.tp > 1 for s in plan.strategies)
+
+    mesh = ht.make_mesh(plan.mesh_axes())
+    x = ht.placeholder_op("x", shape=(batch * seq, d_model))
+    y = ht.placeholder_op("y", shape=(batch * seq, d_model))
+
+    class AttnBlock:                       # 4 projections, attn-shaped
+        def __init__(self):
+            self.q = ht.layers.Linear(d_model, d_model, name="mt.q")
+            self.k = ht.layers.Linear(d_model, d_model, name="mt.k")
+            self.v = ht.layers.Linear(d_model, d_model, name="mt.v")
+            self.o = ht.layers.Linear(d_model, d_model, name="mt.o")
+            self.in_kernels = [self.q.weight_var, self.k.weight_var,
+                               self.v.weight_var]
+            self.out_kernels = [self.o.weight_var]
+
+        def __call__(self, h):
+            return h + self.o(ht.relu_op(self.q(h) + self.k(h) + self.v(h)))
+
+    class MlpBlock:
+        def __init__(self):
+            self.fc1 = ht.layers.Linear(d_model, 4 * d_model,
+                                        activation="relu", name="mt.fc1")
+            self.fc2 = ht.layers.Linear(4 * d_model, d_model, name="mt.fc2")
+            self.in_kernels = [self.fc1.weight_var]
+            self.out_kernels = [self.fc2.weight_var]
+
+        def __call__(self, h):
+            return h + self.fc2(self.fc1(h))
+
+    blocks = [AttnBlock(), MlpBlock()]
+    plan.apply(blocks)
+    h = x
+    for b in blocks:
+        h = b(h)
+    loss = ht.ops.reduce_mean_op(ht.ops.mul_op(h - y, h - y), [0, 1])
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     dist_strategy=plan.strategy(), mesh=mesh)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(batch * seq, d_model).astype(np.float32)
+    yv = rng.randn(batch * seq, d_model).astype(np.float32)
+    l0 = float(ex.run("train", feed_dict={x: xv, y: yv})[0].asnumpy())
+    assert np.isfinite(l0)
+
+
+def test_hardware_spec_from_artifact(tmp_path):
+    import json
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps({"backend": "tpu", "spec": {
+        "flops": 1.23e14, "mem_bytes": 1.6e10, "ici_bw": 5e10,
+        "dcn_bw": 2e9, "overlap": 0.6}}))
+    hw = HardwareSpec.from_artifact(str(p))
+    assert hw.flops == 1.23e14 and hw.overlap == 0.6
+    assert HardwareSpec.from_artifact(str(tmp_path / "missing.json")) is None

@@ -173,3 +173,30 @@ def test_halltoall_op_2d_mesh_routes_tokens():
     blocks = xv.reshape(E, E, k, d)         # [src, dst, k, d]
     expect = blocks.transpose(1, 0, 2, 3).reshape(E * E * k, d)
     np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_hierarchical_a2a_on_dcn_hybrid_mesh():
+    """The 2-phase a2a on a mesh DECLARED hybrid (ep_outer on DCN) still
+    matches the flat a2a — the dcn_axes layout only changes device
+    placement, not routing semantics."""
+    O, I = 2, 4
+    E = O * I
+    k, d = 3, 5
+    rng = np.random.RandomState(2)
+    x = rng.randn(E, E * k, d).astype(np.float32)
+
+    mesh2 = ht.make_mesh({"ep_outer": O, "ep_inner": I},
+                         dcn_axes={"ep_outer": O})
+    spec2 = P(("ep_outer", "ep_inner"), None, None)
+    out_h = _shard_map(
+        mesh2, lambda v: cc.hierarchical_all_to_all(
+            v[0], "ep_outer", "ep_inner")[None],
+        x.reshape(E, E * k, d), in_specs=spec2, out_specs=spec2)
+
+    mesh1 = ht.make_mesh({"ep": E})
+    out_f = _shard_map(
+        mesh1, lambda v: cc.all_to_all(v[0], "ep", 0, 0)[None],
+        x.reshape(E, E * k, d), in_specs=P("ep", None, None),
+        out_specs=P("ep", None, None))
+    np.testing.assert_allclose(np.asarray(out_h), np.asarray(out_f),
+                               rtol=1e-6)

@@ -250,14 +250,14 @@ def test_streamed_checkpoint_full_resume(tmp_path):
 
     # uninterrupted 4-step run
     st_a = EmbeddingStore()
-    t_a = st_a.init_table(vocab, dim, opt="sgd", lr=0.1, seed=0)
+    t_a = st_a.init_table(vocab, dim, opt="adam", lr=0.05, seed=0)
     st_a.set_data(t_a, table0.copy())
     ex_a, ids_a, y_a, w_a = build(st_a, t_a)
     losses_a = steps(ex_a, ids_a, y_a, 4)
 
     # interrupted: 3 steps, checkpoint, resume in a FRESH executor+store
     st_b = EmbeddingStore()
-    t_b = st_b.init_table(vocab, dim, opt="sgd", lr=0.1, seed=0)
+    t_b = st_b.init_table(vocab, dim, opt="adam", lr=0.05, seed=0)
     st_b.set_data(t_b, table0.copy())
     ex_b, ids_b, y_b, w_b = build(st_b, t_b)
     steps(ex_b, ids_b, y_b, 3)
@@ -265,7 +265,7 @@ def test_streamed_checkpoint_full_resume(tmp_path):
     ex_b.save(ckpt)
 
     st_c = EmbeddingStore()
-    t_c = st_c.init_table(vocab, dim, opt="sgd", lr=0.1, seed=99)  # junk init
+    t_c = st_c.init_table(vocab, dim, opt="adam", lr=0.05, seed=99)  # junk init
     ex_c, ids_c, y_c, w_c = build(st_c, t_c)
     ex_c.load(ckpt)
     assert ex_c.step_counter == 3

@@ -334,9 +334,39 @@ def test_ps_prefetch_parity_and_mechanism():
 
 def test_ps_prefetch_overlaps_host_time():
     # with a slowed store and inter-step host work, the pull overlaps the
-    # host work: total ≈ n*max(pull, host) rather than n*(pull + host)
-    _, _, _, dt_off = _run_prefetch(prefetch=False, delay=0.15,
-                                    host_work=0.12)
-    _, _, _, dt_on = _run_prefetch(prefetch=True, delay=0.15,
-                                   host_work=0.12)
-    assert dt_on < dt_off - 0.2, (dt_on, dt_off)
+    # host work: total ≈ n*max(pull, host) rather than n*(pull + host).
+    # Margins are wide (expected saving ≈ 4*0.25s ≈ 1s, asserted 0.4s) so
+    # CI contention cannot flip the verdict.
+    _, _, _, dt_off = _run_prefetch(prefetch=False, delay=0.3,
+                                    host_work=0.25)
+    _, _, _, dt_on = _run_prefetch(prefetch=True, delay=0.3,
+                                   host_work=0.25)
+    assert dt_on < dt_off - 0.4, (dt_on, dt_off)
+
+
+def test_save_load_full_state_adam(tmp_path):
+    """v2 table checkpoints carry optimizer slots + versions: two stores
+    that diverge at save time reconverge EXACTLY after load + identical
+    further pushes (zeroed Adam moments would break this)."""
+    rng = np.random.RandomState(0)
+    st_a = EmbeddingStore()
+    ta = st_a.init_table(20, 4, opt="adam", lr=0.1, seed=1)
+    for i in range(4):
+        st_a.push(ta, rng.randint(0, 20, 6),
+                  rng.randn(6, 4).astype(np.float32))
+    path = str(tmp_path / "adam_table.bin")
+    st_a.save(ta, path)
+
+    st_b = EmbeddingStore()
+    tb = st_b.init_table(20, 4, opt="adam", lr=0.1, seed=7)  # junk init
+    st_b.load(tb, path)
+    np.testing.assert_array_equal(st_b.get_data(tb), st_a.get_data(ta))
+    np.testing.assert_array_equal(st_b.versions(tb, np.arange(20)),
+                                  st_a.versions(ta, np.arange(20)))
+    # identical further pushes must produce identical tables — only true
+    # if m/v/rowstep were restored
+    keys = rng.randint(0, 20, 8)
+    grads = rng.randn(8, 4).astype(np.float32)
+    st_a.push(ta, keys, grads)
+    st_b.push(tb, keys, grads)
+    np.testing.assert_array_equal(st_b.get_data(tb), st_a.get_data(ta))

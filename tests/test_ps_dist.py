@@ -131,3 +131,75 @@ def test_two_process_routing():
             errors.append("child hung")
     assert not errors, "\n".join(errors)
     assert all(p.exitcode == 0 for p in procs)
+
+
+# ------------------------------------------------- preduce over SSP clocks
+
+def _preduce_child(rank, ports, barrier, errq):
+    try:
+        import time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from hetu_tpu.ps.dist_store import DistributedStore
+        from hetu_tpu.parallel.preduce import DistPartialReduce
+
+        world = 2
+        store = DistributedStore(rank, world,
+                                 [("127.0.0.1", p) for p in ports],
+                                 port=ports[rank])
+        if rank == 0:
+            store.ssp_init(world)
+        barrier.wait()
+        pr = DistPartialReduce(store, max_wait_ms=400.0, min_workers=1)
+
+        # --- step 0: both workers arrive promptly -> full mask ------------
+        pr.report_arrival(rank, 0)
+        mask = pr.get_partner(rank, 0)
+        np.testing.assert_allclose(mask, [1.0, 1.0])
+        barrier.wait()
+
+        # --- step 1: rank 1 straggles past rank 0's window ----------------
+        if rank == 0:
+            pr.report_arrival(rank, 1)
+            mask = pr.get_partner(rank, 1)      # waits <=400ms, alone
+            np.testing.assert_allclose(mask, [1.0, 0.0])
+        else:
+            time.sleep(0.9)                     # past the window
+            pr.report_arrival(rank, 1)
+            mask = pr.get_partner(rank, 1)      # rank0 already arrived
+            np.testing.assert_allclose(mask, [1.0, 1.0])
+        barrier.wait()
+        store.close()
+    except Exception:
+        errq.put(f"rank {rank}:\n{traceback.format_exc()}")
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+
+
+@pytest.mark.timeout(180)
+def test_preduce_partner_from_dist_clocks():
+    """The docstring promise (preduce.py) as code: PartialReduce group
+    formation fed by the distributed store's SSP clock arrivals across 2
+    real processes (reference preduce_get_partner / preduce_handler.h)."""
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(2)
+    barrier = ctx.Barrier(2)
+    errq = ctx.Queue()
+    procs = [ctx.Process(target=_preduce_child,
+                         args=(r, ports, barrier, errq))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=150)
+    errors = []
+    while not errq.empty():
+        errors.append(errq.get())
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+            errors.append("child hung")
+    assert not errors, "\n".join(errors)
+    assert all(p.exitcode == 0 for p in procs)
