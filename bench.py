@@ -149,25 +149,30 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
 
 def _child_main(args):
     cpu_fallback = bool(os.environ.get("_HETU_BENCH_FORCE_CPU"))
+
+    def _steps(cpu_cap):
+        # explicit --steps is honored verbatim (comparison harnesses need
+        # BOTH frameworks on the same workload); only the implicit default
+        # shrinks on the CPU fallback
+        if args.steps is not None:
+            return args.steps
+        return cpu_cap if cpu_fallback else DEFAULT_STEPS
+
     if args.config == "bert":
         bs = args.batch_size or (4 if cpu_fallback else 192)
-        steps = min(args.steps, 1) if cpu_fallback else args.steps
-        res = bench_bert(batch_size=bs, steps=steps,
+        res = bench_bert(batch_size=bs, steps=_steps(1),
                          warmup=1 if cpu_fallback else 3)
     elif args.config == "wdl":
         bs = args.batch_size or (256 if cpu_fallback else 2048)
-        steps = min(args.steps, 3) if cpu_fallback else args.steps
-        res = bench_wdl(batch_size=bs, steps=steps,
+        res = bench_wdl(batch_size=bs, steps=_steps(3),
                         warmup=1 if cpu_fallback else 3)
     elif args.config == "moe":
         bs = args.batch_size or (1024 if cpu_fallback else 8192)
-        steps = min(args.steps, 3) if cpu_fallback else args.steps
-        res = bench_moe(batch_tokens=bs, steps=steps,
+        res = bench_moe(batch_tokens=bs, steps=_steps(3),
                         warmup=1 if cpu_fallback else 3)
     else:
         bs = args.batch_size or (16 if cpu_fallback else 128)
-        steps = min(args.steps, 2) if cpu_fallback else args.steps
-        res = bench_resnet18(batch_size=bs, steps=steps,
+        res = bench_resnet18(batch_size=bs, steps=_steps(2),
                              warmup=1 if cpu_fallback else 3)
     if cpu_fallback:
         # an honest artifact: the number exists but is NOT the TPU metric
@@ -317,7 +322,8 @@ def _parent_main(args):
     # serving it for an overridden --batch-size/--steps would mislabel a
     # different workload as this invocation's result
     cached = _cached_tpu_result(args.config) \
-        if args.batch_size is None and args.steps == DEFAULT_STEPS else None
+        if args.batch_size is None \
+        and args.steps in (None, DEFAULT_STEPS) else None
     if cached is not None:
         # top-level marker: a real on-TPU number, but NOT measured by this
         # invocation — consumers must not read it as a live success
@@ -429,7 +435,9 @@ if __name__ == "__main__":
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe"])
     p.add_argument("--batch-size", type=int, default=None)
-    p.add_argument("--steps", type=int, default=DEFAULT_STEPS)
+    p.add_argument("--steps", type=int, default=None,
+                   help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
+                        "the CPU fallback unless given explicitly)")
     args = p.parse_args()
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)

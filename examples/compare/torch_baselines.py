@@ -1,0 +1,212 @@
+"""PyTorch comparison baselines — the reference's perf-comparison
+methodology (every example family ships TF/PyTorch/Horovod scripts with no
+committed numbers, e.g. ``examples/cnn/tf_main.py:1``,
+``examples/embedding/ctr/run_tf_horovod.py:1``).  Each config mirrors the
+matching ``bench.py`` workload exactly (model dims, batch, steps) and prints
+ONE JSON line in the same schema, so ``tools/compare_frameworks.py`` can put
+the two frameworks side by side on identical work.
+
+CPU-only torch is what this image ships; on-TPU comparisons use the
+reference's published claims (BASELINE.md) instead.
+
+Usage: python examples/compare/torch_baselines.py --config {bert,resnet18,wdl,moe}
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+def _timed(step, steps, warmup):
+    for _ in range(warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_bert(batch_size=192, seq_len=128, steps=3, warmup=1):
+    from transformers import BertConfig, BertForMaskedLM
+    cfg = BertConfig()                     # BERT-base, matches bench.py
+    model = BertForMaskedLM(cfg)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-4)
+    rng = np.random.RandomState(0)
+    ids = torch.from_numpy(
+        rng.randint(0, cfg.vocab_size, (batch_size, seq_len))).long()
+    labels = ids.clone()
+    labels[torch.rand(labels.shape) > 0.15] = -100
+
+    def step():
+        opt.zero_grad()
+        out = model(input_ids=ids, labels=labels)
+        out.loss.backward()
+        opt.step()
+
+    dt = _timed(step, steps, warmup)
+    return {"metric": "bert_base_pretrain_samples_per_sec_per_chip",
+            "value": round(batch_size / dt, 2), "unit": "samples/s/chip",
+            "vs_baseline": 0.0,
+            "extra": {"framework": f"torch-{torch.__version__}",
+                      "device": "cpu", "batch_size": batch_size,
+                      "seq_len": seq_len,
+                      "step_time_ms": round(dt * 1e3, 2)}}
+
+
+class _BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.b1 = nn.BatchNorm2d(cout)
+        self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.b2 = nn.BatchNorm2d(cout)
+        self.sc = nn.Sequential()
+        if stride != 1 or cin != cout:
+            self.sc = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        h = torch.relu(self.b1(self.c1(x)))
+        h = self.b2(self.c2(h))
+        return torch.relu(h + self.sc(x))
+
+
+def _resnet18(num_classes=10):
+    layers = [nn.Conv2d(3, 64, 3, 1, 1, bias=False), nn.BatchNorm2d(64),
+              nn.ReLU()]
+    cin = 64
+    for cout, stride in [(64, 1), (64, 1), (128, 2), (128, 1),
+                         (256, 2), (256, 1), (512, 2), (512, 1)]:
+        layers.append(_BasicBlock(cin, cout, stride))
+        cin = cout
+    return nn.Sequential(*layers, nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+                         nn.Linear(512, num_classes))
+
+
+def bench_resnet18(batch_size=128, steps=5, warmup=1):
+    model = _resnet18()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    lossf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = torch.from_numpy(rng.rand(batch_size, 3, 32, 32).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, batch_size)).long()
+
+    def step():
+        opt.zero_grad()
+        lossf(model(x), y).backward()
+        opt.step()
+
+    dt = _timed(step, steps, warmup)
+    return {"metric": "resnet18_cifar10_step_time",
+            "value": round(dt * 1e3, 2), "unit": "ms/step",
+            "vs_baseline": 0.0,
+            "extra": {"framework": f"torch-{torch.__version__}",
+                      "device": "cpu", "batch_size": batch_size}}
+
+
+def bench_wdl(batch_size=2048, steps=5, warmup=1, vocab=100000, dim=16):
+    n_dense, n_sparse = 13, 26
+    emb = nn.EmbeddingBag(vocab, dim, mode="sum")
+
+    class WDL(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, dim)
+            self.deep = nn.Sequential(
+                nn.Linear(n_sparse * dim + n_dense, 256), nn.ReLU(),
+                nn.Linear(256, 256), nn.ReLU(), nn.Linear(256, 1))
+            self.wide = nn.Linear(n_dense, 1)
+
+        def forward(self, dense, sparse):
+            e = self.emb(sparse).reshape(sparse.shape[0], -1)
+            return self.wide(dense) + self.deep(
+                torch.cat([e, dense], dim=1))
+
+    model = WDL()
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    lossf = nn.BCEWithLogitsLoss()
+    rng = np.random.RandomState(0)
+    dense = torch.from_numpy(rng.rand(batch_size, n_dense).astype(np.float32))
+    sparse = torch.from_numpy(
+        rng.randint(0, vocab, (batch_size, n_sparse))).long()
+    y = torch.from_numpy(
+        (rng.rand(batch_size, 1) > 0.5).astype(np.float32))
+
+    def step():
+        opt.zero_grad()
+        lossf(model(dense, sparse), y).backward()
+        opt.step()
+
+    dt = _timed(step, steps, warmup)
+    return {"metric": "wdl_criteo_cache_samples_per_sec",
+            "value": round(batch_size / dt, 1), "unit": "samples/s",
+            "vs_baseline": 0.0,
+            "extra": {"framework": f"torch-{torch.__version__}",
+                      "device": "cpu", "batch_size": batch_size,
+                      "step_time_ms": round(dt * 1e3, 2)}}
+
+
+def bench_moe(batch_tokens=8192, steps=3, warmup=1, d=512, experts=16):
+    class MoE(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.gate = nn.Linear(d, experts)
+            self.w1 = nn.Parameter(torch.randn(experts, d, 4 * d) * 0.02)
+            self.w2 = nn.Parameter(torch.randn(experts, 4 * d, d) * 0.02)
+
+        def forward(self, x):                      # dense top-2 mixture
+            probs = torch.softmax(self.gate(x), dim=-1)      # (T, E)
+            top, idx = probs.topk(2, dim=-1)
+            top = top / top.sum(-1, keepdim=True)
+            out = torch.zeros_like(x)
+            for j in range(2):
+                for e in range(experts):
+                    sel = idx[:, j] == e
+                    if sel.any():
+                        h = torch.relu(x[sel] @ self.w1[e]) @ self.w2[e]
+                        out[sel] += top[sel, j:j + 1] * h
+            return out
+
+    model = MoE()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    rng = np.random.RandomState(0)
+    x = torch.from_numpy(rng.randn(batch_tokens, d).astype(np.float32))
+    y = torch.from_numpy(rng.randn(batch_tokens, d).astype(np.float32))
+
+    def step():
+        opt.zero_grad()
+        ((model(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    dt = _timed(step, steps, warmup)
+    return {"metric": "moe_ep_tokens_per_sec",
+            "value": round(batch_tokens / dt, 1), "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "extra": {"framework": f"torch-{torch.__version__}",
+                      "device": "cpu", "tokens": batch_tokens,
+                      "experts": experts,
+                      "step_time_ms": round(dt * 1e3, 2)}}
+
+
+BENCHES = {"bert": bench_bert, "resnet18": bench_resnet18,
+           "wdl": bench_wdl, "moe": bench_moe}
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="resnet18", choices=sorted(BENCHES))
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    args = p.parse_args()
+    kw = {}
+    if args.batch_size:
+        kw["batch_size" if args.config != "moe" else "batch_tokens"] = \
+            args.batch_size
+    if args.steps:
+        kw["steps"] = args.steps
+    torch.manual_seed(0)
+    print(json.dumps(BENCHES[args.config](**kw)))

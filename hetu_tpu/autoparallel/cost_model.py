@@ -88,7 +88,7 @@ class HardwareSpec:
             with open(path) as f:
                 data = json.load(f)
             kw = dict(data["spec"])
-        except (OSError, KeyError, ValueError):
+        except (OSError, KeyError, ValueError, TypeError):
             return None
         kw.update(overrides)
         fields = {f.name for f in dataclasses.fields(cls)}

@@ -1,0 +1,34 @@
+"""Cross-framework comparison harness smoke tests (reference methodology:
+per-family TF/PyTorch baseline scripts, ``examples/cnn/tf_main.py:1``)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_torch_baseline_schema():
+    sys.path.insert(0, os.path.join(REPO, "examples", "compare"))
+    try:
+        import torch_baselines as tb
+    finally:
+        sys.path.pop(0)
+    res = tb.bench_resnet18(batch_size=8, steps=1, warmup=0)
+    assert res["metric"] == "resnet18_cifar10_step_time"
+    assert res["unit"] == "ms/step" and res["value"] > 0
+    assert res["extra"]["framework"].startswith("torch-")
+    res = tb.bench_wdl(batch_size=64, steps=1, warmup=0, vocab=1000)
+    assert res["value"] > 0
+    json.dumps(res)          # schema is JSON-serializable
+
+
+def test_torch_baseline_cli():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "compare", "torch_baselines.py"),
+         "--config", "wdl", "--batch-size", "64", "--steps", "1"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-300:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "wdl_criteo_cache_samples_per_sec"

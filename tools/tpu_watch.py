@@ -41,12 +41,23 @@ EXTRA_JOBS = (
 
 
 def _pytest_running():
-    try:
-        out = subprocess.run(["pgrep", "-f", "pytest"], capture_output=True,
-                             text=True).stdout.strip()
-        return bool(out)
-    except OSError:
-        return False
+    """True iff a real pytest process is live.  Exact-argv matching via
+    /proc — a substring grep ('pgrep -f pytest') false-positives on any
+    process whose COMMAND LINE merely mentions pytest (e.g. an agent
+    driver carrying instructions), deferring measurements forever."""
+    import glob
+    for p in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(p, "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if b"pytest" in argv:                       # python -m pytest ...
+            return True
+        if any(a.endswith(b"/pytest") or a == b"pytest"
+               for a in argv[:2]):                  # direct pytest binary
+            return True
+    return False
 
 
 def _load_cache():
