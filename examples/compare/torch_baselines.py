@@ -110,7 +110,6 @@ def bench_resnet18(batch_size=128, steps=5, warmup=1):
 
 def bench_wdl(batch_size=2048, steps=5, warmup=1, vocab=100000, dim=16):
     n_dense, n_sparse = 13, 26
-    emb = nn.EmbeddingBag(vocab, dim, mode="sum")
 
     class WDL(nn.Module):
         def __init__(self):

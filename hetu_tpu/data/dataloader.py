@@ -37,6 +37,7 @@ class Dataloader:
         self.func = func
         self.drop_last = drop_last
         self.shuffle = shuffle
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
         self._order = np.arange(len(self.raw_data))
         self._cursor = 0
@@ -44,6 +45,10 @@ class Dataloader:
             self._rng.shuffle(self._order)
         self._queue = None
         self._prefetch = max(0, int(prefetch))
+        self._consumed = 0        # batches handed to the consumer (resume pt)
+        self._gen = 0             # bumped by load_state to retire producers
+        import threading
+        self._plock = threading.Lock()
 
     @property
     def batch_num(self):
@@ -52,17 +57,22 @@ class Dataloader:
             n += 1
         return n
 
-    def _produce(self):
+    def _advance_unlocked(self):
         idx = self._order[self._cursor * self.batch_size:
                           (self._cursor + 1) * self.batch_size]
         batch = self.raw_data[idx]
-        if self.func is not None:
-            batch = self.func(batch)
         self._cursor += 1
         if self._cursor >= self.batch_num:
             self._cursor = 0
             if self.shuffle:
                 self._rng.shuffle(self._order)
+        return batch
+
+    def _produce(self):
+        with self._plock:
+            batch = self._advance_unlocked()
+        if self.func is not None:
+            batch = self.func(batch)
         return batch
 
     def _ensure_thread(self):
@@ -72,9 +82,26 @@ class Dataloader:
         import threading
         self._queue = queue.Queue(maxsize=self._prefetch)
 
-        def worker():
+        def worker(q=self._queue, gen=self._gen):
+            import queue as _q
             while True:
-                self._queue.put(self._produce())
+                # generation check and cursor advance are ATOMIC: a retired
+                # producer (load_state bumped _gen) must not touch the
+                # restored cursor/order/rng
+                with self._plock:
+                    if self._gen != gen:
+                        return
+                    batch = self._advance_unlocked()
+                if self.func is not None:
+                    batch = self.func(batch)
+                while self._gen == gen:
+                    try:
+                        q.put(batch, timeout=0.25)
+                        break
+                    except _q.Full:
+                        continue
+                if self._gen != gen:
+                    return
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
@@ -86,10 +113,45 @@ class Dataloader:
         return self._produce()
 
     def get_arr(self):
+        self._consumed += 1
         if getattr(self, "_peeked", None) is not None:
             batch, self._peeked = self._peeked, None
             return batch
         return self._take()
+
+    # -- checkpointable position (resume at the exact next batch) ----------
+    def state_dict(self):
+        """Resume point: how many batches the CONSUMER has taken.  Batches
+        sitting prefetched in the queue/peek are not counted — they are
+        regenerated after restore (``func`` reruns on them; a stateful
+        func's side effects replay)."""
+        return {"consumed": int(self._consumed), "seed": self._seed,
+                "shuffle": bool(self.shuffle)}
+
+    def load_state(self, state):
+        """Rewind to a saved position: re-derive order/rng from the SAVED
+        seed/shuffle (the live constructor args may differ — exact resume
+        must follow the checkpoint) and fast-forward ``consumed`` batches
+        without materialising them."""
+        with self._plock:
+            self._gen += 1              # retires any live prefetch thread
+            self._queue = None
+            self._peeked = None
+            self._seed = state.get("seed", self._seed)
+            self.shuffle = bool(state.get("shuffle", self.shuffle))
+            self._rng = np.random.RandomState(self._seed)
+            self._order = np.arange(len(self.raw_data))
+            self._cursor = 0
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+            n = int(state["consumed"])
+            for _ in range(n):
+                self._cursor += 1
+                if self._cursor >= self.batch_num:
+                    self._cursor = 0
+                    if self.shuffle:
+                        self._rng.shuffle(self._order)
+            self._consumed = n
 
     def get_next_arr(self):
         """Peek the upcoming batch without consuming it (reference lookahead

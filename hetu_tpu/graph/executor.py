@@ -789,6 +789,22 @@ class Executor:
     def _unname_opt_state(self, op, st):
         return self._rename_dict_keys(st, self._opt_rename_maps(op)[1])
 
+    def _dataloader_sites(self):
+        """Distinct DataloaderOps across subgraphs, stable graph order —
+        their positions are training state (an exact resume must continue
+        at the NEXT batch, not restart the epoch)."""
+        from ..data.dataloader import DataloaderOp
+        seen, sites = set(), []
+        for name in sorted(self.subexecutors):
+            se = self.subexecutors[name]
+            nodes = list(getattr(se, "feed_nodes", [])) \
+                + [n.ids_node for n in getattr(se, "ps_nodes", [])]
+            for node in nodes:
+                if isinstance(node, DataloaderOp) and id(node) not in seen:
+                    seen.add(id(node))
+                    sites.append(node)
+        return sites
+
     def _ps_table_sites(self):
         """Distinct (store, table) pairs across all subgraphs, in a stable
         graph order — the ordinal is the checkpoint identity of a table."""
@@ -851,6 +867,9 @@ class Executor:
             fn = f"ps{i}.bin"
             node.store.save(node.table, os.path.join(path, fn))
             meta["ps_tables"].append({"file": fn, "node": node.name})
+        meta["dataloaders"] = [
+            {split: dl.state_dict() for split, dl in op.dataloaders.items()}
+            for op in self._dataloader_sites()]
         tmp = os.path.join(path, "meta.json.tmp")
         with open(tmp, "w") as f:    # meta last + atomic: marks a complete
             json.dump(meta, f, indent=1)     # checkpoint
@@ -893,6 +912,11 @@ class Executor:
                 fn = f"ps{i}.bin"
                 if fn in entries and hasattr(node.store, "load"):
                     node.store.load(node.table, os.path.join(path, fn))
+            for op, states in zip(self._dataloader_sites(),
+                                  meta.get("dataloaders", [])):
+                for split, st in states.items():
+                    if split in op.dataloaders:
+                        op.dataloaders[split].load_state(st)
             self.step_counter = meta.get("step", 0)
             return
         if os.path.isdir(path):

@@ -320,3 +320,17 @@ def test_hardware_spec_from_artifact(tmp_path):
     hw = HardwareSpec.from_artifact(str(p))
     assert hw.flops == 1.23e14 and hw.overlap == 0.6
     assert HardwareSpec.from_artifact(str(tmp_path / "missing.json")) is None
+
+
+def test_measure_overlap_bounds():
+    """overlap_coe is MEASURED (Galvatron utils/cost_model.py:38) — on the
+    8-dev simulated mesh it must return a sane [0, 1] coefficient and flow
+    into calibrate_hardware's HardwareSpec."""
+    from hetu_tpu.autoparallel import measure_overlap, calibrate_hardware
+    mesh = ht.make_mesh({"dp": 8})
+    ov = measure_overlap(mesh, "dp", probe_bytes=1 << 14, matmul_dim=128,
+                         repeats=2)
+    assert 0.0 <= ov <= 1.0
+    hw = calibrate_hardware(mesh=mesh, matmul_dim=128, chain=4,
+                            probe_bytes=1 << 14)
+    assert 0.0 <= hw.overlap <= 1.0

@@ -274,3 +274,52 @@ def test_streamed_checkpoint_full_resume(tmp_path):
     assert loss4 == losses_a[3], (loss4, losses_a[3])
     np.testing.assert_array_equal(np.asarray(ex_c.var_values[w_c]),
                                   np.asarray(ex_a.var_values[w_a]))
+
+
+def test_checkpoint_resumes_dataloader_position(tmp_path):
+    """Exact resume with dataloader-fed inputs: the restored run continues
+    at the NEXT batch (incl. shuffle order mid-epoch and outstanding
+    prefetch/peek), matching an uninterrupted run bitwise."""
+    from hetu_tpu.data.dataloader import Dataloader, DataloaderOp
+    from hetu_tpu.ps import EmbeddingStore
+
+    rng = np.random.RandomState(0)
+    vocab, dim, batch, steps_total = 24, 4, 6, 7
+    ids_stream = rng.randint(0, vocab, (40 * batch,)).astype(np.int64)
+    table0 = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    yv = np.eye(2, dtype=np.float32)[rng.randint(0, 2, batch)]
+
+    def build():
+        st = EmbeddingStore()
+        t = st.init_table(vocab, dim, opt="adam", lr=0.05, seed=0)
+        st.set_data(t, table0.copy())
+        dl = DataloaderOp([Dataloader(ids_stream, batch, "train",
+                                      shuffle=True, seed=3)], name="ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((st, t), dl, width=dim)
+        w = ht.Variable("w", value=np.full((dim, 2), 0.3, np.float32),
+                        trainable=True)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, w), y_), [0])
+        ex = ht.Executor(
+            {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+            seed=1)
+        return ex, y_, st, t
+
+    def run(ex, y_, n):
+        return [float(ex.run("train", feed_dict={y_: yv})[0].asnumpy())
+                for _ in range(n)]
+
+    ex_a, y_a, st_a, t_a = build()
+    losses_a = run(ex_a, y_a, steps_total)
+
+    ex_b, y_b, st_b, t_b = build()
+    run(ex_b, y_b, 4)
+    ckpt = str(tmp_path / "dl_ckpt")
+    ex_b.save(ckpt)
+
+    ex_c, y_c, st_c, t_c = build()
+    ex_c.load(ckpt)
+    losses_c = run(ex_c, y_c, steps_total - 4)
+    np.testing.assert_array_equal(losses_a[4:], losses_c)
+    np.testing.assert_array_equal(st_c.get_data(t_c), st_a.get_data(t_a))

@@ -19,8 +19,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(cmd, env=None, timeout=900):
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=timeout, env=env, cwd=ROOT)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=ROOT)
+    except subprocess.TimeoutExpired:
+        # degrade to an error row — one hung child (wedged tunnel) must
+        # not lose the other configs' results
+        return {"error": f"timed out after {timeout}s"}
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -46,9 +51,13 @@ def main():
     p.add_argument("--ours-backend", default="cpu",
                    choices=["cpu", "default"])
     args = p.parse_args()
+    configs = [c.strip() for c in args.configs.split(",") if c.strip()]
+    unknown = [c for c in configs if c not in CPU_BATCH]
+    if unknown:
+        p.error(f"unknown config(s) {unknown}; choose from "
+                f"{sorted(CPU_BATCH)}")
     out = {}
-    for config in args.configs.split(","):
-        config = config.strip()
+    for config in configs:
         bs = args.batch_size or CPU_BATCH[config]
         extra = ["--batch-size", str(bs), "--steps", str(args.steps)]
         env = dict(os.environ, _HETU_BENCH_CHILD="1")
