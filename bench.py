@@ -376,7 +376,13 @@ def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
                                 lr=0.01)
     opt = ht.optim.SGDOptimizer(0.01)
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
-    batches = [ctr.synthetic_criteo(batch_size, seed=i)
+    # Zipf-skewed ids: the HET cache's hit pattern (and therefore the
+    # measured step time) is only meaningful under Criteo-like skew
+    d_all, s_all, y_all = ctr.synthetic_criteo_skewed(8 * batch_size,
+                                                      vocab=100000)
+    batches = [(d_all[i * batch_size:(i + 1) * batch_size],
+                s_all[i * batch_size:(i + 1) * batch_size],
+                y_all[i * batch_size:(i + 1) * batch_size])
                for i in range(8)]
 
     def run_step(i):

@@ -135,13 +135,18 @@ def measure_overlap(mesh, axis, probe_bytes=1 << 22, matmul_dim=1024,
         lambda v, b: compute(v) + comm(b), mesh=mesh,
         in_specs=(P(axis, None, None), P(axis, None)), out_specs=P(axis)))
 
+    def sync(out):
+        # remote platforms (axon tunnel) do not honor block_until_ready —
+        # a host read is the only reliable sync (same discipline as the
+        # flops probe above)
+        return float(np.asarray(out).ravel()[0])
+
     def timed(f):
-        out = f(a, buf)
-        jax.block_until_ready(out)
+        sync(f(a, buf))
         best = float("inf")
         for _ in range(repeats):
             t0 = time.perf_counter()
-            jax.block_until_ready(f(a, buf))
+            sync(f(a, buf))
             best = min(best, time.perf_counter() - t0)
         return best
 

@@ -124,15 +124,29 @@ class Dataloader:
         """Resume point: how many batches the CONSUMER has taken.  Batches
         sitting prefetched in the queue/peek are not counted — they are
         regenerated after restore (``func`` reruns on them; a stateful
-        func's side effects replay)."""
+        func's side effects replay).  Batching geometry is recorded so a
+        restore into a DIFFERENTLY-batched loader fails loudly instead of
+        resuming at a silently wrong data position."""
         return {"consumed": int(self._consumed), "seed": self._seed,
-                "shuffle": bool(self.shuffle)}
+                "shuffle": bool(self.shuffle),
+                "batch_size": self.batch_size,
+                "drop_last": bool(self.drop_last),
+                "n_rows": int(len(self.raw_data))}
 
     def load_state(self, state):
         """Rewind to a saved position: re-derive order/rng from the SAVED
-        seed/shuffle (the live constructor args may differ — exact resume
-        must follow the checkpoint) and fast-forward ``consumed`` batches
-        without materialising them."""
+        seed/shuffle (the live seed may differ — exact resume must follow
+        the checkpoint) and fast-forward ``consumed`` batches without
+        materialising them (one shuffle per completed epoch)."""
+        for field, live in (("batch_size", self.batch_size),
+                            ("drop_last", bool(self.drop_last)),
+                            ("n_rows", int(len(self.raw_data)))):
+            saved = state.get(field)
+            if saved is not None and saved != live:
+                raise ValueError(
+                    f"dataloader '{self.name}' cannot resume: checkpoint "
+                    f"{field}={saved} != live {field}={live} (the saved "
+                    f"position is meaningless under different batching)")
         with self._plock:
             self._gen += 1              # retires any live prefetch thread
             self._queue = None
@@ -141,16 +155,13 @@ class Dataloader:
             self.shuffle = bool(state.get("shuffle", self.shuffle))
             self._rng = np.random.RandomState(self._seed)
             self._order = np.arange(len(self.raw_data))
-            self._cursor = 0
             if self.shuffle:
                 self._rng.shuffle(self._order)
             n = int(state["consumed"])
-            for _ in range(n):
-                self._cursor += 1
-                if self._cursor >= self.batch_num:
-                    self._cursor = 0
-                    if self.shuffle:
-                        self._rng.shuffle(self._order)
+            epochs, self._cursor = divmod(n, self.batch_num)
+            if self.shuffle:            # replay completed epochs' shuffles
+                for _ in range(epochs):
+                    self._rng.shuffle(self._order)
             self._consumed = n
 
     def get_next_arr(self):

@@ -88,9 +88,14 @@ class InterOpSubExecutor:
 
         # ---- device assignment: explicit raw_ctx, else inherit from inputs
         # each ordinal is a device GROUP: len 1 = plain placement, len k =
-        # this segment runs k-way data-parallel (heterogeneous-DP pipeline)
+        # this segment runs k-way data-parallel (heterogeneous-DP pipeline).
+        # Segmentation is RUN-LENGTH over topo order, not dedup-by-device:
+        # a chain that revisits a device (d1 → d0 → d1, the reference's
+        # manual-pipeline shape, complex_pipeline_mlp.py:98-174) becomes
+        # three segments executing in order, and the reverse-vjp backward
+        # schedules across all of them
         self.device_groups = []
-        dev_key_to_ord = {}
+        prev_key = [None]
         dev_of = {}
 
         def ordinal(raw_ctx):
@@ -108,10 +113,11 @@ class InterOpSubExecutor:
                         "with a mesh for intra-op parallelism")
                 devs.append(_resolve_device(c))
             k = tuple(repr(d) for d in devs)
-            if k not in dev_key_to_ord:
-                dev_key_to_ord[k] = len(self.device_groups)
-                self.device_groups.append(devs)
-            return dev_key_to_ord[k]
+            if prev_key[0] == k:
+                return len(self.device_groups) - 1
+            prev_key[0] = k
+            self.device_groups.append(devs)
+            return len(self.device_groups) - 1
 
         for n in self.topo:
             if isinstance(n, (OptimizerOp, GradientOp)):
@@ -129,17 +135,10 @@ class InterOpSubExecutor:
                 consumers = [dev_of[c] for c in self.topo
                              if n in c.inputs and dev_of.get(c) is not None]
                 dev_of[n] = min(consumers) if consumers else 0
-        for c in self.topo:
-            if isinstance(c, (OptimizerOp, GradientOp)):
-                continue
-            for a in c.inputs:
-                if isinstance(a, PlaceholderOp):
-                    continue
-                if dev_of[a] > dev_of[c]:
-                    raise NotImplementedError(
-                        f"interop placement is not a forward chain: "
-                        f"{a.name} (dev {dev_of[a]}) feeds {c.name} "
-                        f"(dev {dev_of[c]})")
+        # NOTE: segment ordinals are nondecreasing along topo order by
+        # construction (explicit placements always take the newest segment,
+        # inherited nodes the max of their inputs), so every input edge
+        # points backward — no chain-shape check needed
         self.dev_of = dev_of
         self.n_segments = len(self.device_groups) or 1
         if not self.device_groups:
