@@ -396,6 +396,39 @@ class SubExecutor:
                     ex._ps_async_push(node, g)
                 else:
                     node.push(np.asarray(g))
+        if ex.bsp > 0 and self.training and self.ps_nodes:
+            # SSP (reference bsp>0, _compute_ssp_prefetch:42 ssp_sync):
+            # tick this worker's clock after its push and block while more
+            # than `bsp` steps ahead of the slowest worker.  The wait is a
+            # poll loop with a finite watchdog: the numpy-fallback store's
+            # ssp_sync cannot block (it reports the condition), and an
+            # unbounded native wait would wedge every healthy worker
+            # behind one dead straggler with no diagnostic
+            import time as _time
+            seen = set()
+            for node in self.ps_nodes:
+                store = node.store
+                if id(store) in seen or not hasattr(store, "ssp_sync") \
+                        or not getattr(store, "ssp_ready", True):
+                    continue   # local store without ssp_init: vacuous
+                seen.add(id(store))
+                try:
+                    rank = getattr(store, "rank", 0)
+                    store.clock(rank)
+                    deadline = _time.monotonic() + ex.ssp_timeout_ms / 1e3
+                    while not store.ssp_sync(rank, ex.bsp, timeout_ms=200):
+                        if _time.monotonic() >= deadline:
+                            raise RuntimeError(
+                                f"SSP bound {ex.bsp} not satisfied within "
+                                f"{ex.ssp_timeout_ms}ms — a peer worker "
+                                f"is stalled or dead")
+                        _time.sleep(0.005)
+                except RuntimeError as e:
+                    if "SSP bound" in str(e):
+                        raise
+                    # distributed store whose rank-0 clocks were never
+                    # initialised: bounded staleness is vacuous
+                    pass
         if ex.bsp != -1 and ex.prefetch:
             # BSP: the prefetch pull must observe this step's push (the
             # reference's _compute_bsp_prefetch barriers for the same
@@ -513,6 +546,8 @@ class Executor:
         # the push under BSP (read-after-write preserved) and immediately
         # under ASP
         self.prefetch = bool(kwargs.pop("prefetch", True))
+        # straggler watchdog for SSP waits (bsp>0)
+        self.ssp_timeout_ms = int(kwargs.pop("ssp_timeout_ms", 600000))
         self._ps_futures = []
         self._ps_pool = None
         if pipeline is None and getattr(dist_strategy, "schedule", None):

@@ -138,7 +138,21 @@ class InterOpSubExecutor:
         # NOTE: segment ordinals are nondecreasing along topo order by
         # construction (explicit placements always take the newest segment,
         # inherited nodes the max of their inputs), so every input edge
-        # points backward — no chain-shape check needed
+        # points backward — no chain-shape check needed.  But warn when
+        # run-length segmentation fragments badly: topo-interleaved
+        # independent branches on alternating devices produce one segment
+        # per alternation (correct, but each boundary is a device
+        # transfer + separate jit)
+        distinct = len({tuple(repr(d) for d in g)
+                        for g in self.device_groups}) or 1
+        if len(self.device_groups) > 2 * distinct:
+            import warnings
+            warnings.warn(
+                f"interop placement produced {len(self.device_groups)} "
+                f"segments over {distinct} distinct device groups — "
+                "topo-interleaved branches are fragmenting the chain; "
+                "group ops per device contiguously to reduce boundary "
+                "transfers")
         self.dev_of = dev_of
         self.n_segments = len(self.device_groups) or 1
         if not self.device_groups:

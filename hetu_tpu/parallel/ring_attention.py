@@ -95,8 +95,11 @@ def ulysses_attention_local(q, k, v, axis_name="cp", causal=False,
                             split_axis=1, concat_axis=2, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
     if attn_fn is None:
-        from ..ops.attention import sdpa_reference
-        attn_fn = functools.partial(sdpa_reference, causal=causal,
+        # after the a2a each device holds the FULL sequence for its head
+        # subset — exactly the shape where the flash kernel pays off, so
+        # route through the backend dispatcher (reference path on CPU)
+        from ..ops.attention import dispatch_sdpa
+        attn_fn = functools.partial(dispatch_sdpa, causal=causal,
                                     scale=scale)
     oh = attn_fn(qh, kh, vh)
     # inverse: [B, H/cp, S, D] → [B, H, Sc, D]

@@ -76,6 +76,7 @@ def get_lib():
             "hetu_ps_load": (I, [P, L, c.c_char_p]),
             "hetu_ps_ssp_init": (None, [P, I]),
             "hetu_ps_clock": (None, [P, I]),
+            "hetu_ps_clock_value": (L, [P, I]),
             "hetu_ps_ssp_sync": (I, [P, I, I, I]),
             "hetu_cache_create": (P, [P, L, L, I, L, L]),
             "hetu_cache_destroy": (None, [P]),

@@ -369,6 +369,13 @@ void hetu_ps_clock(void *s_, int worker) {
   s->clk_cv.notify_all();
 }
 
+int64_t hetu_ps_clock_value(void *s_, int worker) {
+  Store *s = (Store *)s_;
+  std::lock_guard<std::mutex> g(s->clk_mtx);
+  if (worker < 0 || (size_t)worker >= s->clocks.size()) return -1;
+  return s->clocks[worker];
+}
+
 // returns 0 on success, 1 on timeout
 int hetu_ps_ssp_sync(void *s_, int worker, int staleness, int timeout_ms) {
   Store *s = (Store *)s_;

@@ -259,11 +259,16 @@ class EmbeddingStore:
                 t.data[:] = np.load(path)
 
     # -- SSP (bounded staleness barrier) ----------------------------------
+    #: set by ssp_init — the native clock/ssp_sync entry points index the
+    #: clock vector unchecked, so callers must not touch them before init
+    ssp_ready = False
+
     def ssp_init(self, n_workers):
         if self._lib:
             self._lib.hetu_ps_ssp_init(self._h, n_workers)
         else:
             self._clocks = np.zeros(n_workers, np.int64)
+        self.ssp_ready = True
 
     def clock(self, worker):
         if self._lib:
@@ -271,9 +276,18 @@ class EmbeddingStore:
         else:
             self._clocks[worker] += 1
 
+    def clock_value(self, worker):
+        """This worker's current SSP clock (testing/monitoring)."""
+        if self._lib:
+            return int(self._lib.hetu_ps_clock_value(self._h, worker))
+        return int(self._clocks[worker])
+
     def ssp_sync(self, worker, staleness, timeout_ms=0):
         """Block until this worker is within ``staleness`` clocks of the
-        slowest worker. Returns False on timeout."""
+        slowest worker. Returns False on timeout.  NOTE: the numpy
+        fallback cannot block — it reports the condition immediately
+        (callers that need to wait poll it, e.g. the executor's SSP
+        loop)."""
         if self._lib:
             return self._lib.hetu_ps_ssp_sync(
                 self._h, worker, staleness, timeout_ms) == 0

@@ -401,3 +401,25 @@ def test_sdpa_varlen_op_graph():
                          mask=jnp.asarray(cols < lv[:, None, None, None],
                                           jnp.float32))
     np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gate_artifact_loading(tmp_path, monkeypatch):
+    # the dispatcher's gate + block shapes come from the committed on-chip
+    # A/B artifact (tools/flash_ab.py)
+    import json
+    import os
+    from hetu_tpu.ops import attention as att
+
+    art = {"backend": "tpu", "flash_min_len": 128, "rows": {
+        "128": {"blocks_dense": [128, 128], "winner_dense": "flash"},
+        "512": {"blocks_dense": [128, 256], "blocks_causal": [256, 128],
+                "winner_dense": "flash"}}}
+    d = tmp_path / "artifacts"
+    d.mkdir()
+    (d / "flash_ab.json").write_text(json.dumps(art))
+    monkeypatch.setenv("HETU_FLASH_AB_PATH", str(d / "flash_ab.json"))
+    gate, blocks = att._load_flash_gate()
+    assert gate == 128
+    assert blocks[(512, False)] == (128, 256)
+    assert blocks[(512, True)] == (256, 128)
+    assert blocks[(128, False)] == (128, 128)

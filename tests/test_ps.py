@@ -370,3 +370,54 @@ def test_save_load_full_state_adam(tmp_path):
     st_a.push(ta, keys, grads)
     st_b.push(tb, keys, grads)
     np.testing.assert_array_equal(st_b.get_data(tb), st_a.get_data(ta))
+
+
+def test_executor_ssp_clock_per_step():
+    """Executor(bsp=k>0) ticks this worker's SSP clock each training step
+    and syncs within the staleness bound (reference _compute_ssp_prefetch:
+    per-step ssp_sync) — clocks advance once per step."""
+    rng = np.random.RandomState(0)
+    vocab, dim, batch = 16, 4, 8
+    st = EmbeddingStore()
+    t = st.init_table(vocab, dim, opt="sgd", lr=0.1, seed=0)
+    st.ssp_init(2)
+    st.clock(1)    # a phantom peer so worker 0 is never > bound ahead
+    st.clock(1)
+    st.clock(1)
+    ids = ht.placeholder_op("ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((st, t), ids, width=dim)
+    w = ht.Variable("w", value=np.full((dim, 2), 0.3, np.float32),
+                    trainable=True)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor({"train": [loss, ht.optim.SGDOptimizer(0.1).minimize(loss)]},
+                     seed=0, bsp=2)
+    ids_v = rng.randint(0, vocab, batch)
+    yv = np.eye(2, dtype=np.float32)[rng.randint(0, 2, batch)]
+    assert st.clock_value(0) == 0
+    for step in range(3):
+        ex.run("train", feed_dict={ids: ids_v, y_: yv})
+        # worker 0's clock ticked exactly once per training step
+        assert st.clock_value(0) == step + 1
+    assert st.clock_value(1) == 3        # the phantom peer untouched
+    assert st.ssp_sync(0, staleness=0, timeout_ms=50)
+
+
+def test_executor_ssp_skips_uninitialised_store():
+    # bsp>0 with a store that never called ssp_init must not crash (the
+    # native clock path indexes the clock vector unchecked)
+    rng = np.random.RandomState(0)
+    st = EmbeddingStore()
+    t = st.init_table(8, 4, opt="sgd", lr=0.1, seed=0)
+    ids = ht.placeholder_op("ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((st, t), ids, width=4)
+    w = ht.Variable("w", value=np.full((4, 2), 0.3, np.float32),
+                    trainable=True)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor({"train": [loss, ht.optim.SGDOptimizer(0.1).minimize(loss)]},
+                     seed=0, bsp=1)
+    ex.run("train", feed_dict={ids: rng.randint(0, 8, 4),
+                               y_: np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]})

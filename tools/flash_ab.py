@@ -75,14 +75,25 @@ def main():
         k = jax.random.normal(kk, shape, jnp.bfloat16)
         v = jax.random.normal(kv, shape, jnp.bfloat16)
         row = {"batch": b}
+        # block-shape sweep: the best (block_q, block_k) is measured, not
+        # guessed — recorded per seq for the dispatcher
+        block_cands = [(bq, bk) for bq in (128, 256) for bk in (128, 256)
+                       if bq <= seq and bk <= seq]
         for causal in (False, True):
-            fl = _timed_grad_step(
-                functools.partial(flash_attention, causal=causal,
-                                  interpret=interpret), q, k, v)
+            best = (float("inf"), None)
+            for bq, bk in block_cands:
+                t = _timed_grad_step(
+                    functools.partial(flash_attention, causal=causal,
+                                      block_q=bq, block_k=bk,
+                                      interpret=interpret), q, k, v)
+                if t < best[0]:
+                    best = (t, (bq, bk))
+            fl, blocks = best
             xl = _timed_grad_step(
                 functools.partial(sdpa_reference, causal=causal), q, k, v)
             tag = "causal" if causal else "dense"
             row[f"flash_ms_{tag}"] = round(fl, 3)
+            row[f"blocks_{tag}"] = list(blocks)
             row[f"xla_ms_{tag}"] = round(xl, 3)
             row[f"winner_{tag}"] = "flash" if fl < xl else "xla"
         rows[str(seq)] = row
