@@ -177,6 +177,16 @@ class SubExecutor:
                                 else env[f] for f in fetch_nodes]
                     return env[self.loss_node], (aux_vals, updates)
 
+                if self.ex.remat:
+                    # rematerialize the forward in the backward pass:
+                    # trades FLOPs for activation memory (the TPU-native
+                    # replacement for the reference's buffer-reuse memory
+                    # plan, memory_pool.py:29; matmul outputs stay saved —
+                    # the standard dots-saveable policy)
+                    loss_fn = jax.checkpoint(
+                        loss_fn, policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)
+
                 M = self.ex.num_microbatches or 1
                 if self.ex.pipeline and M > 1 and not self.has_pipeline_block:
                     aux_vals, updates, grads = self._microbatched_grads(
@@ -412,23 +422,23 @@ class SubExecutor:
                         or not getattr(store, "ssp_ready", True):
                     continue   # local store without ssp_init: vacuous
                 seen.add(id(store))
+                rank = getattr(store, "rank", 0)
                 try:
-                    rank = getattr(store, "rank", 0)
                     store.clock(rank)
-                    deadline = _time.monotonic() + ex.ssp_timeout_ms / 1e3
-                    while not store.ssp_sync(rank, ex.bsp, timeout_ms=200):
-                        if _time.monotonic() >= deadline:
-                            raise RuntimeError(
-                                f"SSP bound {ex.bsp} not satisfied within "
-                                f"{ex.ssp_timeout_ms}ms — a peer worker "
-                                f"is stalled or dead")
-                        _time.sleep(0.005)
                 except RuntimeError as e:
-                    if "SSP bound" in str(e):
-                        raise
-                    # distributed store whose rank-0 clocks were never
-                    # initialised: bounded staleness is vacuous
-                    pass
+                    if "not initialised" in str(e):
+                        # distributed store whose rank-0 clocks were never
+                        # ssp_init'd: bounded staleness is vacuous
+                        continue
+                    raise       # real store failures must surface
+                deadline = _time.monotonic() + ex.ssp_timeout_ms / 1e3
+                while not store.ssp_sync(rank, ex.bsp, timeout_ms=200):
+                    if _time.monotonic() >= deadline:
+                        raise RuntimeError(
+                            f"SSP bound {ex.bsp} not satisfied within "
+                            f"{ex.ssp_timeout_ms}ms — a peer worker "
+                            f"is stalled or dead")
+                    _time.sleep(0.005)
         if ex.bsp != -1 and ex.prefetch:
             # BSP: the prefetch pull must observe this step's push (the
             # reference's _compute_bsp_prefetch barriers for the same
@@ -548,6 +558,9 @@ class Executor:
         self.prefetch = bool(kwargs.pop("prefetch", True))
         # straggler watchdog for SSP waits (bsp>0)
         self.ssp_timeout_ms = int(kwargs.pop("ssp_timeout_ms", 600000))
+        # remat: recompute activations in backward (jax.checkpoint) —
+        # capability analogue of the reference's memory reuse plan
+        self.remat = bool(kwargs.pop("remat", False))
         self._ps_futures = []
         self._ps_pool = None
         if pipeline is None and getattr(dist_strategy, "schedule", None):

@@ -98,6 +98,13 @@ def dispatch_sdpa(q, k, v, causal=False, scale=None):
         from .pallas.flash_attention import flash_attention
         bq, bk = _FLASH_BLOCKS.get((q.shape[-2], bool(causal)),
                                    (None, None))
+        # the artifact measures square (s, s) shapes; cross-attention
+        # (s_q != s_kv) must not inherit a block that exceeds or fails to
+        # divide its own dims — fall back to the kernel's defaults
+        if bq is not None and (bq > q.shape[-2] or q.shape[-2] % bq):
+            bq = None
+        if bk is not None and (bk > k.shape[-2] or k.shape[-2] % bk):
+            bk = None
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=bq, block_k=bk)
     return sdpa_reference(q, k, v, causal=causal, scale=scale)

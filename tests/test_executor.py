@@ -323,3 +323,18 @@ def test_checkpoint_resumes_dataloader_position(tmp_path):
     losses_c = run(ex_c, y_c, steps_total - 4)
     np.testing.assert_array_equal(losses_a[4:], losses_c)
     np.testing.assert_array_equal(st_c.get_data(t_c), st_a.get_data(t_a))
+
+
+def test_remat_training_parity():
+    """Executor(remat=True) recomputes activations in the backward pass;
+    the training trajectory must be identical to the non-remat run."""
+    def run(remat):
+        x, y_, loss, logits, params = _mlp_graph()
+        opt = ht.optim.AdamOptimizer(0.01)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                         remat=remat)
+        xv, yv = _data()
+        return [float(ex.run("train", feed_dict={x: xv, y_: yv})[0].asnumpy())
+                for _ in range(4)]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
