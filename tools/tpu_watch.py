@@ -40,11 +40,15 @@ EXTRA_JOBS = (
 )
 
 
-def _pytest_running():
-    """True iff a real pytest process is live.  Exact-argv matching via
-    /proc — a substring grep ('pgrep -f pytest') false-positives on any
-    process whose COMMAND LINE merely mentions pytest (e.g. an agent
-    driver carrying instructions), deferring measurements forever."""
+def _contending():
+    """True iff a real pytest run OR a foreign bench.py invocation is live
+    (sharing the single chip poisons both measurements).  Exact-argv
+    matching via /proc — a substring grep ('pgrep -f pytest')
+    false-positives on any process whose COMMAND LINE merely mentions
+    pytest (e.g. an agent driver carrying instructions), deferring
+    measurements forever.  The watcher's OWN bench.py children cannot
+    self-match: they are spawned only via blocking subprocess.run between
+    _contending() calls, so none are alive when this runs."""
     import glob
     for p in glob.glob("/proc/[0-9]*/cmdline"):
         try:
@@ -56,6 +60,12 @@ def _pytest_running():
             return True
         if any(a.endswith(b"/pytest") or a == b"pytest"
                for a in argv[:2]):                  # direct pytest binary
+            return True
+        # argv ELEMENTS ending in bench.py (any position: 'python -u
+        # bench.py' etc.); the driver-prompt false-positive can't happen —
+        # a prose argument never ends with the literal filename
+        if any(a.endswith(b"bench.py") or a.endswith(b"/bench.py")
+               for a in argv):
             return True
     return False
 
@@ -141,8 +151,8 @@ def main():
         if not todo and not jobs_todo:
             print("watch: all configs + jobs captured; done", flush=True)
             return 0
-        if _pytest_running():
-            print("watch: pytest active, deferring (contention)", flush=True)
+        if _contending():
+            print("watch: pytest or bench active, deferring (contention)", flush=True)
             time.sleep(60 if not args.once else 0)
             if args.once:
                 return 1
@@ -157,7 +167,7 @@ def main():
         print(f"watch: tunnel LIVE; measuring {todo + [j[0] for j in jobs_todo]}",
               flush=True)
         for config in todo:
-            if _pytest_running():
+            if _contending():
                 break
             res, err = _measure_config(config)
             if res is None:
@@ -171,7 +181,7 @@ def main():
             print(f"watch: {config}: ok {res['value']} {res['unit']}",
                   flush=True)
         for name, cmd, artifact in jobs_todo:
-            if _pytest_running():
+            if _contending():
                 break
             ok, info = _run_extra(name, cmd, artifact)
             cache = _load_cache()
