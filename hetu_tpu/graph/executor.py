@@ -580,9 +580,17 @@ class Executor:
         if dist_strategy is not None and mesh is None:
             self.mesh = dist_strategy.make_mesh()
         self._replicated_sharding = None
+        self._multiprocess = False
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             self._replicated_sharding = NamedSharding(self.mesh, PartitionSpec())
+            # a mesh spanning processes (real multi-host, or launcher-
+            # spawned local ranks) needs global-array construction: every
+            # process holds the FULL host value and contributes its
+            # addressable shards (single-controller API over SPMD ranks)
+            self._multiprocess = any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat)
 
         # materialize variables once, shared across subgraphs
         all_fetches = [n for fl in self.eval_node_dict.values() for n in fl
@@ -639,15 +647,28 @@ class Executor:
                                                       if np.asarray(val).dtype == np.float64
                                                       else np.asarray(val), node)
 
+    def _global_put(self, val, sharding):
+        """Commit a full host value under a (possibly multi-process)
+        sharding.  Cross-process shardings cannot be device_put from host
+        data directly; each process contributes its addressable shards of
+        the SAME full value (callers guarantee identical content — same
+        seeds, same feeds)."""
+        import jax
+        if not self._multiprocess:
+            return jax.device_put(val, sharding)
+        val = np.asarray(val)
+        return jax.make_array_from_callback(
+            val.shape, sharding, lambda idx: val[idx])
+
     def _place_param(self, val, node=None):
         import jax
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             spec = getattr(node, "sharding", None)
             if spec is not None:
-                return jax.device_put(val, NamedSharding(
+                return self._global_put(val, NamedSharding(
                     self.mesh, _filter_spec(self.mesh, spec)))
-            return jax.device_put(val, self._replicated_sharding)
+            return self._global_put(val, self._replicated_sharding)
         return jax.device_put(val)
 
     def _place_feed(self, node, val):
@@ -667,11 +688,15 @@ class Executor:
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             if node.sharding is not None:  # explicit ht.dispatch on a feed
-                return jax.device_put(val, NamedSharding(
+                return self._global_put(val, NamedSharding(
                     self.mesh, _filter_spec(self.mesh, node.sharding)))
             if self.dist_strategy is not None:
                 spec = self.dist_strategy.feed_spec(node, np.ndim(val))
-                return jax.device_put(val, NamedSharding(self.mesh, spec))
+                return self._global_put(val, NamedSharding(self.mesh, spec))
+            # bare-mesh executors (no strategy): replicate — a plain
+            # device_put would pin to local device 0, which is
+            # incompatible with a cross-process mesh
+            return self._global_put(val, self._replicated_sharding)
         return jax.device_put(val)
 
     # -- public API (reference parity) ------------------------------------

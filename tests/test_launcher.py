@@ -115,3 +115,103 @@ def test_cli_single_host(tmp_path):
     from hetu_tpu import launcher
     rc = launcher.main(["--no-ssh", str(script)])
     assert rc == 0
+
+
+MP_EXEC_WORKER = textwrap.dedent("""
+    import os, re, sys, json
+    os.environ["XLA_FLAGS"] = (re.sub(
+        r"--xla_force_host_platform_device_count=\\d+", "",
+        os.environ.get("XLA_FLAGS", "")) +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from hetu_tpu import launcher
+    launcher.init_distributed()
+    import numpy as np
+    import hetu_tpu as ht
+
+    rank = jax.process_index()
+    assert len(jax.devices()) == 8 and jax.process_count() == 2
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+    x = ht.placeholder_op("x"); y_ = ht.placeholder_op("y")
+    w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32) * .1)
+    w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32) * .1)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(ht.relu_op(ht.matmul_op(x, w1)), w2), y_), [0])
+    ex = ht.Executor(
+        {{"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]}},
+        dist_strategy=ht.dist.DataParallel())
+    assert ex._multiprocess
+    losses = [round(float(ex.run("train", feed_dict={{x: xv, y_: yv}}
+                                 )[0].asnumpy()), 7) for _ in range(4)]
+    print(f"RANK{{rank}} LOSSES {{json.dumps(losses)}}", flush=True)
+""")
+
+
+@pytest.mark.timeout(240)
+def test_multiprocess_executor_dp_parity(tmp_path):
+    """The FULL Executor over a mesh spanning 2 real processes (4 virtual
+    devices each): global-array feeds/params, dp8 psum across process
+    boundaries, Adam — both ranks' loss curves must agree with each other
+    AND with the single-process 8-device run of the same graph (the
+    reference's multi-host NCCL scaling story, SURVEY.md §5.8)."""
+    import json
+    import re as _re
+
+    import numpy as np
+    import jax
+    import hetu_tpu as ht
+
+    script = tmp_path / "mp_exec.py"
+    script.write_text(MP_EXEC_WORKER.format(repo=REPO))
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    env_port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = launcher._host_env(config, rank, coordinator_port=env_port)
+        import subprocess as sp
+        procs.append(sp.Popen([sys.executable, str(script)], env=env,
+                              stdout=sp.PIPE, text=True))
+    import time as _time
+    outs, rcs = [], []
+    deadline = _time.monotonic() + 200     # SHARED across both waits, so
+    try:                                   # the pytest timeout wins last
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(5.0, deadline - _time.monotonic()))
+            outs.append(out)
+            rcs.append(p.returncode)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert rcs == [0, 0], outs
+    per_rank = {}
+    for o in outs:
+        for line in o.splitlines():
+            m = _re.match(r"RANK(\d) LOSSES (.*)", line)
+            if m:
+                per_rank[m.group(1)] = json.loads(m.group(2))
+    assert per_rank["0"] == per_rank["1"], per_rank
+
+    # single-process baseline on the in-process 8-device mesh
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32) * .1)
+    w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32) * .1)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(ht.relu_op(ht.matmul_op(x, w1)), w2), y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+        dist_strategy=ht.dist.DataParallel())
+    single = [float(ex.run("train", feed_dict={x: xv, y_: yv}
+                           )[0].asnumpy()) for _ in range(4)]
+    np.testing.assert_allclose(single, per_rank["0"], rtol=2e-5)

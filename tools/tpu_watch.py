@@ -61,11 +61,12 @@ def _contending():
         if any(a.endswith(b"/pytest") or a == b"pytest"
                for a in argv[:2]):                  # direct pytest binary
             return True
-        # argv ELEMENTS ending in bench.py (any position: 'python -u
-        # bench.py' etc.); the driver-prompt false-positive can't happen —
-        # a prose argument never ends with the literal filename
-        if any(a.endswith(b"bench.py") or a.endswith(b"/bench.py")
-               for a in argv):
+        # a bench.py SCRIPT argument in the leading positions ('python
+        # bench.py', 'python -u bench.py'); exact-name or path-suffix only
+        # — a bare endswith would also match editors/grep holding the file
+        # open and unrelated names like 'microbench.py'
+        if any(a == b"bench.py" or a.endswith(b"/bench.py")
+               for a in argv[:3]):
             return True
     return False
 
