@@ -397,15 +397,40 @@ class SubExecutor:
         for node in self.ps_nodes:
             g = updates.pop("psgrad:" + _key(node), None)
             if g is not None:
+                # multiprocess: the host fetch may be a cross-process
+                # COLLECTIVE, so every rank runs it BEFORE the one-pusher
+                # gate below.  Single-process keeps the device array —
+                # ASP's worker thread does the D2H copy off the main
+                # thread
+                gv = self._host_fetch(g) if ex._multiprocess else g
+                # multi-process: the dp-psum'd row grad is REPLICATED
+                # across ranks — exactly one rank applies it (the others
+                # would double-count); routing to key owners is the
+                # store's job
+                if ex._multiprocess and jax.process_index() != 0:
+                    continue
                 if ex.bsp == -1:
                     # ASP (reference bsp=-1, ParameterServerCommunicate
                     # _compute_asp_prefetch:38): push on a background
                     # thread with a bounded in-flight window; the device→
                     # host copy happens on the worker too so the main
                     # thread never blocks on the grad transfer
-                    ex._ps_async_push(node, g)
+                    ex._ps_async_push(node, gv)
                 else:
-                    node.push(np.asarray(g))
+                    node.push(np.asarray(gv))
+        if ex._multiprocess and self.ps_nodes and self.training:
+            # every rank's NEXT pull must observe this step's push (the
+            # reference's _compute_bsp_prefetch barrier) — ranks must
+            # never assemble "replicated" global arrays from DIVERGENT
+            # row values.  This also bounds ASP: pushes stay async within
+            # the step (overlapping the device work) but are flushed at
+            # the step boundary — cross-rank row divergence would be
+            # silent corruption, not bounded staleness
+            if ex.bsp == -1:
+                ex.ps_flush()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"hetu-ps-step-{ex.step_counter}")
         if ex.bsp > 0 and self.training and self.ps_nodes:
             # SSP (reference bsp>0, _compute_ssp_prefetch:42 ssp_sync):
             # tick this worker's clock after its push and block while more
@@ -469,6 +494,22 @@ class SubExecutor:
             else:
                 results.append(NDArray(v))
         return results
+
+    def _host_fetch(self, g):
+        """Bring a step output to host memory across process boundaries.
+
+        Single-process: plain asarray.  Multi-process: value-replicated
+        outputs whose sharding metadata still spans remote devices cannot
+        be fetched directly — read the local replica when metadata says
+        fully-replicated, else allgather (a collective: EVERY rank must
+        call this for such outputs)."""
+        if not self.ex._multiprocess or getattr(
+                g, "is_fully_addressable", True):
+            return np.asarray(g)
+        if getattr(g, "is_fully_replicated", False):
+            return np.asarray(g.addressable_data(0))
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(g, tiled=True))
 
     def _start_ps_prefetch(self):
         """Issue next-batch row pulls on a background thread for every PS

@@ -176,7 +176,7 @@ def test_multiprocess_executor_dp_parity(tmp_path):
         env = launcher._host_env(config, rank, coordinator_port=env_port)
         import subprocess as sp
         procs.append(sp.Popen([sys.executable, str(script)], env=env,
-                              stdout=sp.PIPE, text=True))
+                              stdout=sp.PIPE, stderr=sp.STDOUT, text=True))
     import time as _time
     outs, rcs = [], []
     deadline = _time.monotonic() + 200     # SHARED across both waits, so
@@ -215,3 +215,127 @@ def test_multiprocess_executor_dp_parity(tmp_path):
     single = [float(ex.run("train", feed_dict={x: xv, y_: yv}
                            )[0].asnumpy()) for _ in range(4)]
     np.testing.assert_allclose(single, per_rank["0"], rtol=2e-5)
+
+
+HYBRID_WORKER = textwrap.dedent("""
+    import os, re, sys, json
+    os.environ["XLA_FLAGS"] = (re.sub(
+        r"--xla_force_host_platform_device_count=\\d+", "",
+        os.environ.get("XLA_FLAGS", "")) +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from hetu_tpu import launcher
+    launcher.init_distributed()
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.ps.dist_store import DistributedStore
+
+    rank = jax.process_index()
+    ports = [int(p) for p in sys.argv[1:3]]
+    store = DistributedStore(rank, 2, [("127.0.0.1", p) for p in ports],
+                             port=ports[rank])
+    t = store.init_table(32, 8, opt="sgd", lr=0.1, seed=0, init_scale=0.01)
+    # identical content to the single-store baseline: local shard of rank r
+    # owns keys k with k % 2 == r at local index k // 2
+    table0 = np.random.RandomState(42).normal(
+        0, 0.01, (32, 8)).astype(np.float32)
+    store.local.set_data(t, table0[np.arange(16) * 2 + rank])
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("store-up")
+
+    rng = np.random.RandomState(0)
+    ids_v = rng.randint(0, 32, 16)
+    yv = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    ids = ht.placeholder_op("ids"); y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((store, t), ids, width=8)
+    w = ht.Variable("w", value=rng.randn(8, 2).astype(np.float32) * .3)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor(
+        {{"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]}},
+        seed=0, dist_strategy=ht.dist.DataParallel())
+    assert ex._multiprocess
+    losses = [round(float(ex.run("train",
+                                 feed_dict={{ids: ids_v, y_: yv}}
+                                 )[0].asnumpy()), 7) for _ in range(4)]
+    rows = store.pull(t, np.arange(32))
+    digest = round(float(np.abs(rows).sum()), 5)
+    print(f"RANK{{rank}} RES {{json.dumps([losses, digest])}}", flush=True)
+    multihost_utils.sync_global_devices("done")
+    store.close()
+""")
+
+
+@pytest.mark.timeout(240)
+def test_multiprocess_hybrid_ps_training(tmp_path):
+    """The reference's flagship hybrid deployment shape, end-to-end across
+    2 real processes: dense params dp-psum'd over the cross-process mesh,
+    sparse embedding rows in a 2-shard DISTRIBUTED host store (one rank
+    applies the replicated row grad; a step barrier orders push before
+    every rank's next pull).  Both ranks must agree on losses AND final
+    table state, and match the single-process run with a local store."""
+    import json
+    import re as _re
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.ps import EmbeddingStore
+
+    script = tmp_path / "hybrid.py"
+    script.write_text(HYBRID_WORKER.format(repo=REPO))
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    store_ports = [_free_port(), _free_port()]
+    coord = _free_port()
+    procs = []
+    for rank in range(2):
+        env = launcher._host_env(config, rank, coordinator_port=coord)
+        procs.append(sp.Popen(
+            [sys.executable, str(script)] + [str(p) for p in store_ports],
+            env=env, stdout=sp.PIPE, stderr=sp.STDOUT, text=True))
+    outs, rcs = [], []
+    deadline = _time.monotonic() + 200
+    try:
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(5.0, deadline - _time.monotonic()))
+            outs.append(out)
+            rcs.append(p.returncode)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert rcs == [0, 0], outs
+    res = {}
+    for o in outs:
+        for line in o.splitlines():
+            m = _re.match(r"RANK(\d) RES (.*)", line)
+            if m:
+                res[m.group(1)] = json.loads(m.group(2))
+    assert res["0"] == res["1"], res
+
+    # single-process baseline: same graph, local store
+    st = EmbeddingStore()
+    t = st.init_table(32, 8, opt="sgd", lr=0.1, seed=0, init_scale=0.01)
+    st.set_data(t, np.random.RandomState(42).normal(
+        0, 0.01, (32, 8)).astype(np.float32))
+    rng = np.random.RandomState(0)
+    ids_v = rng.randint(0, 32, 16)
+    yv = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    ids = ht.placeholder_op("ids")
+    y_ = ht.placeholder_op("y")
+    h = ht.ps_embedding_lookup_op((st, t), ids, width=8)
+    w = ht.Variable("w", value=rng.randn(8, 2).astype(np.float32) * .3)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+        seed=0, dist_strategy=ht.dist.DataParallel())
+    single = [round(float(ex.run("train", feed_dict={ids: ids_v, y_: yv}
+                                 )[0].asnumpy()), 7) for _ in range(4)]
+    np.testing.assert_allclose(single, res["0"][0], rtol=2e-5)

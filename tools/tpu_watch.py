@@ -61,12 +61,13 @@ def _contending():
         if any(a.endswith(b"/pytest") or a == b"pytest"
                for a in argv[:2]):                  # direct pytest binary
             return True
-        # a bench.py SCRIPT argument in the leading positions ('python
-        # bench.py', 'python -u bench.py'); exact-name or path-suffix only
-        # — a bare endswith would also match editors/grep holding the file
-        # open and unrelated names like 'microbench.py'
-        if any(a == b"bench.py" or a.endswith(b"/bench.py")
-               for a in argv[:3]):
+        # a bench.py EXECUTION: python interpreter with the script in a
+        # leading position ('python bench.py', 'python -u bench.py') —
+        # an editor/pager/grep holding the file open is not contention
+        interp = argv[0].rsplit(b"/", 1)[-1] if argv and argv[0] else b""
+        if interp.startswith(b"python") and any(
+                a == b"bench.py" or a.endswith(b"/bench.py")
+                for a in argv[1:4]):
             return True
     return False
 
