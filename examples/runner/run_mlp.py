@@ -1,0 +1,59 @@
+"""MLP runner (reference ``examples/runner/run_mlp.py`` + yaml pattern).
+
+Single host:   python examples/runner/run_mlp.py --cpu
+Multi host:    bin/heturun -c examples/runner/config.yml examples/runner/run_mlp.py
+Local 2-rank:  bin/heturun -n 2 --no-ssh --local-devices 4 examples/runner/run_mlp.py --cpu
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                      # noqa: E402
+
+import hetu_tpu as ht                   # noqa: E402
+from hetu_tpu import launcher           # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+    launcher.init_distributed()         # no-op on a single host
+    import jax
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(32, 10).astype(np.float32)
+    X = rng.randn(args.batch_size * 4, 32).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[np.argmax(X @ W, 1)]
+
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    h = ht.layers.Linear(32, 64, activation="relu", name="mlp.fc1")(x)
+    logits = ht.layers.Linear(64, 10, name="mlp.fc2")(h)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(args.lr).minimize(loss)]},
+        seed=0, dist_strategy=ht.dist.DataParallel())
+    n = args.batch_size
+    for i in range(args.steps):
+        lo = (i * n) % (len(X) - n + 1)
+        out = ex.run("train", feed_dict={x: X[lo:lo + n], y_: Y[lo:lo + n]})
+        if jax.process_index() == 0 and i % 5 == 0:
+            print(f"step {i} loss {float(out[0].asnumpy()):.4f}", flush=True)
+    if jax.process_index() == 0:
+        print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

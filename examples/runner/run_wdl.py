@@ -1,0 +1,62 @@
+"""Wide&Deep runner with PS embedding flags (reference
+``examples/runner/run_wdl.py`` + ctr cache flags, run_hetu.py:121-126).
+
+    python examples/runner/run_wdl.py --cpu --embed-mode dense|ps|lru|lfu
+"""
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "examples", "ctr"))
+
+if "--cpu" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                      # noqa: E402
+
+import hetu_tpu as ht                   # noqa: E402
+import models as ctr                    # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--vocab", type=int, default=100000)
+    p.add_argument("--embed-mode", default="lru",
+                   choices=["dense", "ps", "lru", "lfu", "lfuopt"])
+    p.add_argument("--bsp", type=int, default=0,
+                   help="0 BSP, -1 ASP, k>0 SSP staleness bound")
+    args = p.parse_args()
+
+    dense = ht.placeholder_op("dense")
+    sparse = ht.placeholder_op("sparse", dtype=np.int64)
+    y_ = ht.placeholder_op("y")
+    loss, prob = ctr.wdl_criteo(dense, sparse, y_, args.batch_size,
+                                vocab=args.vocab, dim=16,
+                                embed_mode=args.embed_mode, lr=0.01)
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.SGDOptimizer(0.01).minimize(loss)]},
+        seed=0, bsp=args.bsp)
+    d_all, s_all, y_all = ctr.synthetic_criteo_skewed(
+        args.steps * args.batch_size + args.batch_size, vocab=args.vocab)
+    n = args.batch_size
+    for i in range(args.steps):
+        lo = i * n
+        out = ex.run("train", feed_dict={dense: d_all[lo:lo + n],
+                                         sparse: s_all[lo:lo + n],
+                                         y_: y_all[lo:lo + n]})
+        if i % 5 == 0:
+            print(f"step {i} loss {float(out[0].asnumpy()):.4f}", flush=True)
+    ex.ps_flush()
+    print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -521,12 +521,15 @@ class SubExecutor:
         for node in self.ps_nodes:
             if node in self._prefetched:
                 continue
-            if self.ex.bsp != -1 and isinstance(node.store,
-                                                DistributedStore):
+            if isinstance(node.store, DistributedStore) \
+                    and (self.ex.bsp != -1 or self.ex._multiprocess):
                 # synchronous (BSP/SSP) multi-worker training: a lookahead
                 # pull issued after only the LOCAL push would miss other
                 # workers' same-step gradients — one step of hidden
-                # staleness. ASP tolerates it by definition; BSP must not.
+                # staleness. ASP tolerates that — but NOT on a cross-
+                # process mesh, where a pre-barrier prefetch could hand
+                # different ranks different rows for the same "replicated"
+                # global array (silent corruption, not staleness).
                 continue
             idn = node.ids_node
             if not isinstance(idn, DataloaderOp):

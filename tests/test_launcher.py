@@ -339,3 +339,6 @@ def test_multiprocess_hybrid_ps_training(tmp_path):
     single = [round(float(ex.run("train", feed_dict={ids: ids_v, y_: yv}
                                  )[0].asnumpy()), 7) for _ in range(4)]
     np.testing.assert_allclose(single, res["0"][0], rtol=2e-5)
+    # final TABLE state must match too (the docstring's full promise)
+    digest = round(float(np.abs(st.pull(t, np.arange(32))).sum()), 5)
+    assert abs(digest - res["0"][1]) < 2e-4, (digest, res["0"][1])
