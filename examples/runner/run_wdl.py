@@ -1,7 +1,7 @@
 """Wide&Deep runner with PS embedding flags (reference
 ``examples/runner/run_wdl.py`` + ctr cache flags, run_hetu.py:121-126).
 
-    python examples/runner/run_wdl.py --cpu --embed-mode dense|ps|lru|lfu
+    python examples/runner/run_wdl.py --cpu --embed-mode dense|ps|lru|lfu|lfuopt
 """
 import argparse
 import os
@@ -37,7 +37,7 @@ def main():
     dense = ht.placeholder_op("dense")
     sparse = ht.placeholder_op("sparse", dtype=np.int64)
     y_ = ht.placeholder_op("y")
-    loss, prob = ctr.wdl_criteo(dense, sparse, y_, args.batch_size,
+    loss, _prob = ctr.wdl_criteo(dense, sparse, y_, args.batch_size,
                                 vocab=args.vocab, dim=16,
                                 embed_mode=args.embed_mode, lr=0.01)
     ex = ht.Executor(

@@ -342,3 +342,115 @@ def test_multiprocess_hybrid_ps_training(tmp_path):
     # final TABLE state must match too (the docstring's full promise)
     digest = round(float(np.abs(st.pull(t, np.arange(32))).sum()), 5)
     assert abs(digest - res["0"][1]) < 2e-4, (digest, res["0"][1])
+
+
+PP_CP_WORKER = textwrap.dedent("""
+    import os, re, sys, json
+    os.environ["XLA_FLAGS"] = (re.sub(
+        r"--xla_force_host_platform_device_count=\\d+", "",
+        os.environ.get("XLA_FLAGS", "")) +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from hetu_tpu import launcher
+    launcher.init_distributed()
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.layers.core import Linear
+
+    rank = jax.process_index()
+    axes = {{"dp": 2, "pp": 2, "cp": 2}}
+    mesh = ht.make_mesh(axes)          # 8 global devices, spans processes
+    B, S, d, heads = 4, 32, 32, 2
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B * S, d).astype(np.float32)
+    x = ht.placeholder_op("x", shape=(B * S, d))
+    h = ht.pipeline_block(
+        x, lambda s: Linear(d, d, activation="tanh", name="mpp.st")(s),
+        n_stages=2, n_microbatches=2, schedule="1f1b", name="mpp.pipe")
+    h4 = ht.array_reshape_op(h, output_shape=(B, S, heads, d // heads))
+    h4 = ht.transpose_op(h4, perm=(0, 2, 1, 3))
+    a = ht.ring_attention_op(h4, h4, h4, causal=True)
+    a = ht.transpose_op(a, perm=(0, 2, 1, 3))
+    a = ht.array_reshape_op(a, output_shape=(B * S, d))
+    loss = ht.reduce_mean_op(ht.ops.mul_op(a, a), [0, 1])
+    ex = ht.Executor(
+        {{"train": [loss, ht.optim.AdamOptimizer(1e-3).minimize(loss)]}},
+        seed=0, mesh=mesh, dist_strategy=ht.dist.ModelParallel(axes))
+    assert ex._multiprocess
+    ls = [round(float(ex.run("train", feed_dict={{x: xv}}
+                             )[0].asnumpy()), 7) for _ in range(2)]
+    print(f"RANK{{rank}} {{json.dumps(ls)}}", flush=True)
+""")
+
+
+@pytest.mark.timeout(300)
+def test_multiprocess_pipeline_ring_attention(tmp_path):
+    """pp (1F1B pipeline_block) + cp (ring attention) + dp over a mesh
+    spanning 2 real processes — the scheduled collectives (ppermute rings,
+    stage p2p) cross process boundaries; ranks must agree and match the
+    single-process 8-device run."""
+    import json
+    import re as _re
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.layers.core import Linear
+
+    script = tmp_path / "ppcp.py"
+    script.write_text(PP_CP_WORKER.format(repo=REPO))
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    coord = _free_port()
+    procs = []
+    for rank in range(2):
+        env = launcher._host_env(config, rank, coordinator_port=coord)
+        procs.append(sp.Popen([sys.executable, str(script)], env=env,
+                              stdout=sp.PIPE, stderr=sp.STDOUT, text=True))
+    outs, rcs = [], []
+    deadline = _time.monotonic() + 260
+    try:
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(5.0, deadline - _time.monotonic()))
+            outs.append(out)
+            rcs.append(p.returncode)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert rcs == [0, 0], outs
+    res = {}
+    for o in outs:
+        for line in o.splitlines():
+            m = _re.match(r"RANK(\d) (\[.*)", line)
+            if m:
+                res[m.group(1)] = json.loads(m.group(2))
+    assert res["0"] == res["1"], res
+
+    # single-process baseline, same graph over the in-process 8-dev mesh
+    axes = {"dp": 2, "pp": 2, "cp": 2}
+    mesh = ht.make_mesh(axes)
+    B, S, d, heads = 4, 32, 32, 2
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B * S, d).astype(np.float32)
+    x = ht.placeholder_op("x", shape=(B * S, d))
+    h = ht.pipeline_block(
+        x, lambda s: Linear(d, d, activation="tanh", name="mpp.st")(s),
+        n_stages=2, n_microbatches=2, schedule="1f1b", name="mpp.pipe")
+    h4 = ht.array_reshape_op(h, output_shape=(B, S, heads, d // heads))
+    h4 = ht.transpose_op(h4, perm=(0, 2, 1, 3))
+    a = ht.ring_attention_op(h4, h4, h4, causal=True)
+    a = ht.transpose_op(a, perm=(0, 2, 1, 3))
+    a = ht.array_reshape_op(a, output_shape=(B * S, d))
+    loss = ht.reduce_mean_op(ht.ops.mul_op(a, a), [0, 1])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(1e-3).minimize(loss)]},
+        seed=0, mesh=mesh, dist_strategy=ht.dist.ModelParallel(axes))
+    single = [round(float(ex.run("train", feed_dict={x: xv}
+                                 )[0].asnumpy()), 7) for _ in range(2)]
+    np.testing.assert_allclose(single, res["0"], rtol=2e-5)
