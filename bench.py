@@ -209,6 +209,66 @@ def _parse_child_json(stdout, attempt):
     return None
 
 
+# ---- /proc contention scan (shared with tools/tpu_watch.py) -------------
+
+def _iter_procs():
+    import glob
+    for p in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            pid = int(p.split("/")[2])
+            with open(p, "rb") as f:
+                argv = f.read().split(b"\0")
+        except (OSError, ValueError):
+            continue
+        yield pid, argv
+
+
+def _is_pytest_argv(argv):
+    """A real pytest process.  Exact-element matching — a substring grep
+    would false-positive on any command line that merely MENTIONS pytest
+    (e.g. an agent driver carrying instructions)."""
+    if b"pytest" in argv:                           # python -m pytest ...
+        return True
+    return any(a.endswith(b"/pytest") or a == b"pytest"
+               for a in argv[:2])                   # direct pytest binary
+
+
+def _is_bench_argv(argv):
+    """A bench.py EXECUTION ('python [-u] bench.py ...').  Editors/pagers
+    holding the file open are not executions."""
+    interp = argv[0].rsplit(b"/", 1)[-1] if argv and argv[0] else b""
+    return interp.startswith(b"python") and any(
+        a == b"bench.py" or a.endswith(b"/bench.py") for a in argv[1:4])
+
+
+def _pytest_live():
+    return any(_is_pytest_argv(argv) for _, argv in _iter_procs())
+
+
+def _foreign_bench_running():
+    """A bench.py MEASUREMENT owned by another process tree holds the
+    chip.  Only child-flagged processes count — a foreign bench PARENT may
+    itself be idle/deferring, and matching it would mutually deadlock two
+    concurrent invocations.  Deferring is a handoff, not a loss: the other
+    measurement persists its result to the TPU cache we can serve."""
+    me = os.getpid()
+    for pid, argv in _iter_procs():
+        if pid == me or not _is_bench_argv(argv):
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+            if ppid == me:
+                continue    # our own measurement child
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read()
+        except (OSError, ValueError, IndexError):
+            continue
+        if CHILD_ENV_FLAG.encode() + b"=1" in env:
+            return True
+    return False
+
+
 def _probe_backend(timeout_s):
     """(ok, err) — ok iff jax backend init answers within timeout_s AND the
     default backend is an accelerator (a disposable child, so a hang inside
@@ -283,6 +343,15 @@ def _parent_main(args):
             # only delays the fallback artifact
             last_err += " | stopped (insufficient runway for a measurement)"
             break
+        if _foreign_bench_running() or _pytest_live():
+            # another measurement (the watcher's) or a test run owns the
+            # chip; contended children blow their compile budget (the
+            # bench-contention pitfall) — wait it out
+            last_err = f"attempt {attempt}: deferred to a concurrent " \
+                       f"bench measurement or pytest run"
+            attempt += 1
+            time.sleep(20)
+            continue
         ok, probe_err = _probe_backend(min(PROBE_TIMEOUT_S,
                                            remaining - CPU_RESERVE_S))
         if not ok:

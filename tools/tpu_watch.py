@@ -23,7 +23,8 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-from bench import CHILD_ENV_FLAG, TPU_CACHE_PATH, _parse_child_json, \
+from bench import CHILD_ENV_FLAG, TPU_CACHE_PATH, _is_bench_argv, \
+    _is_pytest_argv, _iter_procs, _parse_child_json, \
     _probe_backend  # noqa: E402
 
 CONFIGS = ("bert", "resnet18", "wdl", "moe")
@@ -42,34 +43,12 @@ EXTRA_JOBS = (
 
 def _contending():
     """True iff a real pytest run OR a foreign bench.py invocation is live
-    (sharing the single chip poisons both measurements).  Exact-argv
-    matching via /proc — a substring grep ('pgrep -f pytest')
-    false-positives on any process whose COMMAND LINE merely mentions
-    pytest (e.g. an agent driver carrying instructions), deferring
-    measurements forever.  The watcher's OWN bench.py children cannot
+    (sharing the single chip poisons both measurements); argv matchers are
+    shared with bench.py.  The watcher's OWN bench.py children cannot
     self-match: they are spawned only via blocking subprocess.run between
     _contending() calls, so none are alive when this runs."""
-    import glob
-    for p in glob.glob("/proc/[0-9]*/cmdline"):
-        try:
-            with open(p, "rb") as f:
-                argv = f.read().split(b"\0")
-        except OSError:
-            continue
-        if b"pytest" in argv:                       # python -m pytest ...
-            return True
-        if any(a.endswith(b"/pytest") or a == b"pytest"
-               for a in argv[:2]):                  # direct pytest binary
-            return True
-        # a bench.py EXECUTION: python interpreter with the script in a
-        # leading position ('python bench.py', 'python -u bench.py') —
-        # an editor/pager/grep holding the file open is not contention
-        interp = argv[0].rsplit(b"/", 1)[-1] if argv and argv[0] else b""
-        if interp.startswith(b"python") and any(
-                a == b"bench.py" or a.endswith(b"/bench.py")
-                for a in argv[1:4]):
-            return True
-    return False
+    return any(_is_pytest_argv(argv) or _is_bench_argv(argv)
+               for _, argv in _iter_procs())
 
 
 def _load_cache():
