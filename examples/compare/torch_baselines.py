@@ -37,12 +37,21 @@ def bench_bert(batch_size=192, seq_len=128, steps=3, warmup=1):
     rng = np.random.RandomState(0)
     ids = torch.from_numpy(
         rng.randint(0, cfg.vocab_size, (batch_size, seq_len))).long()
+    # same padded-pretraining length distribution as synthetic_mlm_batch
+    # (hetu_tpu/models/bert.py): 35% packed full, rest uniform [s/4, s]
+    lengths = np.full((batch_size,), seq_len, np.int64)
+    short = rng.rand(batch_size) >= 0.35
+    lengths[short] = rng.randint(max(1, seq_len // 4), seq_len + 1,
+                                 short.sum())
+    attn = torch.from_numpy(
+        (np.arange(seq_len)[None, :] < lengths[:, None]).astype(np.int64))
+    ids[attn == 0] = 0
     labels = ids.clone()
-    labels[torch.rand(labels.shape) > 0.15] = -100
+    labels[(torch.rand(labels.shape) > 0.15) | (attn == 0)] = -100
 
     def step():
         opt.zero_grad()
-        out = model(input_ids=ids, labels=labels)
+        out = model(input_ids=ids, attention_mask=attn, labels=labels)
         out.loss.backward()
         opt.step()
 
@@ -199,12 +208,18 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="resnet18", choices=sorted(BENCHES))
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="bert only — MUST match the hetu side's seq_len")
     p.add_argument("--steps", type=int, default=None)
     args = p.parse_args()
     kw = {}
     if args.batch_size:
         kw["batch_size" if args.config != "moe" else "batch_tokens"] = \
             args.batch_size
+    if args.seq_len:
+        if args.config != "bert":
+            p.error("--seq-len only applies to bert")
+        kw["seq_len"] = args.seq_len
     if args.steps:
         kw["steps"] = args.steps
     torch.manual_seed(0)

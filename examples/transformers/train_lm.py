@@ -33,9 +33,9 @@ def build(model, size, batch_size, seq_len):
                                                seq_len=seq_len)
         feeds, loss, logits = models.bert_pretrain_graph(cfg)
         from hetu_tpu.models.bert import synthetic_mlm_batch
-        ids, tt, labels = synthetic_mlm_batch(cfg)
+        ids, tt, labels, attn = synthetic_mlm_batch(cfg)
         vals = {"input_ids": ids, "token_type_ids": tt,
-                "masked_lm_labels": labels}
+                "masked_lm_labels": labels, "attention_mask": attn}
     elif model == "gpt2":
         cfg = getattr(models.GPT2Config, size)(batch_size=batch_size,
                                                seq_len=seq_len)

@@ -28,26 +28,36 @@ class MultiHeadAttention(BaseLayer):
         x = ops.array_reshape_op(x, output_shape=(batch, seq, self.h, self.dk))
         return ops.transpose_op(x, perm=(0, 2, 1, 3))
 
-    def __call__(self, x, batch, seq, kv=None, kv_seq=None, bias=None,
-                 scale=None):
+    def __call__(self, x, batch, seq, kv=None, kv_seq=None, mask=None,
+                 bias=None, scale=None):
         """x: (batch*seq, hidden) (reference models flatten); returns same.
 
         ``kv``: optional (batch*kv_seq, hidden) memory for cross-attention
-        (encoder-decoder); ``bias``: optional additive logit bias node
-        (T5 relative position bias), broadcastable to (B, H, S_q, S_k).
+        (encoder-decoder); ``mask``: optional key-validity mask node
+        broadcastable to (B, H, S_q, S_k) — a (B, 1, 1, S_k) padding mask
+        rides the flash kernel's O(S) key-mask strip path; ``bias``:
+        optional additive logit bias node (T5 relative position bias),
+        broadcastable to (B, H, S_q, S_k).
         """
         from ..ops.attention import (ring_attention_op, ulysses_attention_op,
-                                     sdpa_bias_op)
-        if bias is not None and self.context_parallel is not None:
+                                     sdpa_bias_op, sdpa_masked_op,
+                                     sdpa_masked_bias_op)
+        if (bias is not None or mask is not None) \
+                and self.context_parallel is not None:
             raise NotImplementedError(
-                "additive attention bias is not threaded through the "
+                "attention mask/bias is not threaded through the "
                 "ring/ulysses context-parallel paths yet")
         kv = x if kv is None else kv
         kv_seq = seq if kv_seq is None else kv_seq
         q = self._split(self.q(x), batch, seq)
         k = self._split(self.k(kv), batch, kv_seq)
         v = self._split(self.v(kv), batch, kv_seq)
-        if bias is not None:
+        if mask is not None and bias is not None:
+            o = sdpa_masked_bias_op(q, k, v, mask, bias, causal=self.causal,
+                                    scale=scale)
+        elif mask is not None:
+            o = sdpa_masked_op(q, k, v, mask, causal=self.causal, scale=scale)
+        elif bias is not None:
             o = sdpa_bias_op(q, k, v, bias, causal=self.causal, scale=scale)
         else:
             attn = {None: sdpa_op, "ring": ring_attention_op,

@@ -76,11 +76,11 @@ def _embeddings(cfg, input_ids, token_type_ids, name="embeddings"):
     return ops.dropout_op(e, 1.0 - cfg.hidden_dropout_prob)
 
 
-def _encoder_layer(cfg, x, name):
+def _encoder_layer(cfg, x, name, mask=None):
     mha = MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads,
                              dropout=cfg.attention_probs_dropout_prob,
                              name=name + ".attn")
-    attn = mha(x, cfg.batch_size, cfg.seq_len)
+    attn = mha(x, cfg.batch_size, cfg.seq_len, mask=mask)
     x = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps,
                   name + ".ln1")(x + attn)
     h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
@@ -94,19 +94,33 @@ def _encoder_layer(cfg, x, name):
                      name + ".ln2")(x + h)
 
 
-def bert_model(cfg, input_ids, token_type_ids, name="bert"):
-    """Returns sequence_output node of shape (batch*seq, hidden)."""
+def bert_model(cfg, input_ids, token_type_ids, attention_mask=None,
+               name="bert"):
+    """Returns sequence_output node of shape (batch*seq, hidden).
+
+    ``attention_mask``: optional (batch, seq) node of 1/0 key-validity flags
+    (reference hetu_bert.py's extended_attention_mask input) — reshaped once
+    to the (B, 1, 1, S) key-padding form that ``sdpa_masked_op`` routes to
+    the flash kernel's O(S) key-mask strip path.
+    """
     x = _embeddings(cfg, input_ids, token_type_ids, name + ".embeddings")
+    mask = None
+    if attention_mask is not None:
+        mask = ops.array_reshape_op(
+            attention_mask, output_shape=(cfg.batch_size, 1, 1, cfg.seq_len))
     for i in range(cfg.num_hidden_layers):
-        x = _encoder_layer(cfg, x, f"{name}.layer{i}")
+        x = _encoder_layer(cfg, x, f"{name}.layer{i}", mask=mask)
     return x
 
 
-def bert_pretrain_graph(cfg, name="bert"):
+def bert_pretrain_graph(cfg, name="bert", use_mask=True):
     """Full MLM pretraining graph (reference train_hetu_bert_dp.py flow).
 
     Returns (placeholders dict, loss node, logits node).
     masked_lm_labels: (batch, seq) with -1 for unmasked positions.
+    ``use_mask=True`` (the flagship default) adds an ``attention_mask``
+    (batch, seq) int32 input so padded pretraining attends only to real
+    tokens (reference hetu_bert.py attention_mask input).
     """
     from ..graph.node import placeholder_op
     shape = (cfg.batch_size, cfg.seq_len)
@@ -116,8 +130,11 @@ def bert_pretrain_graph(cfg, name="bert"):
     token_type_ids = placeholder_op("token_type_ids", shape=shape,
                                     dtype=np.int32)
     labels = placeholder_op("masked_lm_labels", shape=shape, dtype=np.int32)
+    attention_mask = placeholder_op("attention_mask", shape=shape,
+                                    dtype=np.int32) if use_mask else None
 
-    seq = bert_model(cfg, input_ids, token_type_ids, name)
+    seq = bert_model(cfg, input_ids, token_type_ids,
+                     attention_mask=attention_mask, name=name)
     # MLM head: transform + tied-ish decoder (fresh decoder weights, like the
     # reference which also keeps an independent decoder matrix)
     h = Linear(cfg.hidden_size, cfg.hidden_size, activation="gelu",
@@ -131,15 +148,31 @@ def bert_pretrain_graph(cfg, name="bert"):
     loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.seq_len)
     feeds = {"input_ids": input_ids, "token_type_ids": token_type_ids,
              "masked_lm_labels": labels}
+    if attention_mask is not None:
+        feeds["attention_mask"] = attention_mask
     return feeds, loss, logits
 
 
-def synthetic_mlm_batch(cfg, seed=0, mask_frac=0.15):
-    """Deterministic synthetic MLM batch (hermetic benches/tests)."""
+def synthetic_mlm_batch(cfg, seed=0, mask_frac=0.15, full_frac=0.35):
+    """Deterministic synthetic MLM batch (hermetic benches/tests).
+
+    Returns (ids, token_type_ids, labels, attention_mask).  Sequence lengths
+    follow a padded-pretraining distribution: ``full_frac`` of the batch is
+    packed full-length, the rest is uniform over [seq/4, seq] (real MLM
+    corpora mix packed segments with short documents).  Positions beyond a
+    row's length are PAD: id 0, label -1, attention_mask 0.
+    """
     rng = np.random.RandomState(seed)
-    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
-    tt = np.zeros((cfg.batch_size, cfg.seq_len), np.int32)
-    labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int64)
-    mask = rng.rand(cfg.batch_size, cfg.seq_len) < mask_frac
+    b, s = cfg.batch_size, cfg.seq_len
+    ids = rng.randint(0, cfg.vocab_size, (b, s))
+    tt = np.zeros((b, s), np.int32)
+    lengths = np.full((b,), s, np.int32)
+    short = rng.rand(b) >= full_frac
+    lengths[short] = rng.randint(max(1, s // 4), s + 1, short.sum())
+    attn = (np.arange(s)[None, :] < lengths[:, None])
+    ids[~attn] = 0
+    labels = np.full((b, s), -1, np.int64)
+    mask = (rng.rand(b, s) < mask_frac) & attn
     labels[mask] = ids[mask]
-    return (ids.astype(np.int32), tt, labels.astype(np.int32))
+    return (ids.astype(np.int32), tt, labels.astype(np.int32),
+            attn.astype(np.int32))

@@ -68,12 +68,19 @@ def _extra_specs(order, heads, gmode_mask, gmode_bias, block_q, block_k,
     (bh, ki, qi)."""
     specs = []
     if has_lengths:
+        # stored (bh, 1, 1): block (1, 1, 1) keeps the last two dims equal
+        # to the array's (the rank-2 (1, 1) block violated Mosaic tiling)
         specs.append(pl.BlockSpec(
-            (1, 1), lambda *g: (order(*g)[0], 0),
+            (1, 1, 1), lambda *g: (order(*g)[0], 0, 0),
             memory_space=pltpu.SMEM))
     if has_kmask:
+        # stored (B, 1, S_kv): the unit middle dim keeps the block's last
+        # two dims (1, block_k) legal under Mosaic's tiling rule (a
+        # (1, block_k) block over a rank-2 (B, S_kv) array is NOT — the
+        # sublane dim must divide 8 or equal the array dim)
         specs.append(pl.BlockSpec(
-            (1, block_k), lambda *g: (order(*g)[0] // heads, order(*g)[2])))
+            (1, 1, block_k),
+            lambda *g: (order(*g)[0] // heads, 0, order(*g)[2])))
     if has_fmask:
         gm = _g_index(gmode_mask, heads)
         specs.append(pl.BlockSpec(
@@ -110,10 +117,10 @@ def _block_logits(qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref, *,
     if len_ref is not None:
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
             + ki * block_k
-        valid = _and(valid, cols < len_ref[0, 0])
+        valid = _and(valid, cols < len_ref[0, 0, 0])
     if kmask_ref is not None:
-        # keep the load 2-D — (1, block_k) broadcasts over query rows
-        valid = _and(valid, kmask_ref[:] != 0)
+        # (1, 1, block_k) block → (1, block_k) load broadcasts over rows
+        valid = _and(valid, kmask_ref[0] != 0)
     if fmask_ref is not None:
         valid = _and(valid, fmask_ref[0] != 0)
     if causal:
@@ -134,7 +141,7 @@ def _live(qi, ki, len_ref, *, causal, block_q, block_k, kv_off):
     live = (qi * block_q + block_q - 1 + kv_off >= ki * block_k) \
         if causal else True
     if len_ref is not None:
-        cond = ki * block_k < len_ref[0, 0]
+        cond = ki * block_k < len_ref[0, 0, 0]
         live = cond if live is True else jnp.logical_and(live, cond)
     return live
 
@@ -205,7 +212,9 @@ def _fwd_kernel(*refs, scale, causal, flags, block_q, block_k, num_kv,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+        # lse is (bh, s_q, 1): sublane-oriented column write — no
+        # in-kernel transpose, no 128x lane broadcast in HBM
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
@@ -235,11 +244,11 @@ def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
                          gmode_bias, block_q, block_k, **flags),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
@@ -279,8 +288,8 @@ def _dq_kernel(*refs, scale, causal, flags, emit_dbias, block_q, block_k,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]                                  # (bq, d)
-        lse = lse_ref[0][:, None]                       # (bq, 1)
-        delta = delta_ref[0][:, None]                   # (bq, 1)
+        lse = lse_ref[0]                                # (bq, 1)
+        delta = delta_ref[0]                            # (bq, 1)
         s, valid = _block_logits(
             qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
@@ -338,8 +347,8 @@ def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
         k = k_ref[0]                                    # (bk, d)
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                                 # (bq, 1)
+        delta = delta_ref[0]                             # (bq, 1)
         s, valid = _block_logits(
             qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
@@ -377,13 +386,16 @@ def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
                  has_fmask=fmask is not None, has_bias=bias is not None)
     emit_dbias = bias is not None
     extras = [x for x in (lengths, kmask, fmask, bias) if x is not None]
-    # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it
+    # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it.
+    # Shaped (bh, s_q, 1) like lse: the unit lane dim keeps the row
+    # blocks legal under Mosaic tiling AND reads back in sublane
+    # orientation (no in-kernel transpose).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                              # (bh, s_q)
+                    axis=-1)[..., None]                   # (bh, s_q, 1)
 
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    rowspec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     dq_outs = [qspec]
     dq_shapes = [jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)]
     if emit_dbias:
@@ -427,8 +439,8 @@ def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
                          block_k, **flags)
         + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -573,7 +585,7 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
     else:
         len3 = jnp.broadcast_to(
             jnp.asarray(lengths, jnp.int32).reshape(b, 1), (b, h)
-        ).reshape(b * h, 1)
+        ).reshape(b * h, 1, 1)
     gmode_mask = gmode_bias = "one"
     kmask2 = fmask3 = bias3 = None
     if key_mask is not None:
@@ -583,7 +595,8 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
         if km.shape != (b, s_kv):
             raise ValueError(f"key_mask must be (B, S_kv), got "
                             f"{key_mask.shape}")
-        kmask2 = km.astype(jnp.int32)
+        # stored (B, 1, S_kv) — see the kmask BlockSpec note
+        kmask2 = km.astype(jnp.int32)[:, None, :]
     if mask is not None:
         fmask3, gmode_mask = _broadcast_group(
             jnp.asarray(mask).astype(jnp.int32), b, h, s_q, s_kv, "mask")

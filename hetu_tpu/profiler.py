@@ -180,13 +180,8 @@ class HetuProfiler:
         self._sync(outs)
         return (time.perf_counter() - t0) / self.repeats * 1e3
 
-    def hlo_cost(self, feed_dict):
-        """XLA's cost analysis of the compiled step: flops, bytes accessed.
-
-        Replaces per-op replay as the source of cost-model inputs (SURVEY.md
-        §7 'per-op profiler semantics under fusion').
-        """
-        import jax
+    def _compiled(self, feed_dict):
+        """Compile (cache-hitting) the executor's jitted step for analysis."""
         from .graph.executor import _key
         sub, ex = self.sub, self.ex
         if sub._jit is None:
@@ -196,12 +191,24 @@ class HetuProfiler:
         lrs = np.zeros((len(sub.opt_ops),), np.float32)
         # reuse the executor's jitted step — .lower on the same jit object
         # hits jax's compilation cache instead of recompiling
-        compiled = sub._jit.lower(
+        return sub._jit.lower(
             tparams, sparams, opt_states, feeds, key, lrs).compile()
-        cost = compiled.cost_analysis()
+
+    def hlo_cost(self, feed_dict):
+        """XLA's cost analysis of the compiled step: flops, bytes accessed.
+
+        Replaces per-op replay as the source of cost-model inputs (SURVEY.md
+        §7 'per-op profiler semantics under fusion').
+        """
+        cost = self._compiled(feed_dict).cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         return dict(cost) if cost else {}
+
+    def hlo_text(self, feed_dict):
+        """Compiled-step HLO text (evidence of custom-call kernels, fusion
+        decisions) — what the reference reads off nvprof timelines."""
+        return self._compiled(feed_dict).as_text()
 
     def memory_stats(self):
         """Per-device memory stats (reference polls pynvml)."""

@@ -72,10 +72,14 @@ def test_dp8_bert_tiny_loss_curve_parity():
                          dist_strategy=strategy)
         losses = []
         for i in range(steps):
-            ids, tt, labels = synthetic_mlm_batch(cfg, seed=i)
+            # fixed batch: with a fresh random-token batch per step the
+            # loss sits at ln(vocab) and the 'actually trains' check below
+            # is a coin flip; memorizing one batch is a real decrease
+            ids, tt, labels, attn = synthetic_mlm_batch(cfg, seed=0)
             fd = {feeds["input_ids"]: ids.astype(np.int32),
                   feeds["token_type_ids"]: tt.astype(np.int32),
-                  feeds["masked_lm_labels"]: labels.astype(np.int32)}
+                  feeds["masked_lm_labels"]: labels.astype(np.int32),
+                  feeds["attention_mask"]: attn.astype(np.int32)}
             losses.append(float(ex.run("train", feed_dict=fd)[0].asnumpy()))
         return losses
 
@@ -98,10 +102,11 @@ def test_dp8_bert_tiny_momentum_parity():
                          dist_strategy=strategy)
         out = []
         for i in range(steps):
-            ids, tt, labels = synthetic_mlm_batch(cfg, seed=100 + i)
+            ids, tt, labels, attn = synthetic_mlm_batch(cfg, seed=100 + i)
             fd = {feeds["input_ids"]: ids.astype(np.int32),
                   feeds["token_type_ids"]: tt.astype(np.int32),
-                  feeds["masked_lm_labels"]: labels.astype(np.int32)}
+                  feeds["masked_lm_labels"]: labels.astype(np.int32),
+                  feeds["attention_mask"]: attn.astype(np.int32)}
             out.append(float(ex.run("train", feed_dict=fd)[0].asnumpy()))
         return out
 

@@ -40,6 +40,10 @@ def _run(cmd, env=None, timeout=900):
 # absent — an identical workload is the whole point; letting each side pick
 # its own default would compare different batch sizes
 CPU_BATCH = {"bert": 8, "resnet18": 64, "wdl": 512, "moe": 1024}
+# likewise the bert seq_len MUST be pinned on both sides: bench.py's
+# flagship default moved to seq 512 while the torch baseline defaults to
+# 128 — unpinned, the "speedup" would compare different workloads
+DEFAULT_SEQ = {"bert": 128}
 
 
 def main():
@@ -48,6 +52,8 @@ def main():
                    help="comma list of bert,resnet18,wdl,moe")
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="bert sequence length, pinned on BOTH sides")
     p.add_argument("--ours-backend", default="cpu",
                    choices=["cpu", "default"])
     args = p.parse_args()
@@ -60,6 +66,8 @@ def main():
     for config in configs:
         bs = args.batch_size or CPU_BATCH[config]
         extra = ["--batch-size", str(bs), "--steps", str(args.steps)]
+        if config in DEFAULT_SEQ:
+            extra += ["--seq-len", str(args.seq_len or DEFAULT_SEQ[config])]
         env = dict(os.environ, _HETU_BENCH_CHILD="1")
         if args.ours_backend == "cpu":
             env["_HETU_BENCH_FORCE_CPU"] = "1"
