@@ -934,6 +934,20 @@ class Executor:
                     sites.append(node)
         return sites
 
+    def _fetch_host(self, v):
+        """Host copy of a (possibly cross-process-sharded) tensor.
+
+        On a multi-process mesh this is a COLLECTIVE for non-addressable
+        arrays (allgather) — every rank must call it, even ranks that then
+        discard the result (save gates the file writes on rank 0)."""
+        import jax
+        if not self._multiprocess or getattr(v, "is_fully_addressable", True):
+            return np.asarray(v)
+        if getattr(v, "is_fully_replicated", False):
+            return np.asarray(v.addressable_data(0))
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+
     def save(self, path, file=None):
         """Checkpoint params + optimizer state + PS tables + step.
 
@@ -943,22 +957,29 @@ class Executor:
         files under a DistributedStore — reference per-server SaveParam,
         ``ps-lite/src/python_binding.cc:111-118``).  The reference's save
         (:461) loses optimizer state; we keep it (SURVEY.md §5.4).
-        ``file=`` selects the legacy single-pickle blob instead."""
+        ``file=`` selects the legacy single-pickle blob instead.
+
+        Multiprocess: EVERY rank must call save (tensor fetches are
+        collectives and each rank persists its own PS shard) but only rank
+        0 writes params/opt/meta — concurrent same-path np.save from
+        several local ranks interleaves and corrupts tensors."""
         self.ps_flush()  # ASP pushes must land before persisting
         import json
         import os
         import jax
+        rank0 = not self._multiprocess or jax.process_index() == 0
         if file is not None:    # legacy single-file blob
             os.makedirs(path, exist_ok=True)
             blob = {
-                "params": {self.var_names[n]: np.asarray(v)
+                "params": {self.var_names[n]: self._fetch_host(v)
                            for n, v in self.var_values.items()},
-                "opt_states": {op.name: jax.tree.map(np.asarray, st)
+                "opt_states": {op.name: jax.tree.map(self._fetch_host, st)
                                for op, st in self.opt_states.items()},
                 "step": self.step_counter,
             }
-            with open(os.path.join(path, file), "wb") as f:
-                pickle.dump(blob, f)
+            if rank0:
+                with open(os.path.join(path, file), "wb") as f:
+                    pickle.dump(blob, f)
             return
         os.makedirs(os.path.join(path, "params"), exist_ok=True)
         os.makedirs(os.path.join(path, "opt"), exist_ok=True)
@@ -967,7 +988,9 @@ class Executor:
                 "ps_tables": []}
         for i, (n, v) in enumerate(self.var_values.items()):
             fn = f"p{i}.npy"
-            np.save(os.path.join(path, "params", fn), np.asarray(v))
+            hv = self._fetch_host(v)        # collective: all ranks
+            if rank0:
+                np.save(os.path.join(path, "params", fn), hv)
             meta["params"][self.var_names[n]] = fn
         for k, (op, st) in enumerate(self.opt_states.items()):
             named = self._named_opt_state(op, st)
@@ -975,18 +998,28 @@ class Executor:
             for j, (kpath, leaf) in enumerate(
                     jax.tree_util.tree_flatten_with_path(named)[0]):
                 fn = f"o{k}_{j}.npy"
-                np.save(os.path.join(path, "opt", fn), np.asarray(leaf))
+                hl = self._fetch_host(leaf)  # collective: all ranks
+                if rank0:
+                    np.save(os.path.join(path, "opt", fn), hl)
                 leaves[jax.tree_util.keystr(kpath)] = fn
             meta["opt"].append({"name": op.name, "leaves": leaves})
         for i, node in enumerate(self._ps_table_sites()):
             if not hasattr(node.store, "save"):
                 continue
             fn = f"ps{i}.bin"
-            node.store.save(node.table, os.path.join(path, fn))
+            # a DistributedStore (has a .server) self-suffixes .shard{rank}
+            # — every rank persists its own shard.  A plain per-process
+            # EmbeddingStore writes ONE path: rank 0 only (contents are
+            # replicated by the one-pusher gating), or concurrent ranks
+            # would interleave into the same file.
+            if hasattr(node.store, "server") or rank0:
+                node.store.save(node.table, os.path.join(path, fn))
             meta["ps_tables"].append({"file": fn, "node": node.name})
         meta["dataloaders"] = [
             {split: dl.state_dict() for split, dl in op.dataloaders.items()}
             for op in self._dataloader_sites()]
+        if not rank0:
+            return
         tmp = os.path.join(path, "meta.json.tmp")
         with open(tmp, "w") as f:    # meta last + atomic: marks a complete
             json.dump(meta, f, indent=1)     # checkpoint

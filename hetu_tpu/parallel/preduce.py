@@ -102,15 +102,22 @@ class DistPartialReduce(PartialReduce):
     change (see module docstring).
     """
 
+    #: dedicated clock channel — the executor's SSP loop ticks channel 0
+    #: every step; sharing it would double-increment and break the
+    #: 'arrival at step s ⇔ clock >= s+1' assumption below
+    CHANNEL = 1
+
     def __init__(self, store, n_workers=None, max_wait_ms=100.0,
                  min_workers=2, poll_ms=5.0):
         super().__init__(n_workers or store.world,
                          max_wait_ms=max_wait_ms, min_workers=min_workers)
         self.store = store
         self.poll_ms = poll_ms
+        # idempotent server-side: safe for every rank to call
+        store.ssp_init(self.n_workers, channel=self.CHANNEL)
 
     def report_arrival(self, rank, step, t=None):
-        self.store.clock(rank)
+        self.store.clock(rank, channel=self.CHANNEL)
 
     def get_partner(self, rank, step):
         """Active mask for this step from the shared clock vector.
@@ -121,7 +128,12 @@ class DistPartialReduce(PartialReduce):
         target = step + 1
         deadline = time.monotonic() + self.max_wait_ms / 1e3
         while True:
-            clocks = self.store.clocks()
+            clocks = self.store.clocks(channel=self.CHANNEL)
+            if clocks.size < self.n_workers:
+                raise RuntimeError(
+                    f"preduce clock vector has {clocks.size} entries < "
+                    f"n_workers={self.n_workers} — ssp_init raced or ran "
+                    f"with a smaller world")
             mask = (clocks[:self.n_workers] >= target).astype(np.float32)
             if mask.sum() >= self.n_workers or time.monotonic() >= deadline:
                 break

@@ -17,10 +17,12 @@ overlap with PS traffic; ``flush`` drains.  SSP clocks live on rank 0
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -29,7 +31,14 @@ from .store import EmbeddingStore
 OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
     OP_SHUTDOWN, OP_CLOCKS = range(1, 9)
 
-_HDR = struct.Struct("<BiqdI")  # op, table, nkeys, lr, payload_width
+# op, table, nkeys, lr, payload_width, client rank, client sequence number.
+# (client, seq) lets the server DEDUPLICATE retried pushes: the transport
+# retries are at-least-once (the reference's ps-lite ``resender.h`` keeps
+# the same ack+dedup discipline), and double-applying a gradient push would
+# silently corrupt training.
+_HDR = struct.Struct("<BiqdIqq")
+#: retried pushes are remembered per client this many ops back
+_DEDUP_WINDOW = 4096
 
 
 def _recv_exact(sock, n):
@@ -61,7 +70,10 @@ class StoreServer:
                  host="127.0.0.1", port=0):
         self.local, self.world, self.rank = local, world, rank
         self._ssp_lock = threading.Condition()
-        self._clocks = None
+        self._clocks = {}          # channel -> per-worker clock vector
+        self._applied = {}         # client -> OrderedDict of recent push seqs
+        self._applied_lock = threading.Lock()
+        self._live_conns = set()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -77,6 +89,7 @@ class StoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            self._live_conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -97,10 +110,36 @@ class StoreServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            self._live_conns.discard(conn)
             conn.close()
 
+    def _seen(self, client, seq):
+        """True iff this (client, seq) NON-IDEMPOTENT op (push, clock) was
+        already applied — a transport retry resent a frame whose ack was
+        lost.  Window-bounded (reference ``resender.h`` ack+dedup
+        semantics).  Clients base seq on time_ns so a RESTARTED client's
+        sequences are always fresh (old seqs in the window cannot swallow
+        the new instance's ops)."""
+        from collections import OrderedDict
+        with self._applied_lock:
+            seen = self._applied.setdefault(client, OrderedDict())
+            if seq in seen:
+                return True
+            seen[seq] = True
+            while len(seen) > _DEDUP_WINDOW:
+                seen.popitem(last=False)
+            return False
+
+    def _clock_vec(self, channel):
+        v = self._clocks.get(channel)
+        if v is None:
+            raise RuntimeError(
+                f"SSP channel {channel} not initialised: call "
+                f"ssp_init(n_workers, channel={channel}) first")
+        return v
+
     def _handle(self, conn, body):
-        op, table, nkeys, lr, width = _HDR.unpack_from(body)
+        op, table, nkeys, lr, width, client, seq = _HDR.unpack_from(body)
         off = _HDR.size
         keys = np.frombuffer(body, np.int64, nkeys, off)
         off += nkeys * 8
@@ -109,45 +148,56 @@ class StoreServer:
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(out, np.float32).tobytes())
         elif op == OP_PUSH:
-            grads = np.frombuffer(body, np.float32, nkeys * width,
-                                  off).reshape(nkeys, width)
-            self.local.push(table, keys // self.world, grads, lr)
+            if not self._seen(client, seq):
+                grads = np.frombuffer(body, np.float32, nkeys * width,
+                                      off).reshape(nkeys, width)
+                self.local.push(table, keys // self.world, grads, lr)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_VERSIONS:
             v = self.local.versions(table, keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(v, np.int64).tobytes())
         elif op == OP_SSP_INIT:
+            n, channel = int(keys[0]), int(keys[1])
             with self._ssp_lock:
-                self._clocks = np.zeros(int(keys[0]), np.int64)
+                # idempotent: every rank calls init; re-zeroing on the
+                # second caller would erase live arrivals.  A different
+                # size is an explicit reset (fresh run, same server).
+                cur = self._clocks.get(channel)
+                if cur is None or cur.size != n:
+                    self._clocks[channel] = np.zeros(n, np.int64)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_CLOCK:
-            with self._ssp_lock:
-                if self._clocks is None:
-                    raise RuntimeError(
-                        "SSP not initialised: call ssp_init(n_workers) first")
-                self._clocks[int(keys[0])] += 1
-                self._ssp_lock.notify_all()
+            # clock ticks are as non-idempotent as pushes: a retried tick
+            # whose ack was lost must not double-increment (it would fake
+            # an arrival and let stale peers past the SSP bound)
+            channel = int(keys[1]) if nkeys > 1 else 0
+            if not self._seen(client, seq):
+                with self._ssp_lock:
+                    self._clock_vec(channel)[int(keys[0])] += 1
+                    self._ssp_lock.notify_all()
             _send_frame(conn, b"\x00\x01")
         elif op == OP_SSP_SYNC:
             worker, staleness = int(keys[0]), int(keys[1])
-            timeout = lr if lr > 0 else None
+            channel = int(keys[2]) if nkeys > 2 else 0
+            # the server-side wait is ALWAYS bounded (570s < the client's
+            # 600s no-timeout socket deadline): an unbounded cond.wait
+            # would leak this handler thread forever when the client gives
+            # up and drops the connection
+            timeout = lr if lr > 0 else 570.0
             ok = True
             with self._ssp_lock:
-                if self._clocks is None:
-                    raise RuntimeError(
-                        "SSP not initialised: call ssp_init(n_workers) first")
-                while self._clocks[worker] - self._clocks.min() > staleness:
+                v = self._clock_vec(channel)
+                while v[worker] - v.min() > staleness:
                     if not self._ssp_lock.wait(timeout):
                         ok = False
                         break
+                    v = self._clock_vec(channel)
             _send_frame(conn, b"\x00", b"\x01" if ok else b"\x00")
         elif op == OP_CLOCKS:
+            channel = int(keys[0]) if nkeys else 0
             with self._ssp_lock:
-                if self._clocks is None:
-                    raise RuntimeError(
-                        "SSP not initialised: call ssp_init(n_workers) first")
-                v = self._clocks.copy()
+                v = self._clock_vec(channel).copy()
             _send_frame(conn, b"\x00", v.tobytes())
         elif op == OP_SHUTDOWN:
             _send_frame(conn, b"\x00\x01")
@@ -162,6 +212,13 @@ class StoreServer:
             self._sock.close()
         except OSError:
             pass
+        # close live per-connection sockets too: a stopped server must look
+        # DEAD to peers (fast ConnectionError), not wedged
+        for conn in list(self._live_conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class DistributedStore:
@@ -172,12 +229,20 @@ class DistributedStore:
     """
 
     def __init__(self, rank, world, endpoints=None, host="127.0.0.1",
-                 port=0, async_queue=64):
+                 port=0, async_queue=64, rpc_timeout=60.0, rpc_retries=3,
+                 connect_timeout=10.0):
         self.rank, self.world = rank, world
         self.local = EmbeddingStore()
         self.server = StoreServer(self.local, world, rank, host, port)
         self.endpoints = list(endpoints) if endpoints else [None] * world
         self.endpoints[rank] = (host, self.server.port)
+        self.rpc_timeout = rpc_timeout
+        self.rpc_retries = max(1, rpc_retries)
+        self.connect_timeout = connect_timeout
+        # seq base = time_ns: strictly increasing across process restarts,
+        # so a relaunched worker's sequences can never collide with its
+        # predecessor's entries still in the server dedup window
+        self._seq = itertools.count(time.time_ns())  # thread-safe in CPython
         self._conns = {}
         self._conn_locks = {}
         self._connect_lock = threading.Lock()  # guards the conn dicts
@@ -194,18 +259,59 @@ class DistributedStore:
             lock = self._conn_locks.setdefault(peer, threading.Lock())
         with lock:
             if peer not in self._conns:
-                s = socket.create_connection(self.endpoints[peer], timeout=30)
+                s = socket.create_connection(self.endpoints[peer],
+                                             timeout=self.connect_timeout)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conns[peer] = s
             return self._conns[peer], lock
 
-    def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0):
-        sock, lock = self._conn(peer)
-        keys = np.ascontiguousarray(keys, np.int64)
+    def _drop_conn(self, peer):
+        with self._connect_lock:
+            lock = self._conn_locks.setdefault(peer, threading.Lock())
         with lock:
-            _send_frame(sock, _HDR.pack(op, table, keys.size, lr, width),
-                        keys.tobytes(), payload)
-            resp = _recv_frame(sock)
+            s = self._conns.pop(peer, None)
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0,
+             op_timeout=None):
+        """One request/response against ``peer``'s shard.
+
+        Transport discipline (reference ``ps-lite/src/resender.h``): every
+        socket op carries a timeout, a failed op drops the connection and
+        retries on a fresh one with backoff (the same (client, seq) header
+        lets the server dedup a retried PUSH whose ack was lost), and
+        exhausted retries raise a *diagnosable* RuntimeError naming the
+        peer — never a raw OSError or an unbounded blocking recv (the
+        executor's SSP-watchdog discipline applied to the transport)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        hdr = _HDR.pack(op, table, keys.size, lr, width, self.rank,
+                        next(self._seq))
+        last_err = None
+        for attempt in range(self.rpc_retries):
+            if attempt:
+                time.sleep(min(1.0, 0.2 * attempt))
+            try:
+                sock, lock = self._conn(peer)
+                with lock:
+                    sock.settimeout(op_timeout if op_timeout is not None
+                                    else self.rpc_timeout)
+                    _send_frame(sock, hdr, keys.tobytes(), payload)
+                    resp = _recv_frame(sock)
+                break
+            except (TimeoutError, ConnectionError, OSError) as e:
+                last_err = e
+                self._drop_conn(peer)
+        else:
+            host_, port_ = self.endpoints[peer] or ("?", "?")
+            raise RuntimeError(
+                f"PS peer {peer} at {host_}:{port_} unreachable after "
+                f"{self.rpc_retries} attempts "
+                f"({type(last_err).__name__}: {last_err}) — server process "
+                f"dead or wedged")
         if not resp or resp[:1] == b"\x01":
             raise RuntimeError(
                 f"PS rank {peer} error: {resp[1:].decode(errors='replace')}")
@@ -324,24 +430,37 @@ class DistributedStore:
             self._queue.join()
 
     # -- SSP via rank 0 (the reference scheduler role) ---------------------
-    def ssp_init(self, n_workers):
-        self._rpc(0, OP_SSP_INIT, 0, np.asarray([n_workers], np.int64))
+    # ``channel`` separates independent clock consumers on the same server:
+    # the executor's SSP step loop ticks channel 0, partial-reduce arrival
+    # clocks live on their own channel — sharing one vector double-
+    # incremented per step and broke preduce's 'arrival at step s ⇔
+    # clock >= s+1' assumption (round-3 advisor finding).
+    def ssp_init(self, n_workers, channel=0):
+        """Idempotent per (channel, size): every rank may call it."""
+        self._rpc(0, OP_SSP_INIT, 0,
+                  np.asarray([n_workers, channel], np.int64))
 
-    def clock(self, worker=None):
+    def clock(self, worker=None, channel=0):
         w = self.rank if worker is None else worker
-        self._rpc(0, OP_CLOCK, 0, np.asarray([w], np.int64))
+        self._rpc(0, OP_CLOCK, 0, np.asarray([w, channel], np.int64))
 
-    def clocks(self):
+    def clocks(self, channel=0):
         """Every worker's clock value (rank-0 authoritative copy) — the
         arrival feed for partial-reduce group formation."""
-        raw = self._rpc(0, OP_CLOCKS, 0, np.zeros(0, np.int64))
+        raw = self._rpc(0, OP_CLOCKS, 0, np.asarray([channel], np.int64))
         return np.frombuffer(raw, np.int64).copy()
 
-    def ssp_sync(self, worker=None, staleness=0, timeout_ms=0):
+    def ssp_sync(self, worker=None, staleness=0, timeout_ms=0, channel=0):
         w = self.rank if worker is None else worker
+        # the server blocks until the staleness bound clears: the socket
+        # deadline must outlive the requested wait (timeout_ms=0 means
+        # "wait for stragglers" — bounded here at 600s rather than forever,
+        # so a dead scheduler still surfaces as a diagnosable error)
         raw = self._rpc(0, OP_SSP_SYNC, 0,
-                        np.asarray([w, staleness], np.int64),
-                        lr=timeout_ms / 1e3 if timeout_ms else -1.0)
+                        np.asarray([w, staleness, channel], np.int64),
+                        lr=timeout_ms / 1e3 if timeout_ms else -1.0,
+                        op_timeout=(timeout_ms / 1e3 + 30.0) if timeout_ms
+                        else 600.0)
         return raw == b"\x01"
 
     # -- shard persistence (reference per-server SaveParam) ----------------
@@ -359,11 +478,8 @@ class DistributedStore:
             try:
                 self._rpc(peer, OP_SHUTDOWN, 0, np.zeros(0, np.int64))
             except (OSError, RuntimeError, ConnectionError):
-                pass
-            try:
-                self._conns[peer].close()
-            except OSError:
-                pass
+                pass     # peer already gone; _rpc dropped the conn
+            self._drop_conn(peer)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self.server.stop()

@@ -17,3 +17,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # pytest-timeout is not installed on this image; the mark is registered
+    # as DOCUMENTATION of each test's budget (silences unknown-mark
+    # warnings).  The real hang protection in the multiprocess tests is
+    # their explicit subprocess deadlines (communicate(timeout=...) against
+    # a shared monotonic deadline + kill() in finally).
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): intended wall-clock budget; enforced by the "
+        "tests' own subprocess deadlines, not by a pytest plugin")

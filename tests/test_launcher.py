@@ -454,3 +454,114 @@ def test_multiprocess_pipeline_ring_attention(tmp_path):
     single = [round(float(ex.run("train", feed_dict={x: xv}
                                  )[0].asnumpy()), 7) for _ in range(2)]
     np.testing.assert_allclose(single, res["0"], rtol=2e-5)
+
+
+SAVE_WORKER = textwrap.dedent("""
+    import os, re, sys, json
+    os.environ["XLA_FLAGS"] = (re.sub(
+        r"--xla_force_host_platform_device_count=\\d+", "",
+        os.environ.get("XLA_FLAGS", "")) +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from hetu_tpu import launcher
+    launcher.init_distributed()
+    import numpy as np
+    import hetu_tpu as ht
+    from jax.sharding import PartitionSpec as P
+
+    rank = jax.process_index()
+    ckpt = sys.argv[1]
+    axes = {{"dp": 4, "tp": 2}}
+    mesh = ht.make_mesh(axes)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+    x = ht.placeholder_op("x"); y_ = ht.placeholder_op("y")
+    w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32) * .1)
+    w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32) * .1)
+    ht.dispatch(w1, P(None, "tp"))      # tp-sharded: NOT fully addressable
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(ht.relu_op(ht.matmul_op(x, w1)), w2), y_), [0])
+    ex = ht.Executor(
+        {{"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]}},
+        seed=0, mesh=mesh, dist_strategy=ht.dist.ModelParallel(axes))
+    assert ex._multiprocess
+    for _ in range(3):
+        ex.run("train", feed_dict={{x: xv, y_: yv}})
+    ex.save(ckpt)                       # EVERY rank calls save
+    nxt = round(float(ex.run("train", feed_dict={{x: xv, y_: yv}}
+                             )[0].asnumpy()), 7)
+    print(f"RANK{{rank}} NEXT {{nxt}}", flush=True)
+""")
+
+
+@pytest.mark.timeout(240)
+def test_multiprocess_save_then_fresh_resume(tmp_path):
+    """Executor.save on a cross-process mesh with a tp-sharded param: every
+    rank calls save (the allgather fetch is a collective) but only rank 0
+    writes, so concurrent same-path np.save cannot corrupt tensors (the
+    round-3 advisor finding).  A FRESH single-process executor then loads
+    the checkpoint and its next-step loss must match the 2-process run's
+    next step bitwise-roundedly."""
+    import json
+    import re as _re
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+    import hetu_tpu as ht
+
+    ckpt = str(tmp_path / "ckpt")
+    script = tmp_path / "saver.py"
+    script.write_text(SAVE_WORKER.format(repo=REPO))
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    coord = _free_port()
+    procs = []
+    for rank in range(2):
+        env = launcher._host_env(config, rank, coordinator_port=coord)
+        procs.append(sp.Popen([sys.executable, str(script), ckpt], env=env,
+                              stdout=sp.PIPE, stderr=sp.STDOUT, text=True))
+    outs, rcs = [], []
+    deadline = _time.monotonic() + 200
+    try:
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(5.0, deadline - _time.monotonic()))
+            outs.append(out)
+            rcs.append(p.returncode)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert rcs == [0, 0], outs
+    nxt = {}
+    for o in outs:
+        for line in o.splitlines():
+            m = _re.match(r"RANK(\d) NEXT (.*)", line)
+            if m:
+                nxt[m.group(1)] = float(m.group(2))
+    assert nxt["0"] == nxt["1"], nxt
+    assert os.path.exists(os.path.join(ckpt, "meta.json")), \
+        "rank-0 meta.json missing"
+
+    # fresh single-process executor resumes from the checkpoint
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32) * .1)
+    w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32) * .1)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(ht.relu_op(ht.matmul_op(x, w1)), w2), y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+        seed=0)
+    ex.load(ckpt)
+    resumed = round(float(ex.run("train", feed_dict={x: xv, y_: yv}
+                                 )[0].asnumpy()), 7)
+    np.testing.assert_allclose(resumed, nxt["0"], rtol=2e-5)

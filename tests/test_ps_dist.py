@@ -203,3 +203,84 @@ def test_preduce_partner_from_dist_clocks():
             errors.append("child hung")
     assert not errors, "\n".join(errors)
     assert all(p.exitcode == 0 for p in procs)
+
+
+# ------------------------------------------ transport failure diagnostics
+
+def _victim_child(rank, ports, barrier):
+    """Rank-1 server that dies (hard) mid-run after the first barrier."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from hetu_tpu.ps.dist_store import DistributedStore
+    store = DistributedStore(rank, 2, [("127.0.0.1", p) for p in ports],
+                             port=ports[rank])
+    store.init_table(10, 4, opt="sgd", lr=1.0, init_scale=0)
+    barrier.wait()      # parent does one healthy pull
+    barrier.wait()      # parent says: time to die
+    import os
+    os._exit(1)         # hard death: no close(), sockets reset
+
+
+@pytest.mark.timeout(120)
+def test_dead_peer_raises_clean_diagnostic():
+    """Kill one server mid-run: the next RPC to it must raise a RuntimeError
+    naming the peer within the bounded retry budget — not a raw OSError and
+    not a hang inside a blocking recv (round-3 verdict item 5; reference
+    transport resilience ``ps-lite/src/resender.h``)."""
+    import time as _time
+    from hetu_tpu.ps.dist_store import DistributedStore
+
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(2)
+    barrier = ctx.Barrier(2)
+    victim = ctx.Process(target=_victim_child, args=(1, ports, barrier))
+    victim.start()
+    store = DistributedStore(0, 2, [("127.0.0.1", p) for p in ports],
+                             port=ports[0], rpc_timeout=3.0, rpc_retries=2,
+                             connect_timeout=3.0)
+    tid = store.init_table(10, 4, opt="sgd", lr=1.0, init_scale=0)
+    try:
+        barrier.wait(timeout=60)
+        # healthy: key 1 lives on rank 1
+        rows = store.pull(tid, np.asarray([1]))
+        np.testing.assert_allclose(rows, 0.0)
+        barrier.wait(timeout=60)     # victim exits hard now
+        victim.join(timeout=30)
+        t0 = _time.monotonic()
+        with pytest.raises(RuntimeError, match="peer 1 .*unreachable"):
+            for _ in range(3):       # first recv may see a clean reset
+                store.pull(tid, np.asarray([1]))
+        assert _time.monotonic() - t0 < 30, "diagnostic took too long"
+        # healthy shard still answers
+        np.testing.assert_allclose(store.pull(tid, np.asarray([0])), 0.0)
+    finally:
+        if victim.is_alive():
+            victim.terminate()
+        store.close()
+
+
+def test_clock_channels_are_independent():
+    """The executor's SSP loop (channel 0) and preduce arrivals (channel 1)
+    must not share a clock vector (round-3 advisor finding)."""
+    from hetu_tpu.ps.dist_store import DistributedStore
+    from hetu_tpu.parallel.preduce import DistPartialReduce
+
+    store = DistributedStore(0, 1)
+    try:
+        store.ssp_init(1)                       # executor channel
+        pr = DistPartialReduce(store, n_workers=1, max_wait_ms=50.0,
+                               min_workers=1)
+        for _ in range(5):
+            store.clock()                       # executor ticks 5 steps
+        np.testing.assert_array_equal(store.clocks(), [5])
+        np.testing.assert_array_equal(store.clocks(channel=pr.CHANNEL), [0])
+        pr.report_arrival(0, 0)
+        mask = pr.get_partner(0, 0)             # step 0: clock 1 >= 1
+        np.testing.assert_allclose(mask, [1.0])
+        # executor's 5 ticks did NOT leak into preduce arrivals
+        np.testing.assert_array_equal(store.clocks(channel=pr.CHANNEL), [1])
+        # step 4 has NOT arrived on the preduce channel (would have under
+        # the shared-vector bug, where clocks()==5 fakes arrival)
+        assert (store.clocks(channel=pr.CHANNEL) >= 5).sum() == 0
+    finally:
+        store.close()
