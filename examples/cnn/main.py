@@ -8,6 +8,14 @@ import time
 
 import numpy as np
 
+# HETU_PLATFORM=cpu forces the CPU backend (numerics runs while the TPU
+# tunnel is wedged); must land before the first backend use.  The env var
+# JAX_PLATFORMS alone cannot do this: site customization pins it earlier.
+import jax  # noqa: E402
+
+if os.environ.get("HETU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["HETU_PLATFORM"])
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import hetu_tpu as ht  # noqa: E402
 import models  # noqa: E402
@@ -28,6 +36,8 @@ def main():
     parser.add_argument("--timing", action="store_true")
     parser.add_argument("--comm-mode", default=None,
                         help="None (single device) or allreduce/ps/hybrid (DP)")
+    parser.add_argument("--json-out", default=None,
+                        help="write final metrics as JSON (artifact path)")
     args = parser.parse_args()
 
     model = getattr(models, args.model.lower())
@@ -66,22 +76,37 @@ def main():
     n_valid = executor.get_batch_num("validate")
     logger.info("training %s on hetu_tpu (%s)", args.model,
                 "DP" if strategy else "single-device")
+    history = []
     for epoch in range(args.num_epochs):
         t0 = time.time()
         tl = []
         for _ in range(n_train):
             lv, *_ = executor.run("train")
             tl.append(float(lv.asnumpy()))
-        msg = f"epoch {epoch}: train_loss={np.mean(tl):.4f}"
+        entry = {"epoch": epoch, "train_loss": round(float(np.mean(tl)), 4)}
+        msg = f"epoch {epoch}: train_loss={entry['train_loss']:.4f}"
         if args.validate:
             accs = []
             for _ in range(n_valid):
                 _, pred, yv = executor.run("validate")
                 accs.append(ht.metrics.accuracy(pred.asnumpy(), yv.asnumpy()))
-            msg += f" val_acc={np.mean(accs):.4f}"
+            entry["val_acc"] = round(float(np.mean(accs)), 4)
+            msg += f" val_acc={entry['val_acc']:.4f}"
         if args.timing:
             msg += f" ({time.time() - t0:.2f}s)"
+        history.append(entry)
         logger.info(msg)
+    if args.json_out:
+        import json
+        out = {"model": args.model, "dataset": args.dataset,
+               "batch_size": args.batch_size, "opt": args.opt,
+               "learning_rate": args.learning_rate,
+               "epochs": args.num_epochs,
+               "data_dir": os.environ.get("HETU_DATA_DIR"),
+               "history": history, "final": history[-1] if history else {}}
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=1)
+        logger.info("wrote %s", args.json_out)
 
 
 if __name__ == "__main__":

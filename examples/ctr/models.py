@@ -214,10 +214,10 @@ def validate_cache_parity(steps=300, batch_size=512, vocab=100000, dim=16,
         "auc_cache_off": round(auc_off, 4),
         "auc_cache_on": round(auc_on, 4),
         "cache_perf": perf,
-        # row-level: 'hits' and 'fetches' count rows; 'lookups' counts calls
-        "cache_hit_rate": round(
-            perf.get("hits", 0)
-            / max(1, perf.get("hits", 0) + perf.get("fetches", 0)), 4),
+        # READ hit rate: read hits / read lookups (write traffic counts
+        # separately since the round-4 counter split — cache.h perf_
+        # semantics; the old shared counter reported hits > lookups)
+        "cache_hit_rate": round(perf.get("hit_rate", 0.0), 4),
     }
 
 

@@ -20,6 +20,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
 if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    if any(a == "--cp" or a.startswith("--cp=") for a in sys.argv) \
+            and "host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # a dp x cp mesh needs multiple (virtual) devices on CPU
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8"
+                                   ).strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -27,7 +34,7 @@ import hetu_tpu as ht  # noqa: E402
 from hetu_tpu import models  # noqa: E402
 
 
-def build(model, size, batch_size, seq_len):
+def build(model, size, batch_size, seq_len, cp_mode=None):
     if model == "bert":
         cfg = getattr(models.BertConfig, size)(batch_size=batch_size,
                                                seq_len=seq_len)
@@ -44,7 +51,8 @@ def build(model, size, batch_size, seq_len):
         vals = {"input_ids": ids, "labels": labels}
     elif model == "t5":
         cfg = getattr(models.T5Config, size)(batch_size=batch_size,
-                                             src_len=seq_len, tgt_len=seq_len)
+                                             src_len=seq_len, tgt_len=seq_len,
+                                             context_parallel=cp_mode)
         feeds, loss, logits = models.t5_seq2seq_graph(cfg)
         src, tgt_in, labels = models.synthetic_seq2seq_batch(cfg)
         vals = {"input_ids": src, "decoder_input_ids": tgt_in,
@@ -147,19 +155,35 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--cp", type=int, default=0,
+                   help="context-parallel degree over a dp x cp mesh "
+                        "(t5 only: ring/ulysses self-attention)")
+    p.add_argument("--cp-mode", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (handled pre-import)")
     args = p.parse_args()
     if args.size not in SIZES[args.model]:
         p.error(f"--size {args.size!r} invalid for {args.model}; "
                 f"choose from {SIZES[args.model]}")
+    if args.cp and args.model != "t5":
+        p.error("--cp currently applies to t5 (ring/ulysses self-attn)")
 
     feeds, loss, vals = build(args.model, args.size, args.batch_size,
-                              args.seq_len)
+                              args.seq_len,
+                              cp_mode=args.cp_mode if args.cp else None)
     opt = ht.optim.AdamOptimizer(args.lr)
-    strategy = ht.dist.DataParallel() if args.dp else None
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     dist_strategy=strategy)
+    if args.cp:
+        import jax
+        n = len(jax.devices())
+        axes = {"dp": max(1, n // args.cp), "cp": args.cp}
+        mesh = ht.make_mesh(axes)
+        strategy = ht.dist.ModelParallel(axes)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                         mesh=mesh, dist_strategy=strategy)
+    else:
+        strategy = ht.dist.DataParallel() if args.dp else None
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                         dist_strategy=strategy)
     fd = {feeds[k]: v for k, v in vals.items()}
     t0 = time.time()
     for it in range(args.iters):

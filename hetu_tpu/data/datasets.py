@@ -11,7 +11,11 @@ import os
 
 import numpy as np
 
-DATA_DIR = os.environ.get("HETU_DATA_DIR", os.path.expanduser("~/.hetu/data"))
+def _data_dir():
+    """Resolved per call so tests/fixture generators can point
+    ``HETU_DATA_DIR`` at a tmp dir after import."""
+    return os.environ.get("HETU_DATA_DIR",
+                          os.path.expanduser("~/.hetu/data"))
 
 
 def _synthetic(n, shape, num_class, seed):
@@ -26,14 +30,17 @@ def _synthetic(n, shape, num_class, seed):
 def mnist(onehot=True):
     """Returns [(train_x, train_y), (valid_x, valid_y), (test_x, test_y)],
     x: (N, 784) float32 in [0,1], y: (N, 10) one-hot (reference layout)."""
-    path = os.path.join(DATA_DIR, "mnist.npz")
+    path = os.path.join(_data_dir(), "mnist.npz")
     if os.path.exists(path):
         with np.load(path) as d:
             xs = d["x_train"].reshape(-1, 784).astype(np.float32) / 255.0
             ys = np.eye(10, dtype=np.float32)[d["y_train"]]
             xt = d["x_test"].reshape(-1, 784).astype(np.float32) / 255.0
             yt = np.eye(10, dtype=np.float32)[d["y_test"]]
-        return [(xs[:50000], ys[:50000]), (xs[50000:], ys[50000:]), (xt, yt)]
+        # standard MNIST: 50k train / 10k valid; smaller real sets (e.g.
+        # the UCI digits fixture) split 5/6 so the valid split is never empty
+        n_tr = min(50000, len(xs) * 5 // 6)
+        return [(xs[:n_tr], ys[:n_tr]), (xs[n_tr:], ys[n_tr:]), (xt, yt)]
     tx, ty = _synthetic(8192, (784,), 10, 0)
     vx, vy = _synthetic(1024, (784,), 10, 1)
     sx, sy = _synthetic(1024, (784,), 10, 2)
@@ -42,7 +49,7 @@ def mnist(onehot=True):
 
 def normalize_cifar(num_class=10):
     """train_x (N,3,32,32) normalized, train_y one-hot; reference data.py."""
-    path = os.path.join(DATA_DIR, f"cifar{num_class}")
+    path = os.path.join(_data_dir(), f"cifar{num_class}")
     if os.path.isdir(path):
         tx = np.load(os.path.join(path, "train_x.npy"))
         ty = np.load(os.path.join(path, "train_y.npy"))
@@ -92,7 +99,7 @@ class ImageNetFolder:
         self.seed = seed
         self._epoch = 0
         explicit_root = root is not None
-        root = root or os.path.join(DATA_DIR, "imagenet", split)
+        root = root or os.path.join(_data_dir(), "imagenet", split)
         self.samples = []      # (path, class_index)
         self.classes = []
         if os.path.isdir(root):

@@ -1,5 +1,16 @@
 """Multi-head attention layer (new capability; the reference composes this
-per-model in ``examples/transformers/*/hetu_bert.py``)."""
+per-model in ``examples/transformers/*/hetu_bert.py``).
+
+DESIGN NOTE — dropout placement: the reference (and HF) drop attention
+*probabilities* inside the softmax (``hetu_bert.py`` attention_probs
+dropout).  Here ``dropout`` applies to the attention *output* (after the
+o-projection) instead: per-probability dropout is incompatible with the
+flash kernel's blockwise online softmax (probabilities never materialise),
+and output dropout is the standard flash-attention-era substitute with the
+same regularisation strength at equal rate.  Configs named ``attn_pdrop`` /
+``attention_probs_dropout_prob`` are therefore REINTERPRETED as
+output-dropout rates — loss curves match the reference in expectation, not
+step-bitwise, whenever these rates are nonzero."""
 from __future__ import annotations
 
 from .base import BaseLayer
@@ -42,27 +53,33 @@ class MultiHeadAttention(BaseLayer):
         from ..ops.attention import (ring_attention_op, ulysses_attention_op,
                                      sdpa_bias_op, sdpa_masked_op,
                                      sdpa_masked_bias_op)
-        if (bias is not None or mask is not None) \
-                and self.context_parallel is not None:
+        if mask is not None and self.context_parallel is not None:
             raise NotImplementedError(
-                "attention mask/bias is not threaded through the "
-                "ring/ulysses context-parallel paths yet")
+                "attention mask is not threaded through the ring/ulysses "
+                "context-parallel paths yet (additive bias is — route "
+                "padding through the loss mask, or run without cp)")
         kv = x if kv is None else kv
         kv_seq = seq if kv_seq is None else kv_seq
         q = self._split(self.q(x), batch, seq)
         k = self._split(self.k(kv), batch, kv_seq)
         v = self._split(self.v(kv), batch, kv_seq)
+        cp_attn = {"ring": ring_attention_op,
+                   "ulysses": ulysses_attention_op}.get(self.context_parallel)
         if mask is not None and bias is not None:
             o = sdpa_masked_bias_op(q, k, v, mask, bias, causal=self.causal,
                                     scale=scale)
         elif mask is not None:
             o = sdpa_masked_op(q, k, v, mask, causal=self.causal, scale=scale)
         elif bias is not None:
-            o = sdpa_bias_op(q, k, v, bias, causal=self.causal, scale=scale)
+            # T5 + context parallelism: the bias node becomes the schedule's
+            # 4th input (ring-sliced / head-sharded)
+            o = (cp_attn(q, k, v, bias, causal=self.causal, scale=scale)
+                 if cp_attn is not None else
+                 sdpa_bias_op(q, k, v, bias, causal=self.causal, scale=scale))
+        elif cp_attn is not None:
+            o = cp_attn(q, k, v, causal=self.causal, scale=scale)
         else:
-            attn = {None: sdpa_op, "ring": ring_attention_op,
-                    "ulysses": ulysses_attention_op}[self.context_parallel]
-            o = attn(q, k, v, causal=self.causal, scale=scale)
+            o = sdpa_op(q, k, v, causal=self.causal, scale=scale)
         o = ops.transpose_op(o, perm=(0, 2, 1, 3))
         o = ops.array_reshape_op(o, output_shape=(batch * seq, self.hidden))
         o = self.o(o)

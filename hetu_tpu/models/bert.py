@@ -77,6 +77,8 @@ def _embeddings(cfg, input_ids, token_type_ids, name="embeddings"):
 
 
 def _encoder_layer(cfg, x, name, mask=None):
+    # attention_probs_dropout_prob applies to the attention OUTPUT, not
+    # the probabilities (flash-incompatible) — see layers/attention.py
     mha = MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads,
                              dropout=cfg.attention_probs_dropout_prob,
                              name=name + ".attn")

@@ -54,6 +54,8 @@ class GPT2Config:
 def _block(cfg, x, name):
     """Pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
     h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln1")(x)
+    # attn_pdrop applies to the attention OUTPUT, not the probabilities
+    # (flash-incompatible) — see the design note in layers/attention.py
     mha = MultiHeadAttention(cfg.n_embd, cfg.n_head, dropout=cfg.attn_pdrop,
                              causal=True, name=name + ".attn")
     x = x + mha(h, cfg.batch_size, cfg.seq_len)

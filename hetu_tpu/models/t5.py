@@ -22,7 +22,7 @@ class T5Config:
                  num_layers=6, num_heads=8, relative_attention_num_buckets=32,
                  relative_attention_max_distance=128, dropout_rate=0.1,
                  layer_norm_epsilon=1e-6, batch_size=8, src_len=128,
-                 tgt_len=128):
+                 tgt_len=128, context_parallel=None):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.d_ff = d_ff
@@ -35,6 +35,10 @@ class T5Config:
         self.batch_size = batch_size
         self.src_len = src_len
         self.tgt_len = tgt_len
+        # 'ring' | 'ulysses' | None: shard SELF-attention over the 'cp'
+        # mesh axis (the relative-position bias rides the schedule);
+        # cross-attention stays local (unequal q/kv lengths)
+        self.context_parallel = context_parallel
 
     @classmethod
     def small(cls, **kw):
@@ -111,7 +115,9 @@ def t5_encoder(cfg, x_embed, name="t5.encoder"):
     for i in range(cfg.num_layers):
         ln = name + f".block{i}"
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln1")(x)
-        mha = MultiHeadAttention(cfg.d_model, cfg.num_heads, name=ln + ".attn")
+        mha = MultiHeadAttention(cfg.d_model, cfg.num_heads,
+                                 context_parallel=cfg.context_parallel,
+                                 name=ln + ".attn")
         x = x + mha(h, cfg.batch_size, cfg.src_len, bias=bias, scale=1.0)
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln2")(x)
         x = x + ops.dropout_op(_ffn(cfg, h, ln + ".ffn"),
@@ -128,7 +134,9 @@ def t5_decoder(cfg, y_embed, memory, name="t5.decoder"):
         ln = name + f".block{i}"
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln1")(x)
         self_attn = MultiHeadAttention(cfg.d_model, cfg.num_heads,
-                                       causal=True, name=ln + ".self")
+                                       causal=True,
+                                       context_parallel=cfg.context_parallel,
+                                       name=ln + ".self")
         x = x + self_attn(h, cfg.batch_size, cfg.tgt_len, bias=self_bias,
                           scale=1.0)
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln2")(x)

@@ -156,13 +156,20 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
 sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)
 
 
-def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
-    """Attention with an additive logit bias (T5 relative position bias)."""
+def dispatch_sdpa_bias(q, k, v, bias, causal=False, scale=None):
+    """Backend-dispatched attention with an additive logit bias — flash
+    kernel when the gate and broadcast shape allow, XLA-composed otherwise
+    (the functional entry for Ulysses' full-sequence local step)."""
     if _flash_maskable(q, k, bias):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                bias=bias)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, bias=bias)
+
+
+def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
+    """Attention with an additive logit bias (T5 relative position bias)."""
+    return dispatch_sdpa_bias(q, k, v, bias, causal=causal, scale=scale)
 
 
 sdpa_bias_op = def_op("ScaledDotProductAttentionBias", _sdpa_bias)
@@ -207,23 +214,32 @@ def _has_cp(mesh):
         and mesh.shape["cp"] > 1
 
 
-def _ring_attention(c, q, k, v, causal=False, scale=None):
+def _ring_attention(c, q, k, v, bias=None, causal=False, scale=None):
     """Ring attention over the 'cp' mesh axis; plain sdpa when no cp axis
-    (identical numerics — parity-tested in tests/test_context_parallel.py)."""
+    (identical numerics — parity-tested in tests/test_context_parallel.py).
+    ``bias`` (optional 4th graph input): additive logit bias, ring-sliced
+    per step (T5 relative position bias with context parallelism)."""
     if _has_cp(c.mesh):
         from ..parallel.ring_attention import ring_attention
-        return ring_attention(q, k, v, c.mesh, causal=causal, scale=scale)
+        return ring_attention(q, k, v, c.mesh, bias=bias, causal=causal,
+                              scale=scale)
+    if bias is not None:
+        return dispatch_sdpa_bias(q, k, v, bias, causal=causal, scale=scale)
     return _sdpa(c, q, k, v, causal=causal, scale=scale)
 
 
 ring_attention_op = def_op("RingAttention", _ring_attention)
 
 
-def _ulysses_attention(c, q, k, v, causal=False, scale=None):
-    """Ulysses head-sharded all-to-all attention over the 'cp' axis."""
+def _ulysses_attention(c, q, k, v, bias=None, causal=False, scale=None):
+    """Ulysses head-sharded all-to-all attention over the 'cp' axis.
+    ``bias`` (optional 4th graph input): head-sharded additive bias."""
     if _has_cp(c.mesh):
         from ..parallel.ring_attention import ulysses_attention
-        return ulysses_attention(q, k, v, c.mesh, causal=causal, scale=scale)
+        return ulysses_attention(q, k, v, c.mesh, bias=bias, causal=causal,
+                                 scale=scale)
+    if bias is not None:
+        return dispatch_sdpa_bias(q, k, v, bias, causal=causal, scale=scale)
     return _sdpa(c, q, k, v, causal=causal, scale=scale)
 
 

@@ -29,7 +29,10 @@ so the dense hot path compiles the original straight-line code):
 * ``bias``          — additive logit bias broadcast likewise (T5 relative
                       position bias), differentiable: backward emits per-
                       block dbias tiles (dbias is inherently O(S²) — same
-                      footprint as the bias itself).
+                      footprint as the bias itself).  A (·, ·, 1, S_kv)
+                      row-broadcast bias is auto-routed to a per-key strip
+                      path: O(S) loads forward, O(S) column-sum gradient
+                      backward — never materialised to (S_q, S_kv).
 
 Fully-masked rows/blocks produce ZERO output (not a uniform-softmax leak):
 probabilities are multiplied by the block validity mask, so an all-masked
@@ -61,8 +64,9 @@ def _g_index(gmode, heads):
     }[gmode]
 
 
-def _extra_specs(order, heads, gmode_mask, gmode_bias, block_q, block_k,
-                 *, has_lengths, has_kmask, has_fmask, has_bias):
+def _extra_specs(order, heads, gmode_mask, gmode_bias, gmode_kbias, block_q,
+                 block_k, *, has_lengths, has_kmask, has_kbias, has_fmask,
+                 has_bias):
     """BlockSpecs for the optional inputs, in kernel-argument order.
     ``order`` maps grid indices to (bh, qi, ki) — the dkv kernel iterates
     (bh, ki, qi)."""
@@ -81,6 +85,14 @@ def _extra_specs(order, heads, gmode_mask, gmode_bias, block_q, block_k,
         specs.append(pl.BlockSpec(
             (1, 1, block_k),
             lambda *g: (order(*g)[0] // heads, 0, order(*g)[2])))
+    if has_kbias:
+        # per-KEY additive bias, stored un-broadcast as (G, 1, S_kv) and
+        # loaded as O(block_k) column strips — a (·, ·, 1, S_kv) bias never
+        # materialises its (S_q, S_kv) broadcast (round-3 advisor finding)
+        gkb = _g_index(gmode_kbias, heads)
+        specs.append(pl.BlockSpec(
+            (1, 1, block_k),
+            lambda *g: (gkb(order(*g)[0]), 0, order(*g)[2])))
     if has_fmask:
         gm = _g_index(gmode_mask, heads)
         specs.append(pl.BlockSpec(
@@ -95,8 +107,8 @@ def _extra_specs(order, heads, gmode_mask, gmode_bias, block_q, block_k,
 
 
 # ---------------------------------------------------------------- masking
-def _block_logits(qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref, *,
-                  scale, causal, block_q, block_k, kv_off):
+def _block_logits(qi, ki, q, k, len_ref, kmask_ref, kbias_ref, fmask_ref,
+                  bias_ref, *, scale, causal, block_q, block_k, kv_off):
     """Masked+biased logits for one (qi, ki) block → (s, valid).
 
     ``valid`` is None on the pure-dense path (no masking of any kind) so
@@ -109,6 +121,9 @@ def _block_logits(qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref, *,
         preferred_element_type=jnp.float32) * scale      # (bq, bk)
     if bias_ref is not None:
         s = s + bias_ref[0].astype(jnp.float32)
+    if kbias_ref is not None:
+        # (1, 1, block_k) strip broadcasts over the query rows
+        s = s + kbias_ref[0].astype(jnp.float32)
     valid = None
 
     def _and(a, b):
@@ -146,30 +161,32 @@ def _live(qi, ki, len_ref, *, causal, block_q, block_k, kv_off):
     return live
 
 
-def _unpack(refs, *, has_lengths, has_kmask, has_fmask, has_bias):
+def _unpack(refs, *, has_lengths, has_kmask, has_kbias, has_fmask, has_bias):
     """Split the flat pallas ref list into (fixed-ins, extras, outs+scratch).
     Optional inputs are present only when their static flag is set, keeping
     the kernel arity minimal per specialization."""
     q_ref, k_ref, v_ref = refs[:3]
     i = 3
-    len_ref = kmask_ref = fmask_ref = bias_ref = None
+    len_ref = kmask_ref = kbias_ref = fmask_ref = bias_ref = None
     if has_lengths:
         len_ref = refs[i]; i += 1                       # noqa: E702
     if has_kmask:
         kmask_ref = refs[i]; i += 1                     # noqa: E702
+    if has_kbias:
+        kbias_ref = refs[i]; i += 1                     # noqa: E702
     if has_fmask:
         fmask_ref = refs[i]; i += 1                     # noqa: E702
     if has_bias:
         bias_ref = refs[i]; i += 1                      # noqa: E702
     return (q_ref, k_ref, v_ref), \
-        (len_ref, kmask_ref, fmask_ref, bias_ref), refs[i:]
+        (len_ref, kmask_ref, kbias_ref, fmask_ref, bias_ref), refs[i:]
 
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(*refs, scale, causal, flags, block_q, block_k, num_kv,
                 kv_off):
     (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
-    len_ref, kmask_ref, fmask_ref, bias_ref = extras
+    len_ref, kmask_ref, kbias_ref, fmask_ref, bias_ref = extras
     o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -189,9 +206,9 @@ def _fwd_kernel(*refs, scale, causal, flags, block_q, block_k, num_kv,
         k = k_ref[0]                                   # (bk, d)
         v = v_ref[0]                                   # (bk, d)
         s, valid = _block_logits(
-            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            kv_off=kv_off)
+            qi, ki, q, k, len_ref, kmask_ref, kbias_ref, fmask_ref,
+            bias_ref, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, kv_off=kv_off)
         m_prev = m_scr[:, :1]                          # (bq, 1)
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -217,16 +234,18 @@ def _fwd_kernel(*refs, scale, causal, flags, block_q, block_k, num_kv,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
-               gmode_mask, gmode_bias, heads, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, lengths, kmask, kbias, fmask, bias, scale, causal,
+               gmode_mask, gmode_bias, gmode_kbias, heads, block_q, block_k,
+               interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
     grid = (bh, num_q, num_kv)
     flags = dict(has_lengths=lengths is not None, has_kmask=kmask is not None,
-                 has_fmask=fmask is not None, has_bias=bias is not None)
-    inputs = [q, k, v] + [x for x in (lengths, kmask, fmask, bias)
+                 has_kbias=kbias is not None, has_fmask=fmask is not None,
+                 has_bias=bias is not None)
+    inputs = [q, k, v] + [x for x in (lengths, kmask, kbias, fmask, bias)
                           if x is not None]
 
     kernel = functools.partial(
@@ -241,7 +260,7 @@ def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ] + _extra_specs(lambda b, i, j: (b, i, j), heads, gmode_mask,
-                         gmode_bias, block_q, block_k, **flags),
+                         gmode_bias, gmode_kbias, block_q, block_k, **flags),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -264,7 +283,7 @@ def _flash_fwd(q, k, v, lengths, kmask, fmask, bias, scale, causal,
 def _dq_kernel(*refs, scale, causal, flags, emit_dbias, block_q, block_k,
                num_kv, kv_off):
     (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
-    len_ref, kmask_ref, fmask_ref, bias_ref = extras
+    len_ref, kmask_ref, kbias_ref, fmask_ref, bias_ref = extras
     do_ref, lse_ref, delta_ref = rest[:3]
     rest = rest[3:]
     if emit_dbias:
@@ -291,9 +310,9 @@ def _dq_kernel(*refs, scale, causal, flags, emit_dbias, block_q, block_k,
         lse = lse_ref[0]                                # (bq, 1)
         delta = delta_ref[0]                            # (bq, 1)
         s, valid = _block_logits(
-            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            kv_off=kv_off)
+            qi, ki, q, k, len_ref, kmask_ref, kbias_ref, fmask_ref,
+            bias_ref, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, kv_off=kv_off)
         p = jnp.exp(s - lse)                            # (bq, bk)
         if valid is not None:
             p = p * valid
@@ -325,11 +344,16 @@ def _dq_kernel(*refs, scale, causal, flags, emit_dbias, block_q, block_k,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
-                kv_off):
+def _dkv_kernel(*refs, scale, causal, flags, emit_dkbias, block_q, block_k,
+                num_q, kv_off):
     (q_ref, k_ref, v_ref), extras, rest = _unpack(refs, **flags)
-    len_ref, kmask_ref, fmask_ref, bias_ref = extras
-    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    len_ref, kmask_ref, kbias_ref, fmask_ref, bias_ref = extras
+    if emit_dkbias:
+        (do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dkb_ref,
+         dk_scr, dv_scr, dkb_scr) = rest
+    else:
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+        dkb_ref = dkb_scr = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -337,6 +361,8 @@ def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if emit_dkbias:
+            dkb_scr[:] = jnp.zeros_like(dkb_scr)
 
     live = _live(qi, ki, len_ref, causal=causal, block_q=block_q,
                  block_k=block_k, kv_off=kv_off)
@@ -350,9 +376,9 @@ def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
         lse = lse_ref[0]                                 # (bq, 1)
         delta = delta_ref[0]                             # (bq, 1)
         s, valid = _block_logits(
-            qi, ki, q, k, len_ref, kmask_ref, fmask_ref, bias_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            kv_off=kv_off)
+            qi, ki, q, k, len_ref, kmask_ref, kbias_ref, fmask_ref,
+            bias_ref, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, kv_off=kv_off)
         p = jnp.exp(s - lse)                             # (bq, bk)
         if valid is not None:
             p = p * valid
@@ -363,7 +389,14 @@ def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, bk)
-        ds = p * (dp - delta) * scale                    # (bq, bk)
+        t = p * (dp - delta)            # dL/d(logits) block (pre-scale)
+        if emit_dkbias:
+            # d(key-bias)[k] = sum over query rows of t — accumulated
+            # across this ki column's q blocks (broadcast over the scratch
+            # sublanes; row 0 is written out)
+            dkb_scr[:] += jnp.broadcast_to(
+                jnp.sum(t, axis=0, keepdims=True), dkb_scr.shape)
+        ds = t * scale                                   # (bq, bk)
         # dK += dS^T @ Q
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -373,19 +406,24 @@ def _dkv_kernel(*refs, scale, causal, flags, block_q, block_k, num_q,
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if emit_dkbias:
+            dkb_ref[0] = dkb_scr[:1]
 
 
-def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
-               causal, gmode_mask, gmode_bias, heads, block_q, block_k,
-               interpret):
+def _flash_bwd(q, k, v, lengths, kmask, kbias, fmask, bias, out, lse, do,
+               scale, causal, gmode_mask, gmode_bias, gmode_kbias, heads,
+               block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     num_q = s_q // block_q
     num_kv = s_kv // block_k
     flags = dict(has_lengths=lengths is not None, has_kmask=kmask is not None,
-                 has_fmask=fmask is not None, has_bias=bias is not None)
+                 has_kbias=kbias is not None, has_fmask=fmask is not None,
+                 has_bias=bias is not None)
     emit_dbias = bias is not None
-    extras = [x for x in (lengths, kmask, fmask, bias) if x is not None]
+    emit_dkbias = kbias is not None
+    extras = [x for x in (lengths, kmask, kbias, fmask, bias)
+              if x is not None]
     # delta_i = rowsum(dO ⊙ O): tiny elementwise+reduce — XLA fuses it.
     # Shaped (bh, s_q, 1) like lse: the unit lane dim keeps the row
     # blocks legal under Mosaic tiling AND reads back in sublane
@@ -412,7 +450,7 @@ def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
         grid=(bh, num_q, num_kv),
         in_specs=[qspec, kspec, kspec]
         + _extra_specs(lambda b, i, j: (b, i, j), heads, gmode_mask,
-                       gmode_bias, block_q, block_k, **flags)
+                       gmode_bias, gmode_kbias, block_q, block_k, **flags)
         + [qspec, rowspec, rowspec],
         out_specs=dq_outs if emit_dbias else dq_outs[0],
         out_shape=dq_shapes if emit_dbias else dq_shapes[0],
@@ -426,37 +464,52 @@ def _flash_bwd(q, k, v, lengths, kmask, fmask, bias, out, lse, do, scale,
 
     # dkv iterates (bh, kv_block, q_block): remap grid→(bh, qi, ki)
     order = lambda b, j, i: (b, i, j)                    # noqa: E731
-    dk, dv = pl.pallas_call(
+    dkv_outs = [
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+    ]
+    dkv_shapes = [
+        jax.ShapeDtypeStruct((bh, s_kv, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, s_kv, d), v.dtype),
+    ]
+    dkv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    if emit_dkbias:
+        # O(S) per bh: column-strip gradient, reduced over the broadcast
+        # group by the VJP wrapper
+        dkv_outs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda b, j, i: (b, 0, j)))
+        dkv_shapes.append(jax.ShapeDtypeStruct((bh, 1, s_kv), jnp.float32))
+        dkv_scratch.append(pltpu.VMEM((8, block_k), jnp.float32))
+    res2 = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          flags=flags, block_q=block_q, block_k=block_k,
+                          flags=flags, emit_dkbias=emit_dkbias,
+                          block_q=block_q, block_k=block_k,
                           num_q=num_q, kv_off=s_kv - s_q),
         grid=(bh, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ] + _extra_specs(order, heads, gmode_mask, gmode_bias, block_q,
-                         block_k, **flags)
+        ] + _extra_specs(order, heads, gmode_mask, gmode_bias, gmode_kbias,
+                         block_q, block_k, **flags)
         + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s_kv, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_kv, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
+        out_specs=dkv_outs,
+        out_shape=dkv_shapes,
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
     )(q, k, v, *extras, do, lse, delta)
-    return dq, dk, dv, dbias
+    if emit_dkbias:
+        dk, dv, dkbias = res2
+    else:
+        (dk, dv), dkbias = res2, None
+    return dq, dk, dv, dbias, dkbias
 
 
 # ---------------------------------------------------------------- public op
@@ -466,49 +519,60 @@ def _f0(x):
     return _np.zeros(x.shape, _jd.float0)
 
 
-_STATIC = (7, 8, 9, 10, 11, 12, 13, 14)
+def _group_reduce(d, gmode, b, heads, shape, dtype):
+    """Sum a per-(b·h) gradient over its broadcast group → original
+    storage shape."""
+    g = d.reshape(b, heads, *d.shape[1:])
+    if gmode == "one":
+        d = g.sum(axis=(0, 1))[None]
+    elif gmode == "h":
+        d = g.sum(axis=0)
+    elif gmode == "b":
+        d = g.sum(axis=1)
+    return d.reshape(shape).astype(dtype)
+
+
+_STATIC = (8, 9, 10, 11, 12, 13, 14, 15, 16)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=_STATIC)
-def _flash(q3, k3, v3, lengths, kmask, fmask, bias, scale, causal,
-           gmode_mask, gmode_bias, heads, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale,
-                        causal, gmode_mask, gmode_bias, heads, block_q,
-                        block_k, interpret)
+def _flash(q3, k3, v3, lengths, kmask, kbias, fmask, bias, scale, causal,
+           gmode_mask, gmode_bias, gmode_kbias, heads, block_q, block_k,
+           interpret):
+    out, _ = _flash_fwd(q3, k3, v3, lengths, kmask, kbias, fmask, bias,
+                        scale, causal, gmode_mask, gmode_bias, gmode_kbias,
+                        heads, block_q, block_k, interpret)
     return out
 
 
-def _flash_vjp_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale, causal,
-                   gmode_mask, gmode_bias, heads, block_q, block_k,
-                   interpret):
-    out, lse = _flash_fwd(q3, k3, v3, lengths, kmask, fmask, bias, scale,
-                          causal, gmode_mask, gmode_bias, heads, block_q,
-                          block_k, interpret)
-    return out, (q3, k3, v3, lengths, kmask, fmask, bias, out, lse)
+def _flash_vjp_fwd(q3, k3, v3, lengths, kmask, kbias, fmask, bias, scale,
+                   causal, gmode_mask, gmode_bias, gmode_kbias, heads,
+                   block_q, block_k, interpret):
+    out, lse = _flash_fwd(q3, k3, v3, lengths, kmask, kbias, fmask, bias,
+                          scale, causal, gmode_mask, gmode_bias, gmode_kbias,
+                          heads, block_q, block_k, interpret)
+    return out, (q3, k3, v3, lengths, kmask, kbias, fmask, bias, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, gmode_mask, gmode_bias, heads, block_q,
-                   block_k, interpret, res, do):
-    q3, k3, v3, lengths, kmask, fmask, bias, out, lse = res
-    dq, dk, dv, dbias = _flash_bwd(
-        q3, k3, v3, lengths, kmask, fmask, bias, out, lse, do, scale,
-        causal, gmode_mask, gmode_bias, heads, block_q, block_k, interpret)
+def _flash_vjp_bwd(scale, causal, gmode_mask, gmode_bias, gmode_kbias, heads,
+                   block_q, block_k, interpret, res, do):
+    q3, k3, v3, lengths, kmask, kbias, fmask, bias, out, lse = res
+    dq, dk, dv, dbias, dkbias = _flash_bwd(
+        q3, k3, v3, lengths, kmask, kbias, fmask, bias, out, lse, do, scale,
+        causal, gmode_mask, gmode_bias, gmode_kbias, heads, block_q, block_k,
+        interpret)
+    b = q3.shape[0] // heads
     if bias is not None:
         # reduce the dense (B·H, S, S) tile grads over the broadcast group
-        b = q3.shape[0] // heads
-        g = dbias.reshape(b, heads, *dbias.shape[1:])
-        if gmode_bias == "one":
-            dbias = g.sum(axis=(0, 1))[None]
-        elif gmode_bias == "h":
-            dbias = g.sum(axis=0)
-        elif gmode_bias == "b":
-            dbias = g.sum(axis=1)
-        else:                                            # 'bh'
-            dbias = dbias
-        dbias = dbias.reshape(bias.shape).astype(bias.dtype)
+        dbias = _group_reduce(dbias, gmode_bias, b, heads, bias.shape,
+                              bias.dtype)
+    if kbias is not None:
+        dkbias = _group_reduce(dkbias, gmode_kbias, b, heads, kbias.shape,
+                               kbias.dtype)
     return (dq, dk, dv,
             None if lengths is None else _f0(lengths),
             None if kmask is None else _f0(kmask),
+            None if kbias is None else dkbias,
             None if fmask is None else _f0(fmask),
             None if bias is None else dbias)
 
@@ -516,10 +580,10 @@ def _flash_vjp_bwd(scale, causal, gmode_mask, gmode_bias, heads, block_q,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _broadcast_group(x, b, h, s_q, s_kv, name):
-    """Classify a (1|B, 1|H, S_q|1, S_kv)-broadcastable tensor into its
-    un-broadcast (G, S_q, S_kv) storage + gmode — no materialisation of
-    the broadcast."""
+def _classify_group(x, b, h, s_q, s_kv, name):
+    """Validate a (1|B, 1|H, S_q|1, S_kv)-broadcastable tensor and return
+    its broadcast-group mode — the ONE place group semantics live (the
+    dense-bias and key-bias paths both classify through here)."""
     if x.ndim != 4:
         raise ValueError(f"{name} must be rank-4 broadcastable, "
                          f"got {x.shape}")
@@ -528,16 +592,17 @@ def _broadcast_group(x, b, h, s_q, s_kv, name):
             or xh not in (1, h):
         raise ValueError(f"{name} shape {x.shape} not broadcastable to "
                          f"({b}, {h}, {s_q}, {s_kv})")
-    if xq == 1 and s_q != 1:
-        x = jnp.broadcast_to(x, (xb, xh, s_q, s_kv))  # rows only: O(S²/Sq)
-    if xb == 1 and xh == 1:
-        gmode = "one"
-    elif xb == 1:
-        gmode = "h"
-    elif xh == 1:
-        gmode = "b"
-    else:
-        gmode = "bh"
+    return {(True, True): "one", (True, False): "h",
+            (False, True): "b", (False, False): "bh"}[(xb == 1, xh == 1)]
+
+
+def _broadcast_group(x, b, h, s_q, s_kv, name):
+    """Classify into un-broadcast (G, S_q, S_kv) storage + gmode — no
+    materialisation of the broadcast (beyond q-row expansion)."""
+    gmode = _classify_group(x, b, h, s_q, s_kv, name)
+    if x.shape[2] == 1 and s_q != 1:
+        x = jnp.broadcast_to(
+            x, (x.shape[0], x.shape[1], s_q, s_kv))  # rows only: O(S²/Sq)
     return x.reshape(-1, s_q, s_kv), gmode
 
 
@@ -586,8 +651,8 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
         len3 = jnp.broadcast_to(
             jnp.asarray(lengths, jnp.int32).reshape(b, 1), (b, h)
         ).reshape(b * h, 1, 1)
-    gmode_mask = gmode_bias = "one"
-    kmask2 = fmask3 = bias3 = None
+    gmode_mask = gmode_bias = gmode_kbias = "one"
+    kmask2 = kbias3 = fmask3 = bias3 = None
     if key_mask is not None:
         km = jnp.asarray(key_mask)
         if km.ndim == 4:     # (B, 1, 1, S_kv) attention-mask convention
@@ -601,8 +666,15 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
         fmask3, gmode_mask = _broadcast_group(
             jnp.asarray(mask).astype(jnp.int32), b, h, s_q, s_kv, "mask")
     if bias is not None:
-        bias3, gmode_bias = _broadcast_group(
-            jnp.asarray(bias, jnp.float32), b, h, s_q, s_kv, "bias")
-    out = _flash(q3, k3, v3, len3, kmask2, fmask3, bias3, scale, causal,
-                 gmode_mask, gmode_bias, h, block_q, block_k, interpret)
+        ba = jnp.asarray(bias, jnp.float32)
+        if ba.ndim == 4 and ba.shape[2] == 1 and s_q != 1:
+            # per-KEY (row-broadcast) bias: O(S) column strips, never
+            # materialised to (S_q, S_kv) (round-3 advisor finding)
+            gmode_kbias = _classify_group(ba, b, h, s_q, s_kv, "bias")
+            kbias3 = ba.reshape(-1, 1, s_kv)
+        else:
+            bias3, gmode_bias = _broadcast_group(ba, b, h, s_q, s_kv, "bias")
+    out = _flash(q3, k3, v3, len3, kmask2, kbias3, fmask3, bias3, scale,
+                 causal, gmode_mask, gmode_bias, gmode_kbias, h, block_q,
+                 block_k, interpret)
     return out.reshape(b, h, s_q, d)

@@ -31,10 +31,16 @@ from .strategies import Strategy
 _NEG_INF = -1e30  # finite: keeps exp(m - m_new) well-defined on masked rows
 
 
-def ring_attention_local(q, k, v, axis_name="cp", causal=False, scale=None):
+def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
+                         scale=None):
     """Online-softmax ring attention — call INSIDE shard_map over ``cp``.
 
     q, k, v: local chunks [B, H, Sc, D] (sequence dim sharded over the ring).
+    ``bias``: optional additive logit bias, [1|B, 1|H, Sc|1, S_kv] — the
+    query dim is ring-sharded like q, the KEY dim stays FULL locally and the
+    ring step slices the resident chunk's columns (T5 relative position
+    bias through context parallelism).  Differentiable: the scan transposes
+    to a reverse ring, so dbias flows back automatically.
     Returns the local output chunk [B, H, Sc, D].
     """
     import jax
@@ -46,6 +52,7 @@ def ring_attention_local(q, k, v, axis_name="cp", causal=False, scale=None):
     B, H, Sc, D = q.shape
     sc = scale if scale is not None else 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32) * sc
+    bias_f = None if bias is None else bias.astype(jnp.float32)
 
     q_pos = r * Sc + jnp.arange(Sc)
 
@@ -53,6 +60,9 @@ def ring_attention_local(q, k, v, axis_name="cp", causal=False, scale=None):
         kc, vc, m, l, o = carry
         src = (r - t) % S  # which global chunk we currently hold
         logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if bias_f is not None:
+            logits = logits + lax.dynamic_slice_in_dim(
+                bias_f, src * Sc, Sc, axis=3)
         if causal:
             k_pos = src * Sc + jnp.arange(Sc)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -78,11 +88,14 @@ def ring_attention_local(q, k, v, axis_name="cp", causal=False, scale=None):
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ulysses_attention_local(q, k, v, axis_name="cp", causal=False,
+def ulysses_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
                             scale=None, attn_fn=None):
     """Ulysses head/sequence all-to-all attention — INSIDE shard_map.
 
     q, k, v: local chunks [B, H, Sc, D]; H must divide by the ``cp`` size.
+    ``bias``: optional additive logit bias [1|B, Hc|1, S, S] — already the
+    LOCAL head block (the jit entry shards a multi-head bias over 'cp',
+    matching the contiguous head blocks ``all_to_all`` deals out).
     """
     import jax.numpy as jnp
     from jax import lax
@@ -98,9 +111,13 @@ def ulysses_attention_local(q, k, v, axis_name="cp", causal=False,
         # after the a2a each device holds the FULL sequence for its head
         # subset — exactly the shape where the flash kernel pays off, so
         # route through the backend dispatcher (reference path on CPU)
-        from ..ops.attention import dispatch_sdpa
-        attn_fn = functools.partial(dispatch_sdpa, causal=causal,
-                                    scale=scale)
+        from ..ops.attention import dispatch_sdpa, dispatch_sdpa_bias
+        if bias is None:
+            attn_fn = functools.partial(dispatch_sdpa, causal=causal,
+                                        scale=scale)
+        else:
+            attn_fn = functools.partial(dispatch_sdpa_bias, bias=bias,
+                                        causal=causal, scale=scale)
     oh = attn_fn(qh, kh, vh)
     # inverse: [B, H/cp, S, D] → [B, H, Sc, D]
     return lax.all_to_all(oh, axis_name=axis_name, split_axis=2,
@@ -113,26 +130,55 @@ def _cp_spec(mesh, batch_axis="dp"):
     return P(dp, None, "cp", None)
 
 
-def ring_attention(q, k, v, mesh, axis_name="cp", causal=False, scale=None,
-                   batch_axis="dp"):
-    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'."""
+def ring_attention(q, k, v, mesh, bias=None, axis_name="cp", causal=False,
+                   scale=None, batch_axis="dp"):
+    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
+
+    ``bias``: optional [1|B, 1|H, S|1, S] additive bias — its query dim
+    rides the ring shards, the key dim stays full (sliced per ring step)."""
     import jax
+    from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
     fn = functools.partial(ring_attention_local, axis_name=axis_name,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    if bias is None:
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+    # a batched bias must follow q/k/v's batch sharding, or local shapes
+    # mismatch on a dp x cp mesh; broadcast dims stay replicated
+    dp = batch_axis if batch_axis in mesh.axis_names else None
+    bspec = P(dp if bias.shape[0] > 1 else None, None,
+              "cp" if bias.shape[2] > 1 else None, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, bspec),
+                         out_specs=spec, check_vma=False)(q, k, v, bias)
 
 
-def ulysses_attention(q, k, v, mesh, axis_name="cp", causal=False,
+def ulysses_attention(q, k, v, mesh, bias=None, axis_name="cp", causal=False,
                       scale=None, batch_axis="dp"):
-    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'."""
+    """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
+
+    ``bias``: optional [1|B, H|1, S, S] — a multi-head bias shards its head
+    dim over 'cp' (matching all_to_all's contiguous head blocks)."""
     import jax
+    from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
     fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    if bias is None:
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+    dp = batch_axis if batch_axis in mesh.axis_names else None
+    b0 = dp if bias.shape[0] > 1 else None     # follow q/k/v batch sharding
+    if bias.shape[1] == 1:
+        bspec = P(b0, None, None, None)
+    elif bias.shape[1] % mesh.shape[axis_name] == 0:
+        bspec = P(b0, "cp", None, None)
+    else:
+        raise ValueError(
+            f"ulysses bias heads {bias.shape[1]} not divisible by "
+            f"cp={mesh.shape[axis_name]}")
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, bspec),
+                         out_specs=spec, check_vma=False)(q, k, v, bias)
 
 
 class ContextParallel(Strategy):

@@ -129,11 +129,16 @@ class CacheSparseTable:
         if not self._h:
             return {}
         import ctypes
-        out = np.zeros(6, np.int64)
+        out = np.zeros(8, np.int64)
         self._lib.hetu_cache_perf(
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
-        keys = ["lookups", "hits", "evictions", "pushes", "fetches", "size"]
-        return dict(zip(keys, out.tolist()))
+        keys = ["lookups", "hits", "evictions", "pushes", "fetches", "size",
+                "write_lookups", "write_hits"]
+        d = dict(zip(keys, out.tolist()))
+        # read hit rate — the HET cache's citable number (reference cache.h
+        # perf_ semantics: reads and writes count separately)
+        d["hit_rate"] = (d["hits"] / d["lookups"]) if d["lookups"] else 0.0
+        return d
 
     def __len__(self):
         return int(self._lib.hetu_cache_size(self._h)) if self._h else 0

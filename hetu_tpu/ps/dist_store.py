@@ -181,15 +181,17 @@ class StoreServer:
             worker, staleness = int(keys[0]), int(keys[1])
             channel = int(keys[2]) if nkeys > 2 else 0
             # the server-side wait is ALWAYS bounded (570s < the client's
-            # 600s no-timeout socket deadline): an unbounded cond.wait
-            # would leak this handler thread forever when the client gives
-            # up and drops the connection
-            timeout = lr if lr > 0 else 570.0
+            # 600s no-timeout socket deadline) by a TOTAL monotonic
+            # deadline — bounding each cond.wait alone would reset the
+            # budget on every notify_all (any tick, any channel) and
+            # leak this handler thread under steady clock traffic
+            deadline = time.monotonic() + (lr if lr > 0 else 570.0)
             ok = True
             with self._ssp_lock:
                 v = self._clock_vec(channel)
                 while v[worker] - v.min() > staleness:
-                    if not self._ssp_lock.wait(timeout):
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._ssp_lock.wait(left):
                         ok = False
                         break
                     v = self._clock_vec(channel)

@@ -432,8 +432,12 @@ struct Cache {
   using hent = std::pair<int64_t, key_t_>;
   std::priority_queue<hent, std::vector<hent>, std::greater<hent>> heap;
 
-  // perf counters (cache.h perf_ parity)
+  // perf counters (cache.h perf_ parity).  Read (lookup) and write
+  // (update) traffic count SEPARATELY: get_line serves both paths, and a
+  // single shared hit counter mixed them — the committed hit "rate" came
+  // out > 1 (round-3 verdict: hits 4.68M > lookups 4.01M).
   int64_t n_lookup = 0, n_hit = 0, n_evict = 0, n_push = 0, n_fetch = 0;
+  int64_t n_wlookup = 0, n_whit = 0;
 
   Table *tab() { return store->tables[table]; }
 
@@ -506,10 +510,11 @@ struct Cache {
     n_evict++;
   }
 
-  CacheLine &get_line(key_t_ k) {
+  CacheLine &get_line(key_t_ k, bool write) {
+    (write ? n_wlookup : n_lookup)++;
     auto it = lines.find(k);
     if (it != lines.end()) {
-      n_hit++;
+      (write ? n_whit : n_hit)++;
       touch(k, it->second);
       // staleness check: refresh if the store moved past pull_bound
       Table *t = tab();
@@ -573,8 +578,7 @@ void hetu_cache_lookup(void *c_, const key_t_ *keys, int64_t n, float *dest) {
   }
   std::lock_guard<std::mutex> g(c->mtx);
   for (int64_t i = 0; i < n; ++i) {
-    c->n_lookup++;
-    CacheLine &ln = c->get_line(keys[i]);
+    CacheLine &ln = c->get_line(keys[i], /*write=*/false);
     // serve value with local pending updates folded in (SGD-consistent view)
     std::memcpy(dest + (size_t)i * c->width, ln.val.data(),
                 c->width * sizeof(float));
@@ -594,7 +598,7 @@ void hetu_cache_update(void *c_, const key_t_ *keys, int64_t n,
   std::unordered_map<key_t_, std::vector<float>> acc;
   accumulate_unique(keys, n, c->width, grads, acc);
   for (auto &kv : acc) {
-    CacheLine &ln = c->get_line(kv.first);
+    CacheLine &ln = c->get_line(kv.first, /*write=*/true);
     for (int j = 0; j < c->width; ++j) ln.grad[j] += kv.second[j];
     ln.updates++;
     // keep the served value locally fresh: apply plain-SGD preview with the
@@ -624,14 +628,16 @@ void hetu_cache_flush(void *c_) {
   for (auto &kv : c->lines) c->push_line(kv.first, kv.second);
 }
 
-void hetu_cache_perf(void *c_, int64_t *out6) {
+void hetu_cache_perf(void *c_, int64_t *out8) {
   Cache *c = (Cache *)c_;
-  out6[0] = c->n_lookup;
-  out6[1] = c->n_hit;
-  out6[2] = c->n_evict;
-  out6[3] = c->n_push;
-  out6[4] = c->n_fetch;
-  out6[5] = (int64_t)c->lines.size();
+  out8[0] = c->n_lookup;   // read lookups
+  out8[1] = c->n_hit;      // read hits (hit rate = out8[1] / out8[0])
+  out8[2] = c->n_evict;
+  out8[3] = c->n_push;
+  out8[4] = c->n_fetch;
+  out8[5] = (int64_t)c->lines.size();
+  out8[6] = c->n_wlookup;  // write (update) lookups
+  out8[7] = c->n_whit;     // write hits
 }
 
 }  // extern "C"

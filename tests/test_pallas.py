@@ -423,3 +423,38 @@ def test_flash_gate_artifact_loading(tmp_path, monkeypatch):
     assert blocks[(512, False)] == (128, 256)
     assert blocks[(512, True)] == (256, 128)
     assert blocks[(128, False)] == (128, 128)
+
+
+@pytest.mark.parametrize("bias_shape,causal", [
+    ((1, 1, 1, 128), False),    # shared per-key bias (ALiBi-slope-free form)
+    ((2, 1, 1, 128), False),    # per-batch key bias
+    ((2, 4, 1, 128), True),     # full (b, h) group + causal
+])
+def test_flash_key_bias_strip_path(bias_shape, causal):
+    """(·, ·, 1, S_kv) biases ride O(S) column strips (never materialised
+    to (S_q, S_kv)) — fwd and dbias parity vs the jnp reference."""
+    import jax
+    from hetu_tpu.ops.attention import sdpa_reference
+    rng = np.random.RandomState(11)
+    b, h, s, d = 2, 4, 128, 16
+    q, k, v = [jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+               for _ in range(3)]
+    bias = jnp.asarray(rng.randn(*bias_shape), jnp.float32)
+
+    def f(q, k, v, bias):
+        return flash_attention(q, k, v, bias=bias, causal=causal,
+                               block_q=64, block_k=64, interpret=True).sum()
+
+    def fr(q, k, v, bias):
+        return sdpa_reference(q, k, v, bias=bias, causal=causal).sum()
+
+    out = flash_attention(q, k, v, bias=bias, causal=causal,
+                          block_q=64, block_k=64, interpret=True)
+    ref = sdpa_reference(q, k, v, bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+    g = jax.grad(f, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(fr, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, e in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=3e-5, atol=3e-6)

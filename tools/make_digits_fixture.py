@@ -1,0 +1,51 @@
+"""Write a REAL handwritten-digit dataset in the mnist.npz layout.
+
+This image has no network egress, so the canonical MNIST download is
+unavailable; scikit-learn ships the UCI Optical Recognition of Handwritten
+Digits set offline (1797 real 8x8 grayscale digit scans — ``sklearn.
+datasets.load_digits``).  This tool resizes them to MNIST's 28x28 (PIL
+bilinear; documented preprocessing, not synthesis — every image remains a
+real scanned digit) and writes ``$HETU_DATA_DIR/mnist.npz`` in the exact
+format ``hetu_tpu.data.mnist()`` consumes, so the real-data loader path of
+``examples/cnn/main.py --dataset mnist`` is exercised end-to-end (the
+reference trains real MNIST in ``examples/cnn/main.py:75-112``).
+
+Usage: python tools/make_digits_fixture.py [--out DIR]
+"""
+import argparse
+import os
+
+import numpy as np
+
+
+def build(out_dir, test_frac=1 / 6, seed=0):
+    from PIL import Image
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = []
+    for img in d.images:                       # (8, 8) float 0..16
+        arr = np.asarray(img / 16.0 * 255.0, np.uint8)
+        imgs.append(np.asarray(
+            Image.fromarray(arr).resize((28, 28), Image.BILINEAR), np.uint8))
+    x = np.stack(imgs)                         # (1797, 28, 28) uint8
+    y = d.target.astype(np.int64)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = int(len(x) * test_frac)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "mnist.npz")
+    np.savez_compressed(path,
+                        x_train=x[n_test:], y_train=y[n_test:],
+                        x_test=x[:n_test], y_test=y[:n_test])
+    return path, len(x) - n_test, n_test
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.environ.get(
+        "HETU_DATA_DIR", os.path.expanduser("~/.hetu/data")))
+    args = p.parse_args()
+    path, n_train, n_test = build(args.out)
+    print(f"wrote {path}: {n_train} train / {n_test} test real digit scans")
