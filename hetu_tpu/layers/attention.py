@@ -65,6 +65,9 @@ class MultiHeadAttention(BaseLayer):
         v = self._split(self.v(kv), batch, kv_seq)
         cp_attn = {"ring": ring_attention_op,
                    "ulysses": ulysses_attention_op}.get(self.context_parallel)
+        if self.context_parallel is not None and cp_attn is None:
+            raise ValueError(
+                f"unknown context_parallel mode {self.context_parallel!r}")
         if mask is not None and bias is not None:
             o = sdpa_masked_bias_op(q, k, v, mask, bias, causal=self.causal,
                                     scale=scale)

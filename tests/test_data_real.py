@@ -10,6 +10,7 @@ import pytest
 @pytest.fixture()
 def digits_dir(tmp_path, monkeypatch):
     pytest.importorskip("sklearn")
+    pytest.importorskip("PIL")
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
     from tools.make_digits_fixture import build

@@ -79,31 +79,57 @@ def main():
         # guessed — recorded per seq for the dispatcher
         block_cands = [(bq, bk) for bq in (128, 256) for bk in (128, 256)
                        if bq <= seq and bk <= seq]
-        for causal in (False, True):
+        # padded-pretraining key mask (the FLAGSHIP bench path since round
+        # 4): same length distribution as synthetic_mlm_batch
+        import numpy as np
+        lrng = np.random.RandomState(seq)
+        lengths = np.full((b,), seq)
+        short = lrng.rand(b) >= 0.35
+        lengths[short] = lrng.randint(max(1, seq // 4), seq + 1, short.sum())
+        km = jnp.asarray(np.arange(seq)[None, :] < lengths[:, None])
+        cases = [("dense", {}), ("causal", {"causal": True}),
+                 ("kmask", {"key_mask": km})]
+        for tag, kw in cases:
             best = (float("inf"), None)
             for bq, bk in block_cands:
                 t = _timed_grad_step(
-                    functools.partial(flash_attention, causal=causal,
-                                      block_q=bq, block_k=bk,
-                                      interpret=interpret), q, k, v)
+                    functools.partial(flash_attention, block_q=bq,
+                                      block_k=bk, interpret=interpret,
+                                      **kw), q, k, v)
                 if t < best[0]:
                     best = (t, (bq, bk))
             fl, blocks = best
+            ref_kw = dict(causal=kw.get("causal", False))
+            if "key_mask" in kw:
+                ref_kw["mask"] = km[:, None, None, :]
             xl = _timed_grad_step(
-                functools.partial(sdpa_reference, causal=causal), q, k, v)
-            tag = "causal" if causal else "dense"
+                functools.partial(sdpa_reference, **ref_kw), q, k, v)
             row[f"flash_ms_{tag}"] = round(fl, 3)
             row[f"blocks_{tag}"] = list(blocks)
             row[f"xla_ms_{tag}"] = round(xl, 3)
             row[f"winner_{tag}"] = "flash" if fl < xl else "xla"
         rows[str(seq)] = row
         print(f"seq {seq}: {row}", flush=True)
+        _persist(backend, rows, partial=True)  # completion marked below
 
+    out = _persist(backend, rows, partial=False)
+    print(json.dumps({"flash_min_len": out["flash_min_len"]}))
+    return 0
+
+
+def _persist(backend, rows, partial):
+    """Write the artifact after EVERY measured seq (atomic): a wedged
+    tunnel that kills the child mid-sweep must not lose the rows already
+    measured (the watcher's child timeout is finite)."""
+    import jax
+
+    measured = [s for s in SEQS if str(s) in rows]
     # gate rule: the smallest seq from which flash wins the DENSE case at
     # every measured length >= it (dense is the BERT-flagship path)
     flash_min_len = None
-    for i, seq in enumerate(SEQS):
-        if all(rows[str(s)]["winner_dense"] == "flash" for s in SEQS[i:]):
+    for i, seq in enumerate(measured):
+        if all(rows[str(s)]["winner_dense"] == "flash"
+               for s in measured[i:]):
             flash_min_len = seq
             break
     out = {
@@ -112,6 +138,7 @@ def main():
         "heads": HEADS, "head_dim": HEAD_DIM,
         "token_budget": TOKEN_BUDGET,
         "rows": rows,
+        "partial": partial,
         # never-wins sentinel: gate above the largest measured length
         "flash_min_len": flash_min_len if flash_min_len is not None
         else SEQS[-1] * 2,
@@ -122,8 +149,7 @@ def main():
     with open(tmp, "w") as f:   # atomic: a killed child can't truncate it
         json.dump(out, f, indent=1, sort_keys=True)
     os.replace(tmp, path)
-    print(json.dumps({"flash_min_len": out["flash_min_len"]}))
-    return 0
+    return out
 
 
 if __name__ == "__main__":

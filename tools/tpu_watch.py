@@ -88,10 +88,12 @@ def _measure_config(config):
 
 
 def _artifact_valid(path):
+    """Valid AND complete: incremental writers (flash_ab) mark in-progress
+    artifacts with partial=true — those still serve the dispatch gate but
+    must not stop the watcher from finishing the sweep."""
     try:
         with open(path) as f:
-            json.load(f)
-        return True
+            return not json.load(f).get("partial", False)
     except (OSError, json.JSONDecodeError):
         return False
 
