@@ -247,12 +247,16 @@ def _child_main(args):
     if args.config == "bert":
         # the CPU fallback shrinks the workload (seq 128, bs 4) — the
         # artifact is marked with an error field either way
-        bs = args.batch_size or (4 if cpu_fallback else None)
         sl = args.seq_len or (128 if cpu_fallback else 512)
-        attempted = bs if bs is not None else (64 if sl >= 512 else 192)
+        # resolve the default ONCE (bench_bert applies the same rule when
+        # handed None; passing it explicitly keeps the OOM provenance and
+        # the retry size from drifting against bench_bert's constants)
+        attempted = args.batch_size or (4 if cpu_fallback
+                                        else (64 if sl >= 512 else 192))
         oom = False
         try:
-            res = bench_bert(batch_size=bs, seq_len=sl, steps=_steps(1),
+            res = bench_bert(batch_size=attempted, seq_len=sl,
+                             steps=_steps(1),
                              warmup=1 if cpu_fallback else 3)
         except Exception as e:
             # the seq-512 flagship config is sized for a 16G v5e; if the

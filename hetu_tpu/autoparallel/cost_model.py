@@ -18,18 +18,27 @@ from dataclasses import dataclass, field
 
 @dataclass(frozen=True)
 class Strategy:
-    """One per-layer parallelization choice."""
+    """One per-layer parallelization choice.
+
+    ``cp`` (net-new vs Galvatron, whose dims are pp/tp/dp/fsdp only —
+    ``utils/cost_model.py:13-16``): context/sequence parallelism over the
+    'cp' mesh axis — tokens shard over cp everywhere, attention runs the
+    ring schedule (``parallel/ring_attention.py``).  Params replicate over
+    cp, so gradient sync spans dp x cp."""
     pp: int = 1
     tp: int = 1
     dp: int = 1
     fsdp: bool = False
+    cp: int = 1
 
     @property
     def world(self):
-        return self.pp * self.tp * self.dp
+        return self.pp * self.tp * self.dp * self.cp
 
     def __str__(self):
         tag = f"pp{self.pp}-tp{self.tp}-dp{self.dp}"
+        if self.cp > 1:
+            tag += f"-cp{self.cp}"
         return tag + ("-fsdp" if self.fsdp else "")
 
 
@@ -43,12 +52,15 @@ class LayerSpec:
     * ``act_bytes`` — activation bytes for the whole batch (what pipeline
       p2p moves, and what remat trades)
     * ``count`` — how many identical layers share this spec
+    * ``attn`` — contains self-attention: under cp the layer pays the ring
+      K/V rotation (token-parallel layers without attention do not)
     """
     name: str
     param_bytes: float
     fwd_flops: float
     act_bytes: float
     count: int = 1
+    attn: bool = False
 
 
 @dataclass
@@ -132,7 +144,7 @@ class MemoryCostModel:
             states /= s.dp
             params /= s.dp  # gathered transiently; steady-state sharded
             grads /= s.dp   # reduce-scattered
-        acts = spec.act_bytes / (s.dp * s.tp) / self.microbatches
+        acts = spec.act_bytes / (s.dp * s.tp * s.cp) / self.microbatches
         if self.remat:
             acts = acts / 4 + spec.act_bytes * 0.01  # boundary stashes
         return params + states + grads + acts
@@ -161,21 +173,32 @@ class TimeCostModel:
 
     def layer_time(self, spec: LayerSpec, s: Strategy):
         hw = self.hw
-        # fwd+bwd ≈ 3× fwd flops, spread over tp*dp devices (batch over dp,
-        # matmul width over tp)
-        compute = 3.0 * spec.fwd_flops / (s.tp * s.dp) / hw.flops
+        # fwd+bwd ≈ 3× fwd flops, spread over tp*dp*cp devices (batch over
+        # dp, matmul width over tp, tokens over cp)
+        compute = 3.0 * spec.fwd_flops / (s.tp * s.dp * s.cp) / hw.flops
         # TP: 2 allreduces fwd + 2 bwd per transformer layer over the
         # activation bytes (Megatron pattern), ring cost ×2(n-1)/n
         tp_comm = 0.0
         if s.tp > 1:
-            vol = 4.0 * spec.act_bytes / (s.dp * s.tp)
+            vol = 4.0 * spec.act_bytes / (s.dp * s.tp * s.cp)
             tp_comm = vol * 2 * (s.tp - 1) / s.tp / hw.coll_bw(s.tp)
+        # CP: the ring rotates each rank's local K+V chunk (cp-1) times;
+        # the schedule overlaps permute with blockwise compute, so only
+        # the un-overlapped fraction is charged.  Token-parallel layers
+        # without attention pay nothing.
+        cp_comm = 0.0
+        if s.cp > 1 and spec.attn:
+            kv = 2.0 * spec.act_bytes / (s.dp * s.tp * s.cp)
+            cp_comm = kv * (s.cp - 1) / hw.coll_bw(s.cp) \
+                * (1.0 - hw.overlap)
         # DP: grad allreduce (or reduce-scatter+all-gather for fsdp — same
-        # ring volume), partly overlapped with backward
+        # ring volume), partly overlapped with backward.  Params replicate
+        # over cp, so the sync ring spans dp*cp participants.
         dp_comm = 0.0
-        if s.dp > 1:
-            vol = (spec.param_bytes / s.tp) * 2 * (s.dp - 1) / s.dp
-            dp_comm = vol / hw.coll_bw(s.dp) * (1.0 - hw.overlap)
+        n_sync = s.dp * s.cp
+        if n_sync > 1:
+            vol = (spec.param_bytes / s.tp) * 2 * (n_sync - 1) / n_sync
+            dp_comm = vol / hw.coll_bw(n_sync) * (1.0 - hw.overlap)
         if s.fsdp and s.dp > 1:
             # extra fwd all-gather of sharded params (not overlappable fully)
             vol = (spec.param_bytes / s.tp) * (s.dp - 1) / s.dp
@@ -183,10 +206,10 @@ class TimeCostModel:
         # PP: p2p activations between stages + bubble overhead factor
         pp_cost = 0.0
         if s.pp > 1:
-            p2p = spec.act_bytes / (s.dp * s.tp) / hw.coll_bw(2)
+            p2p = spec.act_bytes / (s.dp * s.tp * s.cp) / hw.coll_bw(2)
             bubble = (s.pp - 1) / self.microbatches
             pp_cost = p2p + compute * bubble
-        return compute + tp_comm + dp_comm + pp_cost
+        return compute + tp_comm + cp_comm + dp_comm + pp_cost
 
     def total(self, specs, strategies):
         return sum(self.layer_time(sp, st) * sp.count
@@ -202,7 +225,8 @@ def transformer_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,
     flops = 2 * tokens * (4 * hidden * hidden + 2 * ffn_mult * hidden
                           * hidden) + 2 * 2 * batch * seq * seq * hidden
     acts = tokens * hidden * dtype_bytes * 12  # rough per-block liveset
-    return LayerSpec(name, float(params), float(flops), float(acts), count)
+    return LayerSpec(name, float(params), float(flops), float(acts), count,
+                     attn=True)
 
 
 # -- per-type specs (Galvatron multi-layer-type DP, dp_utils.py:259) --------
@@ -215,7 +239,8 @@ def attention_layer_spec(hidden, seq, batch, dtype_bytes=2, name="attn",
     flops = 2 * tokens * 4 * hidden * hidden \
         + 2 * 2 * batch * seq * seq * hidden
     acts = tokens * hidden * dtype_bytes * 6
-    return LayerSpec(name, float(params), float(flops), float(acts), count)
+    return LayerSpec(name, float(params), float(flops), float(acts), count,
+                     attn=True)
 
 
 def mlp_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,

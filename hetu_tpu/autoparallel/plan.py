@@ -31,13 +31,14 @@ class ParallelPlan:
         leftover (GSPMD handles specs that omit an axis)."""
         pp = max(s.pp for s in self.strategies)
         tp = max(s.tp for s in self.strategies)
-        if pp * tp > self.n_devices:
+        cp = max(s.cp for s in self.strategies)
+        if pp * tp * cp > self.n_devices:
             raise ValueError(
-                f"mixed plan needs a pp{pp} x tp{tp} mesh but only "
-                f"{self.n_devices} devices exist; re-search with "
+                f"mixed plan needs a pp{pp} x tp{tp} x cp{cp} mesh but "
+                f"only {self.n_devices} devices exist; re-search with "
                 "uniform=True (one strategy for all layers) or restrict "
-                "candidates (allow_pp/max_tp)")
-        dp = self.n_devices // (pp * tp)
+                "candidates (allow_pp/max_tp/max_cp)")
+        dp = self.n_devices // (pp * tp * cp)
         axes = {}
         if pp > 1:
             axes["pp"] = pp
@@ -45,6 +46,8 @@ class ParallelPlan:
             axes["dp"] = dp
         if tp > 1:
             axes["tp"] = tp
+        if cp > 1:
+            axes["cp"] = cp
         return axes or {"dp": 1}
 
     def strategy(self):
@@ -83,6 +86,7 @@ class ParallelPlan:
                 "stage": stage,
                 "tp": s.tp,
                 "dp": s.dp,
+                "cp": s.cp,
                 "fsdp": s.fsdp,
                 # fsdp composes with tp: the non-tp weight dim shards over
                 # 'dp' (Megatron+ZeRO layout), realizing the cost model's
@@ -124,6 +128,15 @@ class ParallelPlan:
                    "cannot retrofit onto a built model — construct the "
                    "model with ht.pipeline_block(n_stages=%d) and pass "
                    "the plan's stage assignment instead" % pp)
+            if strict:
+                raise ValueError(msg)
+            warnings.warn(msg)
+        cp = max(s.cp for s in self.strategies)
+        if cp > 1:
+            msg = (f"plan assigns cp={cp} context parallelism, which "
+                   "apply() cannot retrofit onto built attention — "
+                   "construct the model with context_parallel='ring' (or "
+                   "'ulysses') and run on this plan's mesh")
             if strict:
                 raise ValueError(msg)
             warnings.warn(msg)

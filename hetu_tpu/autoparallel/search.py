@@ -12,8 +12,15 @@ from .cost_model import (HardwareSpec, MemoryCostModel, Strategy,
 
 
 def candidate_strategies(n_devices, allow_pp=True, allow_fsdp=True,
-                         max_tp=None):
-    """All (pp, tp, dp, fsdp) factorizations of n_devices (powers of 2)."""
+                         max_tp=None, allow_cp=False, max_cp=None,
+                         max_dp=None):
+    """All (pp, tp, dp[, cp], fsdp) factorizations of n_devices (powers of
+    2).  ``allow_cp`` adds the context-parallel axis (net-new vs Galvatron
+    — the searcher can trade dp width for sequence sharding when
+    activations dominate memory).  ``max_dp`` bounds data parallelism by
+    the GLOBAL BATCH (dp cannot exceed the number of samples) — the
+    long-context small-batch regime where only cp can spread one
+    sequence's activations over devices."""
     cands = []
     pps = [1]
     p = 2
@@ -28,11 +35,20 @@ def candidate_strategies(n_devices, allow_pp=True, allow_fsdp=True,
         while tp <= rest:
             if max_tp and tp > max_tp:
                 break
-            dp = rest // tp
-            if tp * dp == rest:
-                cands.append(Strategy(pp, tp, dp, False))
-                if allow_fsdp and dp > 1:
-                    cands.append(Strategy(pp, tp, dp, True))
+            inner = rest // tp
+            if tp * inner == rest:
+                cp = 1
+                while cp <= inner:
+                    if not allow_cp and cp > 1:
+                        break
+                    if max_cp and cp > max_cp:
+                        break
+                    dp = inner // cp
+                    if cp * dp == inner and not (max_dp and dp > max_dp):
+                        cands.append(Strategy(pp, tp, dp, False, cp))
+                        if allow_fsdp and dp > 1:
+                            cands.append(Strategy(pp, tp, dp, True, cp))
+                    cp *= 2
             tp *= 2
     return cands
 
@@ -41,7 +57,7 @@ def _switch_cost(a: Strategy, b: Strategy, act_bytes, hw: HardwareSpec):
     """Resharding cost between consecutive layers with different layouts —
     an all-to-allish move of the activations (Galvatron models this as a
     fixed transfer coefficient)."""
-    if (a.tp, a.dp, a.pp) == (b.tp, b.dp, b.pp):
+    if (a.tp, a.dp, a.pp, a.cp) == (b.tp, b.dp, b.pp, b.cp):
         return 0.0
     return act_bytes / hw.coll_bw(max(a.world, b.world))
 
@@ -56,7 +72,8 @@ class DPAlg:
     """
 
     def __init__(self, specs, n_devices, hw=None, microbatches=1,
-                 remat=False, allow_pp=True, allow_fsdp=True, max_tp=None):
+                 remat=False, allow_pp=True, allow_fsdp=True, max_tp=None,
+                 allow_cp=False, max_cp=None, max_dp=None):
         self.specs = list(specs)
         # unspecified hardware: prefer the committed on-chip calibration
         # artifact over the built-in defaults (profile→search workflow)
@@ -64,7 +81,7 @@ class DPAlg:
         self.mem = MemoryCostModel(self.hw, microbatches, remat)
         self.time = TimeCostModel(self.hw, microbatches)
         self.cands = candidate_strategies(n_devices, allow_pp, allow_fsdp,
-                                          max_tp)
+                                          max_tp, allow_cp, max_cp, max_dp)
         if not self.cands:
             raise ValueError(f"no strategy candidates for {n_devices} devices")
 

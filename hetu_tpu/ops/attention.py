@@ -34,12 +34,16 @@ def _load_flash_gate(default=256):
         with open(path) as f:
             data = json.load(f)
         if data.get("backend") == "tpu":
-            gate = int(data["flash_min_len"])
+            # a PARTIAL artifact (sweep killed mid-way) still serves its
+            # measured block shapes, but its gate covers only a prefix of
+            # the lengths — keep the default gate until the sweep completes
+            if not data.get("partial"):
+                gate = int(data["flash_min_len"])
             for seq, row in data.get("rows", {}).items():
-                for tag in ("dense", "causal"):
+                for tag in ("dense", "causal", "kmask"):
                     bl = row.get(f"blocks_{tag}")
                     if bl:
-                        blocks[(int(seq), tag == "causal")] = tuple(bl)
+                        blocks[(int(seq), tag)] = tuple(bl)
     except (OSError, ValueError, KeyError, TypeError):
         pass
     env = os.environ.get("HETU_FLASH_MIN_LEN")
@@ -96,8 +100,8 @@ def dispatch_sdpa(q, k, v, causal=False, scale=None):
     full-sequence local step, pipeline stages)."""
     if _use_flash(q, k):
         from .pallas.flash_attention import flash_attention
-        bq, bk = _FLASH_BLOCKS.get((q.shape[-2], bool(causal)),
-                                   (None, None))
+        bq, bk = _FLASH_BLOCKS.get(
+            (q.shape[-2], "causal" if causal else "dense"), (None, None))
         # the artifact measures square (s, s) shapes; cross-attention
         # (s_q != s_kv) must not inherit a block that exceeds or fails to
         # divide its own dims — fall back to the kernel's defaults
@@ -148,8 +152,16 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
     if _flash_maskable(q, k, mask):
         from .pallas.flash_attention import flash_attention
         km, fm = _split_mask_kinds(mask, q)
+        # the key-mask strip path (flagship) uses ITS OWN measured blocks
+        bq, bk = (None, None)
+        if km is not None and not causal:
+            bq, bk = _FLASH_BLOCKS.get((q.shape[-2], "kmask"), (None, None))
+            if bq is not None and (bq > q.shape[-2] or q.shape[-2] % bq):
+                bq = None
+            if bk is not None and (bk > k.shape[-2] or k.shape[-2] % bk):
+                bk = None
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               key_mask=km, mask=fm)
+                               key_mask=km, mask=fm, block_q=bq, block_k=bk)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
 
 

@@ -65,8 +65,11 @@ def main():
               file=sys.stderr)
         return 1
     interpret = backend != "tpu"
-    rows = {}
+    rows = _load_previous_rows(backend)
     for seq in SEQS:
+        if str(seq) in rows:
+            print(f"seq {seq}: already measured (resumed)", flush=True)
+            continue
         b = max(1, TOKEN_BUDGET // seq)
         key = jax.random.PRNGKey(seq)
         kq, kk, kv = jax.random.split(key, 3)
@@ -117,6 +120,20 @@ def main():
     return 0
 
 
+def _load_previous_rows(backend):
+    """Rows measured by an earlier (killed) sweep on the SAME backend —
+    restarting from scratch would re-lose them at the first persist."""
+    path = os.path.join(ROOT, "artifacts", "flash_ab.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("backend") == backend:
+            return dict(data.get("rows", {}))
+    except (OSError, json.JSONDecodeError):
+        pass
+    return {}
+
+
 def _persist(backend, rows, partial):
     """Write the artifact after EVERY measured seq (atomic): a wedged
     tunnel that kills the child mid-sweep must not lose the rows already
@@ -124,12 +141,18 @@ def _persist(backend, rows, partial):
     import jax
 
     measured = [s for s in SEQS if str(s) in rows]
-    # gate rule: the smallest seq from which flash wins the DENSE case at
-    # every measured length >= it (dense is the BERT-flagship path)
+    # gate rule: the smallest seq from which flash wins BOTH the dense AND
+    # the key-mask case at every measured length >= it (kmask is the
+    # flagship padded-pretraining path; dense the generic one).  Partial
+    # artifacts carry a prefix-only gate — consumers must ignore it until
+    # partial=false (ops/attention.py does).
+    def _wins(s):
+        row = rows[str(s)]
+        return row["winner_dense"] == "flash" \
+            and row.get("winner_kmask", "flash") == "flash"
     flash_min_len = None
     for i, seq in enumerate(measured):
-        if all(rows[str(s)]["winner_dense"] == "flash"
-               for s in measured[i:]):
+        if all(_wins(s) for s in measured[i:]):
             flash_min_len = seq
             break
     out = {
