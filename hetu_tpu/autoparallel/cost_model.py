@@ -61,6 +61,10 @@ class LayerSpec:
     act_bytes: float
     count: int = 1
     attn: bool = False
+    #: K+V bytes for the whole batch (what the cp ring actually rotates);
+    #: act_bytes carries a ~6-12x liveset multiplier and must not be used
+    #: for ring volume.  0 → approximated as act_bytes / 3.
+    kv_bytes: float = 0.0
 
 
 @dataclass
@@ -188,7 +192,8 @@ class TimeCostModel:
         # without attention pay nothing.
         cp_comm = 0.0
         if s.cp > 1 and spec.attn:
-            kv = 2.0 * spec.act_bytes / (s.dp * s.tp * s.cp)
+            kv_total = spec.kv_bytes or (spec.act_bytes / 3.0)
+            kv = kv_total / (s.dp * s.tp * s.cp)
             cp_comm = kv * (s.cp - 1) / hw.coll_bw(s.cp) \
                 * (1.0 - hw.overlap)
         # DP: grad allreduce (or reduce-scatter+all-gather for fsdp — same
@@ -226,7 +231,8 @@ def transformer_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,
                           * hidden) + 2 * 2 * batch * seq * seq * hidden
     acts = tokens * hidden * dtype_bytes * 12  # rough per-block liveset
     return LayerSpec(name, float(params), float(flops), float(acts), count,
-                     attn=True)
+                     attn=True, kv_bytes=float(2 * tokens * hidden
+                                               * dtype_bytes))
 
 
 # -- per-type specs (Galvatron multi-layer-type DP, dp_utils.py:259) --------
@@ -240,7 +246,8 @@ def attention_layer_spec(hidden, seq, batch, dtype_bytes=2, name="attn",
         + 2 * 2 * batch * seq * seq * hidden
     acts = tokens * hidden * dtype_bytes * 6
     return LayerSpec(name, float(params), float(flops), float(acts), count,
-                     attn=True)
+                     attn=True, kv_bytes=float(2 * tokens * hidden
+                                               * dtype_bytes))
 
 
 def mlp_layer_spec(hidden, seq, batch, ffn_mult=4, dtype_bytes=2,

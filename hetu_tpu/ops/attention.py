@@ -93,6 +93,18 @@ def _use_flash(q, k):
             and s_q % 128 == 0 and s_kv % 128 == 0)
 
 
+def _clipped_blocks(tag, q, k):
+    """Measured (block_q, block_k) for this (seq, tag), dropped when they
+    exceed or fail to divide the actual dims (the artifact measures square
+    (s, s) shapes; cross-attention must not inherit a bad block)."""
+    bq, bk = _FLASH_BLOCKS.get((q.shape[-2], tag), (None, None))
+    if bq is not None and (bq > q.shape[-2] or q.shape[-2] % bq):
+        bq = None
+    if bk is not None and (bk > k.shape[-2] or k.shape[-2] % bk):
+        bk = None
+    return bq, bk
+
+
 def dispatch_sdpa(q, k, v, causal=False, scale=None):
     """Backend-dispatched dense attention: the Pallas flash kernel when the
     empirical gate says it wins, XLA-composed otherwise.  The functional
@@ -100,15 +112,7 @@ def dispatch_sdpa(q, k, v, causal=False, scale=None):
     full-sequence local step, pipeline stages)."""
     if _use_flash(q, k):
         from .pallas.flash_attention import flash_attention
-        bq, bk = _FLASH_BLOCKS.get(
-            (q.shape[-2], "causal" if causal else "dense"), (None, None))
-        # the artifact measures square (s, s) shapes; cross-attention
-        # (s_q != s_kv) must not inherit a block that exceeds or fails to
-        # divide its own dims — fall back to the kernel's defaults
-        if bq is not None and (bq > q.shape[-2] or q.shape[-2] % bq):
-            bq = None
-        if bk is not None and (bk > k.shape[-2] or k.shape[-2] % bk):
-            bk = None
+        bq, bk = _clipped_blocks("causal" if causal else "dense", q, k)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=bq, block_k=bk)
     return sdpa_reference(q, k, v, causal=causal, scale=scale)
@@ -155,11 +159,7 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
         # the key-mask strip path (flagship) uses ITS OWN measured blocks
         bq, bk = (None, None)
         if km is not None and not causal:
-            bq, bk = _FLASH_BLOCKS.get((q.shape[-2], "kmask"), (None, None))
-            if bq is not None and (bq > q.shape[-2] or q.shape[-2] % bq):
-                bq = None
-            if bk is not None and (bk > k.shape[-2] or k.shape[-2] % bk):
-                bk = None
+            bq, bk = _clipped_blocks("kmask", q, k)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                key_mask=km, mask=fm, block_q=bq, block_k=bk)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)

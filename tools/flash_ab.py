@@ -121,17 +121,26 @@ def main():
 
 
 def _load_previous_rows(backend):
-    """Rows measured by an earlier (killed) sweep on the SAME backend —
-    restarting from scratch would re-lose them at the first persist."""
+    """Rows measured by an earlier KILLED sweep (partial=true) on the SAME
+    backend and measurement geometry — restarting from scratch would
+    re-lose them at the first persist.  Complete artifacts are never
+    resumed (a manual rerun means the caller wants fresh numbers), rows
+    from a different geometry or from a pre-kmask tool version (no
+    winner_kmask) are dropped so they get re-measured rather than
+    vacuously satisfying the both-must-win gate."""
     path = os.path.join(ROOT, "artifacts", "flash_ab.json")
     try:
         with open(path) as f:
             data = json.load(f)
-        if data.get("backend") == backend:
-            return dict(data.get("rows", {}))
     except (OSError, json.JSONDecodeError):
-        pass
-    return {}
+        return {}
+    if data.get("backend") != backend or not data.get("partial"):
+        return {}
+    if (data.get("heads"), data.get("head_dim"),
+            data.get("token_budget")) != (HEADS, HEAD_DIM, TOKEN_BUDGET):
+        return {}
+    return {seq: row for seq, row in data.get("rows", {}).items()
+            if "winner_kmask" in row}
 
 
 def _persist(backend, rows, partial):
@@ -148,8 +157,10 @@ def _persist(backend, rows, partial):
     # partial=false (ops/attention.py does).
     def _wins(s):
         row = rows[str(s)]
+        # an absent kmask measurement is NOT a win — the flagship path
+        # must be measured before the gate can claim flash wins it
         return row["winner_dense"] == "flash" \
-            and row.get("winner_kmask", "flash") == "flash"
+            and row.get("winner_kmask") == "flash"
     flash_min_len = None
     for i, seq in enumerate(measured):
         if all(_wins(s) for s in measured[i:]):
