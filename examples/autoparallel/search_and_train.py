@@ -1,0 +1,127 @@
+"""Auto-parallel workflow: profile -> search -> train.
+
+Reference: the Galvatron workflow (``tools/Galvatron/README.md:15-100`` —
+profile hardware, search a layerwise hybrid strategy, train with the
+emitted config).  Here the three phases are:
+
+1. profile  — ``calibrate_hardware()`` measures matmul FLOP/s + collective
+              bandwidths on THIS machine (or loads the committed
+              ``artifacts/tpu_calibration.json``);
+2. search   — layerwise DP over (pp, tp, dp, cp, fsdp) candidates under
+              the memory budget ('cp' is net-new vs Galvatron: sequence
+              sharding for long-context, small-batch workloads);
+3. train    — the plan's mesh axes + sharding directives drive a real
+              Executor run.
+
+    python examples/autoparallel/search_and_train.py               # BERT-ish
+    python examples/autoparallel/search_and_train.py --long-context  # cp demo
+    python examples/autoparallel/search_and_train.py --devices 16 --dry-run
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before backend init (train_lm.py pattern)
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8"
+                                   ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu.autoparallel.cost_model import (  # noqa: E402
+    HardwareSpec, model_layer_specs)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--hidden", type=int, default=768)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=30522)
+    p.add_argument("--mem-gb", type=float, default=16.0)
+    p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--long-context", action="store_true",
+                   help="batch-1 256k-token workload: demonstrates the cp "
+                        "axis (dp capped at the batch)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="measure hardware live instead of artifact/defaults")
+    p.add_argument("--dry-run", action="store_true",
+                   help="search + describe only, no training step")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    # -- 1. profile --------------------------------------------------------
+    if args.calibrate:
+        hw = HardwareSpec.measure()
+    else:
+        hw = HardwareSpec.from_artifact() or HardwareSpec()
+    hw.mem_bytes = args.mem_gb * 1e9
+    print(f"hardware: {hw.flops/1e12:.0f} TF/s, "
+          f"{hw.mem_bytes/1e9:.0f} GB, ici {hw.ici_bw/1e9:.1f} GB/s")
+
+    # -- 2. search ---------------------------------------------------------
+    if args.long_context:
+        plan, _ = ht.autoparallel.long_context_cp_plan(
+            args.devices, hw=hw, layers=args.layers, hidden=args.hidden)
+    else:
+        specs = model_layer_specs(args.layers, args.hidden, args.seq,
+                                  args.batch, args.vocab)
+        plan = ht.autoparallel.search(specs, n_devices=args.devices, hw=hw,
+                                      microbatches=args.microbatches,
+                                      uniform=True)
+    print(plan.describe())
+    if args.dry_run:
+        return 0
+
+    # -- 3. train (tiny stand-in model on the PLANNED mesh) ----------------
+    import jax
+    axes = plan.mesh_axes()
+    n_needed = 1
+    for v in axes.values():
+        n_needed *= v
+    if len(jax.devices()) < n_needed:
+        print(f"(only {len(jax.devices())} devices visible; "
+              f"skipping the training step — plan needs {n_needed})")
+        return 0
+    axes.setdefault("dp", 1)
+    if args.long_context:
+        from hetu_tpu.models.t5 import T5Config, t5_seq2seq_graph
+        from hetu_tpu.models import synthetic_seq2seq_batch
+        cfg = T5Config.tiny(batch_size=2 * axes["dp"], src_len=32,
+                            tgt_len=32, num_heads=4, dropout_rate=0.0,
+                            context_parallel="ring")
+        feeds, loss, _ = t5_seq2seq_graph(cfg)
+        src, tgt_in, labels = synthetic_seq2seq_batch(cfg)
+        fd_vals = {"input_ids": src, "decoder_input_ids": tgt_in,
+                   "labels": labels}
+    else:
+        from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,
+                                          synthetic_mlm_batch)
+        cfg = BertConfig.tiny(batch_size=4 * axes.get("dp", 1), seq_len=32)
+        feeds, loss, _ = bert_pretrain_graph(cfg)
+        ids, tt, labels, attn = synthetic_mlm_batch(cfg)
+        fd_vals = {"input_ids": ids, "token_type_ids": tt,
+                   "masked_lm_labels": labels, "attention_mask": attn}
+    mesh = ht.make_mesh(axes, jax.devices()[:n_needed])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(1e-3).minimize(loss)]},
+        seed=0, mesh=mesh, dist_strategy=ht.dist.ModelParallel(axes))
+    fd = {feeds[k]: v for k, v in fd_vals.items()}
+    for i in range(3):
+        out = ex.run("train", feed_dict=fd)
+        print(f"step {i}: loss {float(out[0].asnumpy()):.4f}")
+    print("trained on the searched mesh:", dict(mesh.shape))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

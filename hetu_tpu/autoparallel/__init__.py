@@ -158,8 +158,28 @@ def measure_overlap(mesh, axis, probe_bytes=1 << 22, matmul_dim=1024,
     return float(np.clip(hidden / denom, 0.0, 1.0))
 
 
+def long_context_cp_plan(n_devices, mem_bytes=2.5e9, hw=None, layers=4,
+                         hidden=512, seq=262144):
+    """The canonical long-context cp search: batch 1 caps dp, so only
+    sequence sharding can spread one sequence's activations — the regime
+    the cp axis exists for (shared by the dryrun config D and
+    examples/autoparallel/search_and_train.py --long-context so the two
+    demonstrations cannot drift)."""
+    from .cost_model import HardwareSpec, attention_layer_spec
+    from .search import search
+    if hw is None:
+        hw = HardwareSpec(mem_bytes=mem_bytes)
+    spec = attention_layer_spec(hidden=hidden, seq=seq, batch=1,
+                                count=layers)
+    plan = search([spec], n_devices=n_devices, hw=hw, allow_pp=False,
+                  max_tp=1, max_dp=1, allow_cp=True)
+    axes = plan.mesh_axes()
+    axes.setdefault("dp", 1)
+    return plan, axes
+
+
 __all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
-           "Strategy", "transformer_layer_spec", "attention_layer_spec",
+           "long_context_cp_plan", "Strategy", "transformer_layer_spec", "attention_layer_spec",
            "mlp_layer_spec", "embedding_layer_spec", "model_layer_specs",
            "DPAlg", "candidate_strategies", "search", "ParallelPlan",
            "calibrate_hardware", "measure_overlap"]

@@ -418,3 +418,47 @@ def test_cp_plan_executes_t5_end_to_end():
                                      feeds["decoder_input_ids"]: tgt_in,
                                      feeds["labels"]: labels})
     assert np.isfinite(float(out[0].asnumpy()))
+
+
+def test_flash_ab_resume_and_gate_rules(tmp_path, monkeypatch):
+    """Producer-side lifecycle rules of tools/flash_ab.py: complete or
+    geometry-mismatched or pre-kmask artifacts are never resumed, and the
+    gate requires a MEASURED kmask win (review findings)."""
+    import json
+    import sys
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    import tools.flash_ab as ab
+
+    monkeypatch.setattr(ab, "ROOT", str(tmp_path))
+    art_dir = tmp_path / "artifacts"
+    art_dir.mkdir()
+    path = art_dir / "flash_ab.json"
+    row = {"winner_dense": "flash", "winner_kmask": "flash",
+           "blocks_dense": [128, 128]}
+    base = {"backend": "cpu", "heads": ab.HEADS, "head_dim": ab.HEAD_DIM,
+            "token_budget": ab.TOKEN_BUDGET, "rows": {"128": row},
+            "partial": True, "flash_min_len": 128}
+
+    path.write_text(json.dumps(base))
+    assert ab._load_previous_rows("cpu") == {"128": row}   # resumable
+    assert ab._load_previous_rows("tpu") == {}             # other backend
+
+    complete = dict(base, partial=False)
+    path.write_text(json.dumps(complete))
+    assert ab._load_previous_rows("cpu") == {}     # complete: fresh rerun
+
+    wrong_geom = dict(base, token_budget=ab.TOKEN_BUDGET * 2)
+    path.write_text(json.dumps(wrong_geom))
+    assert ab._load_previous_rows("cpu") == {}     # geometry mismatch
+
+    old_tool = dict(base)
+    old_tool["rows"] = {"128": {"winner_dense": "flash"}}  # pre-kmask row
+    path.write_text(json.dumps(old_tool))
+    assert ab._load_previous_rows("cpu") == {}     # must re-measure
+
+    # gate: an unmeasured kmask case is NOT a win
+    out = ab._persist("cpu", {"128": {"winner_dense": "flash"}}, False)
+    assert out["flash_min_len"] == ab.SEQS[-1] * 2        # sentinel
+    out = ab._persist("cpu", {"128": row}, False)
+    assert out["flash_min_len"] == 128

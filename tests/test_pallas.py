@@ -413,16 +413,24 @@ def test_flash_gate_artifact_loading(tmp_path, monkeypatch):
     art = {"backend": "tpu", "flash_min_len": 128, "rows": {
         "128": {"blocks_dense": [128, 128], "winner_dense": "flash"},
         "512": {"blocks_dense": [128, 256], "blocks_causal": [256, 128],
-                "winner_dense": "flash"}}}
+                "blocks_kmask": [256, 256], "winner_dense": "flash"}}}
     d = tmp_path / "artifacts"
     d.mkdir()
     (d / "flash_ab.json").write_text(json.dumps(art))
     monkeypatch.setenv("HETU_FLASH_AB_PATH", str(d / "flash_ab.json"))
     gate, blocks = att._load_flash_gate()
     assert gate == 128
-    assert blocks[(512, False)] == (128, 256)
-    assert blocks[(512, True)] == (256, 128)
-    assert blocks[(128, False)] == (128, 128)
+    assert blocks[(512, "dense")] == (128, 256)
+    assert blocks[(512, "causal")] == (256, 128)
+    assert blocks[(512, "kmask")] == (256, 256)
+    assert blocks[(128, "dense")] == (128, 128)
+
+    # a PARTIAL artifact serves blocks but never its prefix-only gate
+    art["partial"] = True
+    (d / "flash_ab.json").write_text(json.dumps(art))
+    gate, blocks = att._load_flash_gate(default=256)
+    assert gate == 256                       # default kept
+    assert blocks[(512, "kmask")] == (256, 256)
 
 
 @pytest.mark.parametrize("bias_shape,causal", [
