@@ -1,0 +1,135 @@
+"""On-chip parity check of EVERY flash-kernel specialization + gradients.
+
+Interpret-mode tests cannot catch Mosaic lowering violations (the round-4
+lesson: every BlockSpec was hardware-invalid through two rounds of green
+CPU suites).  This tool runs each specialization — dense, causal, lengths,
+key_mask, full-mask, dense bias, key bias, and combinations — forward AND
+backward on the real chip against the jnp reference, and writes
+``artifacts/kernel_check.json``.  Run by tools/tpu_watch.py when the
+tunnel is healthy.
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+B = int(os.environ.get("HETU_KC_B", "2"))
+H = int(os.environ.get("HETU_KC_H", "4"))
+S = int(os.environ.get("HETU_KC_S", "256"))   # smoke on CPU: 128 (slow
+D = int(os.environ.get("HETU_KC_D", "64"))    # pallas interpreter)
+TOL = 2e-2      # bf16-free fp32 path on MXU: ~1e-3 observed; 2e-2 margin
+
+
+def main():
+    import jax
+    if os.environ.get("_HETU_KC_ALLOW_CPU"):
+        # CPU smoke: force the platform BEFORE the first backend query —
+        # a wedged axon tunnel hangs inside jax.default_backend()
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hetu_tpu.ops.attention import sdpa_reference
+    from hetu_tpu.ops.pallas.flash_attention import flash_attention
+
+    backend = jax.default_backend()
+    if backend != "tpu" and not os.environ.get("_HETU_KC_ALLOW_CPU"):
+        print("refusing kernel check off-TPU (set _HETU_KC_ALLOW_CPU=1)",
+              file=sys.stderr)
+        return 1
+    interpret = backend != "tpu"
+    rng = np.random.RandomState(0)
+    q, k, v = [jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+               for _ in range(3)]
+    lengths = jnp.asarray(rng.randint(S // 4, S + 1, B), jnp.int32)
+    km = jnp.asarray(rng.rand(B, S) > 0.3)
+    fm = jnp.asarray(rng.rand(1, 1, S, S) > 0.3)
+    bias = jnp.asarray(rng.randn(1, H, S, S), jnp.float32)
+    kbias = jnp.asarray(rng.randn(B, 1, 1, S), jnp.float32)
+    cols = jnp.arange(S)[None, None, None, :]
+    lmask = cols < lengths[:, None, None, None]
+
+    cases = {
+        "dense": ({}, {}),
+        "causal": ({"causal": True}, {"causal": True}),
+        "lengths": ({"lengths": lengths}, {"mask": lmask}),
+        "key_mask": ({"key_mask": km}, {"mask": km[:, None, None, :]}),
+        "full_mask": ({"mask": fm}, {"mask": fm}),
+        "bias": ({"bias": bias}, {"bias": bias}),
+        "key_bias": ({"bias": kbias}, {"bias": kbias}),
+        "causal_lengths_kmask": (
+            {"causal": True, "lengths": lengths, "key_mask": km},
+            {"causal": True,
+             "mask": jnp.logical_and(lmask, km[:, None, None, :])}),
+    }
+    only = os.environ.get("HETU_KC_CASES")
+    if only:    # CPU smoke: the pallas interpreter is ~100x slower than
+        cases = {k: v for k, v in cases.items()   # Mosaic — subset cases
+                 if k in only.split(",")}
+        if not cases:
+            print(f"HETU_KC_CASES={only!r} matches no case", file=sys.stderr)
+            return 1    # a vacuous green artifact would mask the typo
+    results = {}
+    ok_all = True
+    for name, (fkw, rkw) in cases.items():
+        entry = {}
+        try:
+            t0 = time.perf_counter()
+            out = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, interpret=interpret, **fkw))(q, k, v)
+            ref = sdpa_reference(q, k, v, **rkw)
+            entry["fwd_maxerr"] = float(jnp.max(jnp.abs(out - ref)))
+
+            diff_args = (0, 1, 2) + ((3,) if "bias" in fkw else ())
+            ins = (q, k, v) + ((fkw["bias"],) if "bias" in fkw else ())
+
+            def f(*a):
+                kw = dict(fkw)
+                if "bias" in kw:
+                    kw["bias"] = a[3]
+                return flash_attention(a[0], a[1], a[2],
+                                       interpret=interpret, **kw).sum()
+
+            def fr(*a):
+                kw = dict(rkw)
+                if "bias" in kw:
+                    kw["bias"] = a[3]
+                return sdpa_reference(a[0], a[1], a[2], **kw).sum()
+
+            g = jax.jit(jax.grad(f, argnums=diff_args))(*ins)
+            gr = jax.jit(jax.grad(fr, argnums=diff_args))(*ins)
+            entry["grad_maxerr"] = max(
+                float(jnp.max(jnp.abs(a - b))) for a, b in zip(g, gr))
+            entry["wall_s"] = round(time.perf_counter() - t0, 2)
+            entry["ok"] = (entry["fwd_maxerr"] < TOL
+                           and entry["grad_maxerr"] < TOL)
+        except Exception as e:
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+        ok_all = ok_all and entry["ok"]
+        results[name] = entry
+        print(f"{name}: {entry}", flush=True)
+
+    out = {"backend": backend,
+           "device_kind": jax.devices()[0].device_kind,
+           "shape": [B, H, S, D], "tol": TOL,
+           "cases": results, "ok": ok_all,
+           # a failing check must be re-run at the next window: partial is
+           # the watcher's "not complete" marker (_artifact_valid), so a
+           # red artifact never short-circuits the retry as "present"
+           "partial": not ok_all}
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    path = os.path.join(ROOT, "artifacts", "kernel_check.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps({"ok": ok_all}))
+    return 0 if ok_all else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,12 +32,18 @@ CHILD_TIMEOUT_S = int(os.environ.get("HETU_WATCH_CHILD_TIMEOUT", "600"))
 PROBE_TIMEOUT_S = int(os.environ.get("HETU_WATCH_PROBE_TIMEOUT", "75"))
 # extra one-shot measurement jobs (flash A/B, hardware calibration) run
 # after the bench configs; each writes its own artifact file
+# (name, cmd, artifact, pre): pre-jobs run BEFORE the bench configs —
+# kernel_check diagnoses a specialization that fails to lower on this chip
+# before any bench/A/B number builds on it
 EXTRA_JOBS = (
+    ("kernel_check",
+     [sys.executable, os.path.join(ROOT, "tools", "tpu_kernel_check.py")],
+     os.path.join(ROOT, "artifacts", "kernel_check.json"), True),
     ("flash_ab", [sys.executable, os.path.join(ROOT, "tools", "flash_ab.py")],
-     os.path.join(ROOT, "artifacts", "flash_ab.json")),
+     os.path.join(ROOT, "artifacts", "flash_ab.json"), False),
     ("calibration",
      [sys.executable, os.path.join(ROOT, "tools", "calibrate_tpu.py")],
-     os.path.join(ROOT, "artifacts", "tpu_calibration.json")),
+     os.path.join(ROOT, "artifacts", "tpu_calibration.json"), False),
 )
 
 
@@ -127,7 +133,7 @@ def main():
     while time.monotonic() < deadline:
         cache = _load_cache()
         todo = [c for c in CONFIGS if c not in cache["configs"]]
-        jobs_todo = [(n, c, a) for n, c, a in EXTRA_JOBS
+        jobs_todo = [(n, c, a, pre) for n, c, a, pre in EXTRA_JOBS
                      if not (cache.get("jobs", {}).get(n, {}).get("ok")
                              and _artifact_valid(a))
                      and os.path.exists(c[1])]
@@ -149,6 +155,22 @@ def main():
             continue
         print(f"watch: tunnel LIVE; measuring {todo + [j[0] for j in jobs_todo]}",
               flush=True)
+
+        def _run_jobs(jobs):
+            for name, cmd, artifact, _pre in jobs:
+                if _contending():
+                    return
+                ok, info = _run_extra(name, cmd, artifact)
+                cache = _load_cache()
+                cache.setdefault("jobs", {})[name] = {
+                    "ok": ok, "info": info,
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+                _save_cache(cache)
+                print(f"watch: job {name}: ok={ok} {info}", flush=True)
+
+        # pre-jobs (kernel_check) land their diagnosis before any bench
+        # number is measured on this chip
+        _run_jobs([j for j in jobs_todo if j[3]])
         for config in todo:
             if _contending():
                 break
@@ -163,16 +185,7 @@ def main():
             _save_cache(cache)
             print(f"watch: {config}: ok {res['value']} {res['unit']}",
                   flush=True)
-        for name, cmd, artifact in jobs_todo:
-            if _contending():
-                break
-            ok, info = _run_extra(name, cmd, artifact)
-            cache = _load_cache()
-            cache.setdefault("jobs", {})[name] = {
-                "ok": ok, "info": info,
-                "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
-            _save_cache(cache)
-            print(f"watch: job {name}: ok={ok} {info}", flush=True)
+        _run_jobs([j for j in jobs_todo if not j[3]])
         if args.once:
             return 0
         time.sleep(10)
