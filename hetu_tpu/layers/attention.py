@@ -46,18 +46,17 @@ class MultiHeadAttention(BaseLayer):
         ``kv``: optional (batch*kv_seq, hidden) memory for cross-attention
         (encoder-decoder); ``mask``: optional key-validity mask node
         broadcastable to (B, H, S_q, S_k) — a (B, 1, 1, S_k) padding mask
-        rides the flash kernel's O(S) key-mask strip path; ``bias``:
-        optional additive logit bias node (T5 relative position bias),
-        broadcastable to (B, H, S_q, S_k).
+        rides the flash kernel's O(S) key-mask strip path, and under
+        context parallelism shards over the ring/ulysses schedule (full
+        per-query masks do not and raise); ``bias``: optional additive
+        logit bias node (T5 relative position bias), broadcastable to
+        (B, H, S_q, S_k).
         """
         from ..ops.attention import (ring_attention_op, ulysses_attention_op,
+                                     ring_attention_masked_op,
+                                     ulysses_attention_masked_op,
                                      sdpa_bias_op, sdpa_masked_op,
                                      sdpa_masked_bias_op)
-        if mask is not None and self.context_parallel is not None:
-            raise NotImplementedError(
-                "attention mask is not threaded through the ring/ulysses "
-                "context-parallel paths yet (additive bias is — route "
-                "padding through the loss mask, or run without cp)")
         kv = x if kv is None else kv
         kv_seq = seq if kv_seq is None else kv_seq
         q = self._split(self.q(x), batch, seq)
@@ -65,14 +64,26 @@ class MultiHeadAttention(BaseLayer):
         v = self._split(self.v(kv), batch, kv_seq)
         cp_attn = {"ring": ring_attention_op,
                    "ulysses": ulysses_attention_op}.get(self.context_parallel)
+        cp_masked = {"ring": ring_attention_masked_op,
+                     "ulysses": ulysses_attention_masked_op
+                     }.get(self.context_parallel)
         if self.context_parallel is not None and cp_attn is None:
             raise ValueError(
                 f"unknown context_parallel mode {self.context_parallel!r}")
-        if mask is not None and bias is not None:
-            o = sdpa_masked_bias_op(q, k, v, mask, bias, causal=self.causal,
-                                    scale=scale)
-        elif mask is not None:
-            o = sdpa_masked_op(q, k, v, mask, causal=self.causal, scale=scale)
+        if mask is not None:
+            if cp_masked is not None:
+                # key-padding masks (and optional bias) shard over the
+                # cp schedule; full per-query masks raise inside the op
+                o = (cp_masked(q, k, v, mask, bias, causal=self.causal,
+                               scale=scale) if bias is not None else
+                     cp_masked(q, k, v, mask, causal=self.causal,
+                               scale=scale))
+            elif bias is not None:
+                o = sdpa_masked_bias_op(q, k, v, mask, bias,
+                                        causal=self.causal, scale=scale)
+            else:
+                o = sdpa_masked_op(q, k, v, mask, causal=self.causal,
+                                   scale=scale)
         elif bias is not None:
             # T5 + context parallelism: the bias node becomes the schedule's
             # 4th input (ring-sliced / head-sharded)

@@ -152,7 +152,9 @@ def _flash_maskable(q, k, mask):
         and mask.shape[2] in (1, q.shape[2]) and mask.shape[3] == k.shape[2]
 
 
-def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
+def dispatch_sdpa_masked(q, k, v, mask, causal=False, scale=None):
+    """Backend-dispatched masked attention (functional entry — Ulysses'
+    full-sequence local step with a padding mask)."""
     if _flash_maskable(q, k, mask):
         from .pallas.flash_attention import flash_attention
         km, fm = _split_mask_kinds(mask, q)
@@ -163,6 +165,10 @@ def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                key_mask=km, mask=fm, block_q=bq, block_k=bk)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
+
+
+def _sdpa_masked(c, q, k, v, mask, causal=False, scale=None):
+    return dispatch_sdpa_masked(q, k, v, mask, causal=causal, scale=scale)
 
 
 sdpa_masked_op = def_op("ScaledDotProductAttentionMasked", _sdpa_masked)
@@ -187,8 +193,10 @@ def _sdpa_bias(c, q, k, v, bias, causal=False, scale=None):
 sdpa_bias_op = def_op("ScaledDotProductAttentionBias", _sdpa_bias)
 
 
-def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
-    """Masked attention with an additive bias (XLNet two-stream layers)."""
+def dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=False,
+                              scale=None):
+    """Backend-dispatched masked+biased attention (functional entry —
+    the non-cp fallbacks of the masked CP ops and Ulysses' local step)."""
     if _flash_maskable(q, k, mask) and _flash_maskable(q, k, bias):
         from .pallas.flash_attention import flash_attention
         km, fm = _split_mask_kinds(mask, q)
@@ -196,6 +204,12 @@ def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
                                key_mask=km, mask=fm, bias=bias)
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask,
                           bias=bias)
+
+
+def _sdpa_masked_bias(c, q, k, v, mask, bias, causal=False, scale=None):
+    """Masked attention with an additive bias (XLNet two-stream layers)."""
+    return dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=causal,
+                                     scale=scale)
 
 
 sdpa_masked_bias_op = def_op("ScaledDotProductAttentionMaskedBias",
@@ -256,3 +270,51 @@ def _ulysses_attention(c, q, k, v, bias=None, causal=False, scale=None):
 
 
 ulysses_attention_op = def_op("UlyssesAttention", _ulysses_attention)
+
+
+def _key_type(mask):
+    """CP schedules support KEY-type masks only ((B|1, 1, 1, S_kv) —
+    validity does not vary per query); anything else must raise loudly."""
+    if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
+        raise NotImplementedError(
+            f"context-parallel attention supports key-padding masks "
+            f"(B, 1, 1, S_kv); got {mask.shape} — full per-query masks "
+            f"do not shard over the ring")
+    return mask
+
+
+def _ring_attention_masked(c, q, k, v, mask, bias=None, causal=False,
+                           scale=None):
+    """Ring attention with a key-padding mask (padded pretraining through
+    cp); optional additive bias rides the same ring slicing."""
+    if _has_cp(c.mesh):
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, c.mesh, bias=bias,
+                              key_mask=_key_type(mask), causal=causal,
+                              scale=scale)
+    if bias is not None:
+        return dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=causal,
+                                         scale=scale)
+    return dispatch_sdpa_masked(q, k, v, mask, causal=causal, scale=scale)
+
+
+ring_attention_masked_op = def_op("RingAttentionMasked",
+                                  _ring_attention_masked)
+
+
+def _ulysses_attention_masked(c, q, k, v, mask, bias=None, causal=False,
+                              scale=None):
+    """Ulysses attention with a key-padding mask."""
+    if _has_cp(c.mesh):
+        from ..parallel.ring_attention import ulysses_attention
+        return ulysses_attention(q, k, v, c.mesh, bias=bias,
+                                 key_mask=_key_type(mask), causal=causal,
+                                 scale=scale)
+    if bias is not None:
+        return dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=causal,
+                                         scale=scale)
+    return dispatch_sdpa_masked(q, k, v, mask, causal=causal, scale=scale)
+
+
+ulysses_attention_masked_op = def_op("UlyssesAttentionMasked",
+                                     _ulysses_attention_masked)

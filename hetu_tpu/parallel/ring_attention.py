@@ -31,8 +31,8 @@ from .strategies import Strategy
 _NEG_INF = -1e30  # finite: keeps exp(m - m_new) well-defined on masked rows
 
 
-def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
-                         scale=None):
+def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
+                         causal=False, scale=None):
     """Online-softmax ring attention — call INSIDE shard_map over ``cp``.
 
     q, k, v: local chunks [B, H, Sc, D] (sequence dim sharded over the ring).
@@ -41,6 +41,9 @@ def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
     ring step slices the resident chunk's columns (T5 relative position
     bias through context parallelism).  Differentiable: the scan transposes
     to a reverse ring, so dbias flows back automatically.
+    ``key_mask``: optional [1|B, S_kv] key-validity flags, kept FULL locally
+    and column-sliced per ring step (padded pretraining through cp; rows
+    with no valid key yield zero output via the l==0 guard below).
     Returns the local output chunk [B, H, Sc, D].
     """
     import jax
@@ -53,6 +56,7 @@ def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
     sc = scale if scale is not None else 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32) * sc
     bias_f = None if bias is None else bias.astype(jnp.float32)
+    km = None if key_mask is None else (key_mask != 0)
 
     q_pos = r * Sc + jnp.arange(Sc)
 
@@ -63,13 +67,25 @@ def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
         if bias_f is not None:
             logits = logits + lax.dynamic_slice_in_dim(
                 bias_f, src * Sc, Sc, axis=3)
+        valid = None
+        if km is not None:
+            cols = lax.dynamic_slice_in_dim(km, src * Sc, Sc, axis=1)
+            valid = jnp.broadcast_to(cols[:, None, None, :], logits.shape)
         if causal:
             k_pos = src * Sc + jnp.arange(Sc)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask, logits, _NEG_INF)
+            cmask = jnp.broadcast_to(q_pos[:, None] >= k_pos[None, :],
+                                     logits.shape)
+            valid = cmask if valid is None else jnp.logical_and(valid, cmask)
+        if valid is not None:
+            logits = jnp.where(valid, logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(logits - m_new[..., None])
+        if valid is not None:
+            # an all-masked chunk before any valid one has m == m_new ==
+            # _NEG_INF, where exp(logits - m_new) == 1 would leak a uniform
+            # average of the value vectors (kernel-side guard mirrored)
+            p = p * valid
         l = l * alpha + p.sum(-1)
         o = o * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
@@ -88,14 +104,18 @@ def ring_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ulysses_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
-                            scale=None, attn_fn=None):
+def ulysses_attention_local(q, k, v, bias=None, key_mask=None,
+                            axis_name="cp", causal=False, scale=None,
+                            attn_fn=None):
     """Ulysses head/sequence all-to-all attention — INSIDE shard_map.
 
     q, k, v: local chunks [B, H, Sc, D]; H must divide by the ``cp`` size.
     ``bias``: optional additive logit bias [1|B, Hc|1, S, S] — already the
     LOCAL head block (the jit entry shards a multi-head bias over 'cp',
     matching the contiguous head blocks ``all_to_all`` deals out).
+    ``key_mask``: optional [1|B, S_kv] key-validity flags (head-independent,
+    so the a2a does not touch them) — applied on the full-sequence local
+    attention (padded pretraining through cp).
     """
     import jax.numpy as jnp
     from jax import lax
@@ -111,13 +131,24 @@ def ulysses_attention_local(q, k, v, bias=None, axis_name="cp", causal=False,
         # after the a2a each device holds the FULL sequence for its head
         # subset — exactly the shape where the flash kernel pays off, so
         # route through the backend dispatcher (reference path on CPU)
-        from ..ops.attention import dispatch_sdpa, dispatch_sdpa_bias
-        if bias is None:
-            attn_fn = functools.partial(dispatch_sdpa, causal=causal,
-                                        scale=scale)
-        else:
+        from ..ops.attention import (dispatch_sdpa, dispatch_sdpa_bias,
+                                     dispatch_sdpa_masked,
+                                     dispatch_sdpa_masked_bias)
+        if key_mask is not None:
+            mask4 = key_mask[:, None, None, :]
+            if bias is not None:
+                attn_fn = functools.partial(dispatch_sdpa_masked_bias,
+                                            mask=mask4, bias=bias,
+                                            causal=causal, scale=scale)
+            else:
+                attn_fn = functools.partial(dispatch_sdpa_masked, mask=mask4,
+                                            causal=causal, scale=scale)
+        elif bias is not None:
             attn_fn = functools.partial(dispatch_sdpa_bias, bias=bias,
                                         causal=causal, scale=scale)
+        else:
+            attn_fn = functools.partial(dispatch_sdpa, causal=causal,
+                                        scale=scale)
     oh = attn_fn(qh, kh, vh)
     # inverse: [B, H/cp, S, D] → [B, H, Sc, D]
     return lax.all_to_all(oh, axis_name=axis_name, split_axis=2,
@@ -130,55 +161,94 @@ def _cp_spec(mesh, batch_axis="dp"):
     return P(dp, None, "cp", None)
 
 
-def ring_attention(q, k, v, mesh, bias=None, axis_name="cp", causal=False,
-                   scale=None, batch_axis="dp"):
+def _norm_key_mask(key_mask, s_kv):
+    """Accept (B|1, S_kv) or the (B|1, 1, 1, S_kv) attention-mask
+    convention → (B|1, S_kv)."""
+    import jax.numpy as jnp
+    km = jnp.asarray(key_mask)
+    if km.ndim == 4:
+        km = km.reshape(km.shape[0], km.shape[-1])
+    if km.ndim != 2 or km.shape[-1] != s_kv:
+        raise ValueError(f"key_mask must be (B, {s_kv}), got "
+                         f"{key_mask.shape}")
+    return km
+
+
+def ring_attention(q, k, v, mesh, bias=None, key_mask=None, axis_name="cp",
+                   causal=False, scale=None, batch_axis="dp"):
     """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
 
     ``bias``: optional [1|B, 1|H, S|1, S] additive bias — its query dim
-    rides the ring shards, the key dim stays full (sliced per ring step)."""
+    rides the ring shards, the key dim stays full (sliced per ring step).
+    ``key_mask``: optional (B|1, S) or (B|1, 1, 1, S) key-validity flags —
+    kept full locally, column-sliced per ring step."""
     import jax
     from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
-    fn = functools.partial(ring_attention_local, axis_name=axis_name,
-                           causal=causal, scale=scale)
-    if bias is None:
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
-    # a batched bias must follow q/k/v's batch sharding, or local shapes
+    # batched extras must follow q/k/v's batch sharding, or local shapes
     # mismatch on a dp x cp mesh; broadcast dims stay replicated
     dp = batch_axis if batch_axis in mesh.axis_names else None
-    bspec = P(dp if bias.shape[0] > 1 else None, None,
-              "cp" if bias.shape[2] > 1 else None, None)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, bspec),
-                         out_specs=spec, check_vma=False)(q, k, v, bias)
+    args, in_specs, keys = [q, k, v], [spec, spec, spec], []
+    if bias is not None:
+        args.append(bias)
+        in_specs.append(P(dp if bias.shape[0] > 1 else None, None,
+                          "cp" if bias.shape[2] > 1 else None, None))
+        keys.append("bias")
+    if key_mask is not None:
+        km = _norm_key_mask(key_mask, k.shape[2])
+        args.append(km)
+        in_specs.append(P(dp if km.shape[0] > 1 else None, None))
+        keys.append("key_mask")
+
+    def fn(q, k, v, *extras):
+        kw = dict(zip(keys, extras))
+        return ring_attention_local(q, k, v, axis_name=axis_name,
+                                    causal=causal, scale=scale, **kw)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=spec, check_vma=False)(*args)
 
 
-def ulysses_attention(q, k, v, mesh, bias=None, axis_name="cp", causal=False,
-                      scale=None, batch_axis="dp"):
+def ulysses_attention(q, k, v, mesh, bias=None, key_mask=None,
+                      axis_name="cp", causal=False, scale=None,
+                      batch_axis="dp"):
     """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
 
     ``bias``: optional [1|B, H|1, S, S] — a multi-head bias shards its head
-    dim over 'cp' (matching all_to_all's contiguous head blocks)."""
+    dim over 'cp' (matching all_to_all's contiguous head blocks).
+    ``key_mask``: optional (B|1, S) or (B|1, 1, 1, S) — head-independent,
+    applied after the a2a on the full sequence."""
     import jax
     from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
-    fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
-                           causal=causal, scale=scale)
-    if bias is None:
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
     dp = batch_axis if batch_axis in mesh.axis_names else None
-    b0 = dp if bias.shape[0] > 1 else None     # follow q/k/v batch sharding
-    if bias.shape[1] == 1:
-        bspec = P(b0, None, None, None)
-    elif bias.shape[1] % mesh.shape[axis_name] == 0:
-        bspec = P(b0, "cp", None, None)
-    else:
-        raise ValueError(
-            f"ulysses bias heads {bias.shape[1]} not divisible by "
-            f"cp={mesh.shape[axis_name]}")
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, bspec),
-                         out_specs=spec, check_vma=False)(q, k, v, bias)
+    args, in_specs, keys = [q, k, v], [spec, spec, spec], []
+    if bias is not None:
+        b0 = dp if bias.shape[0] > 1 else None  # follow q/k/v batch shard
+        if bias.shape[1] == 1:
+            bspec = P(b0, None, None, None)
+        elif bias.shape[1] % mesh.shape[axis_name] == 0:
+            bspec = P(b0, "cp", None, None)
+        else:
+            raise ValueError(
+                f"ulysses bias heads {bias.shape[1]} not divisible by "
+                f"cp={mesh.shape[axis_name]}")
+        args.append(bias)
+        in_specs.append(bspec)
+        keys.append("bias")
+    if key_mask is not None:
+        km = _norm_key_mask(key_mask, k.shape[2])
+        args.append(km)
+        in_specs.append(P(dp if km.shape[0] > 1 else None, None))
+        keys.append("key_mask")
+
+    def fn(q, k, v, *extras):
+        kw = dict(zip(keys, extras))
+        return ulysses_attention_local(q, k, v, axis_name=axis_name,
+                                       causal=causal, scale=scale, **kw)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=spec, check_vma=False)(*args)
 
 
 class ContextParallel(Strategy):
