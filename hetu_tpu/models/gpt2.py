@@ -96,8 +96,10 @@ def gpt2_lm_graph(cfg, name="gpt2"):
     with -1 at padded positions (ignored).
     """
     shape = (cfg.batch_size, cfg.seq_len)
-    input_ids = placeholder_op("input_ids", shape=shape)
-    labels = placeholder_op("labels", shape=shape)
+    # int32: fp32 id feeds would ride the bf16 compute_dtype cast (exact
+    # only up to 256 — silent corruption for any real vocab)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    labels = placeholder_op("labels", shape=shape, dtype=np.int32)
     hidden = gpt2_model(cfg, input_ids, name)
     logits = Linear(cfg.n_embd, cfg.vocab_size,
                     initializer=init.GenTruncatedNormal(0.0, 0.02),

@@ -107,8 +107,10 @@ def _ffn(cfg, x, name):
                   name=name + ".wo")(h)
 
 
-def t5_encoder(cfg, x_embed, name="t5.encoder"):
-    """x_embed: (batch*src_len, d_model); returns same shape."""
+def t5_encoder(cfg, x_embed, mask=None, name="t5.encoder"):
+    """x_embed: (batch*src_len, d_model); returns same shape.
+    ``mask``: optional (B, 1, 1, src_len) key-padding mask node — composes
+    with the relative-position bias (and with context parallelism)."""
     bias = _relpos_bias(cfg, cfg.src_len, cfg.src_len, True,
                         name + ".relpos")
     x = x_embed
@@ -118,15 +120,18 @@ def t5_encoder(cfg, x_embed, name="t5.encoder"):
         mha = MultiHeadAttention(cfg.d_model, cfg.num_heads,
                                  context_parallel=cfg.context_parallel,
                                  name=ln + ".attn")
-        x = x + mha(h, cfg.batch_size, cfg.src_len, bias=bias, scale=1.0)
+        x = x + mha(h, cfg.batch_size, cfg.src_len, mask=mask, bias=bias,
+                    scale=1.0)
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln2")(x)
         x = x + ops.dropout_op(_ffn(cfg, h, ln + ".ffn"),
                                1.0 - cfg.dropout_rate)
     return RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, name + ".ln_f")(x)
 
 
-def t5_decoder(cfg, y_embed, memory, name="t5.decoder"):
-    """y_embed: (batch*tgt_len, d_model); memory: encoder output."""
+def t5_decoder(cfg, y_embed, memory, mem_mask=None, name="t5.decoder"):
+    """y_embed: (batch*tgt_len, d_model); memory: encoder output.
+    ``mem_mask``: optional (B, 1, 1, src_len) padding mask over the
+    encoder memory keys (cross-attention must not attend to PAD)."""
     self_bias = _relpos_bias(cfg, cfg.tgt_len, cfg.tgt_len, False,
                              name + ".relpos")
     x = y_embed
@@ -143,22 +148,39 @@ def t5_decoder(cfg, y_embed, memory, name="t5.decoder"):
         cross = MultiHeadAttention(cfg.d_model, cfg.num_heads,
                                    name=ln + ".cross")
         x = x + cross(h, cfg.batch_size, cfg.tgt_len, kv=memory,
-                      kv_seq=cfg.src_len, scale=1.0)
+                      kv_seq=cfg.src_len, mask=mem_mask, scale=1.0)
         h = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, ln + ".ln3")(x)
         x = x + ops.dropout_op(_ffn(cfg, h, ln + ".ffn"),
                                1.0 - cfg.dropout_rate)
     return RMSNorm(cfg.d_model, cfg.layer_norm_epsilon, name + ".ln_f")(x)
 
 
-def t5_seq2seq_graph(cfg, name="t5"):
+def t5_seq2seq_graph(cfg, name="t5", use_mask=False):
     """Teacher-forced seq2seq training graph.
 
     Returns (feeds dict, loss node, logits node).
+    ``use_mask=True`` adds an ``attention_mask`` (B, src_len) input
+    (reference T5 takes attention_mask) threaded through encoder
+    self-attention AND decoder cross-attention — padded sources stop
+    leaking into the memory the decoder reads.  Opt-in: the dense default
+    keeps existing callers/benches unchanged.
     """
-    src = placeholder_op("input_ids", shape=(cfg.batch_size, cfg.src_len))
+    # int32 ids/labels: fp32 feeds would ride the compute_dtype bf16 cast,
+    # which corrupts token ids > 256 (bert.py precedent)
+    src = placeholder_op("input_ids", shape=(cfg.batch_size, cfg.src_len),
+                         dtype=np.int32)
     tgt_in = placeholder_op("decoder_input_ids",
-                            shape=(cfg.batch_size, cfg.tgt_len))
-    labels = placeholder_op("labels", shape=(cfg.batch_size, cfg.tgt_len))
+                            shape=(cfg.batch_size, cfg.tgt_len),
+                            dtype=np.int32)
+    labels = placeholder_op("labels", shape=(cfg.batch_size, cfg.tgt_len),
+                            dtype=np.int32)
+    mask = None
+    if use_mask:
+        attention_mask = placeholder_op(
+            "attention_mask", shape=(cfg.batch_size, cfg.src_len),
+            dtype=np.int32)
+        mask = ops.array_reshape_op(
+            attention_mask, output_shape=(cfg.batch_size, 1, 1, cfg.src_len))
 
     shared = init.truncated_normal((cfg.vocab_size, cfg.d_model), 0.0, 0.02,
                                    name=name + ".shared_embed")
@@ -168,8 +190,9 @@ def t5_seq2seq_graph(cfg, name="t5"):
     tgt_e = ops.array_reshape_op(
         ops.embedding_lookup_op(shared, tgt_in),
         output_shape=(cfg.batch_size * cfg.tgt_len, cfg.d_model))
-    mem = t5_encoder(cfg, src_e, name + ".encoder")
-    dec = t5_decoder(cfg, tgt_e, mem, name + ".decoder")
+    mem = t5_encoder(cfg, src_e, mask=mask, name=name + ".encoder")
+    dec = t5_decoder(cfg, tgt_e, mem, mem_mask=mask,
+                     name=name + ".decoder")
     # T5 scales decoder output by d_model^-0.5 before the (untied) lm head
     dec = dec * float(cfg.d_model) ** -0.5
     logits = Linear(cfg.d_model, cfg.vocab_size, bias=False,
@@ -178,12 +201,23 @@ def t5_seq2seq_graph(cfg, name="t5"):
     from .common import masked_lm_loss
     loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.tgt_len)
     feeds = {"input_ids": src, "decoder_input_ids": tgt_in, "labels": labels}
+    if use_mask:
+        feeds["attention_mask"] = attention_mask
     return feeds, loss, logits
 
 
-def synthetic_seq2seq_batch(cfg, seed=0):
+def synthetic_seq2seq_batch(cfg, seed=0, padded=False):
+    """``padded=True`` additionally returns an attention_mask with a
+    padded source-length distribution (PAD id 0 beyond each length)."""
     rng = np.random.RandomState(seed)
     src = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.src_len))
     tgt = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.tgt_len + 1))
-    return (src.astype(np.float32), tgt[:, :-1].astype(np.float32),
-            tgt[:, 1:].astype(np.float32))
+    if not padded:
+        return (src.astype(np.int32), tgt[:, :-1].astype(np.int32),
+                tgt[:, 1:].astype(np.int32))
+    lengths = rng.randint(max(1, cfg.src_len // 4), cfg.src_len + 1,
+                          cfg.batch_size)
+    attn = (np.arange(cfg.src_len)[None, :] < lengths[:, None])
+    src[~attn] = 0
+    return (src.astype(np.int32), tgt[:, :-1].astype(np.int32),
+            tgt[:, 1:].astype(np.int32), attn.astype(np.int32))

@@ -63,9 +63,13 @@ def _ffn(cfg, x, name):
 
 def transformer_graph(cfg, name="transformer"):
     """Seq2seq training graph. Returns (feeds, loss, logits)."""
-    src = placeholder_op("src_ids", shape=(cfg.batch_size, cfg.src_len))
-    tgt_in = placeholder_op("tgt_ids", shape=(cfg.batch_size, cfg.tgt_len))
-    labels = placeholder_op("labels", shape=(cfg.batch_size, cfg.tgt_len))
+    # int32 ids/labels (see bert.py: fp32 feeds ride the bf16 cast)
+    src = placeholder_op("src_ids", shape=(cfg.batch_size, cfg.src_len),
+                         dtype=np.int32)
+    tgt_in = placeholder_op("tgt_ids", shape=(cfg.batch_size, cfg.tgt_len),
+                            dtype=np.int32)
+    labels = placeholder_op("labels", shape=(cfg.batch_size, cfg.tgt_len),
+                            dtype=np.int32)
     table = init.truncated_normal((cfg.vocab_size, cfg.d_model), 0.0, 0.02,
                                   name=name + ".embed")
 
