@@ -28,11 +28,14 @@ def main():
         print("refusing to calibrate on cpu (set _HETU_CAL_ALLOW_CPU=1)",
               file=sys.stderr)
         return 1
+    from artifact_schema import provenance
+
     spec = calibrate_hardware()
     out = {
         "backend": backend,
         "device_kind": jax.devices()[0].device_kind,
         "spec": dataclasses.asdict(spec),
+        **provenance({"kind": "hardware_calibration"}),
     }
     os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
     path = os.path.join(ROOT, "artifacts", "tpu_calibration.json")

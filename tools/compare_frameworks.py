@@ -16,6 +16,7 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
 
 def _run(cmd, env=None, timeout=900):
@@ -90,6 +91,11 @@ def main():
             row["speedup_ours_over_torch"] = round(
                 (ov / tv) if higher_better else (tv / ov), 3)
         out[config] = row
+    from artifact_schema import provenance
+    out["provenance"] = provenance(
+        {c: {"batch_size": args.batch_size or CPU_BATCH[c],
+             **({"seq_len": args.seq_len or DEFAULT_SEQ[c]}
+                if c in DEFAULT_SEQ else {})} for c in configs})
     print(json.dumps(out, indent=1))
     return 0
 

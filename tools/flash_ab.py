@@ -166,11 +166,17 @@ def _persist(backend, rows, partial):
         if all(_wins(s) for s in measured[i:]):
             flash_min_len = seq
             break
+    from artifact_schema import provenance
+
     out = {
         "backend": backend,
         "device_kind": jax.devices()[0].device_kind,
+        # heads/head_dim/token_budget stay top-level (the resume check
+        # reads them); provenance embeds only sha + hash over them
         "heads": HEADS, "head_dim": HEAD_DIM,
         "token_budget": TOKEN_BUDGET,
+        **provenance({"heads": HEADS, "head_dim": HEAD_DIM,
+                      "token_budget": TOKEN_BUDGET}, embed_workload=False),
         "rows": rows,
         "partial": partial,
         # never-wins sentinel: gate above the largest measured length

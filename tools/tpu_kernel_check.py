@@ -113,9 +113,12 @@ def main():
         results[name] = entry
         print(f"{name}: {entry}", flush=True)
 
+    from artifact_schema import provenance
+
     out = {"backend": backend,
            "device_kind": jax.devices()[0].device_kind,
            "shape": [B, H, S, D], "tol": TOL,
+           **provenance({"shape": [B, H, S, D]}, embed_workload=False),
            "cases": results, "ok": ok_all,
            # partial (= the watcher's "not complete" marker) covers three
            # states that must all RE-RUN at the next healthy window: a red
