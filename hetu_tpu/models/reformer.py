@@ -111,8 +111,9 @@ def lsh_attention(qk, v, rotations, chunk_length, causal=True):
     look = jnp.arange(2 * c)[None, None, None, None, :] < c
     logits = jnp.where(first & look, -1e30, logits)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhnck,bhnkd->bhncd", probs.astype(vals.dtype), vals,
-                     preferred_element_type=jnp.float32)
+    # operand-dtype result (no f32 forcing): keeps the backward dots in
+    # bf16 — the matmul.py dtype-discipline note
+    out = jnp.einsum("bhnck,bhnkd->bhncd", probs.astype(vals.dtype), vals)
     out = out.reshape(b, h, s, d).astype(qk.dtype)
     return take(out, inv)                                   # un-sort
 

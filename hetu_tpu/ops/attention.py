@@ -56,12 +56,39 @@ def _load_flash_gate(default=256):
 _FLASH_MIN_LEN, _FLASH_BLOCKS = _load_flash_gate()
 
 
+@jax.custom_vjp
+def _scores_f32(q, k):
+    """q·kᵀ with an f32 RESULT from low-precision operands (softmax needs
+    the f32 range) — but with a custom backward that casts the f32
+    cotangent down to the operand dtype before the dq/dk dots, the same
+    discipline flash backward kernels use.  Without this, the f32 primal
+    output makes dscores f32 and both backward dots run f32×f32 at half
+    MXU throughput (the matmul.py dtype-discipline note; found by
+    tools/hlo_audit.py — 24 residual f32 dots, 2 per layer)."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32)
+
+
+def _scores_f32_fwd(q, k):
+    return _scores_f32(q, k), (q, k)
+
+
+def _scores_f32_bwd(res, g):
+    q, k = res
+    g = g.astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", g, k)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", g, q)
+    return dq, dk.astype(k.dtype)
+
+
+_scores_f32.defvjp(_scores_f32_fwd, _scores_f32_bwd)
+
+
 def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
     """(B, H, S, D) reference attention in plain jnp."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    logits = _scores_f32(q, k) * scale
     if bias is not None:  # additive position bias (T5-style), broadcastable
         logits = logits + bias
     valid = None
@@ -81,8 +108,11 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
         # stream's first-in-permutation position)
         row_any = jnp.any(valid, axis=-1, keepdims=True)
         probs = jnp.where(row_any, probs, 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    # result dtype follows the operands (bf16 in → bf16 out): forcing an
+    # f32 result here would make the cotangent f32 and run the backward
+    # dots as f32×f32 (the matmul.py dtype-discipline note); the scores
+    # einsum above keeps its f32 RESULT because softmax needs the range
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
 def _use_flash(q, k):
@@ -272,26 +302,27 @@ def _ulysses_attention(c, q, k, v, bias=None, causal=False, scale=None):
 ulysses_attention_op = def_op("UlyssesAttention", _ulysses_attention)
 
 
-def _key_type(mask):
-    """CP schedules support KEY-type masks only ((B|1, 1, 1, S_kv) —
-    validity does not vary per query); anything else must raise loudly."""
-    if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
-        raise NotImplementedError(
-            f"context-parallel attention supports key-padding masks "
-            f"(B, 1, 1, S_kv); got {mask.shape} — full per-query masks "
-            f"do not shard over the ring")
-    return mask
+def _cp_mask_kwargs(mask):
+    """Route a 4-D attention mask onto the cheapest cp schedule input:
+    KEY-padding masks ((B|1, 1, 1, S_kv) — validity does not vary per
+    query) ride the ring as (B, S_kv) column flags; anything else is a
+    FULL per-query mask, query-sharded like the bias (round-4 verdict
+    item 5 made these shard over the ring instead of raising)."""
+    if mask.ndim != 4:
+        raise ValueError(f"attention mask must be 4-D, got {mask.shape}")
+    if mask.shape[1] == 1 and mask.shape[2] == 1:
+        return {"key_mask": mask}
+    return {"mask": mask}
 
 
 def _ring_attention_masked(c, q, k, v, mask, bias=None, causal=False,
                            scale=None):
-    """Ring attention with a key-padding mask (padded pretraining through
-    cp); optional additive bias rides the same ring slicing."""
+    """Ring attention with a key-padding OR full per-query mask; optional
+    additive bias rides the same ring slicing."""
     if _has_cp(c.mesh):
         from ..parallel.ring_attention import ring_attention
-        return ring_attention(q, k, v, c.mesh, bias=bias,
-                              key_mask=_key_type(mask), causal=causal,
-                              scale=scale)
+        return ring_attention(q, k, v, c.mesh, bias=bias, causal=causal,
+                              scale=scale, **_cp_mask_kwargs(mask))
     if bias is not None:
         return dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=causal,
                                          scale=scale)
@@ -304,12 +335,11 @@ ring_attention_masked_op = def_op("RingAttentionMasked",
 
 def _ulysses_attention_masked(c, q, k, v, mask, bias=None, causal=False,
                               scale=None):
-    """Ulysses attention with a key-padding mask."""
+    """Ulysses attention with a key-padding OR full per-query mask."""
     if _has_cp(c.mesh):
         from ..parallel.ring_attention import ulysses_attention
-        return ulysses_attention(q, k, v, c.mesh, bias=bias,
-                                 key_mask=_key_type(mask), causal=causal,
-                                 scale=scale)
+        return ulysses_attention(q, k, v, c.mesh, bias=bias, causal=causal,
+                                 scale=scale, **_cp_mask_kwargs(mask))
     if bias is not None:
         return dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=causal,
                                          scale=scale)

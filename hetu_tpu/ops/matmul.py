@@ -3,7 +3,16 @@
 Reference: ``src/ops/MatrixMult.cu`` (cublasSgemm), ``BatchMatrixMult.cu``,
 ``Linear.cu``, ``Addmm.cu``, ``Baddbmm.cu``, ``Dot.cu``.  Here they lower to
 ``jnp.matmul``/``lax.dot_general`` which XLA tiles onto the 128x128 systolic
-array; ``preferred_element_type=f32`` keeps bf16 inputs accumulating in f32.
+array.
+
+Dtype discipline: the dot's result dtype follows its operands (bf16 in →
+bf16 out).  The MXU accumulates bf16 operands in f32 internally regardless,
+so forcing ``preferred_element_type=f32`` buys nothing on the forward — and
+it COSTS the backward: an f32 primal output makes every cotangent f32, and
+JAX's dot vjp then promotes the bf16 operand, running all dgrad/wgrad dots
+as f32×f32 at half MXU throughput (found by tools/hlo_audit.py: 196 of 294
+flagship-step dots were f32).  Softmax-feeding contractions that genuinely
+need an f32 RESULT (attention scores) opt in locally in ops/attention.py.
 """
 import jax.numpy as jnp
 
@@ -15,7 +24,7 @@ def _mm(c, a, b, trans_A=False, trans_B=False):
         a = a.T
     if trans_B:
         b = b.T
-    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.matmul(a, b)
 
 
 def _mm_shape(a, b, trans_A=False, trans_B=False):
@@ -41,7 +50,7 @@ def _bmm(c, a, b, trans_A=False, trans_B=False):
         a = jnp.swapaxes(a, -1, -2)
     if trans_B:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.matmul(a, b)
 
 
 batch_matmul_op = def_op("BatchMatrixMult", _bmm)
@@ -63,6 +72,5 @@ def einsum_op(subscripts, *nodes, name=None):
     from .base import SimpleOp
     return SimpleOp("Einsum", list(nodes),
                     lambda c, *vals, subscripts=None: jnp.einsum(
-                        subscripts, *vals,
-                        preferred_element_type=jnp.float32).astype(vals[0].dtype),
+                        subscripts, *vals),
                     name=name, subscripts=subscripts)

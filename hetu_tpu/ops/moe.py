@@ -188,14 +188,12 @@ def topk_gate_op(logits_node, k=1, capacity=None, name=None):
 layout_transform_op = def_op(
     "LayoutTransform",
     lambda c, dispatch, tokens: jnp.einsum(
-        "sec,sm->ecm", dispatch, tokens,
-        preferred_element_type=jnp.float32).astype(tokens.dtype))
+        "sec,sm->ecm", dispatch.astype(tokens.dtype), tokens))
 
 reverse_layout_transform_op = def_op(
     "ReverseLayoutTransform",
     lambda c, combine, expert_out: jnp.einsum(
-        "sec,ecm->sm", combine, expert_out,
-        preferred_element_type=jnp.float32).astype(expert_out.dtype))
+        "sec,ecm->sm", combine.astype(expert_out.dtype), expert_out))
 
 
 def _hash_dispatch(c, idx, num_experts=1, capacity=None):

@@ -180,8 +180,8 @@ class HetuProfiler:
         self._sync(outs)
         return (time.perf_counter() - t0) / self.repeats * 1e3
 
-    def _compiled(self, feed_dict):
-        """Compile (cache-hitting) the executor's jitted step for analysis."""
+    def _lowered(self, feed_dict):
+        """Lower (cache-hitting) the executor's jitted step for analysis."""
         from .graph.executor import _key
         sub, ex = self.sub, self.ex
         if sub._jit is None:
@@ -191,8 +191,11 @@ class HetuProfiler:
         lrs = np.zeros((len(sub.opt_ops),), np.float32)
         # reuse the executor's jitted step — .lower on the same jit object
         # hits jax's compilation cache instead of recompiling
-        return sub._jit.lower(
-            tparams, sparams, opt_states, feeds, key, lrs).compile()
+        return sub._jit.lower(tparams, sparams, opt_states, feeds, key, lrs)
+
+    def _compiled(self, feed_dict):
+        """Compile (cache-hitting) the executor's jitted step for analysis."""
+        return self._lowered(feed_dict).compile()
 
     def hlo_cost(self, feed_dict):
         """XLA's cost analysis of the compiled step: flops, bytes accessed.
@@ -209,6 +212,13 @@ class HetuProfiler:
         """Compiled-step HLO text (evidence of custom-call kernels, fusion
         decisions) — what the reference reads off nvprof timelines."""
         return self._compiled(feed_dict).as_text()
+
+    def lowered_text(self, feed_dict):
+        """Pre-backend (StableHLO) program text: the step's own dtype and
+        donation semantics, uncontaminated by backend quirks (XLA-CPU
+        upcasts bf16 dots and drops donation; tools/hlo_audit.py reads
+        this for the program-level checks)."""
+        return self._lowered(feed_dict).as_text()
 
     def memory_stats(self):
         """Per-device memory stats (reference polls pynvml)."""
