@@ -44,11 +44,12 @@ class MultiHeadAttention(BaseLayer):
         """x: (batch*seq, hidden) (reference models flatten); returns same.
 
         ``kv``: optional (batch*kv_seq, hidden) memory for cross-attention
-        (encoder-decoder); ``mask``: optional key-validity mask node
+        (encoder-decoder); ``mask``: optional validity mask node
         broadcastable to (B, H, S_q, S_k) — a (B, 1, 1, S_k) padding mask
         rides the flash kernel's O(S) key-mask strip path, and under
-        context parallelism shards over the ring/ulysses schedule (full
-        per-query masks do not and raise); ``bias``: optional additive
+        context parallelism shards over the ring/ulysses schedule; a FULL
+        per-query mask (XLNet-style permutation masks) shards its query
+        dim over the ring like the bias does; ``bias``: optional additive
         logit bias node (T5 relative position bias), broadcastable to
         (B, H, S_q, S_k).
         """
@@ -72,8 +73,8 @@ class MultiHeadAttention(BaseLayer):
                 f"unknown context_parallel mode {self.context_parallel!r}")
         if mask is not None:
             if cp_masked is not None:
-                # key-padding masks (and optional bias) shard over the
-                # cp schedule; full per-query masks raise inside the op
+                # key-padding AND full per-query masks (plus optional
+                # bias) shard over the cp schedule
                 o = (cp_masked(q, k, v, mask, bias, causal=self.causal,
                                scale=scale) if bias is not None else
                      cp_masked(q, k, v, mask, causal=self.causal,

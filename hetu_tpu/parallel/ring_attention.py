@@ -31,8 +31,8 @@ from .strategies import Strategy
 _NEG_INF = -1e30  # finite: keeps exp(m - m_new) well-defined on masked rows
 
 
-def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
-                         causal=False, scale=None):
+def ring_attention_local(q, k, v, bias=None, key_mask=None, mask=None,
+                         axis_name="cp", causal=False, scale=None):
     """Online-softmax ring attention — call INSIDE shard_map over ``cp``.
 
     q, k, v: local chunks [B, H, Sc, D] (sequence dim sharded over the ring).
@@ -44,6 +44,10 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
     ``key_mask``: optional [1|B, S_kv] key-validity flags, kept FULL locally
     and column-sliced per ring step (padded pretraining through cp; rows
     with no valid key yield zero output via the l==0 guard below).
+    ``mask``: optional FULL per-query validity [1|B, 1|H, Sc|1, S_kv] —
+    query dim ring-sharded like the bias's, key dim full locally and
+    column-sliced per step (XLNet-style permutation masks at long
+    context — round-4 verdict item 5).
     Returns the local output chunk [B, H, Sc, D].
     """
     import jax
@@ -57,6 +61,7 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
     qf = q.astype(jnp.float32) * sc
     bias_f = None if bias is None else bias.astype(jnp.float32)
     km = None if key_mask is None else (key_mask != 0)
+    fm = None if mask is None else (mask != 0)
 
     q_pos = r * Sc + jnp.arange(Sc)
 
@@ -71,6 +76,10 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
         if km is not None:
             cols = lax.dynamic_slice_in_dim(km, src * Sc, Sc, axis=1)
             valid = jnp.broadcast_to(cols[:, None, None, :], logits.shape)
+        if fm is not None:
+            cols = lax.dynamic_slice_in_dim(fm, src * Sc, Sc, axis=3)
+            cols = jnp.broadcast_to(cols, logits.shape)
+            valid = cols if valid is None else jnp.logical_and(valid, cols)
         if causal:
             k_pos = src * Sc + jnp.arange(Sc)
             cmask = jnp.broadcast_to(q_pos[:, None] >= k_pos[None, :],
@@ -104,7 +113,7 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, axis_name="cp",
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ulysses_attention_local(q, k, v, bias=None, key_mask=None,
+def ulysses_attention_local(q, k, v, bias=None, key_mask=None, mask=None,
                             axis_name="cp", causal=False, scale=None,
                             attn_fn=None):
     """Ulysses head/sequence all-to-all attention — INSIDE shard_map.
@@ -116,6 +125,9 @@ def ulysses_attention_local(q, k, v, bias=None, key_mask=None,
     ``key_mask``: optional [1|B, S_kv] key-validity flags (head-independent,
     so the a2a does not touch them) — applied on the full-sequence local
     attention (padded pretraining through cp).
+    ``mask``: optional FULL per-query validity [1|B, Hc|1, S, S_kv] —
+    like the bias, a multi-head mask arrives pre-sharded to the local
+    head block; both sequence dims are full after the a2a.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -134,8 +146,13 @@ def ulysses_attention_local(q, k, v, bias=None, key_mask=None,
         from ..ops.attention import (dispatch_sdpa, dispatch_sdpa_bias,
                                      dispatch_sdpa_masked,
                                      dispatch_sdpa_masked_bias)
+        mask4 = None
         if key_mask is not None:
             mask4 = key_mask[:, None, None, :]
+        if mask is not None:
+            mask4 = mask if mask4 is None \
+                else jnp.logical_and(mask4 != 0, mask != 0)
+        if mask4 is not None:
             if bias is not None:
                 attn_fn = functools.partial(dispatch_sdpa_masked_bias,
                                             mask=mask4, bias=bias,
@@ -174,14 +191,18 @@ def _norm_key_mask(key_mask, s_kv):
     return km
 
 
-def ring_attention(q, k, v, mesh, bias=None, key_mask=None, axis_name="cp",
-                   causal=False, scale=None, batch_axis="dp"):
+def ring_attention(q, k, v, mesh, bias=None, key_mask=None, mask=None,
+                   axis_name="cp", causal=False, scale=None,
+                   batch_axis="dp"):
     """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
 
     ``bias``: optional [1|B, 1|H, S|1, S] additive bias — its query dim
     rides the ring shards, the key dim stays full (sliced per ring step).
     ``key_mask``: optional (B|1, S) or (B|1, 1, 1, S) key-validity flags —
-    kept full locally, column-sliced per ring step."""
+    kept full locally, column-sliced per ring step.
+    ``mask``: optional FULL per-query validity [1|B, 1|H, S|1, S] — query
+    dim ring-sharded exactly like the bias's, key dim column-sliced per
+    step (XLNet-style permutation masks under cp)."""
     import jax
     from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
@@ -199,6 +220,14 @@ def ring_attention(q, k, v, mesh, bias=None, key_mask=None, axis_name="cp",
         args.append(km)
         in_specs.append(P(dp if km.shape[0] > 1 else None, None))
         keys.append("key_mask")
+    if mask is not None:
+        if mask.ndim != 4:
+            raise ValueError(f"full mask must be 4-D (B|1, H|1, S|1, S); "
+                             f"got {mask.shape}")
+        args.append(mask)
+        in_specs.append(P(dp if mask.shape[0] > 1 else None, None,
+                          "cp" if mask.shape[2] > 1 else None, None))
+        keys.append("mask")
 
     def fn(q, k, v, *extras):
         kw = dict(zip(keys, extras))
@@ -209,7 +238,20 @@ def ring_attention(q, k, v, mesh, bias=None, key_mask=None, axis_name="cp",
                          out_specs=spec, check_vma=False)(*args)
 
 
-def ulysses_attention(q, k, v, mesh, bias=None, key_mask=None,
+def _head_extra_spec(x, what, b0, cp_size):
+    """Spec for a [B|1, H|1, S, S] extra whose HEAD dim (not sequence)
+    shards over 'cp' — matching the contiguous head blocks all_to_all
+    deals out in the Ulysses schedule."""
+    from jax.sharding import PartitionSpec as P
+    if x.shape[1] == 1:
+        return P(b0, None, None, None)
+    if x.shape[1] % cp_size == 0:
+        return P(b0, "cp", None, None)
+    raise ValueError(f"ulysses {what} heads {x.shape[1]} not divisible "
+                     f"by cp={cp_size}")
+
+
+def ulysses_attention(q, k, v, mesh, bias=None, key_mask=None, mask=None,
                       axis_name="cp", causal=False, scale=None,
                       batch_axis="dp"):
     """jit-level entry: q/k/v are full [B, H, S, D]; S shards over 'cp'.
@@ -217,30 +259,33 @@ def ulysses_attention(q, k, v, mesh, bias=None, key_mask=None,
     ``bias``: optional [1|B, H|1, S, S] — a multi-head bias shards its head
     dim over 'cp' (matching all_to_all's contiguous head blocks).
     ``key_mask``: optional (B|1, S) or (B|1, 1, 1, S) — head-independent,
-    applied after the a2a on the full sequence."""
+    applied after the a2a on the full sequence.
+    ``mask``: optional FULL per-query validity [1|B, H|1, S, S] — sharded
+    like the bias (head dim over 'cp'; sequence dims full after the a2a)."""
     import jax
     from jax.sharding import PartitionSpec as P
     spec = _cp_spec(mesh, batch_axis)
     dp = batch_axis if batch_axis in mesh.axis_names else None
+    cp_size = mesh.shape[axis_name]
     args, in_specs, keys = [q, k, v], [spec, spec, spec], []
     if bias is not None:
         b0 = dp if bias.shape[0] > 1 else None  # follow q/k/v batch shard
-        if bias.shape[1] == 1:
-            bspec = P(b0, None, None, None)
-        elif bias.shape[1] % mesh.shape[axis_name] == 0:
-            bspec = P(b0, "cp", None, None)
-        else:
-            raise ValueError(
-                f"ulysses bias heads {bias.shape[1]} not divisible by "
-                f"cp={mesh.shape[axis_name]}")
         args.append(bias)
-        in_specs.append(bspec)
+        in_specs.append(_head_extra_spec(bias, "bias", b0, cp_size))
         keys.append("bias")
     if key_mask is not None:
         km = _norm_key_mask(key_mask, k.shape[2])
         args.append(km)
         in_specs.append(P(dp if km.shape[0] > 1 else None, None))
         keys.append("key_mask")
+    if mask is not None:
+        if mask.ndim != 4:
+            raise ValueError(f"full mask must be 4-D (B|1, H|1, S, S); "
+                             f"got {mask.shape}")
+        b0 = dp if mask.shape[0] > 1 else None
+        args.append(mask)
+        in_specs.append(_head_extra_spec(mask, "mask", b0, cp_size))
+        keys.append("mask")
 
     def fn(q, k, v, *extras):
         kw = dict(zip(keys, extras))

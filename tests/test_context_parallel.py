@@ -304,3 +304,121 @@ def test_bert_tiny_trains_masked_with_cp():
                 for _ in range(3)]
 
     np.testing.assert_allclose(run(False), run(True), rtol=2e-4)
+
+
+# ------------------------------------------------ full per-query masks via CP
+
+def _perm_mask(rng, B, S, H=1):
+    """XLNet-style content mask: key j visible to query i iff j's position
+    in a random factorisation order precedes i's (every query sees at
+    least itself)."""
+    out = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        rank = np.empty(S, int)
+        rank[rng.permutation(S)] = np.arange(S)
+        out[b] = rank[None, None, :] <= rank[None, :, None]
+    return out
+
+
+@pytest.mark.parametrize("schedule", ["ring", "ulysses"])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_cp_full_mask_matches_reference(schedule, with_bias):
+    """An XLNet-style (B, 1, S, S) per-query mask shards over both cp
+    schedules and matches the unsharded reference (round-4 verdict item 5:
+    these used to raise)."""
+    import jax
+    rng = np.random.RandomState(21)
+    q, k, v = _qkv(rng, B=4, H=4)
+    mask = _perm_mask(rng, 4, 32)
+    bias = rng.randn(1, 4, 32, 32).astype(np.float32) if with_bias else None
+    mesh = ht.make_mesh({"dp": 2, "cp": 2}, jax.devices()[:4])
+    fn = ring_attention if schedule == "ring" else ulysses_attention
+    out = fn(q, k, v, mesh, bias=bias, mask=mask)
+    ref = sdpa_reference(q, k, v, mask=mask, bias=bias)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("schedule", ["ring", "ulysses"])
+def test_cp_full_mask_head_dependent(schedule):
+    """A per-HEAD (B, H, S, S) mask: the ring broadcasts it over the local
+    head dim; Ulysses shards the head dim over 'cp' like a multi-head
+    bias."""
+    import jax
+    rng = np.random.RandomState(22)
+    q, k, v = _qkv(rng, B=2, H=4)
+    mask = _perm_mask(rng, 2, 32, H=4)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    fn = ring_attention if schedule == "ring" else ulysses_attention
+    out = fn(q, k, v, mesh, mask=mask)
+    ref = sdpa_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_full_mask_grads_match():
+    import jax
+    rng = np.random.RandomState(23)
+    q, k, v = _qkv(rng, B=2, S=16)
+    mask = _perm_mask(rng, 2, 16)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh, mask=mask).sum()
+
+    def fr(q, k, v):
+        return sdpa_reference(q, k, v, mask=mask).sum()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_cp_full_mask_causal_combines():
+    """causal=True AND a full mask: validities intersect (the ring ANDs
+    the sliced mask chunk with its position mask)."""
+    import jax
+    rng = np.random.RandomState(24)
+    q, k, v = _qkv(rng, B=2)
+    mask = _perm_mask(rng, 2, 32)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    out = ring_attention(q, k, v, mesh, mask=mask, causal=True)
+    ref = sdpa_reference(q, k, v, mask=mask, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("flavor", ["ring", "ulysses"])
+def test_graph_mha_full_mask_under_cp(flavor):
+    """Graph-level: MultiHeadAttention with a FULL per-query mask node
+    trains under cp>1 and matches the single-device run (the op-level
+    router sends non-key-type masks down the full-mask schedule input)."""
+    def run(strategy, cp_flavor):
+        rng = np.random.RandomState(25)
+        B, S, hid = 2, 16, 32
+        x = ht.placeholder_op("x")
+        y_ = ht.placeholder_op("y_")
+        m = ht.placeholder_op("m", shape=(B, 1, S, S), dtype=np.int32)
+        mha = ht.layers.MultiHeadAttention(hid, 4,
+                                           context_parallel=cp_flavor,
+                                           name="fmha")
+        h = mha(x, B, S, mask=m)
+        w = ht.Variable("w", value=rng.randn(hid, 3).astype(np.float32) * .2)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+        opt = ht.optim.AdamOptimizer(1e-2)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                         dist_strategy=strategy, seed=0)
+        rng = np.random.RandomState(26)
+        xv = rng.randn(B * S, hid).astype(np.float32)
+        yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, B * S)]
+        mv = _perm_mask(np.random.RandomState(27), B, S).astype(np.int32)
+        fd = {x: xv, y_: yv, m: mv}
+        return [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+                for _ in range(4)]
+
+    single = run(None, None)
+    sharded = run(ht.ContextParallel(cp=4), flavor)
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)
