@@ -32,8 +32,8 @@ for ox, ctor in {
         "Tanh": _ops.tanh_op, "Exp": _ops.exp_op, "Log": _ops.log_op,
         "Sqrt": _ops.sqrt_op, "Abs": _ops.abs_op, "Floor": _ops.floor_op,
         "Sin": _ops.sin_op, "Cos": _ops.cos_op, "Neg": _ops.opposite_op,
-        "Gelu": _ops.gelu_op, "Identity": lambda x: x,
-        "Flatten": _ops.flatten_op}.items():
+        "Gelu": _ops.gelu_op, "Erf": _ops.erf_op,
+        "Identity": lambda x: x}.items():
     _IMPORTERS[ox] = (lambda c: lambda node, ins, env: c(ins[0]))(ctor)
 
 for ox, ctor in {"Add": _ops.add_op, "Sub": _ops.minus_op,
@@ -81,6 +81,40 @@ def _gemm(node, ins, env):
             _ops.mulbyconst_op(ins[2], const_attr=beta)
         out = out + c
     return out
+
+
+@register_importer("Flatten")
+def _flatten_onnx(node, ins, env):
+    """ONNX Flatten is strictly 2-D: [prod(d[:axis]), prod(d[axis:])] —
+    NOT torch's start_dim/end_dim flatten."""
+    axis = node.attrs.get("axis", 1)
+    shape = _node_shape(ins[0])
+    if shape is None:
+        if axis != 1:
+            raise NotImplementedError(
+                f"Flatten axis={axis} needs a static input shape "
+                f"(ONNX output is strictly 2-D)")
+        # axis=1 with unknown shape: collapsing all trailing dims IS the
+        # ONNX 2-D result for the (batch, ...) layouts torch exports
+        return _ops.flatten_op(ins[0], start_dim=1)
+    lead = int(np.prod(shape[:axis] or [1]))
+    tail = int(np.prod(shape[axis:] or [1]))
+    return _ops.array_reshape_op(ins[0], output_shape=(lead, tail))
+
+
+@register_importer("Constant")
+def _constant(node, ins, env):
+    """Inline constant: lands in env as a raw ndarray, so downstream
+    shape-consuming handlers (Reshape) and const-op binary forms see it
+    exactly like an initializer."""
+    v = node.attrs.get("value")
+    if v is None:
+        for k in ("value_float", "value_int"):
+            if k in node.attrs:
+                return np.asarray(node.attrs[k])
+        raise NotImplementedError(
+            f"Constant node {node.name!r} without a value attribute")
+    return v.array if hasattr(v, "array") else np.asarray(v)
 
 
 @register_importer("Transpose")
@@ -204,14 +238,16 @@ def _reduce_axes(node, env):
 
 @register_importer("ReduceMean")
 def _rmean(node, ins, env):
+    # ONNX default keepdims=1 (our exporter always writes it explicitly;
+    # torch relies on the default)
     return _ops.reduce_mean_op(ins[0], _reduce_axes(node, env),
-                               keepdims=bool(node.attrs.get("keepdims")))
+                               keepdims=bool(node.attrs.get("keepdims", 1)))
 
 
 @register_importer("ReduceSum")
 def _rsum(node, ins, env):
     return _ops.reduce_sum_op(ins[0], _reduce_axes(node, env),
-                              keepdims=bool(node.attrs.get("keepdims")))
+                              keepdims=bool(node.attrs.get("keepdims", 1)))
 
 
 @register_importer("Slice")
@@ -334,9 +370,19 @@ def load(path):
             shape=shape if all(d is not None for d in shape) else None)
         env[vi.name] = feeds[vi.name]
 
+    const_names = set()   # Constant-node outputs: data, not weights
+    const_vars = {}       # one Variable per constant, however many users
+
     def as_node(name):
         v = env[name]
         if isinstance(v, np.ndarray):
+            if name in const_names:
+                # env keeps the raw ndarray (for _const_value / shape
+                # consumers); the graph gets ONE shared non-trainable node
+                if name not in const_vars:
+                    const_vars[name] = Variable(name, value=v,
+                                                trainable=False)
+                return const_vars[name]
             var = Variable(name, value=v, trainable=True)
             params[name] = var
             env[name] = var
@@ -360,6 +406,8 @@ def load(path):
             else:
                 ins.append(as_node(iname))
         out = handler(node, ins, env)
+        if node.op_type == "Constant":
+            const_names.add(node.outputs[0])
         env[node.outputs[0]] = out
     outputs = [env[vi.name] for vi in g.outputs]
     return ImportedModel(feeds, outputs, params)

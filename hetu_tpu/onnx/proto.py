@@ -96,6 +96,19 @@ class _Reader:
         raise ValueError(f"unsupported wire type {wire}")
 
 
+def _ints_any(v):
+    """Repeated int field value → list of ints, whether the element came
+    packed (length-delimited blob of varints) or unpacked (single
+    varint)."""
+    if not isinstance(v, bytes):
+        return [v]
+    rr = _Reader(v)
+    out = []
+    while not rr.eof():
+        out.append(rr.svarint())
+    return out
+
+
 # -- ONNX dtypes -------------------------------------------------------------
 
 FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = \
@@ -147,16 +160,18 @@ class Tensor:
                 name = v.decode("utf-8")
             elif f == 9:
                 raw = v
-            elif f == 4:  # packed float_data
-                floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
-            elif f == 7:  # packed int64_data
-                rr = _Reader(v)
-                while not rr.eof():
-                    int64s.append(rr.svarint())
-            elif f == 5:  # packed int32_data
-                rr = _Reader(v)
-                while not rr.eof():
-                    int32s.append(rr.svarint())
+            # repeated scalar fields arrive PACKED (one length-delimited
+            # blob — proto3 default, our own writer) or UNPACKED (one tag
+            # per element — what torch's exporter emits); accept both
+            elif f == 4:  # float_data
+                if isinstance(v, bytes):
+                    floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                else:
+                    floats.append(v)
+            elif f == 7:  # int64_data
+                int64s.extend(_ints_any(v))
+            elif f == 5:  # int32_data
+                int32s.extend(_ints_any(v))
         np_dt = ONNX2NP.get(dtype, np.dtype(np.float32))
         if raw is not None:
             arr = np.frombuffer(raw, np_dt).reshape(dims)
@@ -226,12 +241,14 @@ class Attribute:
                 s_v = v.decode("utf-8")
             elif f == 5:
                 t_v = Tensor.decode(v)
-            elif f == 7:
-                floats = list(struct.unpack(f"<{len(v) // 4}f", v))
-            elif f == 8:
-                rr = _Reader(v)
-                while not rr.eof():
-                    ints.append(rr.svarint())
+            elif f == 7:  # floats: packed blob(s) or unpacked elements —
+                # protobuf decoders must CONCATENATE repeated chunks
+                if isinstance(v, bytes):
+                    floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                else:
+                    floats.append(v)
+            elif f == 8:  # ints: packed blob or one unpacked element
+                ints.extend(_ints_any(v))
             elif f == 20:
                 atype = v
         if atype == cls.FLOAT_T:

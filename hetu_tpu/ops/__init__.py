@@ -5,6 +5,7 @@ from .arithmetic import (
     mul_op, mulbyconst_op, mul_byconst_op, div_op, div_const_op, const_div_op,
     div_handle_zero_op, fmod_op, ne_op, outer_op, const_pow_op, abs_op,
     opposite_op, exp_op, log_op, sqrt_op, rsqrt_op, sigmoid_op, tanh_op,
+    erf_op,
     sin_op, cos_op, floor_op, bool_op, pow_op, clamp_op, oneslike_op,
     zeroslike_op, where_op, where_const_op, full_op, full_like_op, eye_op,
     arange_op, rand_op)

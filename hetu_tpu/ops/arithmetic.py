@@ -55,6 +55,7 @@ sqrt_op = def_op("Sqrt", lambda c, a: jnp.sqrt(a), _same)
 rsqrt_op = def_op("ReciprocalSqrt", lambda c, a: jax.lax.rsqrt(a), _same)
 sigmoid_op = def_op("Sigmoid", lambda c, a: jax.nn.sigmoid(a), _same)
 tanh_op = def_op("Tanh", lambda c, a: jnp.tanh(a), _same)
+erf_op = def_op("Erf", lambda c, a: jax.lax.erf(a), _same)
 sin_op = def_op("Sin", lambda c, a: jnp.sin(a), _same)
 cos_op = def_op("Cos", lambda c, a: jnp.cos(a), _same)
 floor_op = def_op("Floor", lambda c, a: jnp.floor(a), _same)
