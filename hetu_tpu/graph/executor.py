@@ -90,6 +90,22 @@ class SubExecutor:
         # block; executor-level microbatching would double-split the batch
         self.has_pipeline_block = any(
             n.op_type == "PipelineBlock" for n in self.topo)
+        if self.ex.pipeline and not self.has_pipeline_block and self.grad_ops:
+            # loud, not silent: the schedule NAME promises stage overlap,
+            # but without a PipelineBlock the executor can only run scanned
+            # gradient accumulation (same numerics for mean-reduced losses;
+            # 1F1B/hetpipe additionally remat each microbatch's forward).
+            # The reference auto-partitions at recv/loss pivots
+            # (pipeline_subexecutor.py:29-81); here stage functions must be
+            # shape-homogeneous, so partitioning is the caller's call.
+            import warnings
+            warnings.warn(
+                f"pipeline={self.ex.pipeline!r} on a graph with no "
+                f"PipelineBlock: running scanned gradient accumulation "
+                f"over {self.ex.num_microbatches} microbatches with NO "
+                f"stage overlap — wrap the repeated layer chain in "
+                f"ht.pipeline_block(...) to get the scheduled pipeline",
+                UserWarning, stacklevel=4)
         # which fetches are batch-derived (transitively consume a fed
         # placeholder)? drives how microbatched aux outputs recombine
         feed_set = set(self.feed_nodes)
