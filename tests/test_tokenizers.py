@@ -216,3 +216,65 @@ def test_transfoxl_word_level():
     ids = tok.encode("the fox flies")
     toks = tok.convert_ids_to_tokens(ids)
     assert toks == ["the", "fox", "<unk>", "<eos>"]
+
+
+# ------------------------------------------------ golden-fixture parity
+# (round-4 verdict item 7: exact encodings vs the battle-tested HF lineage;
+#  fixture generated ONCE by tools/make_tokenizer_goldens.py from the HF
+#  Rust `tokenizers` reference and committed — no HF dependency here)
+
+import json as _json
+import os as _os
+
+_GOLDENS = _os.path.join(_os.path.dirname(__file__), "fixtures",
+                         "tokenizers", "goldens.json")
+
+
+def _goldens(family):
+    with open(_GOLDENS, encoding="utf-8") as f:
+        return _json.load(f)[family]
+
+
+def test_golden_wordpiece_exact():
+    from hetu_tpu.tokenizers.algorithms import BasicTokenizer, WordPiece
+    g = _goldens("wordpiece")
+    basic, wp = BasicTokenizer(do_lower_case=True), WordPiece(g["vocab"])
+    for row in g["rows"]:
+        pieces = [p for w in basic.tokenize(row["text"])
+                  for p in wp.tokenize(w)]
+        assert pieces == row["tokens"], row["text"]
+        assert [g["vocab"][p] for p in pieces] == row["ids"], row["text"]
+
+
+def test_golden_byte_bpe_exact():
+    from hetu_tpu.tokenizers.algorithms import ByteLevelBPE
+    g = _goldens("byte_bpe")
+    bpe = ByteLevelBPE(g["vocab"], [tuple(m) for m in g["merges"]])
+    for row in g["rows"]:
+        pieces = bpe.tokenize(row["text"])
+        assert pieces == row["tokens"], row["text"]
+        assert [g["vocab"][p] for p in pieces] == row["ids"], row["text"]
+
+
+def test_golden_unigram_exact_ids():
+    """ID-level parity (HF surfaces unknown chars' raw text with the unk
+    id; our core surfaces '<unk>' — ids are the contract)."""
+    from hetu_tpu.tokenizers.algorithms import Unigram
+    g = _goldens("unigram")
+    uni = Unigram([(p, s) for p, s in g["vocab_scores"]])
+    ids = {p: i for i, (p, _) in enumerate(g["vocab_scores"])}
+    unk = ids["<unk>"]
+    for row in g["rows"]:
+        got = [ids.get(p, unk) for p in uni.tokenize(row["text"])]
+        assert got == row["ids"], row["text"]
+
+
+def test_golden_word_level_exact():
+    from hetu_tpu.tokenizers.algorithms import WordLevel
+    g = _goldens("word_level")
+    wl = WordLevel(g["vocab"])
+    for row in g["rows"]:
+        pieces = [t if t in g["vocab"] else "<unk>"
+                  for t in wl.tokenize(row["text"])]
+        assert pieces == row["tokens"], row["text"]
+        assert [g["vocab"][p] for p in pieces] == row["ids"], row["text"]

@@ -1,0 +1,172 @@
+"""Generate tokenizer golden fixtures from the HF `tokenizers` library.
+
+Round-4 verdict item 7: the native algorithm cores (WordPiece greedy
+longest-match, byte-level BPE merge ordering, Unigram Viterbi, word-level)
+were only self-consistency-tested; silent divergence from the battle-tested
+lineage (reference ``python/hetu/tokenizers/`` is HF-derived) would hide
+there.  This script trains TINY vocabularies with the HF Rust `tokenizers`
+package (present in the image), encodes a dozen adversarial strings per
+family with HF as the reference implementation, and writes everything —
+vocab, merges/scores, strings, expected pieces+ids — to a committed JSON
+fixture.  The test (tests/test_tokenizers.py::test_golden_*) replays the
+fixture through OUR cores with no HF dependency at test time.
+
+The script REFUSES to write a fixture whose expectations our own cores do
+not currently reproduce — goldens must be verified equivalences, not
+aspirations; a later regression then fails the committed test.
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world, hello tokenizers!",
+    "unbelievable transformations untangle underlying tokens",
+    "she sells seashells by the seashore",
+    "I can't won't don't shouldn't contractions",
+    "numbers 123 456 7890 and symbols #@$%",
+    "lowercase UPPERCASE MixedCase cases",
+    "prefix presuppose prefixes represented pre",
+    "running runner runs ran run",
+    "internationalization localization globalization",
+]
+
+STRINGS = [
+    "the quick brown fox",
+    "hello world",
+    "unbelievable tokens",
+    "she sells seashells",
+    "can't stop won't stop",
+    "numbers 123 and 456",
+    "UPPERCASE and lowercase",
+    "presuppose the prefixes",
+    "running runner runs",
+    "internationalization",
+    "unseen wordforms zzzqqq",
+    "punctuation, with: marks!",
+]
+
+
+def _wordpiece():
+    from tokenizers import Tokenizer, models, trainers, pre_tokenizers, \
+        normalizers
+    tok = Tokenizer(models.WordPiece(unk_token="[UNK]"))
+    tok.normalizer = normalizers.BertNormalizer(lowercase=True)
+    tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
+    tok.train_from_iterator(CORPUS, trainers.WordPieceTrainer(
+        vocab_size=200, special_tokens=["[UNK]", "[PAD]"]))
+    vocab = tok.get_vocab()
+    rows = [{"text": s,
+             "tokens": tok.encode(s).tokens,
+             "ids": tok.encode(s).ids} for s in STRINGS]
+
+    # replay through OUR core (BasicTokenizer + WordPiece greedy match)
+    from hetu_tpu.tokenizers.algorithms import BasicTokenizer, WordPiece
+    basic, wp = BasicTokenizer(do_lower_case=True), WordPiece(vocab)
+    for row in rows:
+        ours = [p for w in basic.tokenize(row["text"])
+                for p in wp.tokenize(w)]
+        assert ours == row["tokens"], \
+            (row["text"], ours, row["tokens"])
+    return {"vocab": vocab, "rows": rows}
+
+
+def _byte_bpe():
+    from tokenizers import Tokenizer, models, trainers, pre_tokenizers, \
+        decoders
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.train_from_iterator(CORPUS, trainers.BpeTrainer(
+        vocab_size=300,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet()))
+    vocab = tok.get_vocab()
+    # merges are not exposed directly; reconstruct from the serialized model
+    model = json.loads(tok.to_str())["model"]
+    merges = [list(m) if isinstance(m, list) else m.split(" ")
+              for m in model["merges"]]
+    rows = [{"text": s,
+             "tokens": tok.encode(s).tokens,
+             "ids": tok.encode(s).ids} for s in STRINGS]
+
+    from hetu_tpu.tokenizers.algorithms import ByteLevelBPE
+    bpe = ByteLevelBPE(vocab, merges)
+    for row in rows:
+        ours = bpe.tokenize(row["text"])
+        assert ours == row["tokens"], (row["text"], ours, row["tokens"])
+    return {"vocab": vocab, "merges": merges, "rows": rows}
+
+
+def _unigram():
+    from tokenizers import Tokenizer, models, trainers, pre_tokenizers
+    tok = Tokenizer(models.Unigram())
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.train_from_iterator(CORPUS, trainers.UnigramTrainer(
+        vocab_size=150, special_tokens=["<unk>"], unk_token="<unk>"))
+    model = json.loads(tok.to_str())["model"]
+    vocab_scores = [[p, s] for p, s in model["vocab"]]
+    rows = [{"text": s,
+             "tokens": tok.encode(s).tokens,
+             "ids": tok.encode(s).ids} for s in STRINGS]
+
+    from hetu_tpu.tokenizers.algorithms import Unigram
+    uni = Unigram([(p, s) for p, s in vocab_scores])
+    # compare at ID level: HF surfaces an unknown character's RAW text as
+    # the token string (with the unk id); our core surfaces "<unk>" — the
+    # ids are the contract
+    ids = {p: i for i, (p, _) in enumerate(vocab_scores)}
+    unk_id = ids["<unk>"]
+    for row in rows:
+        ours = [ids.get(p, unk_id) for p in uni.tokenize(row["text"])]
+        assert ours == row["ids"], (row["text"], ours, row["ids"])
+    return {"vocab_scores": vocab_scores, "rows": rows}
+
+
+def _word_level():
+    from tokenizers import Tokenizer, models, trainers, pre_tokenizers
+    tok = Tokenizer(models.WordLevel(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    tok.train_from_iterator(CORPUS, trainers.WordLevelTrainer(
+        special_tokens=["<unk>"]))
+    vocab = tok.get_vocab()
+    rows = [{"text": s,
+             "tokens": tok.encode(s).tokens,
+             "ids": tok.encode(s).ids} for s in STRINGS]
+
+    from hetu_tpu.tokenizers.algorithms import WordLevel
+    wl = WordLevel(vocab)
+    for row in rows:
+        ours = [t if t in vocab else "<unk>"
+                for t in wl.tokenize(row["text"])]
+        assert ours == row["tokens"], (row["text"], ours, row["tokens"])
+    return {"vocab": vocab, "rows": rows}
+
+
+def main():
+    import tokenizers
+    out = {
+        "generator": f"HF tokenizers {tokenizers.__version__} "
+                     "(tools/make_tokenizer_goldens.py)",
+        "wordpiece": _wordpiece(),
+        "byte_bpe": _byte_bpe(),
+        "unigram": _unigram(),
+        "word_level": _word_level(),
+    }
+    path = os.path.join(ROOT, "tests", "fixtures", "tokenizers",
+                        "goldens.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True, ensure_ascii=False)
+    n = sum(len(out[k]["rows"]) for k in
+            ("wordpiece", "byte_bpe", "unigram", "word_level"))
+    print(f"wrote {path}: {n} golden encodings, all reproduced by the "
+          f"native cores")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
