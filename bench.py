@@ -147,6 +147,15 @@ def _flash_in_hlo(ex, fd, name="train"):
         return None
 
 
+def _compute_dtype():
+    """bf16 on TPU (the real mixed-precision config); f32 on the CPU
+    fallback — XLA-CPU EMULATES bf16 (measured 1.54x slower on resnet18)
+    and the committed torch baselines run f32, so a CPU-side comparison
+    must be f32 vs f32 to mean anything."""
+    import jax
+    return "bfloat16" if jax.default_backend() == "tpu" else None
+
+
 def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
     """Flagship config: BERT-base padded MLM pretraining.
 
@@ -164,7 +173,7 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
     feeds, loss, logits = bert_pretrain_graph(cfg)
     opt = ht.optim.AdamOptimizer(1e-4)
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     compute_dtype="bfloat16")
+                     compute_dtype=_compute_dtype())
     ids, tt, labels, attn = synthetic_mlm_batch(cfg)
     # ids/labels/mask stay int32 end-to-end: integer feeds are exempt from
     # the bf16 compute_dtype cast (bf16 is exact only up to 256)
@@ -217,6 +226,7 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
             "peak_flops": peak, "device_kind": device_kind,
             "flash_in_hlo": _flash_in_hlo(ex, fd),
             "peak_hbm_gb": hbm_gb,
+            "compute_dtype": _compute_dtype() or "float32",
             "backend": jax.default_backend(),
             "devices": n_dev, "loss": round(final_loss, 4),
         },
@@ -231,9 +241,12 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
 
     x = ht.placeholder_op("x", shape=(batch_size, 3, 32, 32))
     y_ = ht.placeholder_op("y", shape=(batch_size, 10))
-    loss, y = models.resnet18(x, y_)
+    # layout per backend (measured: NHWC wins on TPU-style lane mapping,
+    # loses 1.5x on XLA-CPU — artifacts/resnet_cpu_root_cause.json)
+    df = "NHWC" if jax.default_backend() == "tpu" else "NCHW"
+    loss, y = models.resnet18(x, y_, data_format=df)
     ex = ht.Executor({"train": [loss, ht.optim.MomentumOptimizer(0.1).minimize(loss)]},
-                     compute_dtype="bfloat16")
+                     compute_dtype=_compute_dtype())
     rng = np.random.RandomState(0)
     xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
     yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
@@ -252,6 +265,7 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
                                   "unavailable: no committed same-workload "
                                   "torch baseline",
                   **_provenance({"batch_size": batch_size}),
+                  "compute_dtype": _compute_dtype() or "float32",
                   "backend": jax.default_backend()},
     }
 
@@ -645,7 +659,7 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
         + aux * 0.01
     opt = ht.optim.AdamOptimizer(1e-3)
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     compute_dtype="bfloat16")
+                     compute_dtype=_compute_dtype())
     rng = np.random.RandomState(0)
     xv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
     yv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
@@ -664,6 +678,7 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
                   **_provenance({"tokens": batch_tokens}),
                   "experts": experts,
                   "step_time_ms": round(dt * 1e3, 2),
+                  "compute_dtype": _compute_dtype() or "float32",
                   "backend": jax.default_backend()},
     }
 

@@ -4,16 +4,19 @@ import hetu_tpu as ht
 from hetu_tpu import initializers as init
 
 
-def conv2d(x, in_ch, out_ch, kernel_size=3, stride=1, padding=1, name="conv"):
+def conv2d(x, in_ch, out_ch, kernel_size=3, stride=1, padding=1, name="conv",
+           data_format="NCHW"):
     w = init.he_normal(shape=(out_ch, in_ch, kernel_size, kernel_size),
                        name=name + "_weight")
-    return ht.conv2d_op(x, w, stride=stride, padding=padding)
+    return ht.conv2d_op(x, w, stride=stride, padding=padding,
+                        data_format=data_format)
 
 
-def bn(x, ch, name, relu=False):
+def bn(x, ch, name, relu=False, data_format="NCHW"):
     scale = init.ones(shape=(ch,), name=name + "_scale")
     bias = init.zeros(shape=(ch,), name=name + "_bias")
-    x = ht.batch_normalization_op(x, scale, bias, momentum=0.9, eps=1e-5)
+    x = ht.batch_normalization_op(x, scale, bias, momentum=0.9, eps=1e-5,
+                                  data_format=data_format)
     return ht.relu_op(x) if relu else x
 
 

@@ -141,8 +141,20 @@ def _leaky(node, ins, out, ctx):
                  alpha=float(node.attrs.get("alpha", 0.01)))]
 
 
+def _require_nchw(node):
+    """ONNX Conv/Pool/BatchNormalization are channels-first by spec; an
+    NHWC-authored graph must not export to silently-wrong semantics."""
+    if node.attrs.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError(
+            f"ONNX export of {node.op_type} with data_format="
+            f"{node.attrs['data_format']!r}: ONNX is NCHW-only — author "
+            f"the exported graph in NCHW (NHWC is a TPU runtime layout "
+            f"choice, not an interchange format)")
+
+
 @register_exporter("Conv2d")
 def _conv(node, ins, out, ctx):
+    _require_nchw(node)
     p = node.attrs.get("padding", 0)
     s = node.attrs.get("stride", 1)
     ph, pw = (p, p) if isinstance(p, int) else p
@@ -156,6 +168,7 @@ _EXPORTERS["Conv2dAddBias"] = _EXPORTERS["Conv2d"]
 
 def _pool(onnx_op):
     def fn(node, ins, out, ctx):
+        _require_nchw(node)
         a = node.attrs
         p, s = a.get("padding", 0), a.get("stride", 1)
         ph, pw = (p, p) if isinstance(p, int) else p
@@ -250,6 +263,7 @@ def _layernorm(node, ins, out, ctx):
 
 @register_exporter("BatchNorm")
 def _batchnorm(node, ins, out, ctx):
+    _require_nchw(node)
     # inputs are (x, scale, bias, running_mean, running_var) — the trained
     # stats are real graph variables and export as initializers
     # BatchNormOp's momentum weights the BATCH; ONNX momentum weights the

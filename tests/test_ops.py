@@ -178,3 +178,36 @@ def test_gather_onehot_topk():
         -np.sort(-a, axis=-1)[:, :3], rtol=1e-6)
     np.testing.assert_allclose(
         run_op(ht.argmax_op(pa, dim=1), {pa: a}), a.argmax(1))
+
+
+def test_conv_bn_pool_nhwc_matches_nchw():
+    """data_format='NHWC' (the TPU-preferred channels-last authoring) is
+    numerically identical to NCHW across conv/bias/BN/pool."""
+    import hetu_tpu as ht
+    rng = np.random.RandomState(3)
+    xv = rng.rand(2, 3, 8, 8).astype(np.float32)
+    wv = rng.randn(4, 3, 3, 3).astype(np.float32)
+    bv = rng.randn(4).astype(np.float32)
+    sv = rng.rand(4).astype(np.float32) + 0.5
+    bb = rng.randn(4).astype(np.float32)
+
+    def run(df):
+        x = ht.placeholder_op("x", shape=(2, 3, 8, 8))
+        h = x if df == "NCHW" else ht.transpose_op(x, perm=(0, 2, 3, 1))
+        w = ht.Variable("w", value=wv)
+        b = ht.Variable("b", value=bv)
+        s = ht.Variable("s", value=sv)
+        b2 = ht.Variable("b2", value=bb)
+        h = ht.conv2d_add_bias_op(h, w, b, padding=1, stride=1,
+                                  data_format=df)
+        h = ht.batch_normalization_op(h, s, b2, data_format=df)
+        h = ht.max_pool2d_op(h, 2, 2, padding=0, stride=2, data_format=df)
+        h = ht.avg_pool2d_op(h, 2, 2, padding=0, stride=2, data_format=df)
+        if df == "NHWC":
+            h = ht.transpose_op(h, perm=(0, 3, 1, 2))
+        ex = ht.Executor({"default": [h]}, seed=0)
+        return np.asarray(ex.run("default",
+                                 feed_dict={x: xv})[0].asnumpy())
+
+    np.testing.assert_allclose(run("NCHW"), run("NHWC"),
+                               rtol=1e-5, atol=1e-5)
