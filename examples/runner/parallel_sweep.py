@@ -1,0 +1,174 @@
+"""Parallelism config sweep — the reference's cookbook surface.
+
+The reference ships 20+ per-config scripts under ``examples/runner/
+parallel/`` (``complex_pipeline_mlp.py``, ``dp4_tp2.py``, ...) plus
+``all_mlp_tests.sh``/``all_cnn_tests.sh`` drivers.  Here the same cookbook
+is ONE parameterised sweep: every named config builds the same model under
+a different strategy on the virtual 8-device CPU mesh, trains a few steps
+and (where the math promises it) checks loss parity against the
+single-device run — so each config doubles as copy-paste documentation
+for that parallelism mode.
+
+    python examples/runner/parallel_sweep.py                # all configs
+    python examples/runner/parallel_sweep.py --model mlp --configs dp8,tp4
+    python examples/runner/parallel_sweep.py --list
+
+Add a config: one entry in CONFIGS — (name, strategy factory, kwargs).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    # append, don't setdefault: a pre-existing XLA_FLAGS must keep its
+    # options AND gain the 8 virtual devices the sweep meshes need
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                      # noqa: E402
+
+import hetu_tpu as ht                   # noqa: E402
+
+
+def build_mlp(batch, strategy=None, pipeline=None, num_microbatches=None):
+    rng = np.random.RandomState(0)
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    h = ht.layers.Linear(32, 64, activation="relu", name="swp.fc1")(x)
+    h = ht.layers.Linear(64, 64, activation="relu", name="swp.fc2")(h)
+    logits = ht.layers.Linear(64, 10, name="swp.fc3")(h)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(1e-2).minimize(loss)]},
+        seed=0, dist_strategy=strategy, pipeline=pipeline,
+        num_microbatches=num_microbatches)
+    W = rng.randn(32, 10).astype(np.float32)
+    X = rng.randn(batch, 32).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[np.argmax(X @ W, 1)]
+    return ex, {x: X, y_: Y}
+
+
+def build_pipeline_mlp(batch, strategy=None, **_):
+    """Staged MLP through ht.pipeline_block (the scheduled-pipeline path —
+    reference complex_pipeline_mlp.py)."""
+    rng = np.random.RandomState(0)
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+
+    def stage(h):
+        return ht.layers.Linear(32, 32, activation="relu", name="swp.ps")(h)
+
+    h = ht.pipeline_block(x, stage, n_stages=4, n_microbatches=4)
+    w = ht.Variable("swp.head", value=rng.randn(32, 10).astype(np.float32) * .2)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.SGDOptimizer(0.1).minimize(loss)]},
+        seed=0, dist_strategy=strategy)
+    W = rng.randn(32, 10).astype(np.float32)
+    X = rng.randn(batch, 32).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[np.argmax(X @ W, 1)]
+    return ex, {x: X, y_: Y}
+
+
+def build_cp_attention(batch, strategy=None, **_):
+    """Causal MHA under context parallelism (ring) — the long-context
+    recipe at toy size."""
+    rng = np.random.RandomState(0)
+    B, S, hid = 2, 16, 32
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y")
+    mha = ht.layers.MultiHeadAttention(
+        hid, 4, causal=True,
+        context_parallel="ring" if strategy else None, name="swp.mha")
+    h = mha(x, B, S)
+    w = ht.Variable("swp.aw", value=rng.randn(hid, 3).astype(np.float32) * .2)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y_), [0])
+    ex = ht.Executor(
+        {"train": [loss, ht.optim.AdamOptimizer(1e-2).minimize(loss)]},
+        seed=0, dist_strategy=strategy)
+    X = rng.randn(B * S, hid).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, B * S)]
+    return ex, {x: X, y_: Y}
+
+
+#: name -> (builder, strategy factory, executor kwargs, parity?)
+CONFIGS = {
+    "single":      (build_mlp, lambda: None, {}, True),
+    "dp8":         (build_mlp, lambda: ht.dist.DataParallel(), {}, True),
+    "tp4":         (build_mlp, lambda: ht.dist.ModelParallel(
+                        {"tp": 4}), {}, True),
+    "dp2_tp4":     (build_mlp, lambda: ht.dist.ModelParallel(
+                        {"dp": 2, "tp": 4}), {}, True),
+    "gpipe_mb4":   (build_mlp, lambda: None,
+                    {"pipeline": "gpipe", "num_microbatches": 4}, True),
+    "1f1b_mb4":    (build_mlp, lambda: None,
+                    {"pipeline": "pipedream", "num_microbatches": 4}, True),
+    "pp4_block":   (build_pipeline_mlp, lambda: ht.PipelineParallel(pp=4),
+                    {}, True),
+    "dp2_pp4":     (build_pipeline_mlp,
+                    lambda: ht.PipelineParallel(pp=4, dp=2), {}, True),
+    "cp4_ring":    (build_cp_attention, lambda: ht.ContextParallel(cp=4),
+                    {}, True),
+}
+
+
+def run_config(name, steps, batch):
+    builder, strat, kw, _ = CONFIGS[name]
+    ex, fd = builder(batch, strategy=strat(), **kw)
+    return [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+            for _ in range(steps)]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", default=None,
+                   help="comma list (default: all)")
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--list", action="store_true")
+    args = p.parse_args()
+    if args.list:
+        print("\n".join(CONFIGS))
+        return 0
+    names = [c.strip() for c in (args.configs or ",".join(CONFIGS)).split(",")
+             if c.strip()]
+    unknown = [c for c in names if c not in CONFIGS]
+    if unknown:
+        p.error(f"unknown config(s) {unknown}; see --list")
+    base = {}
+    failures = []
+    for name in names:
+        builder = CONFIGS[name][0]
+        if (builder, "single") not in base and CONFIGS[name][3]:
+            # single-device reference per builder, for parity checks
+            ex, fd = builder(args.batch_size, strategy=None)
+            base[(builder, "single")] = [
+                float(ex.run("train", feed_dict=fd)[0].asnumpy())
+                for _ in range(args.steps)]
+        losses = run_config(name, args.steps, args.batch_size)
+        status = "ok"
+        if CONFIGS[name][3]:
+            ref = base[(builder, "single")]
+            if not np.allclose(ref, losses, rtol=2e-4):
+                status = f"PARITY FAIL vs single: {ref} != {losses}"
+                failures.append(name)
+        print(f"{name:12s} losses={[round(v, 4) for v in losses]} {status}",
+              flush=True)
+    if failures:
+        print(f"FAILED: {failures}")
+        return 1
+    print(f"all {len(names)} configs ran; parity checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

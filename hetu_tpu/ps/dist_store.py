@@ -14,6 +14,19 @@ ASP (reference ``ParameterServerCommunicate.py:38`` async path):
 ``push_async`` enqueues onto a bounded background queue so device steps
 overlap with PS traffic; ``flush`` drains.  SSP clocks live on rank 0
 (the reference's scheduler role).
+
+Deliberate non-goals (vs ps-lite's transport depth).  ps-lite ships
+priority-scheduled message dispatch (``ps-lite/src/p3_van.h``) and an
+RDMA/IBVerbs zero-copy van (``ibverbs_van.h``, ~1.2k LoC).  Neither is
+reimplemented here, on purpose: on a TPU pod the dense-parameter path
+rides XLA collectives over ICI (this store only carries sparse embedding
+rows between host RAM and host RAM), the P3 priority trick exists to
+overlap push/pull with GPU backprop at single-digit-ms step times —
+covered here by ``push_async``'s bounded queue + the executor's
+one-pusher gating — and RDMA presumes NIC hardware this runtime does not
+manage.  What IS kept from ps-lite's transport: at-least-once retries
+with (client, seq) dedup for pushes AND clock ticks (``resender.h``
+semantics), socket timeouts + reconnect, and dead-peer diagnostics.
 """
 from __future__ import annotations
 

@@ -462,3 +462,49 @@ def test_flash_ab_resume_and_gate_rules(tmp_path, monkeypatch):
     assert out["flash_min_len"] == ab.SEQS[-1] * 2        # sentinel
     out = ab._persist("cpu", {"128": row}, False)
     assert out["flash_min_len"] == 128
+
+
+def test_plan_responds_to_hardware_constants():
+    """The searched plan must be a function of the MEASURED constants
+    (round-4 verdict item 6), not a fixed answer: starving the collective
+    bandwidth moves the plan away from comm-heavy strategies, and the
+    estimated time responds monotonically."""
+    specs = [transformer_layer_spec(2048, 512, 32, name=f"l{i}")
+             for i in range(6)]
+    # memory tight enough that pure dp8 is infeasible -> the search must
+    # pick SOME sharded/hybrid strategy, and the interconnect speed
+    # decides which
+    one_full = MemoryCostModel(HardwareSpec()).layer_bytes(
+        specs[0], Strategy(1, 1, 8, False))
+    mem = one_full * len(specs) * 0.5
+    fast = HardwareSpec(mem_bytes=mem, ici_bw=4.5e10)
+    slow = HardwareSpec(mem_bytes=mem, ici_bw=4.5e8)   # 100x starved
+    plan_fast = search(specs, 8, hw=fast)
+    plan_slow = search(specs, 8, hw=slow)
+    assert plan_slow.est_time > plan_fast.est_time
+    # under a starved interconnect the plan must not use MORE tensor-
+    # parallel ways (the strategy whose comm term pays activation
+    # allreduces every layer) than the fast-interconnect plan
+    assert max(s.tp for s in plan_slow.strategies) \
+        <= max(s.tp for s in plan_fast.strategies)
+
+
+def test_search_consumes_committed_calibration(tmp_path):
+    """HardwareSpec.from_artifact grounds the search in the committed
+    on-chip measurement (tools/calibrate_tpu.py artifact schema)."""
+    import dataclasses
+    import json
+    art = {"backend": "tpu", "device_kind": "TPU v5 lite",
+           "spec": dataclasses.asdict(HardwareSpec(
+               flops=1e12, mem_bytes=2e9, ici_bw=1e9, overlap=0.5))}
+    p = tmp_path / "tpu_calibration.json"
+    p.write_text(json.dumps(art))
+    hw = HardwareSpec.from_artifact(str(p))
+    assert hw is not None and hw.flops == 1e12 and hw.ici_bw == 1e9
+    # the loaded constants drive the estimate: same plan costed under the
+    # measured (slow) spec is strictly slower than under the default
+    specs = [transformer_layer_spec(1024, 256, 16, name=f"l{i}")
+             for i in range(4)]
+    t_default = DPAlg(specs, 8, hw=HardwareSpec()).fit()[0]
+    t_measured = DPAlg(specs, 8, hw=hw).fit()[0]
+    assert t_measured > t_default
