@@ -54,6 +54,14 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, mask=None,
     import jax.numpy as jnp
     from jax import lax
 
+    from .ring_flash import flash_ring_supported, ring_flash_attention_local
+    if flash_ring_supported(q, k, bias=bias):
+        # per-step Pallas flash kernel + LSE merge (TPU; the einsum ring
+        # below is the reference path and the CPU/odd-shape fallback)
+        return ring_flash_attention_local(
+            q, k, v, key_mask=key_mask, mask=mask, axis_name=axis_name,
+            causal=causal, scale=scale)
+
     S = lax.psum(1, axis_name)
     r = lax.axis_index(axis_name)
     B, H, Sc, D = q.shape

@@ -1,0 +1,252 @@
+"""Ring attention with the Pallas flash kernel per ring step.
+
+The plain ring schedule (:func:`ring_attention_local`) computes each
+resident K/V chunk with an XLA einsum — materialising (B, H, Sc, Sc)
+score blocks per step.  On TPU the flash kernel is the faster and
+O(Sc·D)-memory way to process a chunk, so this module composes the two:
+
+* **forward** — each ring step runs the EXISTING flash forward
+  (``_flash_fwd``: out + per-row LSE) on the resident chunk; chunk
+  results merge with the standard log-sum-exp combination (the same
+  online-softmax algebra the kernel uses internally, lifted one level).
+* **backward** — flash-attention-2's chunked backward needs only the
+  GLOBAL out/LSE: per chunk, ``p_ij = exp(s_ij − lse_i)`` reconstructs
+  the exact global softmax, so each ring step runs the EXISTING
+  ``_flash_bwd`` on its resident chunk; dq accumulates locally while
+  dk/dv ride the ring home with their chunk.
+
+No kernel changes: both pallas_calls are the hardware-validated
+specializations from :mod:`hetu_tpu.ops.pallas.flash_attention`; this
+module is pure orchestration under ``jax.custom_vjp`` (pallas_call has no
+autodiff — the ring IS the vjp).  CPU CI runs the same code with
+``interpret=True``.
+
+Supported: dense, causal, key-padding masks, full per-query masks.
+Additive bias stays on the einsum ring (its gradient needs per-chunk
+column accumulation that is not worth a second code path until a workload
+demands it) — the dispatcher in :mod:`ring_attention` falls back.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas.flash_attention import (_broadcast_group, _f0, _flash_bwd,
+                                          _flash_fwd)
+
+_NEG = -1e30
+
+
+def _chunk_cols(x, src, sc, axis):
+    return lax.dynamic_slice_in_dim(x, src * sc, sc, axis=axis)
+
+
+def _masks_for_chunk(key_mask, fmask, src, sc, b, h):
+    """Per-step kernel inputs: key-mask column strip (B, 1, Sc) and/or
+    full-mask block in un-broadcast (G, Sc, Sc) storage + gmode."""
+    kmask2 = fmask3 = None
+    gmode = "one"
+    if key_mask is not None:
+        kmask2 = _chunk_cols(key_mask, src, sc, 1).astype(jnp.int32)[:, None, :]
+    if fmask is not None:
+        blk = _chunk_cols(fmask, src, sc, 3).astype(jnp.int32)
+        fmask3, gmode = _broadcast_group(blk, b, h, sc, sc, "mask")
+    return kmask2, fmask3, gmode
+
+
+def _ring_perm(axis_name, S):
+    return [(i, (i + 1) % S) for i in range(S)]
+
+
+def _fwd_step(q3, kc3, vc3, kmask2, fmask3, gmode, scale, causal_flag,
+              h, blocks, interpret):
+    """branch body: flash forward on one resident chunk → (o, lse)."""
+    bq, bk = blocks
+    return _flash_fwd(q3, kc3, vc3, None, kmask2, None, fmask3, None,
+                      scale, causal_flag, gmode, "one", "one", h, bq, bk,
+                      interpret)
+
+
+def ring_flash_attention_local(q, k, v, key_mask=None, mask=None,
+                               axis_name="cp", causal=False, scale=None,
+                               block_q=None, block_k=None,
+                               interpret=False):
+    """Flash-kernel ring attention — call INSIDE shard_map over ``cp``.
+
+    Same contract as :func:`ring_attention_local` (q/k/v local chunks
+    [B, H, Sc, D]; ``key_mask`` [1|B, S_kv] full-key local; ``mask``
+    [1|B, 1|H, Sc|1, S_kv] query-sharded/full-key local), minus ``bias``.
+    Sc and D must satisfy the kernel's 128-divisibility.
+    """
+    B, H, Sc, D = q.shape
+    sc_val = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    blocks = (block_q or min(128, Sc), block_k or min(128, Sc))
+    km = None
+    if key_mask is not None:
+        km = jnp.broadcast_to(jnp.asarray(key_mask),
+                              (B, key_mask.shape[-1]))
+    fm = None
+    if mask is not None:
+        fm = jnp.broadcast_to(
+            jnp.asarray(mask),
+            (mask.shape[0], mask.shape[1], Sc, mask.shape[3]))
+
+    return _ring_flash(q, k, v, km, fm, axis_name, bool(causal), sc_val,
+                       blocks, B, H, Sc, D, bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
+                                                    11, 12, 13))
+def _ring_flash(q, k, v, km, fm, axis_name, causal, scale, blocks,
+                B, H, Sc, D, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal,
+                                  scale, blocks, B, H, Sc, D, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal, scale, blocks,
+                         B, H, Sc, D, interpret):
+    S = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    perm = _ring_perm(axis_name, S)
+    q3 = q.reshape(B * H, Sc, D)
+
+    m = jnp.full((B * H, Sc, 1), _NEG, jnp.float32)   # running max of lse
+    s = jnp.zeros((B * H, Sc, 1), jnp.float32)        # Σ exp(lse_i − m)
+    o = jnp.zeros((B * H, Sc, D), jnp.float32)        # Σ w_i · o_i
+    kc, vc = k, v
+    for t in range(S):
+        src = (r - t) % S
+        kc3 = kc.reshape(B * H, Sc, D)
+        vc3 = vc.reshape(B * H, Sc, D)
+        kmask2, fmask3, gmode = _masks_for_chunk(km, fm, src, Sc, B, H)
+
+        def dense_or_causal(flag):
+            def f(_):
+                return _fwd_step(q3, kc3, vc3, kmask2, fmask3, gmode,
+                                 scale, flag, H, blocks, interpret)
+            return f
+
+        def skipped(_):
+            return (jnp.zeros_like(q3),
+                    jnp.full((B * H, Sc, 1), 2 * _NEG, jnp.float32))
+
+        if causal:
+            # src == r: diagonal chunk (kernel causal); src < r: every key
+            # precedes every query (dense); src > r: fully masked — skip
+            # the kernel entirely (the causal-ring FLOP saving)
+            branch = jnp.where(src == r, 2, jnp.where(src < r, 1, 0))
+            oi, lse = lax.switch(branch, [skipped,
+                                          dense_or_causal(False),
+                                          dense_or_causal(True)],
+                                 operand=None)
+        else:
+            oi, lse = dense_or_causal(False)(None)
+
+        m_new = jnp.maximum(m, lse)
+        # guard the all-masked rows: exp(−inf − (−inf)) must be 0, not 1
+        w_old = jnp.where(m > _NEG, jnp.exp(m - m_new), 0.0)
+        w_new = jnp.where(lse > _NEG, jnp.exp(lse - m_new), 0.0)
+        s = s * w_old + w_new
+        o = o * w_old + oi.astype(jnp.float32) * w_new
+        m = m_new
+        if t < S - 1:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+
+    s_safe = jnp.where(s == 0.0, 1.0, s)
+    out = (o / s_safe).astype(q.dtype).reshape(B, H, Sc, D)
+    lse_g = jnp.where(s > 0.0, m + jnp.log(s_safe),
+                      jnp.full_like(m, 2 * _NEG))       # (B·H, Sc, 1)
+    return out, lse_g
+
+
+def _ring_flash_vjp_fwd(q, k, v, km, fm, axis_name, causal, scale, blocks,
+                        B, H, Sc, D, interpret):
+    out, lse_g = _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal,
+                                      scale, blocks, B, H, Sc, D, interpret)
+    return out, (q, k, v, km, fm, out, lse_g)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, blocks, B, H, Sc, D,
+                        interpret, res, do):
+    q, k, v, km, fm, out, lse_g = res
+    # fully-masked rows carry the 2·_NEG LSE sentinel; fed raw into the
+    # kernel's p = exp(s − lse) it overflows to inf and NaNs the whole
+    # chunk's dk/dv.  Re-pin those rows to lse=0: their s entries are all
+    # ≈ −1e30, so p = exp(−1e30) = 0 and the row's gradients vanish —
+    # matching the forward's zero output.  Valid rows are untouched.
+    lse_g = jnp.where(lse_g <= _NEG, 0.0, lse_g)
+    S = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    perm = _ring_perm(axis_name, S)
+    q3 = q.reshape(B * H, Sc, D)
+    out3 = out.reshape(B * H, Sc, D)
+    do3 = do.reshape(B * H, Sc, D)
+
+    dq = jnp.zeros((B * H, Sc, D), jnp.float32)
+    # dk/dv accumulators ride the ring WITH their chunk: after S rotations
+    # every chunk is home again carrying the sum over all query owners
+    kc, vc = k, v
+    dkc = jnp.zeros_like(k, dtype=jnp.float32)
+    dvc = jnp.zeros_like(v, dtype=jnp.float32)
+    for t in range(S):
+        src = (r - t) % S
+        kc3 = kc.reshape(B * H, Sc, D)
+        vc3 = vc.reshape(B * H, Sc, D)
+        kmask2, fmask3, gmode = _masks_for_chunk(km, fm, src, Sc, B, H)
+
+        def run(flag):
+            def f(_):
+                dqi, dki, dvi, _db, _dkb = _flash_bwd(
+                    q3, kc3, vc3, None, kmask2, None, fmask3, None,
+                    out3, lse_g, do3, scale, flag, gmode, "one", "one",
+                    H, blocks[0], blocks[1], interpret)
+                return dqi, dki, dvi
+            return f
+
+        def skipped(_):
+            return (jnp.zeros_like(q3), jnp.zeros_like(q3),
+                    jnp.zeros_like(q3))
+
+        if causal:
+            branch = jnp.where(src == r, 2, jnp.where(src < r, 1, 0))
+            dqi, dki, dvi = lax.switch(branch, [skipped, run(False),
+                                                run(True)], operand=None)
+        else:
+            dqi, dki, dvi = run(False)(None)
+
+        dq = dq + dqi.astype(jnp.float32)
+        dkc = dkc + dki.astype(jnp.float32).reshape(B, H, Sc, D)
+        dvc = dvc + dvi.astype(jnp.float32).reshape(B, H, Sc, D)
+        if t < S - 1:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            dkc = lax.ppermute(dkc, axis_name, perm)
+            dvc = lax.ppermute(dvc, axis_name, perm)
+    # one final rotation brings chunk (r−(S−1))%S ≡ (r+1)%S home
+    dkc = lax.ppermute(dkc, axis_name, perm)
+    dvc = lax.ppermute(dvc, axis_name, perm)
+    return (dq.astype(q.dtype).reshape(B, H, Sc, D),
+            dkc.astype(k.dtype), dvc.astype(v.dtype),
+            None if km is None else _f0(km),
+            None if fm is None else _f0(fm))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def flash_ring_supported(q, k, bias=None, backend=None):
+    """Gate: the flash ring needs kernel-legal CHUNK sequence lengths
+    (both local chunks divisible by the 128 block) and no bias."""
+    if bias is not None:
+        return False
+    ok_shapes = q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
+    be = backend or jax.default_backend()
+    return ok_shapes and be == "tpu"
+
+
+__all__ = ["ring_flash_attention_local", "flash_ring_supported"]
