@@ -465,6 +465,10 @@ class DistributedStore:
         raw = self._rpc(0, OP_CLOCKS, 0, np.asarray([channel], np.int64))
         return np.frombuffer(raw, np.int64).copy()
 
+    #: the server side blocks on a condition variable (OP_SSP_SYNC
+    #: handler) — one RPC waits out the whole bound, no client polling
+    ssp_blocking = True
+
     def ssp_sync(self, worker=None, staleness=0, timeout_ms=0, channel=0):
         w = self.rank if worker is None else worker
         # the server blocks until the staleness bound clears: the socket

@@ -282,12 +282,19 @@ class EmbeddingStore:
             return int(self._lib.hetu_ps_clock_value(self._h, worker))
         return int(self._clocks[worker])
 
+    @property
+    def ssp_blocking(self):
+        """True when ssp_sync really BLOCKS on the native condvar until
+        the bound holds (one wait, no host polling); the numpy fallback
+        reports the condition immediately and callers must poll."""
+        return bool(self._lib)
+
     def ssp_sync(self, worker, staleness, timeout_ms=0):
         """Block until this worker is within ``staleness`` clocks of the
         slowest worker. Returns False on timeout.  NOTE: the numpy
         fallback cannot block — it reports the condition immediately
         (callers that need to wait poll it, e.g. the executor's SSP
-        loop)."""
+        loop; see ``ssp_blocking``)."""
         if self._lib:
             return self._lib.hetu_ps_ssp_sync(
                 self._h, worker, staleness, timeout_ms) == 0

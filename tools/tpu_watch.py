@@ -47,6 +47,40 @@ EXTRA_JOBS = (
 )
 
 
+PROBE_LOG = os.path.join(ROOT, "artifacts", "tpu_probe_log.jsonl")
+
+
+PROBE_LOG_CAP = 2000
+
+
+def _log_probe(ok, err):
+    """Append every probe attempt to a committed artifact: if no healthy
+    window ever opens, the log IS the evidence of continuous attempts
+    (round-4 verdict item 1's fallback requirement).  Rotated at
+    PROBE_LOG_CAP lines (oldest dropped, header kept) so a long watch
+    cannot bloat the repo."""
+    os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps({
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "ok": ok, "err": err}) + "\n")
+    try:
+        with open(PROBE_LOG) as f:
+            lines = f.readlines()
+        if len(lines) > PROBE_LOG_CAP + 200:
+            head = lines[:1] if lines and "note" in lines[0] else []
+            kept = head + [json.dumps(
+                {"note": f"rotated: {len(lines) - len(head) - PROBE_LOG_CAP}"
+                         f" older probes dropped"}) + "\n"] \
+                + lines[-PROBE_LOG_CAP:]
+            tmp = PROBE_LOG + ".tmp"
+            with open(tmp, "w") as f:
+                f.writelines(kept)
+            os.replace(tmp, PROBE_LOG)
+    except OSError:
+        pass
+
+
 def _contending():
     """True iff a real pytest run OR a foreign bench.py invocation is live
     (sharing the single chip poisons both measurements); argv matchers are
@@ -147,6 +181,7 @@ def main():
                 return 1
             continue
         ok, err = _probe_backend(PROBE_TIMEOUT_S)
+        _log_probe(ok, err)
         if not ok:
             print(f"watch: tunnel down: {err}", flush=True)
             if args.once:
