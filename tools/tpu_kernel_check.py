@@ -67,11 +67,15 @@ def main():
     }
     only = os.environ.get("HETU_KC_CASES")
     if only:    # CPU smoke: the pallas interpreter is ~100x slower than
-        cases = {k: v for k, v in cases.items()   # Mosaic — subset cases
-                 if k in only.split(",")}
-        if not cases:
-            print(f"HETU_KC_CASES={only!r} matches no case", file=sys.stderr)
+        sel = only.split(",")
+        known = set(cases) | {"ring_flash"}
+        bad = [c for c in sel if c not in known]
+        if bad:
+            print(f"HETU_KC_CASES={only!r}: unknown case(s) {bad}",
+                  file=sys.stderr)
             return 1    # a vacuous green artifact would mask the typo
+        cases = {k: v for k, v in cases.items()   # Mosaic — subset cases
+                 if k in sel}
     results = {}
     ok_all = True
     for name, (fkw, rkw) in cases.items():
@@ -112,6 +116,53 @@ def main():
         ok_all = ok_all and entry["ok"]
         results[name] = entry
         print(f"{name}: {entry}", flush=True)
+
+    # (duplicates the per-case harness: the ring needs its own call form —
+    # shard_map + mask plumbing — and folding it into the kwargs-driven
+    # loop would complicate eight simple cases to save one)
+    if not only or "ring_flash" in only.split(","):
+        # the flash-RING composition (ring-level custom VJP + lax.switch
+        # around the kernels) on a 1-device 'cp' mesh: a degenerate ring,
+        # but it lowers the kernel calls in their branch/shard_map context
+        # on this chip — the composition the multi-chip path runs
+        entry = {}
+        try:
+            from jax.sharding import PartitionSpec as P
+            import hetu_tpu as ht
+            from hetu_tpu.parallel.ring_flash import \
+                ring_flash_attention_local
+            t0 = time.perf_counter()
+            mesh = ht.make_mesh({"cp": 1}, jax.devices()[:1])
+            spec = P(None, None, "cp", None)
+            ring = jax.shard_map(
+                lambda q, k, v, km: ring_flash_attention_local(
+                    q, k, v, key_mask=km, causal=True,
+                    interpret=interpret),
+                mesh=mesh, in_specs=(spec, spec, spec, P(None, None)),
+                out_specs=spec, check_vma=False)
+            out = jax.jit(ring)(q, k, v, km)
+            ref = sdpa_reference(q, k, v, causal=True,
+                                 mask=km[:, None, None, :])
+            entry["fwd_maxerr"] = float(jnp.max(jnp.abs(out - ref)))
+            g = jax.jit(jax.grad(
+                lambda q, k, v: ring(q, k, v, km).sum(),
+                argnums=(0, 1, 2)))(q, k, v)
+            gr = jax.jit(jax.grad(
+                lambda q, k, v: sdpa_reference(
+                    q, k, v, causal=True,
+                    mask=km[:, None, None, :]).sum(),
+                argnums=(0, 1, 2)))(q, k, v)
+            entry["grad_maxerr"] = max(
+                float(jnp.max(jnp.abs(a - b))) for a, b in zip(g, gr))
+            entry["wall_s"] = round(time.perf_counter() - t0, 2)
+            entry["ok"] = (entry["fwd_maxerr"] < TOL
+                           and entry["grad_maxerr"] < TOL)
+        except Exception as e:
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+        ok_all = ok_all and entry["ok"]
+        results["ring_flash"] = entry
+        print(f"ring_flash: {entry}", flush=True)
 
     from artifact_schema import provenance
 

@@ -59,11 +59,15 @@ def _log_probe(ok, err):
     (round-4 verdict item 1's fallback requirement).  Rotated at
     PROBE_LOG_CAP lines (oldest dropped, header kept) so a long watch
     cannot bloat the repo."""
-    os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
-    with open(PROBE_LOG, "a") as f:
-        f.write(json.dumps({
-            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "ok": ok, "err": err}) + "\n")
+    try:    # logging must never kill the watcher — capturing a healthy
+            # window matters more than the evidence trail
+        os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
+        with open(PROBE_LOG, "a") as f:
+            f.write(json.dumps({
+                "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "ok": ok, "err": err}) + "\n")
+    except OSError:
+        return
     try:
         with open(PROBE_LOG) as f:
             lines = f.readlines()
