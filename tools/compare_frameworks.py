@@ -69,22 +69,43 @@ def main():
         extra = ["--batch-size", str(bs), "--steps", str(args.steps)]
         if config in DEFAULT_SEQ:
             extra += ["--seq-len", str(args.seq_len or DEFAULT_SEQ[config])]
+        ours_extra = list(extra)   # bench.py-only flags stay off the
+        if config == "wdl":        # torch script's argv
+            # same-semantics comparison: torch's baseline is a PLAIN
+            # embedding, so ours must be too; the HET-cache number is
+            # measured separately below and reported alongside
+            ours_extra += ["--wdl-embed", "dense"]
         env = dict(os.environ, _HETU_BENCH_CHILD="1")
         if args.ours_backend == "cpu":
             env["_HETU_BENCH_FORCE_CPU"] = "1"
-        ours = _run([sys.executable, os.path.join(ROOT, "bench.py"),
-                     "--config", config] + extra, env=env)
-        err = ours.get("error", "")
-        if err.startswith("TPU backend unavailable") \
-                and args.ours_backend == "cpu":
-            # the requested CPU run is not a failure — keep the note but
+        def _normalize_cpu_note(res):
+            # a requested CPU run is not a failure — keep the note but
             # don't present it as an error (genuine errors stay)
-            ours.setdefault("extra", {})["note"] = ours.pop("error")
+            if res.get("error", "").startswith("TPU backend unavailable") \
+                    and args.ours_backend == "cpu":
+                res.setdefault("extra", {})["note"] = res.pop("error")
+            return res
+
+        ours = _normalize_cpu_note(
+            _run([sys.executable, os.path.join(ROOT, "bench.py"),
+                  "--config", config] + ours_extra, env=env))
         theirs = _run([sys.executable,
                        os.path.join(ROOT, "examples", "compare",
                                     "torch_baselines.py"),
                        "--config", config] + extra)
         row = {"ours": ours, "torch": theirs}
+        if config == "wdl":
+            if "error" in ours:
+                # the dense run already burnt its budget on a down
+                # backend — don't spend another timeout hitting the same
+                # wall; stamp the reason instead
+                row["ours_het_cache"] = {
+                    "error": f"skipped: dense run failed ({ours['error'][:120]})"}
+            else:
+                row["ours_het_cache"] = _normalize_cpu_note(
+                    _run([sys.executable, os.path.join(ROOT, "bench.py"),
+                          "--config", "wdl"] + extra
+                         + ["--wdl-embed", "lru"], env=env))
         ov, tv = ours.get("value"), theirs.get("value")
         if ov and tv:
             higher_better = ours.get("unit", "") != "ms/step"
@@ -95,7 +116,11 @@ def main():
     out["provenance"] = provenance(
         {c: {"batch_size": args.batch_size or CPU_BATCH[c],
              **({"seq_len": args.seq_len or DEFAULT_SEQ[c]}
-                if c in DEFAULT_SEQ else {})} for c in configs})
+                if c in DEFAULT_SEQ else {}),
+             # wdl measures BOTH embed modes (dense = the comparison row,
+             # lru = the HET-cache row) — the hash must say so
+             **({"embed": ["dense", "lru"]} if c == "wdl" else {})}
+         for c in configs})
     print(json.dumps(out, indent=1))
     return 0
 
