@@ -71,6 +71,12 @@ class MultiHeadAttention(BaseLayer):
         if self.context_parallel is not None and cp_attn is None:
             raise ValueError(
                 f"unknown context_parallel mode {self.context_parallel!r}")
+        if cp_attn is not None and kv_seq != seq:
+            # unequal-length cross-attention stays LOCAL (the T5 design,
+            # models/t5.py:40): the cp schedules slice key columns by the
+            # QUERY chunk size, which is only meaningful for matched
+            # lengths — routing it onto the ring would be silently wrong
+            cp_attn = cp_masked = None
         if mask is not None:
             if cp_masked is not None:
                 # key-padding AND full per-query masks (plus optional

@@ -534,3 +534,28 @@ def test_ring_flash_all_masked_row_zero_grads():
         a = np.asarray(a)
         assert np.isfinite(a).all()
         np.testing.assert_allclose(a[1], 0.0, atol=1e-5)
+
+
+def test_cross_attention_with_cp_routes_local():
+    """Unequal-length cross-attention on a cp-enabled MHA must use the
+    LOCAL attention path (the cp schedules slice key columns by the query
+    chunk size — only valid for matched lengths) and match the plain-MHA
+    result."""
+    rng = np.random.RandomState(40)
+    B, Sq, Skv, hid = 2, 8, 24, 32
+    xv = rng.randn(B * Sq, hid).astype(np.float32)
+    mv = rng.randn(B * Skv, hid).astype(np.float32)
+
+    def run(cp_flavor):
+        x = ht.placeholder_op("x")
+        kv = ht.placeholder_op("kv")
+        mha = ht.layers.MultiHeadAttention(hid, 4, context_parallel=cp_flavor,
+                                           name="xmha")
+        h = mha(x, B, Sq, kv=kv, kv_seq=Skv)
+        ex = ht.Executor({"default": [h]}, seed=0)
+        return np.asarray(ex.run("default",
+                                 feed_dict={x: xv, kv: mv})[0].asnumpy())
+
+    base = run(None)
+    np.testing.assert_allclose(base, run("ring"), rtol=1e-6)
+    np.testing.assert_allclose(base, run("ulysses"), rtol=1e-6)
