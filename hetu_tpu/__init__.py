@@ -19,6 +19,7 @@ Typical use (identical shape to reference examples)::
 
 from . import initializers as init
 from . import optim
+from .optim import lr_scheduler as lr  # reference alias: ht.lr.StepScheduler
 from . import context as _context_mod
 from .context import (cpu, gpu, tpu, rcpu, rgpu, DLContext, DeviceGroup,
                       context, DistConfig, make_mesh)

@@ -2,4 +2,5 @@ from .dataloader import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
 from .datasets import (mnist, cifar10, cifar100, normalize_cifar,
                        imagenet, ImageNetFolder)
 from . import transforms
-from .transforms import Compose, Normalize, RandomHorizontalFlip, RandomCrop
+from .transforms import (Compose, Normalize, RandomHorizontalFlip,
+                         RandomCrop, Resize, CenterCrop)

@@ -87,4 +87,57 @@ class Cutout:
 
 
 __all__ = ["Compose", "Normalize", "RandomHorizontalFlip", "RandomCrop",
-           "Cutout"]
+           "Cutout", "Resize", "CenterCrop"]
+
+
+class Resize:
+    """Bilinear resize of an NCHW batch to ``size`` (int or (H, W)) —
+    reference ``transforms.py:13`` (PIL) reimplemented as a vectorised
+    numpy bilinear interpolation (no per-image PIL round-trip)."""
+
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, batch):
+        n, c, h, w = batch.shape
+        oh, ow = self.size
+        if (oh, ow) == (h, w):
+            return batch
+        ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+        xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+        y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)
+        wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)
+        top = batch[:, :, y0][..., x0] * (1 - wx) \
+            + batch[:, :, y0][..., x1] * wx
+        bot = batch[:, :, y1][..., x0] * (1 - wx) \
+            + batch[:, :, y1][..., x1] * wx
+        out = top * (1 - wy[:, None]) + bot * wy[:, None]
+        if np.issubdtype(batch.dtype, np.integer):
+            out = np.rint(out)     # PIL rounds; truncation would darken
+        return out.astype(batch.dtype)
+
+
+class CenterCrop:
+    """Center-crop an NCHW batch to ``size`` (reference
+    ``transforms.py:22``); pads with zeros when the target exceeds the
+    input, matching the reference's behavior for small images."""
+
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, batch):
+        n, c, h, w = batch.shape
+        th, tw = self.size
+        if th > h or tw > w:
+            out = np.zeros((n, c, max(th, h), max(tw, w)), batch.dtype)
+            out[:, :, (out.shape[2] - h) // 2:(out.shape[2] - h) // 2 + h,
+                (out.shape[3] - w) // 2:(out.shape[3] - w) // 2 + w] = batch
+            batch = out
+            n, c, h, w = batch.shape
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return batch[:, :, i:i + th, j:j + tw]

@@ -311,12 +311,14 @@ def test_bert_tiny_trains_masked_with_cp():
 def _perm_mask(rng, B, S, H=1):
     """XLNet-style content mask: key j visible to query i iff j's position
     in a random factorisation order precedes i's (every query sees at
-    least itself)."""
+    least itself).  H>1 draws an INDEPENDENT order per head — a head
+    mix-up in sliced/broadcast mask plumbing must change the output."""
     out = np.zeros((B, H, S, S), bool)
     for b in range(B):
-        rank = np.empty(S, int)
-        rank[rng.permutation(S)] = np.arange(S)
-        out[b] = rank[None, None, :] <= rank[None, :, None]
+        for h in range(H):
+            rank = np.empty(S, int)
+            rank[rng.permutation(S)] = np.arange(S)
+            out[b, h] = rank[None, :] <= rank[:, None]
     return out
 
 
@@ -559,3 +561,17 @@ def test_cross_attention_with_cp_routes_local():
     base = run(None)
     np.testing.assert_allclose(base, run("ring"), rtol=1e-6)
     np.testing.assert_allclose(base, run("ulysses"), rtol=1e-6)
+
+
+def test_ring_flash_head_dependent_full_mask():
+    """(B, H, S, S) masks through the flash ring: the per-chunk broadcast
+    grouping (gmode='bh') must classify and slice correctly."""
+    import jax
+    rng = np.random.RandomState(34)
+    q, k, v = _qkv(rng, B=2, H=2, S=512, D=8)
+    mask = _perm_mask(rng, 2, 512, H=2)
+    mesh = ht.make_mesh({"cp": 4}, jax.devices()[:4])
+    out = _ring_flash_call(q, k, v, mesh, mask=mask)
+    ref = sdpa_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)

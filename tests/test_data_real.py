@@ -56,3 +56,22 @@ def test_mlp_learns_real_digits(digits_dir):
         accs.append(ht.metrics.accuracy(pred.asnumpy(), yv.asnumpy()))
     acc = float(np.mean(accs))
     assert acc > 0.9, f"real-digit val accuracy {acc} (random would be 0.1)"
+
+
+def test_resize_and_center_crop_transforms():
+    """Reference transforms.py Resize/CenterCrop parity: shapes, exact
+    center-crop content, pad-when-smaller behavior, bilinear ramp
+    preservation, Compose chaining (the dataloader func= path)."""
+    from hetu_tpu.data.transforms import CenterCrop, Compose, Resize
+    b = np.arange(2 * 3 * 8 * 8, dtype=np.float32).reshape(2, 3, 8, 8)
+    assert Resize(4)(b).shape == (2, 3, 4, 4)
+    assert Resize((16, 12))(b).shape == (2, 3, 16, 12)
+    np.testing.assert_allclose(CenterCrop(4)(b), b[:, :, 2:6, 2:6])
+    assert CenterCrop(12)(b).shape == (2, 3, 12, 12)
+    # bilinear on a horizontal ramp: every row stays identical
+    ramp = np.broadcast_to(np.arange(8, dtype=np.float32),
+                           (1, 1, 8, 8)).copy()
+    rr = Resize(4)(ramp)
+    np.testing.assert_allclose(rr[0, 0, 0], rr[0, 0, -1])
+    pipe = Compose([Resize(6), CenterCrop(4)])
+    assert pipe(b).shape == (2, 3, 4, 4)
