@@ -22,8 +22,10 @@ from . import optim
 from .optim import lr_scheduler as lr  # reference alias: ht.lr.StepScheduler
 from . import context as _context_mod
 from .context import (cpu, gpu, tpu, rcpu, rgpu, DLContext, DeviceGroup,
-                      context, DistConfig, make_mesh)
-from .ndarray import NDArray, array, empty, IndexedSlices, is_gpu_ctx
+                      context, current_context, get_current_context,
+                      DistConfig, make_mesh)
+from .ndarray import (NDArray, NDSparseArray, array, empty, sparse_array,
+                      IndexedSlices, is_gpu_ctx)
 from .graph import (Op, PlaceholderOp, Variable, placeholder_op, gradients,
                     GradientOp, Executor, topo_sort,
                     worker_init, worker_finish, server_init, server_finish,

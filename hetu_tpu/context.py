@@ -159,6 +159,10 @@ def current_context():
     return _ctx_stack.peek()
 
 
+#: reference name (context.py:170) — same function
+get_current_context = current_context
+
+
 @contextlib.contextmanager
 def context(ctx):
     """``with ht.context(ht.gpu(0)):`` placement scope (reference context.py:174)."""

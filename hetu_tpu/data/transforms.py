@@ -91,18 +91,39 @@ __all__ = ["Compose", "Normalize", "RandomHorizontalFlip", "RandomCrop",
 
 
 class Resize:
-    """Bilinear resize of an NCHW batch to ``size`` (int or (H, W)) —
-    reference ``transforms.py:13`` (PIL) reimplemented as a vectorised
-    numpy bilinear interpolation (no per-image PIL round-trip)."""
+    """Resize an NCHW batch to ``size`` (int or (H, W)) — reference
+    ``transforms.py:13`` (PIL bilinear), vectorised numpy (no per-image
+    PIL round-trip).  PIL area-weights over the full source footprint on
+    downscale (antialias); a plain 2-tap bilinear would alias past 2×
+    reduction, so heavier downscales box-prefilter by 2× halvings (the
+    mipmap construction) until within bilinear range."""
 
     def __init__(self, size):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
+    @staticmethod
+    def _halve(batch, axis):
+        n = batch.shape[axis]
+        if n % 2:   # drop the trailing odd row/col (size-preserving
+            batch = np.take(batch, range(n - 1), axis=axis)  # enough here)
+        sl0 = [slice(None)] * batch.ndim
+        sl1 = [slice(None)] * batch.ndim
+        sl0[axis] = slice(0, None, 2)
+        sl1[axis] = slice(1, None, 2)
+        return (batch[tuple(sl0)].astype(np.float32)
+                + batch[tuple(sl1)]) * 0.5
+
     def __call__(self, batch):
-        n, c, h, w = batch.shape
         oh, ow = self.size
-        if (oh, ow) == (h, w):
-            return batch
+        if (oh, ow) == batch.shape[2:]:
+            return np.array(batch, copy=True)   # uniform fresh-array
+        dt = batch.dtype                        # contract (see CenterCrop)
+        work = batch
+        while work.shape[2] >= 2 * oh and work.shape[2] >= 4:
+            work = self._halve(work, 2)
+        while work.shape[3] >= 2 * ow and work.shape[3] >= 4:
+            work = self._halve(work, 3)
+        n, c, h, w = work.shape
         ys = (np.arange(oh) + 0.5) * h / oh - 0.5
         xs = (np.arange(ow) + 0.5) * w / ow - 0.5
         y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
@@ -111,14 +132,14 @@ class Resize:
         x1 = np.clip(x0 + 1, 0, w - 1)
         wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)
         wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)
-        top = batch[:, :, y0][..., x0] * (1 - wx) \
-            + batch[:, :, y0][..., x1] * wx
-        bot = batch[:, :, y1][..., x0] * (1 - wx) \
-            + batch[:, :, y1][..., x1] * wx
+        rows0 = work[:, :, y0]       # hoisted: one gather per source row
+        rows1 = work[:, :, y1]
+        top = rows0[..., x0] * (1 - wx) + rows0[..., x1] * wx
+        bot = rows1[..., x0] * (1 - wx) + rows1[..., x1] * wx
         out = top * (1 - wy[:, None]) + bot * wy[:, None]
-        if np.issubdtype(batch.dtype, np.integer):
-            out = np.rint(out)     # PIL rounds; truncation would darken
-        return out.astype(batch.dtype)
+        if np.issubdtype(dt, np.integer):
+            out = np.rint(out)       # PIL rounds; truncation would darken
+        return out.astype(dt)
 
 
 class CenterCrop:
@@ -140,4 +161,7 @@ class CenterCrop:
             n, c, h, w = batch.shape
         i = (h - th) // 2
         j = (w - tw) // 2
-        return batch[:, :, i:i + th, j:j + tw]
+        # fresh contiguous array, not a view: transforms run on the
+        # dataloader prefetch thread and a view would alias the cached
+        # dataset (and pin the uncropped parent buffer)
+        return np.ascontiguousarray(batch[:, :, i:i + th, j:j + tw])

@@ -108,3 +108,31 @@ class IndexedSlices:
         out = np.zeros((len(uniq), vals.shape[-1]), vals.dtype)
         np.add.at(out, inv, vals)
         return uniq, out
+
+
+class NDSparseArray:
+    """COO sparse matrix (reference ``ND_Sparse_Array``, ndarray.py:460):
+    values + (row, col) indices + dense shape.  On TPU the compute path
+    uses dense/segment-sum lowerings (:mod:`hetu_tpu.ops.gnn`); this host
+    type keeps the construction API portable."""
+
+    __slots__ = ("values", "row", "col", "shape", "ctx")
+
+    def __init__(self, values, row, col, shape, ctx=None):
+        self.values = np.asarray(values)
+        self.row = np.asarray(row).astype(np.int64)
+        self.col = np.asarray(col).astype(np.int64)
+        self.shape = tuple(shape)
+        self.ctx = ctx
+
+    def asnumpy(self):
+        out = np.zeros(self.shape, self.values.dtype)
+        np.add.at(out, (self.row, self.col), self.values)
+        return out
+
+
+def sparse_array(values, indices, shape, ctx=None):
+    """Reference ``ndarray.py:477``: COO construction from
+    ``(values, (rows, cols), shape)``."""
+    row, col = indices
+    return NDSparseArray(values, row, col, shape, ctx=ctx)

@@ -211,3 +211,19 @@ def test_conv_bn_pool_nhwc_matches_nchw():
 
     np.testing.assert_allclose(run("NCHW"), run("NHWC"),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_reference_export_parity_surface():
+    """Reference __init__ exports (python/hetu/__init__.py) resolve here:
+    a ported script's imports must not break."""
+    import hetu_tpu as ht
+    for name in ("context", "get_current_context", "DistConfig",
+                 "dataloader_op", "Dataloader", "GNNDataLoaderOp",
+                 "cpu", "gpu", "rcpu", "rgpu", "array", "sparse_array",
+                 "empty", "is_gpu_ctx", "IndexedSlices",
+                 "optim", "lr", "init", "data", "layers", "dist",
+                 "HetuProfiler"):
+        assert hasattr(ht, name), name
+    # COO sparse_array round-trips to dense (reference ndarray.py:477)
+    sa = ht.sparse_array([1.0, 2.0], ([0, 1], [1, 0]), (2, 2))
+    np.testing.assert_allclose(sa.asnumpy(), [[0.0, 1.0], [2.0, 0.0]])
