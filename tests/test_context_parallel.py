@@ -575,3 +575,25 @@ def test_ring_flash_head_dependent_full_mask():
     ref = sdpa_reference(q, k, v, mask=mask)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_dp_times_cp_with_masks():
+    """dp x cp mesh: batch-sharded q/k/v AND batch-sharded key mask through
+    the flash ring (local-batch slicing of every kernel input)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from hetu_tpu.parallel.ring_flash import ring_flash_attention_local
+    rng = np.random.RandomState(35)
+    q, k, v = _qkv(rng, B=4, H=2, S=256, D=8)
+    km = rng.rand(4, 256) > 0.3
+    km[:, 0] = True
+    mesh = ht.make_mesh({"dp": 2, "cp": 2}, jax.devices()[:4])
+    spec = P("dp", None, "cp", None)
+    out = jax.shard_map(
+        lambda q, k, v, km: ring_flash_attention_local(
+            q, k, v, key_mask=km, causal=True, interpret=True),
+        mesh=mesh, in_specs=(spec, spec, spec, P("dp", None)),
+        out_specs=spec, check_vma=False)(q, k, v, km)
+    ref = sdpa_reference(q, k, v, causal=True, mask=km[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
