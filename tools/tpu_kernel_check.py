@@ -177,7 +177,10 @@ def main():
            # lowering), and a HETU_KC_CASES subset run
            "partial": (not ok_all) or backend != "tpu" or bool(only)}
     os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
-    path = os.path.join(ROOT, "artifacts", "kernel_check.json")
+    # HETU_KC_ARTIFACT: subset/smoke runs write elsewhere so they never
+    # overwrite a full check's red-case diagnostics
+    path = os.environ.get("HETU_KC_ARTIFACT") or \
+        os.path.join(ROOT, "artifacts", "kernel_check.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)

@@ -32,18 +32,27 @@ CHILD_TIMEOUT_S = int(os.environ.get("HETU_WATCH_CHILD_TIMEOUT", "600"))
 PROBE_TIMEOUT_S = int(os.environ.get("HETU_WATCH_PROBE_TIMEOUT", "75"))
 # extra one-shot measurement jobs (flash A/B, hardware calibration) run
 # after the bench configs; each writes its own artifact file
-# (name, cmd, artifact, pre): pre-jobs run BEFORE the bench configs —
-# kernel_check diagnoses a specialization that fails to lower on this chip
-# before any bench/A/B number builds on it
+# (name, cmd, artifact, pre): pre-jobs run BEFORE the bench configs.
+# The pre-job is a SMOKE subset (the flagship-relevant specializations):
+# it diagnoses a kernel that fails to lower on this chip before any bench
+# number builds on it, but doesn't spend a short healthy window compiling
+# all nine cases — the FULL check runs as a post-job.  The smoke writes a
+# partial artifact (subset), so it re-runs each window until the full
+# check lands; its cache entry is keyed separately so the full job still
+# runs.
+_KC = [sys.executable, os.path.join(ROOT, "tools", "tpu_kernel_check.py")]
+_KC_ARTIFACT = os.path.join(ROOT, "artifacts", "kernel_check.json")
+_KC_SMOKE_ARTIFACT = os.path.join(ROOT, "artifacts", "kernel_smoke.json")
 EXTRA_JOBS = (
-    ("kernel_check",
-     [sys.executable, os.path.join(ROOT, "tools", "tpu_kernel_check.py")],
-     os.path.join(ROOT, "artifacts", "kernel_check.json"), True),
+    ("kernel_smoke", _KC, _KC_SMOKE_ARTIFACT, True,
+     {"HETU_KC_CASES": "dense,key_mask,causal,ring_flash",
+      "HETU_KC_ARTIFACT": _KC_SMOKE_ARTIFACT}),
     ("flash_ab", [sys.executable, os.path.join(ROOT, "tools", "flash_ab.py")],
-     os.path.join(ROOT, "artifacts", "flash_ab.json"), False),
+     os.path.join(ROOT, "artifacts", "flash_ab.json"), False, None),
     ("calibration",
      [sys.executable, os.path.join(ROOT, "tools", "calibrate_tpu.py")],
-     os.path.join(ROOT, "artifacts", "tpu_calibration.json"), False),
+     os.path.join(ROOT, "artifacts", "tpu_calibration.json"), False, None),
+    ("kernel_check", _KC, _KC_ARTIFACT, False, None),
 )
 
 
@@ -142,13 +151,14 @@ def _artifact_valid(path):
         return False
 
 
-def _run_extra(name, cmd, artifact):
+def _run_extra(name, cmd, artifact, extra_env=None):
     if _artifact_valid(artifact):
         return True, "artifact already present"
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=CHILD_TIMEOUT_S,
-                              env=dict(os.environ, **{CHILD_ENV_FLAG: "1"}))
+                              env=dict(os.environ, **{CHILD_ENV_FLAG: "1"},
+                                       **(extra_env or {})))
     except subprocess.TimeoutExpired:
         return False, "timeout"
     except OSError as e:
@@ -171,10 +181,19 @@ def main():
     while time.monotonic() < deadline:
         cache = _load_cache()
         todo = [c for c in CONFIGS if c not in cache["configs"]]
-        jobs_todo = [(n, c, a, pre) for n, c, a, pre in EXTRA_JOBS
-                     if not (cache.get("jobs", {}).get(n, {}).get("ok")
-                             and _artifact_valid(a))
-                     and os.path.exists(c[1])]
+        def _job_done(n, a):
+            if not cache.get("jobs", {}).get(n, {}).get("ok"):
+                return False
+            if n == "kernel_smoke":
+                # the smoke is a subset by design (always partial=true):
+                # one green run per round is its job — don't recompile it
+                # at the head of every subsequent window
+                return os.path.exists(a)
+            return _artifact_valid(a)
+
+        jobs_todo = [(n, c, a, pre, env)
+                     for n, c, a, pre, env in EXTRA_JOBS
+                     if not _job_done(n, a) and os.path.exists(c[1])]
         if not todo and not jobs_todo:
             print("watch: all configs + jobs captured; done", flush=True)
             return 0
@@ -196,10 +215,10 @@ def main():
               flush=True)
 
         def _run_jobs(jobs):
-            for name, cmd, artifact, _pre in jobs:
+            for name, cmd, artifact, _pre, extra_env in jobs:
                 if _contending():
                     return
-                ok, info = _run_extra(name, cmd, artifact)
+                ok, info = _run_extra(name, cmd, artifact, extra_env)
                 cache = _load_cache()
                 cache.setdefault("jobs", {})[name] = {
                     "ok": ok, "info": info,
