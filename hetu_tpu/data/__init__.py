@@ -1,6 +1,6 @@
 from .dataloader import Dataloader, DataloaderOp, GNNDataLoaderOp, dataloader_op
 from .datasets import (mnist, cifar10, cifar100, normalize_cifar,
-                       imagenet, ImageNetFolder)
+                       imagenet, ImageNetFolder, convert_to_one_hot)
 from . import transforms
 from .transforms import (Compose, Normalize, RandomHorizontalFlip,
                          RandomCrop, Resize, CenterCrop)

@@ -181,3 +181,14 @@ def imagenet(root=None, image_size=224, batch_size=32, **kw):
                            **kw),
             ImageNetFolder(va, "val", image_size, batch_size=batch_size,
                            shuffle=False, **kw))
+
+
+def convert_to_one_hot(vals, max_val=0):
+    """Label array → one-hot float32 (reference ``data.py:226`` — used
+    across its example mains)."""
+    vals = np.asarray(vals).astype(np.int64).reshape(-1)
+    if max_val == 0:
+        max_val = int(vals.max()) + 1
+    out = np.zeros((vals.size, max_val), np.float32)
+    out[np.arange(vals.size), vals] = 1.0
+    return out

@@ -227,3 +227,7 @@ def test_reference_export_parity_surface():
     # COO sparse_array round-trips to dense (reference ndarray.py:477)
     sa = ht.sparse_array([1.0, 2.0], ([0, 1], [1, 0]), (2, 2))
     np.testing.assert_allclose(sa.asnumpy(), [[0.0, 1.0], [2.0, 0.0]])
+    # label one-hot helper (reference data.py:226)
+    np.testing.assert_allclose(
+        ht.data.convert_to_one_hot(np.array([1, 0]), max_val=3),
+        [[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
