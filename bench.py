@@ -420,14 +420,21 @@ def _foreign_bench_running():
 
 def _probe_backend(timeout_s):
     """(ok, err) — ok iff jax backend init answers within timeout_s AND the
-    default backend is an accelerator (a disposable child, so a hang inside
-    jax.devices() cannot wedge the parent).  ``err`` carries the real cause
+    default backend is an accelerator AND a tiny computation actually
+    executes on it (a disposable child, so a hang inside jax cannot wedge
+    the parent).  The compute check matters: the axon tunnel has been
+    observed half-wedged — ``jax.devices()`` answers (control plane) while
+    any dispatched program hangs forever (data plane) — and a
+    metadata-only probe would green-light a window in which every bench
+    child burns its full timeout.  ``err`` carries the real cause
     (timeout vs init failure vs silent-CPU) for the final JSON artifact."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print('LIVE', jax.default_backend(), d[0].device_kind)"],
+             "import jax, jax.numpy as jnp; d = jax.devices(); "
+             "v = jnp.arange(8.0).sum().block_until_ready(); "
+             "print('LIVE', jax.default_backend(), d[0].device_kind, "
+             "float(v))"],
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return False, f"probe timed out after {timeout_s:.0f}s (tunnel wedged)"
