@@ -7,6 +7,7 @@ from .t5 import (T5Config, t5_encoder, t5_decoder, t5_seq2seq_graph,
                  synthetic_seq2seq_batch)
 from .vit import (ViTConfig, vit_model, vit_classify_graph,
                   synthetic_image_batch)
+from .swin import SwinConfig, swin_model, swin_classify_graph
 from .transformer import (TransformerConfig, transformer_graph,
                           synthetic_copy_batch)
 from .bart import BartConfig, bart_seq2seq_graph
