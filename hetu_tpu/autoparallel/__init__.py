@@ -14,7 +14,8 @@ Workflow (reference ``tools/Galvatron/README.md:15-100``):
 from .cost_model import (HardwareSpec, LayerSpec, MemoryCostModel, Strategy,
                          TimeCostModel, transformer_layer_spec,
                          attention_layer_spec, mlp_layer_spec,
-                         embedding_layer_spec, model_layer_specs)
+                         embedding_layer_spec, model_layer_specs,
+                         swin_layer_specs)
 from .search import DPAlg, candidate_strategies, search
 from .plan import ParallelPlan
 
@@ -181,5 +182,6 @@ def long_context_cp_plan(n_devices, mem_bytes=2.5e9, hw=None, layers=4,
 __all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
            "long_context_cp_plan", "Strategy", "transformer_layer_spec", "attention_layer_spec",
            "mlp_layer_spec", "embedding_layer_spec", "model_layer_specs",
+           "swin_layer_specs",
            "DPAlg", "candidate_strategies", "search", "ParallelPlan",
            "calibrate_hardware", "measure_overlap"]

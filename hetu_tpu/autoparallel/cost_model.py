@@ -287,7 +287,67 @@ def model_layer_specs(n_layers, hidden, seq, batch, vocab, ffn_mult=4,
     return specs
 
 
+def swin_layer_specs(image_size, patch_size, embed_dim, depths, num_heads,
+                     window_size, batch, mlp_ratio=4, dtype_bytes=2):
+    """Hierarchical swin chain for the multi-layer-type DP search — the
+    reference's fourth Galvatron runtime family (``tools/Galvatron/swin/``
+    profiles these same per-layer costs from torch; here they derive from
+    the geometry of ``models/swin.py``).
+
+    Swin's cost structure differs from the uniform-transformer chain in
+    two ways the search must see: (1) attention is WINDOWED — the s² score
+    term runs at seq=w² over batch·nW windows, so it stays cheap while the
+    projection/MLP cost tracks the full token count; (2) the stage ladder
+    halves tokens and doubles width at each patch-merge, so early stages
+    are activation-heavy (pipeline-split-expensive) while late stages are
+    parameter-heavy (fsdp/tp-friendly).
+    """
+    import dataclasses
+    del num_heads  # head count does not change FLOPs/bytes at this level
+    assert image_size % patch_size == 0
+    specs = []
+    res = image_size // patch_size
+    in_dim = 3 * patch_size * patch_size
+    specs.append(LayerSpec(
+        "patch_embed", float(in_dim * embed_dim * dtype_bytes),
+        float(2 * batch * res * res * in_dim * embed_dim),
+        float(batch * res * res * embed_dim * dtype_bytes * 2)))
+    dim = embed_dim
+    for si, depth in enumerate(depths):
+        w = min(window_size, res)
+        # mirror the model's build-time geometry contract
+        # (models/swin.py SwinConfig): silently floor-dividing here would
+        # price a model that cannot be built
+        assert res % w == 0, (
+            f"stage {si}: resolution {res} not divisible by window {w}")
+        tokens = batch * res * res            # == (batch·nW) · w²
+        for bi in range(depth):
+            spec = attention_layer_spec(
+                hidden=dim, seq=w * w, batch=tokens // (w * w),
+                dtype_bytes=dtype_bytes, name=f"s{si}.attn{bi}")
+            # windows are mutually independent: a token-parallel cp shard
+            # aligned to window boundaries exchanges NO K/V, so the cp
+            # ring charge (TimeCostModel attn path) must not apply
+            specs.append(dataclasses.replace(spec, attn=False,
+                                             kv_bytes=0.0))
+            specs.append(mlp_layer_spec(
+                hidden=dim, seq=res * res, batch=batch,
+                ffn_mult=mlp_ratio, dtype_bytes=dtype_bytes,
+                name=f"s{si}.mlp{bi}"))
+        if si + 1 < len(depths):
+            assert res % 2 == 0, f"stage {si}: odd resolution {res}"
+            merged = tokens // 4
+            specs.append(LayerSpec(
+                f"s{si}.merge", float(4 * dim * 2 * dim * dtype_bytes),
+                float(2 * merged * 4 * dim * 2 * dim),
+                float(merged * 4 * dim * dtype_bytes)))
+            res //= 2
+            dim *= 2
+    return specs
+
+
 __all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
            "TimeCostModel", "transformer_layer_spec",
            "attention_layer_spec", "mlp_layer_spec",
-           "embedding_layer_spec", "model_layer_specs"]
+           "embedding_layer_spec", "model_layer_specs",
+           "swin_layer_specs"]

@@ -508,3 +508,40 @@ def test_search_consumes_committed_calibration(tmp_path):
     t_default = DPAlg(specs, 8, hw=HardwareSpec()).fit()[0]
     t_measured = DPAlg(specs, 8, hw=hw).fit()[0]
     assert t_measured > t_default
+
+
+def test_swin_layer_specs_stage_ladder():
+    """The swin chain exposes the hierarchy the search must see: windowed
+    attention keeps the score term cheap, and patch merges trade tokens
+    for width (later stages parameter-heavy, earlier activation-heavy)."""
+    from hetu_tpu.autoparallel import swin_layer_specs
+    specs = swin_layer_specs(image_size=224, patch_size=4, embed_dim=96,
+                             depths=(2, 2, 6, 2), num_heads=(3, 6, 12, 24),
+                             window_size=7, batch=8)
+    by_name = {s.name: s for s in specs}
+    # 1 embed + sum(depths)*2 blocks + 3 merges
+    assert len(specs) == 1 + 2 * (2 + 2 + 6 + 2) + 3
+    # width doubles per stage: params grow ~4x stage-over-stage
+    assert by_name["s3.attn0"].param_bytes == \
+        pytest.approx(64 * by_name["s0.attn0"].param_bytes)
+    # tokens quarter per stage: activations shrink
+    assert by_name["s3.mlp0"].act_bytes < by_name["s0.mlp0"].act_bytes
+    # windowed attention: the score term is w2-bounded, so stage-0
+    # attention FLOPs stay within ~2x of its projection FLOPs (a global
+    # 3136-token attention would be ~25x)
+    proj_flops = 2 * (8 * 56 * 56) * 4 * 96 * 96
+    assert by_name["s0.attn0"].fwd_flops < 2 * proj_flops
+    # the chain is searchable end-to-end
+    plan = search(specs, n_devices=8)
+    assert len(plan.strategies) == len(specs)
+
+
+def test_swin_specs_reject_untileable_geometry_and_skip_cp_charge():
+    """Geometry the model would refuse must fail the cost model too, and
+    window-local attention must not pay the cp ring rotation."""
+    from hetu_tpu.autoparallel import swin_layer_specs
+    with pytest.raises(AssertionError):
+        swin_layer_specs(224, 4, 96, (2, 2), (3, 6), window_size=12,
+                         batch=8)
+    specs = swin_layer_specs(32, 4, 32, (2, 2), (2, 4), 4, batch=8)
+    assert all(not s.attn for s in specs if "attn" in s.name)
