@@ -156,24 +156,54 @@ def _compute_dtype():
     return "bfloat16" if jax.default_backend() == "tpu" else None
 
 
-def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
-    """Flagship config: BERT-base padded MLM pretraining.
+def _load_example_models(family):
+    """Load ``examples/<family>``'s models under a unique module name.
 
-    seq 512 (the flash-gated regime) with a real attention_mask input —
-    the kernel's key-mask strip path is the measured path, per the round-3
-    verdict (seq 128 dense never reached the kernel)."""
+    Both cnn and ctr call their module ``models``; a plain ``import
+    models`` serves whichever loaded first to the second caller when one
+    process builds several configs (tools/hlo_audit.py --config all), and
+    the old relative ``sys.path.insert(0, "examples/cnn")`` broke when
+    invoked from outside the repo root."""
+    import importlib.util
+    root = os.path.dirname(os.path.abspath(__file__))
+    base = os.path.join(root, "examples", family)
+    path = os.path.join(base, "models", "__init__.py")
+    if not os.path.exists(path):
+        path = os.path.join(base, "models.py")
+    name = f"_bench_{family}_models"
+    if name in sys.modules:
+        return sys.modules[name]
+    kw = {}
+    if path.endswith("__init__.py"):   # package: enable relative imports
+        kw["submodule_search_locations"] = [os.path.dirname(path)]
+    spec = importlib.util.spec_from_file_location(name, path, **kw)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- shared graph builders ---------------------------------------------------
+# Each bench_* measures the graph its build_*_graph builds, and
+# tools/hlo_audit.py audits the SAME builders — the audited program and the
+# measured program cannot drift apart.
+
+def build_bert_graph(batch_size=64, seq_len=512,
+                     compute_dtype="__bench_default__"):
+    """The flagship training step: BERT-base padded MLM (see bench_bert).
+    Returns (cfg, ex, fd)."""
     import jax
     import hetu_tpu as ht
     from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,
                                       synthetic_mlm_batch)
 
-    if batch_size is None:
-        batch_size = 64 if seq_len >= 512 else 192
+    if compute_dtype == "__bench_default__":
+        compute_dtype = _compute_dtype()
     cfg = BertConfig.base(batch_size=batch_size, seq_len=seq_len)
     feeds, loss, logits = bert_pretrain_graph(cfg)
     opt = ht.optim.AdamOptimizer(1e-4)
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     compute_dtype=_compute_dtype())
+                     compute_dtype=compute_dtype)
     ids, tt, labels, attn = synthetic_mlm_batch(cfg)
     # ids/labels/mask stay int32 end-to-end: integer feeds are exempt from
     # the bf16 compute_dtype cast (bf16 is exact only up to 256)
@@ -181,6 +211,96 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
           feeds["token_type_ids"]: jax.device_put(np.asarray(tt, np.int32)),
           feeds["masked_lm_labels"]: jax.device_put(np.asarray(labels, np.int32)),
           feeds["attention_mask"]: jax.device_put(np.asarray(attn, np.int32))}
+    return cfg, ex, fd
+
+
+def build_resnet18_graph(batch_size=128, data_format=None,
+                         compute_dtype="__bench_default__"):
+    """resnet18/CIFAR10 Momentum step (see bench_resnet18); data_format
+    None → per-backend pick (measured: NHWC wins on TPU lane mapping,
+    loses 1.5x on XLA-CPU — artifacts/resnet_cpu_root_cause.json).
+    Returns (None, ex, fd)."""
+    import jax
+    import hetu_tpu as ht
+    models = _load_example_models("cnn")
+
+    if compute_dtype == "__bench_default__":
+        compute_dtype = _compute_dtype()
+    x = ht.placeholder_op("x", shape=(batch_size, 3, 32, 32))
+    y_ = ht.placeholder_op("y", shape=(batch_size, 10))
+    if data_format is None:
+        data_format = "NHWC" if jax.default_backend() == "tpu" else "NCHW"
+    loss, y = models.resnet18(x, y_, data_format=data_format)
+    ex = ht.Executor(
+        {"train": [loss,
+                   ht.optim.MomentumOptimizer(0.1).minimize(loss)]},
+        seed=0, compute_dtype=compute_dtype)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
+    fd = {x: jax.device_put(xv), y_: jax.device_put(yv)}
+    return None, ex, fd
+
+
+def build_wdl_graph(batch_size=2048, policy="lru"):
+    """Wide&Deep CTR SGD step (see bench_wdl) — f32 end-to-end by design:
+    the workload is embedding-lookup bound; bf16 would round 100k-row
+    id-gradients for no MXU win.  Returns (None, ex, fd) plus the
+    placeholder nodes for multi-batch feeding: (dense, sparse, y_)."""
+    import hetu_tpu as ht
+    ctr = _load_example_models("ctr")
+
+    dense = ht.placeholder_op("dense")
+    # ids must stay integral: float32 is exact only below 2^24, real
+    # Criteo vocabs exceed it (the bench_bert int32-feed lesson)
+    sparse = ht.placeholder_op("sparse", dtype=np.int64)
+    y_ = ht.placeholder_op("y")
+    loss, prob = ctr.wdl_criteo(dense, sparse, y_, batch_size,
+                                vocab=100000, dim=16, embed_mode=policy,
+                                lr=0.01)
+    opt = ht.optim.SGDOptimizer(0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    d, s, y = ctr.synthetic_criteo(batch_size, vocab=100000)
+    return None, ex, {dense: d, sparse: s, y_: y}, (dense, sparse, y_)
+
+
+def build_moe_graph(batch_tokens=8192, compute_dtype="__bench_default__"):
+    """GShard top-2 16-expert MoE Adam step (see bench_moe).
+    Returns (None, ex, fd)."""
+    import jax
+    import hetu_tpu as ht
+
+    if compute_dtype == "__bench_default__":
+        compute_dtype = _compute_dtype()
+    d, experts = 512, 16
+    x = ht.placeholder_op("x", shape=(batch_tokens, d))
+    y_ = ht.placeholder_op("y", shape=(batch_tokens, d))
+    gate = ht.layers.TopKGate(d, batch_tokens, experts, k=2,
+                              capacity_factor=1.25)
+    moe = ht.layers.MoELayer(gate, ht.layers.Expert(experts, d, 4 * d))
+    h, aux = moe(x)
+    loss = ht.reduce_mean_op(ht.ops.mul_op(h - y_, h - y_), [0, 1]) \
+        + aux * 0.01
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     compute_dtype=compute_dtype)
+    rng = np.random.RandomState(0)
+    fd = {x: jax.device_put(rng.randn(batch_tokens, d).astype(np.float32)),
+          y_: jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))}
+    return None, ex, fd
+
+
+def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
+    """Flagship config: BERT-base padded MLM pretraining.
+
+    seq 512 (the flash-gated regime) with a real attention_mask input —
+    the kernel's key-mask strip path is the measured path, per the round-3
+    verdict (seq 128 dense never reached the kernel)."""
+    import jax
+
+    if batch_size is None:
+        batch_size = 64 if seq_len >= 512 else 192
+    cfg, ex, fd = build_bert_graph(batch_size=batch_size, seq_len=seq_len)
 
     dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
     out = ex.run("train", feed_dict=fd)
@@ -235,22 +355,8 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
 
 def bench_resnet18(batch_size=128, steps=20, warmup=3):
     import jax
-    import hetu_tpu as ht
-    sys.path.insert(0, "examples/cnn")
-    import models
 
-    x = ht.placeholder_op("x", shape=(batch_size, 3, 32, 32))
-    y_ = ht.placeholder_op("y", shape=(batch_size, 10))
-    # layout per backend (measured: NHWC wins on TPU-style lane mapping,
-    # loses 1.5x on XLA-CPU — artifacts/resnet_cpu_root_cause.json)
-    df = "NHWC" if jax.default_backend() == "tpu" else "NCHW"
-    loss, y = models.resnet18(x, y_, data_format=df)
-    ex = ht.Executor({"train": [loss, ht.optim.MomentumOptimizer(0.1).minimize(loss)]},
-                     compute_dtype=_compute_dtype())
-    rng = np.random.RandomState(0)
-    xv = rng.rand(batch_size, 3, 32, 32).astype(np.float32)
-    yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
-    fd = {x: jax.device_put(xv), y_: jax.device_put(yv)}  # on-device feeds
+    _, ex, fd = build_resnet18_graph(batch_size=batch_size)
     dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
     base_ms, label = _torch_bench_baseline("resnet18",
                                            {"batch_size": batch_size})
@@ -605,20 +711,10 @@ def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
     rows pulled through the bounded-staleness cache around each jitted
     step (reference run_hetu.py:121-126 cache flags)."""
     import jax
-    import hetu_tpu as ht
-    sys.path.insert(0, "examples/ctr")
-    import models as ctr
 
-    dense = ht.placeholder_op("dense")
-    # ids must stay integral: float32 is exact only below 2^24, real
-    # Criteo vocabs exceed it (the bench_bert int32-feed lesson)
-    sparse = ht.placeholder_op("sparse", dtype=np.int64)
-    y_ = ht.placeholder_op("y")
-    loss, prob = ctr.wdl_criteo(dense, sparse, y_, batch_size,
-                                vocab=100000, dim=16, embed_mode=policy,
-                                lr=0.01)
-    opt = ht.optim.SGDOptimizer(0.01)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    _, ex, _fd0, (dense, sparse, y_) = build_wdl_graph(
+        batch_size=batch_size, policy=policy)
+    ctr = _load_example_models("ctr")
     # Zipf-skewed ids: the HET cache's hit pattern (and therefore the
     # measured step time) is only meaningful under Criteo-like skew
     d_all, s_all, y_all = ctr.synthetic_criteo_skewed(8 * batch_size,
@@ -670,24 +766,9 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
     top-2 gate, 16 experts; on one chip the a2a is local, on an 'ep'
     mesh XLA shards the expert dim)."""
     import jax
-    import hetu_tpu as ht
 
-    d, experts = 512, 16
-    x = ht.placeholder_op("x", shape=(batch_tokens, d))
-    y_ = ht.placeholder_op("y", shape=(batch_tokens, d))
-    gate = ht.layers.TopKGate(d, batch_tokens, experts, k=2,
-                              capacity_factor=1.25)
-    moe = ht.layers.MoELayer(gate, ht.layers.Expert(experts, d, 4 * d))
-    h, aux = moe(x)
-    loss = ht.reduce_mean_op(ht.ops.mul_op(h - y_, h - y_), [0, 1]) \
-        + aux * 0.01
-    opt = ht.optim.AdamOptimizer(1e-3)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     compute_dtype=_compute_dtype())
-    rng = np.random.RandomState(0)
-    xv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
-    yv = jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))
-    fd = {x: xv, y_: yv}
+    _, ex, fd = build_moe_graph(batch_tokens=batch_tokens)
+    experts = 16
     dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
     base, label = _torch_bench_baseline("moe", {"tokens": batch_tokens})
     return {

@@ -325,11 +325,23 @@ def swin_layer_specs(image_size, patch_size, embed_dim, depths, num_heads,
             spec = attention_layer_spec(
                 hidden=dim, seq=w * w, batch=tokens // (w * w),
                 dtype_bytes=dtype_bytes, name=f"s{si}.attn{bi}")
-            # windows are mutually independent: a token-parallel cp shard
-            # aligned to window boundaries exchanges NO K/V, so the cp
-            # ring charge (TimeCostModel attn path) must not apply
-            specs.append(dataclasses.replace(spec, attn=False,
-                                             kv_bytes=0.0))
+            shifted = bi % 2 == 1 and w < res  # models/swin.py shift rule
+            if not shifted:
+                # unshifted windows are mutually independent: a cp shard
+                # aligned to window boundaries exchanges NO K/V, so the
+                # ring charge (TimeCostModel attn path) must not apply
+                spec = dataclasses.replace(spec, attn=False, kv_bytes=0.0)
+            else:
+                # SHIFTED windows straddle any window-aligned shard cut:
+                # each shard swaps a w/2-row halo strip (both H and W
+                # rolls) with ONE neighbour.  Keep attn=True with
+                # kv_bytes = the halo volume; the ring formula's (cp-1)
+                # multiplier overcounts a single-neighbour exchange, so
+                # this prices cp PESSIMISTICALLY on shifted blocks —
+                # the safe direction for an un-modeled halo schedule.
+                halo = 2 * batch * res * (w // 2) * dim * dtype_bytes
+                spec = dataclasses.replace(spec, kv_bytes=float(2 * halo))
+            specs.append(spec)
             specs.append(mlp_layer_spec(
                 hidden=dim, seq=res * res, batch=batch,
                 ffn_mult=mlp_ratio, dtype_bytes=dtype_bytes,

@@ -537,11 +537,20 @@ def test_swin_layer_specs_stage_ladder():
 
 
 def test_swin_specs_reject_untileable_geometry_and_skip_cp_charge():
-    """Geometry the model would refuse must fail the cost model too, and
-    window-local attention must not pay the cp ring rotation."""
+    """Geometry the model would refuse must fail the cost model too;
+    UNSHIFTED window attention pays no cp ring rotation, while SHIFTED
+    blocks (which straddle any window-aligned shard cut) carry a halo
+    kv_bytes charge — and blocks where window == resolution never shift
+    (models/swin.py's shift rule)."""
     from hetu_tpu.autoparallel import swin_layer_specs
     with pytest.raises(AssertionError):
         swin_layer_specs(224, 4, 96, (2, 2), (3, 6), window_size=12,
                          batch=8)
     specs = swin_layer_specs(32, 4, 32, (2, 2), (2, 4), 4, batch=8)
-    assert all(not s.attn for s in specs if "attn" in s.name)
+    by_name = {s.name: s for s in specs}
+    assert not by_name["s0.attn0"].attn                 # unshifted
+    assert by_name["s0.attn1"].attn                     # shifted: halo
+    assert by_name["s0.attn1"].kv_bytes > 0
+    # stage 1: window == resolution → no shift anywhere
+    assert not by_name["s1.attn0"].attn
+    assert not by_name["s1.attn1"].attn

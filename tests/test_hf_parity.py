@@ -1,0 +1,187 @@
+"""Model-architecture parity against HuggingFace transformers (torch).
+
+The tokenizer goldens (round 4) pin the text front-end to the HF Rust
+reference; this pins the MODEL math: our BERT encoder's weights are
+copied into a config-matched ``transformers.BertModel`` and both
+forwards must agree on the same padded batch.  This is the strongest
+cheap check against silent architecture divergence (layernorm placement,
+residual order, head split, mask semantics, activation variant) — the
+reference's own BERT is an HF-style port (``examples/transformers/bert/
+hetu_bert.py``), so agreement with HF is agreement with the reference.
+
+No pretrained weights are involved (zero-egress image): HF side is
+random-init and then OVERWRITTEN with our weights.  ``hidden_act`` is
+``gelu_new`` on the HF side because our gelu_op is the tanh
+approximation (ops/nn.py:26 — jax.nn.gelu approximate=True), which is
+exactly HF's "gelu_new"; erf-vs-tanh would otherwise diverge at ~1e-3.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models.bert import BertConfig, bert_model
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _our_bert_forward(cfg, ids, tt, attn):
+    from hetu_tpu.graph.node import placeholder_op
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    token_type_ids = placeholder_op("token_type_ids", shape=shape,
+                                    dtype=np.int32)
+    attention_mask = placeholder_op("attention_mask", shape=shape,
+                                    dtype=np.int32)
+    seq = bert_model(cfg, input_ids, token_type_ids,
+                     attention_mask=attention_mask, name="bert")
+    ex = ht.Executor({"fwd": [seq]}, seed=3)
+    out = ex.run("fwd", feed_dict={input_ids: ids, token_type_ids: tt,
+                                   attention_mask: attn})[0].asnumpy()
+    weights = {n.name: np.asarray(v) for n, v in ex.var_values.items()}
+    return out.reshape(cfg.batch_size, cfg.seq_len, cfg.hidden_size), weights
+
+
+def _hf_bert(cfg, weights):
+    hf_cfg = transformers.BertConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=cfg.layer_norm_eps, hidden_act="gelu_new")
+    model = transformers.BertModel(hf_cfg, add_pooling_layer=False)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    sd = {
+        "embeddings.word_embeddings.weight": t("bert.embeddings.word.weight"),
+        "embeddings.position_embeddings.weight":
+            t("bert.embeddings.position"),
+        "embeddings.token_type_embeddings.weight":
+            t("bert.embeddings.token_type.weight"),
+        "embeddings.LayerNorm.weight": t("bert.embeddings.ln.scale"),
+        "embeddings.LayerNorm.bias": t("bert.embeddings.ln.bias"),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p, q = f"encoder.layer.{i}.", f"bert.layer{i}."
+        # our Linear stores (in, out) for x @ W; torch nn.Linear is (out, in)
+        for hf_name, ours in [("attention.self.query", "attn.q"),
+                              ("attention.self.key", "attn.k"),
+                              ("attention.self.value", "attn.v"),
+                              ("attention.output.dense", "attn.o"),
+                              ("intermediate.dense", "ffn1"),
+                              ("output.dense", "ffn2")]:
+            sd[p + hf_name + ".weight"] = t(q + ours + ".weight").T
+            sd[p + hf_name + ".bias"] = t(q + ours + ".bias")
+        for hf_name, ours in [("attention.output.LayerNorm", "ln1"),
+                              ("output.LayerNorm", "ln2")]:
+            sd[p + hf_name + ".weight"] = t(q + ours + ".scale")
+            sd[p + hf_name + ".bias"] = t(q + ours + ".bias")
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    # position_ids buffers may be "missing" (registered buffers); no
+    # PARAMETER may be left unset
+    assert not [m for m in missing if "position_ids" not in m], missing
+    assert not unexpected, unexpected
+    return model
+
+
+def test_bert_forward_matches_hf():
+    cfg = BertConfig.tiny(batch_size=2, seq_len=16, vocab_size=99,
+                          hidden_size=64, intermediate_size=128,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tt = rng.randint(0, cfg.type_vocab_size, (2, 16)).astype(np.int32)
+    attn = np.ones((2, 16), np.int32)
+    attn[0, 11:] = 0                      # padded row
+    ids[0, 11:] = 0
+
+    ours, weights = _our_bert_forward(cfg, ids, tt, attn)
+    model = _hf_bert(cfg, weights)
+    with torch.no_grad():
+        theirs = model(input_ids=torch.from_numpy(ids.astype(np.int64)),
+                       token_type_ids=torch.from_numpy(tt.astype(np.int64)),
+                       attention_mask=torch.from_numpy(attn.astype(np.int64))
+                       ).last_hidden_state.numpy()
+
+    # padded positions may legitimately differ (HF computes them against
+    # masked keys too, but downstream semantics only depend on valid
+    # positions) — compare where attention_mask == 1
+    valid = attn.astype(bool)
+    np.testing.assert_allclose(ours[valid], theirs[valid],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gpt2_forward_matches_hf():
+    """Pre-LN causal path: our GPT-2 weights into transformers.GPT2Model.
+    HF's Conv1D stores (in, out) like our Linear — NO transpose here
+    (the BERT mapping above transposes for nn.Linear)."""
+    from hetu_tpu.models.gpt2 import GPT2Config, gpt2_model
+    from hetu_tpu.graph.node import placeholder_op
+
+    cfg = GPT2Config.tiny(batch_size=2, seq_len=16, vocab_size=97,
+                          n_embd=64, resid_pdrop=0.0, embd_pdrop=0.0,
+                          attn_pdrop=0.0)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+
+    input_ids = placeholder_op("input_ids", shape=(2, 16), dtype=np.int32)
+    hidden = gpt2_model(cfg, input_ids, name="gpt2")
+    ex = ht.Executor({"fwd": [hidden]}, seed=5)
+    ours = ex.run("fwd", feed_dict={input_ids: ids})[0].asnumpy() \
+        .reshape(2, 16, cfg.n_embd)
+    weights = {n.name: np.asarray(v) for n, v in ex.var_values.items()}
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+        n_embd=cfg.n_embd, n_layer=cfg.n_layer, n_head=cfg.n_head,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=cfg.layer_norm_epsilon,
+        activation_function="gelu_new")
+    model = transformers.GPT2Model(hf_cfg)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    sd = {"wte.weight": t("gpt2.wte"), "wpe.weight": t("gpt2.wpe"),
+          "ln_f.weight": t("gpt2.ln_f.scale"),
+          "ln_f.bias": t("gpt2.ln_f.bias")}
+    for i in range(cfg.n_layer):
+        p, q = f"h.{i}.", f"gpt2.h{i}."
+        # HF fuses qkv into one Conv1D (n_embd, 3*n_embd)
+        sd[p + "attn.c_attn.weight"] = torch.cat(
+            [t(q + "attn.q.weight"), t(q + "attn.k.weight"),
+             t(q + "attn.v.weight")], dim=1)
+        sd[p + "attn.c_attn.bias"] = torch.cat(
+            [t(q + "attn.q.bias"), t(q + "attn.k.bias"),
+             t(q + "attn.v.bias")])
+        sd[p + "attn.c_proj.weight"] = t(q + "attn.o.weight")
+        sd[p + "attn.c_proj.bias"] = t(q + "attn.o.bias")
+        sd[p + "mlp.c_fc.weight"] = t(q + "mlp_fc.weight")
+        sd[p + "mlp.c_fc.bias"] = t(q + "mlp_fc.bias")
+        sd[p + "mlp.c_proj.weight"] = t(q + "mlp_proj.weight")
+        sd[p + "mlp.c_proj.bias"] = t(q + "mlp_proj.bias")
+        for ln in ("ln_1", "ln_2"):
+            ours_ln = "ln1" if ln == "ln_1" else "ln2"
+            sd[p + ln + ".weight"] = t(q + ours_ln + ".scale")
+            sd[p + ln + ".bias"] = t(q + ours_ln + ".bias")
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    # HF registers non-parameter causal-mask buffers named attn.bias /
+    # attn.masked_bias; ONLY those exact suffixes may be absent — the
+    # real parameters attn.c_attn.bias / attn.c_proj.bias must not be
+    assert not [m for m in missing
+                if not m.endswith(("attn.bias", "attn.masked_bias"))
+                or ".c_" in m], missing
+    assert not unexpected, unexpected
+
+    with torch.no_grad():
+        theirs = model(input_ids=torch.from_numpy(ids.astype(np.int64))
+                       ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)

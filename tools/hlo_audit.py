@@ -1,31 +1,38 @@
-"""HLO audit of the flagship bench step (round-4 verdict item 2).
+"""HLO audit of the bench-config training steps (round-4 verdict item 2,
+extended to every tracked config in round 5).
 
-Tunes the program OFF hardware so a healthy tunnel window measures a fast
-step, not a first draft: AOT-compiles the exact ``bench.py --config bert``
-flagship (BERT-base, bs 64, seq 512, bf16 compute, Adam, padded MLM) and
-audits the compiled HLO for the properties that set the TPU performance
-ceiling:
+Tunes the programs OFF hardware so a healthy tunnel window measures fast
+steps, not first drafts: AOT-compiles the exact ``bench.py`` graphs
+(flagship BERT seq-512 padded MLM, resnet18 NHWC, WDL dense, MoE top-2)
+and audits each compiled HLO for the properties that set the TPU
+performance ceiling:
 
   one_entry            whole step is ONE fused XLA computation (no
                        per-op dispatch — SURVEY.md L3 executor design)
   no_retrace           jit cache stays at one entry across repeated steps
-                       with stable shapes (live-run check, small config)
-  dots_bf16            every dot/conv contraction runs in bf16 (f32 dots
-                       on the MXU halve throughput); the fp32 master
-                       copies live OUTSIDE the step's matmuls
+                       with stable shapes (live-run check, small config,
+                       flagship only)
+  contractions_bf16    every dot AND conv contraction runs on bf16
+                       operands (f32 contractions on the MXU halve
+                       throughput); the fp32 master copies live OUTSIDE
+                       the step's matmuls.  WDL is exempt: CTR trains
+                       f32 end-to-end by design (embedding-lookup bound,
+                       bf16 would round 100k-row ids' gradients for no
+                       MXU win — bench.py:621 passes no compute_dtype).
   donation             params + optimizer state buffers are donated
                        (input_output_alias in the compiled module) so
-                       weights update in place — no 2× HBM residency
-  no_host_transfers    no infeed/outfeed/send/recv/host custom-calls
-                       inside the step
-  flops reconciliation XLA cost_analysis FLOPs vs bench.py's analytic
-                       6N+attention formula — the ratio validates the MFU
-                       denominator a reviewer reconciles against bench.py
+                       weights update in place — no 2x HBM residency
+  no_host_transfers    no infeed/outfeed/send/recv custom-calls inside
+                       the step
+  flops reconciliation (flagship only) XLA cost_analysis FLOPs vs
+                       bench.py's analytic 6N+attention formula — the
+                       ratio validates the MFU denominator a reviewer
+                       reconciles against bench.py
 
-Writes ``artifacts/hlo_audit.json``; exits non-zero if a MUST property
-fails.  Runs on any backend (the audit is structural); flash-kernel
-presence is additionally asserted when the backend is really the TPU
-(the gate at ops/attention.py:_use_flash is tpu-only by design).
+Writes ``artifacts/hlo_audit_{backend}.json``; exits non-zero if a MUST
+property fails.  Runs on any backend (the audit is structural); flash-
+kernel presence is additionally asserted when the backend is really the
+TPU (the gate at ops/attention.py:_use_flash is tpu-only by design).
 """
 import json
 import os
@@ -40,42 +47,66 @@ if os.environ.get("_HETU_AUDIT_FORCE_CPU"):
     jax.config.update("jax_platforms", "cpu")
 
 
-def _build_flagship(batch_size, seq_len):
-    """The exact bench_bert graph (bench.py keeps these in sync)."""
-    import jax
-    import numpy as np
-    import hetu_tpu as ht
-    from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,
-                                      synthetic_mlm_batch)
+# The audit compiles bench.py's OWN graph builders — the audited program
+# and the measured program cannot drift apart (they are the same code).
+# compute_dtype is forced to bfloat16 where the bench would pick it per
+# backend (_compute_dtype is bf16 on TPU): the audit predicts the TPU
+# program even when compiled on CPU.  resnet18 likewise pins NHWC (the
+# bench's TPU-side layout pick).
 
-    cfg = BertConfig.base(batch_size=batch_size, seq_len=seq_len)
-    feeds, loss, _ = bert_pretrain_graph(cfg)
-    opt = ht.optim.AdamOptimizer(1e-4)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
-                     compute_dtype="bfloat16")
-    ids, tt, labels, attn = synthetic_mlm_batch(cfg)
-    fd = {feeds["input_ids"]: jax.device_put(np.asarray(ids, np.int32)),
-          feeds["token_type_ids"]: jax.device_put(np.asarray(tt, np.int32)),
-          feeds["masked_lm_labels"]:
-              jax.device_put(np.asarray(labels, np.int32)),
-          feeds["attention_mask"]: jax.device_put(np.asarray(attn, np.int32))}
+def _build_bert(batch_size=64, seq_len=512):
+    from bench import build_bert_graph
+    return build_bert_graph(batch_size=batch_size, seq_len=seq_len,
+                            compute_dtype="bfloat16")
+
+
+def _build_resnet18(batch_size=128):
+    from bench import build_resnet18_graph
+    return build_resnet18_graph(batch_size=batch_size, data_format="NHWC",
+                                compute_dtype="bfloat16")
+
+
+def _build_wdl(batch_size=2048):
+    """The jitted step with the plain (dense) embedding; the HET-cache
+    row traffic happens OUTSIDE the step and does not change the
+    compiled program."""
+    from bench import build_wdl_graph
+    cfg, ex, fd, _nodes = build_wdl_graph(batch_size=batch_size,
+                                          policy="dense")
     return cfg, ex, fd
 
 
-def _audit_dots(lowered_text):
-    """Operand-dtype census over dot_general ops in the LOWERED (pre-
-    backend) program — the program's own dtype discipline, uncontaminated
-    by backend quirks (XLA-CPU upcasts bf16 dots to f32; the TPU MXU runs
-    them native).  A dot counts as bf16 iff BOTH operands are bf16; the
-    deliberate exceptions (attention-scores einsums that keep an f32
-    RESULT from bf16 operands for softmax range) still have bf16 operands
-    and count as bf16.  f32×f32 dots are the mixed-precision leak this
-    audit exists to catch: an f32 primal output makes the cotangent f32
-    and the whole backward runs at half MXU throughput."""
+def _build_moe(batch_tokens=8192):
+    from bench import build_moe_graph
+    return build_moe_graph(batch_tokens=batch_tokens,
+                           compute_dtype="bfloat16")
+
+
+#: name → (builder, expect_bf16_contractions)
+BUILDERS = {
+    "bert": (_build_bert, True),
+    "resnet18": (_build_resnet18, True),
+    "wdl": (_build_wdl, False),   # f32 by design — see module docstring
+    "moe": (_build_moe, True),
+}
+
+
+def _audit_contractions(lowered_text):
+    """Operand-dtype census over dot_general AND convolution ops in the
+    LOWERED (pre-backend) program — the program's own dtype discipline,
+    uncontaminated by backend quirks (XLA-CPU upcasts bf16 contractions
+    to f32; the TPU MXU runs them native).  A contraction counts as bf16
+    iff BOTH operands are bf16; the deliberate exceptions (attention-
+    scores einsums that keep an f32 RESULT from bf16 operands for softmax
+    range) still have bf16 operands and count as bf16.  f32xf32
+    contractions are the mixed-precision leak this audit exists to catch:
+    an f32 primal output makes the cotangent f32 and the whole backward
+    runs at half MXU throughput (the round-4 flagship bug: 196/294 dots)."""
     n_bf16 = n_f32 = 0
     f32_lines = []
     for line in lowered_text.splitlines():
-        if "stablehlo.dot_general" not in line:
+        if "stablehlo.dot_general" not in line \
+                and "stablehlo.convolution" not in line:
             continue
         sig = line.rsplit(":", 1)[-1]
         in_sig = sig.split("->")[0]
@@ -102,8 +133,8 @@ def _audit_aliasing(lowered_text, compiled_text):
 
 
 def _retrace_check(steps=4):
-    """Small live config: the jit cache must not grow across steps."""
-    cfg, ex, fd = _build_flagship(batch_size=2, seq_len=128)
+    """Small live flagship config: the jit cache must not grow."""
+    _, ex, fd = _build_bert(batch_size=2, seq_len=128)
     sub = ex.subexecutors["train"]
     for _ in range(steps):
         ex.run("train", feed_dict=fd)
@@ -111,99 +142,125 @@ def _retrace_check(steps=4):
     return int(size_fn()) if size_fn else None
 
 
-def main():
-    import argparse
+def _audit_config(name, backend, args):
     import jax
-
-    from artifact_schema import provenance
     from hetu_tpu.profiler import HetuProfiler
 
-    p = argparse.ArgumentParser()
-    p.add_argument("--batch-size", type=int, default=64)
-    p.add_argument("--seq-len", type=int, default=512)
-    p.add_argument("--skip-retrace", action="store_true")
-    args = p.parse_args()
-
-    backend = jax.default_backend()
-    print(f"audit: backend={backend}, compiling flagship "
-          f"bs={args.batch_size} seq={args.seq_len} ...", flush=True)
-    cfg, ex, fd = _build_flagship(args.batch_size, args.seq_len)
+    builder, expect_bf16 = BUILDERS[name]
+    # effective workload dims are recorded in the artifact so bert's
+    # bench_formula_flops can always be tied to the dimensions it was
+    # computed with; --batch-size/--seq-len apply to bert only (the other
+    # configs audit the bench defaults)
+    if name == "bert":
+        kw = {"batch_size": args.batch_size or 64,
+              "seq_len": args.seq_len or 512}
+    elif name == "moe":
+        kw = {"batch_tokens": 8192}
+    else:
+        kw = {"batch_size": {"resnet18": 128, "wdl": 2048}[name]}
+    print(f"audit[{name}]: compiling ...", flush=True)
+    cfg, ex, fd = builder(**kw)
     prof = HetuProfiler(ex, name="train")
     lowered = prof.lowered_text(fd)
     hlo = prof.hlo_text(fd)
     cost = prof.hlo_cost(fd)
 
     n_entry = len(re.findall(r"^ENTRY ", hlo, re.MULTILINE))
-    n_bf16, n_f32, f32_lines = _audit_dots(lowered)
+    n_bf16, n_f32, f32_lines = _audit_contractions(lowered)
     n_alias_prog, n_alias_compiled = _audit_aliasing(lowered, hlo)
     host_ops = [op for op in ("infeed", "outfeed", "send(", "recv(")
                 if op in hlo]
     flash_in_hlo = any(t in hlo for t in ("tpu_custom_call", "mosaic"))
 
-    # reconcile XLA-counted FLOPs with bench.py's analytic formula (the
-    # MFU denominator): cost_analysis counts the optimized module's real
-    # flops — fwd+bwd matmuls, attention, remat replays
-    import numpy as np
-    n_params = int(sum(np.prod(v.shape) for n, v in ex.var_values.items()
-                       if n.trainable))
-    embed = (cfg.vocab_size + cfg.max_position_embeddings
-             + cfg.type_vocab_size) * cfg.hidden_size
-    tokens = args.batch_size * args.seq_len
-    bench_flops = (6 * (n_params - embed) + 12 * cfg.num_hidden_layers
-                   * cfg.hidden_size * args.seq_len) * tokens
-    xla_flops = float(cost.get("flops", 0.0))
-
-    n_dots = n_bf16 + n_f32
+    n_contr = n_bf16 + n_f32
     checks = {
         "one_entry": n_entry == 1,
-        # the scores einsum keeps an f32 RESULT from bf16 OPERANDS, so a
-        # clean program has zero non-bf16-operand dots
-        "dots_bf16": n_dots > 0 and n_f32 == 0,
         "donation": n_alias_prog > 0,
         "no_host_transfers": not host_ops,
     }
-    if not args.skip_retrace:
-        cache_size = _retrace_check()
-        checks["no_retrace"] = cache_size in (1, None)
-    else:
-        cache_size = None
-    if backend == "tpu":
+    if expect_bf16:
+        checks["contractions_bf16"] = n_contr > 0 and n_f32 == 0
+    if backend == "tpu" and name == "bert":
         checks["flash_in_hlo"] = flash_in_hlo
+
+    detail = {
+        "workload": dict(kw),
+        "entry_computations": n_entry,
+        "contractions_total": n_contr,
+        "contractions_bf16": n_bf16, "contractions_f32": n_f32,
+        "f32_contraction_samples": f32_lines,
+        "alias_pairs_program": n_alias_prog,
+        "alias_pairs_compiled": n_alias_compiled,
+        "host_ops_found": host_ops,
+        "flash_in_hlo": flash_in_hlo,
+        "xla_cost_flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": cost.get("bytes accessed"),
+    }
+
+    if name == "bert":
+        # reconcile XLA-counted FLOPs with bench.py's analytic formula
+        # (the MFU denominator): cost_analysis counts the optimized
+        # module's real flops — fwd+bwd matmuls, attention, remat replays
+        import numpy as np
+        bs, sl = kw["batch_size"], kw["seq_len"]
+        n_params = int(sum(np.prod(v.shape)
+                           for n, v in ex.var_values.items() if n.trainable))
+        embed = (cfg.vocab_size + cfg.max_position_embeddings
+                 + cfg.type_vocab_size) * cfg.hidden_size
+        bench_flops = (6 * (n_params - embed) + 12 * cfg.num_hidden_layers
+                       * cfg.hidden_size * sl) * bs * sl
+        detail["bench_formula_flops"] = bench_flops
+        # >1: XLA counts more (remat replay, attention softmax);
+        # <1: bench formula overcounts → MFU would be inflated
+        detail["xla_over_bench_ratio"] = \
+            round(detail["xla_cost_flops"] / bench_flops, 4) \
+            if bench_flops else None
+        if not args.skip_retrace:
+            cache_size = _retrace_check()
+            checks["no_retrace"] = cache_size in (1, None)
+            detail["jit_cache_size_after_steps"] = cache_size
+
+    return {"checks": checks, "ok": all(checks.values()), "detail": detail}
+
+
+def main():
+    import argparse
+    import jax
+
+    from artifact_schema import provenance
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="all",
+                   choices=["all"] + list(BUILDERS))
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--skip-retrace", action="store_true")
+    args = p.parse_args()
+
+    backend = jax.default_backend()
+    names = list(BUILDERS) if args.config == "all" else [args.config]
+    configs = {}
+    for name in names:
+        configs[name] = _audit_config(name, backend, args)
+        print(json.dumps({name: configs[name]["checks"],
+                          "ok": configs[name]["ok"]}))
 
     out = {
         "backend": backend,
         "device_kind": jax.devices()[0].device_kind,
-        "checks": checks,
-        "ok": all(checks.values()),
-        "detail": {
-            "entry_computations": n_entry,
-            "dots_total": n_dots, "dots_bf16": n_bf16, "dots_f32": n_f32,
-            "f32_dot_samples": f32_lines,
-            "alias_pairs_program": n_alias_prog,
-            "alias_pairs_compiled": n_alias_compiled,
-            "host_ops_found": host_ops,
-            "flash_in_hlo": flash_in_hlo,
-            "jit_cache_size_after_steps": cache_size,
-            "xla_cost_flops": xla_flops,
-            "bench_formula_flops": bench_flops,
-            # >1: XLA counts more (remat replay, attention softmax);
-            # <1: bench formula overcounts → MFU would be inflated
-            "xla_over_bench_ratio": round(xla_flops / bench_flops, 4)
-            if bench_flops else None,
-            "bytes_accessed": cost.get("bytes accessed"),
-        },
-        **provenance({"batch_size": args.batch_size,
-                      "seq_len": args.seq_len, "config": "bert"}),
+        "configs": configs,
+        "ok": all(c["ok"] for c in configs.values()),
+        **provenance({"configs": names}),
     }
     os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
-    path = os.path.join(ROOT, "artifacts",
-                        f"hlo_audit_{backend}.json")
+    path = os.path.join(ROOT, "artifacts", f"hlo_audit_{backend}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
     os.replace(tmp, path)
-    print(json.dumps({k: v for k, v in out.items()
-                      if k in ("backend", "checks", "ok")}))
+    print(json.dumps({"backend": backend, "ok": out["ok"],
+                      "per_config": {k: v["ok"] for k, v in
+                                     configs.items()}}))
     return 0 if out["ok"] else 1
 
 
