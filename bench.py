@@ -179,7 +179,13 @@ def _load_example_models(family):
     spec = importlib.util.spec_from_file_location(name, path, **kw)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[name] = mod
-    spec.loader.exec_module(mod)
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        # never leave a half-initialized module for the next caller's
+        # fast path to silently reuse
+        sys.modules.pop(name, None)
+        raise
     return mod
 
 
@@ -266,7 +272,8 @@ def build_wdl_graph(batch_size=2048, policy="lru"):
 
 def build_moe_graph(batch_tokens=8192, compute_dtype="__bench_default__"):
     """GShard top-2 16-expert MoE Adam step (see bench_moe).
-    Returns (None, ex, fd)."""
+    Returns ({"d":..., "experts":...}, ex, fd) — the dims dict keeps
+    bench_moe's reported metadata tied to the graph actually built."""
     import jax
     import hetu_tpu as ht
 
@@ -287,7 +294,7 @@ def build_moe_graph(batch_tokens=8192, compute_dtype="__bench_default__"):
     rng = np.random.RandomState(0)
     fd = {x: jax.device_put(rng.randn(batch_tokens, d).astype(np.float32)),
           y_: jax.device_put(rng.randn(batch_tokens, d).astype(np.float32))}
-    return None, ex, fd
+    return {"d": d, "experts": experts}, ex, fd
 
 
 def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
@@ -767,8 +774,8 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
     mesh XLA shards the expert dim)."""
     import jax
 
-    _, ex, fd = build_moe_graph(batch_tokens=batch_tokens)
-    experts = 16
+    dims, ex, fd = build_moe_graph(batch_tokens=batch_tokens)
+    experts = dims["experts"]
     dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
     base, label = _torch_bench_baseline("moe", {"tokens": batch_tokens})
     return {

@@ -185,3 +185,77 @@ def test_gpt2_forward_matches_hf():
         theirs = model(input_ids=torch.from_numpy(ids.astype(np.int64))
                        ).last_hidden_state.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+def test_t5_encoder_forward_matches_hf():
+    """RMSNorm + log-bucketed relative-position bias + unscaled attention:
+    our T5 encoder weights into transformers.T5EncoderModel.  Our MHA
+    projections carry zero-initialized biases; HF T5 has NO projection
+    biases, so parity additionally proves those biases are still zero at
+    init (asserted explicitly).  The shared bias table maps to HF block 0's
+    relative_attention_bias (HF computes it once and shares it downstream
+    — same sharing structure as our single _relpos_bias node)."""
+    from hetu_tpu.models.t5 import T5Config, t5_encoder
+    from hetu_tpu.graph.node import placeholder_op
+    from hetu_tpu import ops as htops
+
+    cfg = T5Config.tiny(batch_size=2, src_len=24, vocab_size=101,
+                        d_model=64, d_ff=128, num_heads=2,
+                        dropout_rate=0.0)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, cfg.vocab_size, (2, 24)).astype(np.int32)
+
+    from hetu_tpu import initializers as init
+    src = placeholder_op("input_ids", shape=(2, 24), dtype=np.int32)
+    shared = init.truncated_normal((cfg.vocab_size, cfg.d_model), 0.0, 0.02,
+                                   name="t5.shared")
+    x = htops.array_reshape_op(
+        htops.embedding_lookup_op(shared, src),
+        output_shape=(2 * 24, cfg.d_model))
+    out = t5_encoder(cfg, x, name="t5.encoder")
+    ex = ht.Executor({"fwd": [out]}, seed=7)
+    ours = ex.run("fwd", feed_dict={src: ids})[0].asnumpy() \
+        .reshape(2, 24, cfg.d_model)
+    weights = {n.name: np.asarray(v) for n, v in ex.var_values.items()}
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        d_kv=cfg.d_model // cfg.num_heads, d_ff=cfg.d_ff,
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        relative_attention_num_buckets=cfg.relative_attention_num_buckets,
+        relative_attention_max_distance=cfg.relative_attention_max_distance,
+        dropout_rate=0.0, layer_norm_epsilon=cfg.layer_norm_epsilon,
+        feed_forward_proj="relu")
+    model = transformers.T5EncoderModel(hf_cfg)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    sd = {"shared.weight": t("t5.shared"),
+          "encoder.embed_tokens.weight": t("t5.shared"),
+          "encoder.final_layer_norm.weight": t("t5.encoder.ln_f.scale"),
+          "encoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+          ".weight": t("t5.encoder.relpos")}
+    for i in range(cfg.num_layers):
+        p, q = f"encoder.block.{i}.", f"t5.encoder.block{i}."
+        for hf_name, ours_name in [("layer.0.SelfAttention.q", "attn.q"),
+                                   ("layer.0.SelfAttention.k", "attn.k"),
+                                   ("layer.0.SelfAttention.v", "attn.v"),
+                                   ("layer.0.SelfAttention.o", "attn.o")]:
+            sd[p + hf_name + ".weight"] = t(q + ours_name + ".weight").T
+            # HF T5 has no projection biases; ours must still be zero
+            np.testing.assert_array_equal(
+                weights[q + ours_name + ".bias"], 0.0)
+        sd[p + "layer.0.layer_norm.weight"] = t(q + "ln1.scale")
+        sd[p + "layer.1.DenseReluDense.wi.weight"] = t(q + "ffn.wi.weight").T
+        sd[p + "layer.1.DenseReluDense.wo.weight"] = t(q + "ffn.wo.weight").T
+        sd[p + "layer.1.layer_norm.weight"] = t(q + "ln2.scale")
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    assert not missing, missing
+    assert not unexpected, unexpected
+
+    with torch.no_grad():
+        theirs = model(input_ids=torch.from_numpy(ids.astype(np.int64))
+                       ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)

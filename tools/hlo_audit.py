@@ -54,32 +54,29 @@ if os.environ.get("_HETU_AUDIT_FORCE_CPU"):
 # program even when compiled on CPU.  resnet18 likewise pins NHWC (the
 # bench's TPU-side layout pick).
 
-def _build_bert(batch_size=64, seq_len=512):
+def _build_bert(**kw):
     from bench import build_bert_graph
-    return build_bert_graph(batch_size=batch_size, seq_len=seq_len,
-                            compute_dtype="bfloat16")
+    return build_bert_graph(compute_dtype="bfloat16", **kw)
 
 
-def _build_resnet18(batch_size=128):
+def _build_resnet18(**kw):
     from bench import build_resnet18_graph
-    return build_resnet18_graph(batch_size=batch_size, data_format="NHWC",
-                                compute_dtype="bfloat16")
+    return build_resnet18_graph(data_format="NHWC",
+                                compute_dtype="bfloat16", **kw)
 
 
-def _build_wdl(batch_size=2048):
+def _build_wdl(**kw):
     """The jitted step with the plain (dense) embedding; the HET-cache
     row traffic happens OUTSIDE the step and does not change the
     compiled program."""
     from bench import build_wdl_graph
-    cfg, ex, fd, _nodes = build_wdl_graph(batch_size=batch_size,
-                                          policy="dense")
+    cfg, ex, fd, _nodes = build_wdl_graph(policy="dense", **kw)
     return cfg, ex, fd
 
 
-def _build_moe(batch_tokens=8192):
+def _build_moe(**kw):
     from bench import build_moe_graph
-    return build_moe_graph(batch_tokens=batch_tokens,
-                           compute_dtype="bfloat16")
+    return build_moe_graph(compute_dtype="bfloat16", **kw)
 
 
 #: name → (builder, expect_bf16_contractions)
@@ -146,18 +143,26 @@ def _audit_config(name, backend, args):
     import jax
     from hetu_tpu.profiler import HetuProfiler
 
+    import inspect
+    import bench
+
     builder, expect_bf16 = BUILDERS[name]
-    # effective workload dims are recorded in the artifact so bert's
-    # bench_formula_flops can always be tied to the dimensions it was
-    # computed with; --batch-size/--seq-len apply to bert only (the other
-    # configs audit the bench defaults)
+    # --batch-size/--seq-len apply to bert only; the other configs audit
+    # the bench builders' OWN defaults (read from their signatures, not
+    # re-hardcoded here — retuning a bench default retunes the audit)
     if name == "bert":
         kw = {"batch_size": args.batch_size or 64,
               "seq_len": args.seq_len or 512}
-    elif name == "moe":
-        kw = {"batch_tokens": 8192}
     else:
-        kw = {"batch_size": {"resnet18": 128, "wdl": 2048}[name]}
+        kw = {}
+    bench_fn = getattr(bench, f"build_{name}_graph")
+    # effective workload dims recorded in the artifact so bert's
+    # bench_formula_flops can always be tied to the dimensions it was
+    # computed with
+    dims = {pname: p.default
+            for pname, p in inspect.signature(bench_fn).parameters.items()
+            if isinstance(p.default, (int, float))}
+    dims.update(kw)
     print(f"audit[{name}]: compiling ...", flush=True)
     cfg, ex, fd = builder(**kw)
     prof = HetuProfiler(ex, name="train")
@@ -184,7 +189,7 @@ def _audit_config(name, backend, args):
         checks["flash_in_hlo"] = flash_in_hlo
 
     detail = {
-        "workload": dict(kw),
+        "workload": dims,
         "entry_computations": n_entry,
         "contractions_total": n_contr,
         "contractions_bf16": n_bf16, "contractions_f32": n_f32,
@@ -245,15 +250,31 @@ def main():
         print(json.dumps({name: configs[name]["checks"],
                           "ok": configs[name]["ok"]}))
 
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    path = os.path.join(ROOT, "artifacts", f"hlo_audit_{backend}.json")
+    # MERGE into the existing artifact: a quick single-config re-check
+    # must not erase the other configs' evidence (each config entry keeps
+    # the provenance of the run that produced it; top-level ok covers the
+    # merged set)
+    merged = {}
+    try:
+        with open(path) as f:
+            prior = json.load(f).get("configs", {})
+        merged = {k: v for k, v in prior.items()
+                  if isinstance(v, dict) and "ok" in v}   # schema guard
+    except (OSError, json.JSONDecodeError):
+        pass
+    prov = provenance({"configs": names})
+    for name in names:
+        configs[name].update(prov)
+    merged.update(configs)
     out = {
         "backend": backend,
         "device_kind": jax.devices()[0].device_kind,
-        "configs": configs,
-        "ok": all(c["ok"] for c in configs.values()),
-        **provenance({"configs": names}),
+        "configs": merged,
+        "ok": all(c["ok"] for c in merged.values()),
+        **prov,
     }
-    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
-    path = os.path.join(ROOT, "artifacts", f"hlo_audit_{backend}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
