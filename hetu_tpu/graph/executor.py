@@ -1060,7 +1060,14 @@ class Executor:
             json.dump(meta, f, indent=1)     # checkpoint
         os.replace(tmp, os.path.join(path, "meta.json"))
 
-    def load(self, path, file=None, consider_splits=False):
+    def load(self, path, file=None, consider_splits=False,
+             params_only=False):
+        """Restore a checkpoint.  ``params_only=True`` is the WARM-START
+        form (pretrain → fine-tune): it restores parameters (and PS
+        embedding rows) by name and leaves optimizer moments, the step
+        counter, and dataloader cursors at their fresh state — a full
+        restore would resume the pretrain LR schedule mid-curve and
+        apply stale Adam second moments to the new task."""
         import json
         import os
         import jax
@@ -1075,6 +1082,14 @@ class Executor:
                 if node is not None:    # streamed: one tensor at a time
                     self.var_values[node] = self._place_param(
                         np.load(os.path.join(path, "params", fn)), node)
+            if params_only:
+                entries = {e["file"] for e in meta["ps_tables"]}
+                for i, node in enumerate(self._ps_table_sites()):
+                    fn = f"ps{i}.bin"
+                    if fn in entries and hasattr(node.store, "load"):
+                        node.store.load(node.table,
+                                        os.path.join(path, fn))
+                return
             # optimizer states match by ORDINAL (graph order is the stable
             # identity; auto-generated op names are not) and leaves match
             # by param-name-translated tree path (raw paths embed node-id
@@ -1109,6 +1124,8 @@ class Executor:
         with open(path, "rb") as f:
             blob = pickle.load(f)
         self.load_dict(blob["params"])
+        if params_only:
+            return
         by_name = {op.name: op for op in self.opt_states}
         for name, st in blob.get("opt_states", {}).items():
             if name in by_name:

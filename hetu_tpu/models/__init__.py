@@ -1,7 +1,8 @@
 """Flagship model zoo built on the graph API (reference keeps these in
 ``examples/transformers/*``; they live in-package here so benchmarks, the
 graft entry and examples share one implementation)."""
-from .bert import BertConfig, bert_model, bert_pretrain_graph
+from .bert import (BertConfig, bert_model, bert_pretrain_graph,
+                   bert_pooler, bert_classify_graph)
 from .gpt2 import GPT2Config, gpt2_model, gpt2_lm_graph, synthetic_lm_batch
 from .t5 import (T5Config, t5_encoder, t5_decoder, t5_seq2seq_graph,
                  synthetic_seq2seq_batch)

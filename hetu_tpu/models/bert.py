@@ -155,6 +155,61 @@ def bert_pretrain_graph(cfg, name="bert", use_mask=True):
     return feeds, loss, logits
 
 
+def bert_pooler(cfg, seq, name="bert.pooler"):
+    """HF-style pooler: dense+tanh over the [CLS] (first) token
+    (reference hetu_bert.py BertPooler).  ``seq``: (batch*seq_len,
+    hidden) → (batch, hidden)."""
+    x = ops.array_reshape_op(
+        seq, output_shape=(cfg.batch_size, cfg.seq_len, cfg.hidden_size))
+    cls = ops.slice_op(x, begin=(0, 0, 0),
+                       size=(cfg.batch_size, 1, cfg.hidden_size))
+    cls = ops.array_reshape_op(
+        cls, output_shape=(cfg.batch_size, cfg.hidden_size))
+    return Linear(cfg.hidden_size, cfg.hidden_size, activation="tanh",
+                  initializer=init.GenTruncatedNormal(0.0, 0.02),
+                  name=name + ".dense")(cls)
+
+
+def bert_classify_graph(cfg, num_labels, name="bert", use_mask=True):
+    """Sequence-classification fine-tuning graph (the reference's GLUE
+    flow: ``examples/transformers/bert/test_glue_hetu_bert.py`` —
+    pooler + classifier head over the pretrained encoder).
+
+    Returns (placeholders dict, loss node, logits node).  ``labels``:
+    (batch,) int class ids.  Warm-start: encoder/embedding variable
+    names match ``bert_pretrain_graph``'s exactly, so
+    ``Executor.load(pretrain_ckpt, params_only=True)`` restores the
+    shared trunk by name and leaves the fresh pooler/classifier at
+    their init — the pretrain → fine-tune flow needs no remapping.
+    (``params_only`` matters: a full ``load`` would also resume the
+    pretrain LR-schedule step and Adam moments into the new task.)
+    """
+    from ..graph.node import placeholder_op
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    token_type_ids = placeholder_op("token_type_ids", shape=shape,
+                                    dtype=np.int32)
+    labels = placeholder_op("labels", shape=(cfg.batch_size,),
+                            dtype=np.int32)
+    attention_mask = placeholder_op("attention_mask", shape=shape,
+                                    dtype=np.int32) if use_mask else None
+
+    seq = bert_model(cfg, input_ids, token_type_ids,
+                     attention_mask=attention_mask, name=name)
+    pooled = bert_pooler(cfg, seq, name + ".pooler")
+    pooled = ops.dropout_op(pooled, 1.0 - cfg.hidden_dropout_prob)
+    logits = Linear(cfg.hidden_size, num_labels,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".classifier")(pooled)
+    loss = ops.reduce_mean_op(
+        ops.softmaxcrossentropy_sparse_op(logits, labels), [0])
+    feeds = {"input_ids": input_ids, "token_type_ids": token_type_ids,
+             "labels": labels}
+    if attention_mask is not None:
+        feeds["attention_mask"] = attention_mask
+    return feeds, loss, logits
+
+
 def synthetic_mlm_batch(cfg, seed=0, mask_frac=0.15, full_frac=0.35):
     """Deterministic synthetic MLM batch (hermetic benches/tests).
 

@@ -375,3 +375,61 @@ def test_swin_shifted_blocks_isolate_rolled_regions():
     losses = _train_steps(feeds, loss, {"images": imgs, "labels": y},
                           steps=2, lr=3e-3)
     assert np.isfinite(losses).all()
+
+
+def test_bert_finetune_warm_starts_from_pretrain_checkpoint(tmp_path):
+    """The reference's GLUE flow (test_glue_hetu_bert.py): pretrain,
+    checkpoint, rebuild with a classification head, fine-tune.  The
+    shared trunk restores BY NAME; the fresh pooler/classifier stay at
+    init; fine-tuning then learns a sequence-level rule."""
+    import hetu_tpu as ht
+    from hetu_tpu.models.bert import synthetic_mlm_batch
+
+    cfg = models.BertConfig.tiny(batch_size=4, seq_len=16, vocab_size=64,
+                                 hidden_size=32, intermediate_size=64,
+                                 num_hidden_layers=1,
+                                 hidden_dropout_prob=0.0,
+                                 attention_probs_dropout_prob=0.0)
+    feeds, loss, _ = models.bert_pretrain_graph(cfg)
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    ids, tt, labels, attn = synthetic_mlm_batch(cfg)
+    fd = {feeds["input_ids"]: ids, feeds["token_type_ids"]: tt,
+          feeds["masked_lm_labels"]: labels, feeds["attention_mask"]: attn}
+    for _ in range(3):
+        ex.run("train", feed_dict=fd)
+    ckpt = str(tmp_path / "pretrain_ckpt")
+    ex.save(ckpt)
+    trunk = {name: v.copy() for name, v in ex.return_tensor_values().items()
+             if name.startswith("bert.")}
+
+    # rebuild with a classification head and warm-start
+    feeds2, loss2, logits2 = models.bert_classify_graph(cfg, num_labels=3)
+    opt2 = ht.optim.AdamOptimizer(1e-3)
+    ex2 = ht.Executor({"train": [loss2, opt2.minimize(loss2)]}, seed=11)
+    before = ex2.return_tensor_values()["bert.layer0.attn.q.weight"].copy()
+    ex2.load(ckpt, params_only=True)
+    # warm start must NOT resume the pretrain LR-schedule step or Adam
+    # moments (executor.load docstring) — only parameters restore
+    assert ex2.step_counter == 0
+    after = ex2.return_tensor_values()
+    # trunk restored by name (not equal to the fresh seed-11 init) ...
+    np.testing.assert_array_equal(after["bert.layer0.attn.q.weight"],
+                                  trunk["bert.layer0.attn.q.weight"])
+    assert not np.array_equal(before, trunk["bert.layer0.attn.q.weight"])
+    # ... and the mlm head + classifier are absent/fresh respectively
+    assert "bert.classifier.weight" in after
+    assert "bert.mlm_decoder.weight" not in after
+
+    # fine-tune on a learnable sequence-level rule (label = first token
+    # id mod 3) — the warm-started graph must train
+    rng = np.random.RandomState(7)
+    f_ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    f_lab = (f_ids[:, 0] % 3).astype(np.int32)
+    fd2 = {feeds2["input_ids"]: f_ids,
+           feeds2["token_type_ids"]: np.zeros((4, 16), np.int32),
+           feeds2["labels"]: f_lab,
+           feeds2["attention_mask"]: np.ones((4, 16), np.int32)}
+    hist = [float(ex2.run("train", feed_dict=fd2)[0].asnumpy())
+            for _ in range(30)]
+    assert np.isfinite(hist).all() and hist[-1] < hist[0]
