@@ -188,8 +188,32 @@ def _audit_config(name, backend, args):
     if backend == "tpu" and name == "bert":
         checks["flash_in_hlo"] = flash_in_hlo
 
+    # v5e compute-leg projection from the compiled program's own FLOP
+    # count: the step-time FLOOR at 100% MXU utilization, and what the
+    # step time would be at the 0.45 north-star MFU (BASELINE.md) — the
+    # number a reviewer reconciles against a healthy-window measurement.
+    # The memory leg is deliberately NOT projected from this module:
+    # the CPU-compiled cost analysis counts bytes through unfused f32
+    # upcasts (measured ~1 TB/step for the 133M-param flagship — off by
+    # an order of magnitude for a TPU layout); the real roofline comes
+    # from tools/calibrate_tpu.py's measured constants at a healthy
+    # window.  bytes_accessed stays in the detail as a CPU diagnostic.
+    V5E_PEAK_FLOPS = 197e12   # bf16, public spec (bench._TPU_PEAK_BY_KIND)
+    xla_flops = float(cost.get("flops", 0.0))
+    compute_s = xla_flops / V5E_PEAK_FLOPS
+    projection = {
+        "compute_floor_ms": round(compute_s * 1e3, 3) if compute_s
+        else None,
+        "step_ms_at_north_star_mfu": round(compute_s / 0.45 * 1e3, 3)
+        if compute_s else None,
+        "peak_flops": V5E_PEAK_FLOPS,
+        "note": "compute leg only; CPU-module bytes are not a TPU "
+                "memory-leg estimate",
+    }
+
     detail = {
         "workload": dims,
+        "v5e_projection": projection,
         "entry_computations": n_entry,
         "contractions_total": n_contr,
         "contractions_bf16": n_bf16, "contractions_f32": n_f32,
