@@ -115,7 +115,7 @@ def bert_model(cfg, input_ids, token_type_ids, attention_mask=None,
     return x
 
 
-def bert_pretrain_graph(cfg, name="bert", use_mask=True):
+def bert_pretrain_graph(cfg, name="bert", use_mask=True, use_nsp=False):
     """Full MLM pretraining graph (reference train_hetu_bert_dp.py flow).
 
     Returns (placeholders dict, loss node, logits node).
@@ -123,6 +123,11 @@ def bert_pretrain_graph(cfg, name="bert", use_mask=True):
     ``use_mask=True`` (the flagship default) adds an ``attention_mask``
     (batch, seq) int32 input so padded pretraining attends only to real
     tokens (reference hetu_bert.py attention_mask input).
+    ``use_nsp=True`` adds the next-sentence-prediction objective of the
+    reference's full pretrain loss (train_hetu_bert.py:59 — mlm + nsp):
+    pooler over [CLS] → 2-way head, a ``next_sentence_label`` (batch,)
+    feed, and loss = mlm_mean + nsp_mean.  Opt-in so the flagship bench
+    workload (MLM-only, BASELINE.md) is unchanged.
     """
     from ..graph.node import placeholder_op
     shape = (cfg.batch_size, cfg.seq_len)
@@ -150,6 +155,16 @@ def bert_pretrain_graph(cfg, name="bert", use_mask=True):
     loss = masked_lm_loss(logits, labels, cfg.batch_size * cfg.seq_len)
     feeds = {"input_ids": input_ids, "token_type_ids": token_type_ids,
              "masked_lm_labels": labels}
+    if use_nsp:
+        nsp_label = placeholder_op("next_sentence_label",
+                                   shape=(cfg.batch_size,), dtype=np.int32)
+        pooled = bert_pooler(cfg, seq, name + ".pooler")
+        nsp_logits = Linear(cfg.hidden_size, 2,
+                            initializer=init.GenTruncatedNormal(0.0, 0.02),
+                            name=name + ".seq_relationship")(pooled)
+        loss = loss + ops.reduce_mean_op(
+            ops.softmaxcrossentropy_sparse_op(nsp_logits, nsp_label), [0])
+        feeds["next_sentence_label"] = nsp_label
     if attention_mask is not None:
         feeds["attention_mask"] = attention_mask
     return feeds, loss, logits
