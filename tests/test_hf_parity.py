@@ -259,3 +259,87 @@ def test_t5_encoder_forward_matches_hf():
         theirs = model(input_ids=torch.from_numpy(ids.astype(np.int64))
                        ).last_hidden_state.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+def test_bert_gradients_match_hf():
+    """Backward parity: d(MLM-style pooled loss)/d(params) of our BERT
+    encoder vs torch autograd through the weight-matched HF model.  The
+    forward tests above pin the function; this pins its derivative —
+    the quantity every training step actually consumes.  A scalar loss
+    (mean of squared sequence output) avoids mapping our masked-LM head
+    onto HF's and isolates ENCODER autodiff."""
+    from hetu_tpu.graph.node import placeholder_op
+    from hetu_tpu.graph.gradients import gradients
+
+    cfg = BertConfig.tiny(batch_size=2, seq_len=12, vocab_size=67,
+                          hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=1, hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    tt = np.zeros((2, 12), np.int32)
+    attn = np.ones((2, 12), np.int32)
+    attn[1, 9:] = 0
+    ids[1, 9:] = 0
+
+    shape = (cfg.batch_size, cfg.seq_len)
+    input_ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    token_type_ids = placeholder_op("token_type_ids", shape=shape,
+                                    dtype=np.int32)
+    attention_mask = placeholder_op("attention_mask", shape=shape,
+                                    dtype=np.int32)
+    seq = bert_model(cfg, input_ids, token_type_ids,
+                     attention_mask=attention_mask, name="bert")
+    loss = ht.reduce_mean_op(ht.ops.mul_op(seq, seq), [0, 1])
+
+    # gradient nodes for a representative spread of parameters: first/
+    # deepest matmuls, layernorms, and the embedding table
+    probe_names = ["bert.embeddings.word.weight",
+                   "bert.embeddings.ln.scale",
+                   "bert.layer0.attn.q.weight",
+                   "bert.layer0.attn.o.bias",
+                   "bert.layer0.ffn2.weight",
+                   "bert.layer0.ln2.bias"]
+    ex0 = ht.Executor({"probe": [loss]}, seed=3)
+    by_name = {ex0.var_names[n]: n for n in ex0.var_values}
+    grad_nodes = gradients(loss, [by_name[n] for n in probe_names])
+    ex = ht.Executor({"grads": [loss] + grad_nodes}, seed=3)
+    fd = {input_ids: ids, token_type_ids: tt, attention_mask: attn}
+    outs = ex.run("grads", feed_dict=fd)
+    our_loss = float(outs[0].asnumpy())
+    our_grads = {n: outs[1 + i].asnumpy()
+                 for i, n in enumerate(probe_names)}
+    weights = {ex.var_names[n]: np.asarray(v)
+               for n, v in ex.var_values.items()}
+
+    model = _hf_bert(cfg, weights)
+    model.train()   # grads required; dropout probs are all 0
+    out = model(input_ids=torch.from_numpy(ids.astype(np.int64)),
+                token_type_ids=torch.from_numpy(tt.astype(np.int64)),
+                attention_mask=torch.from_numpy(attn.astype(np.int64))
+                ).last_hidden_state
+    t_loss = (out * out).mean()
+    t_loss.backward()
+    assert abs(our_loss - float(t_loss)) < 2e-4 * max(1, abs(our_loss))
+
+    hf_names = {
+        "bert.embeddings.word.weight":
+            ("embeddings.word_embeddings.weight", False),
+        "bert.embeddings.ln.scale": ("embeddings.LayerNorm.weight", False),
+        "bert.layer0.attn.q.weight":
+            ("encoder.layer.0.attention.self.query.weight", True),
+        "bert.layer0.attn.o.bias":
+            ("encoder.layer.0.attention.output.dense.bias", False),
+        "bert.layer0.ffn2.weight":
+            ("encoder.layer.0.output.dense.weight", True),
+        "bert.layer0.ln2.bias":
+            ("encoder.layer.0.output.LayerNorm.bias", False),
+    }
+    params = dict(model.named_parameters())
+    for ours_name, (hf_name, transpose) in hf_names.items():
+        g = params[hf_name].grad.numpy()
+        if transpose:
+            g = g.T
+        np.testing.assert_allclose(
+            our_grads[ours_name], g, rtol=5e-4, atol=1e-6,
+            err_msg=f"gradient mismatch: {ours_name} vs {hf_name}")
