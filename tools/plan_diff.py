@@ -1,0 +1,84 @@
+"""Before/after auto-parallel plan diff against measured hardware.
+
+Round-4 verdict item 6's live leg: when ``tools/calibrate_tpu.py``
+lands ``artifacts/tpu_calibration.json`` at a healthy tunnel window,
+re-run the flagship-shaped layerwise search with the MEASURED constants
+and persist both plans side by side — a reviewer can see exactly how
+grounding the cost model in hardware moved the strategy (or that it
+validated the estimate).  The watcher runs this as a post-job after
+calibration; it exits non-zero while the calibration artifact is absent
+so the watcher retries it at the next healthy window.
+
+The search itself is pure host work — the backend is pinned to CPU so
+this never occupies the chip during a measurement window.
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _summarize(plan, specs):
+    return {
+        "est_time_s": plan.est_time,
+        "uniform": plan.uniform,
+        "mesh_axes": plan.mesh_axes(),
+        "strategies": [{"layer": sp.name, "strategy": str(st)}
+                       for sp, st in zip(specs, plan.strategies)],
+    }
+
+
+def main():
+    from artifact_schema import provenance
+    from hetu_tpu.autoparallel import search
+    from hetu_tpu.autoparallel.cost_model import (HardwareSpec,
+                                                  model_layer_specs)
+
+    calib_path = os.path.join(ROOT, "artifacts", "tpu_calibration.json")
+    measured = HardwareSpec.from_artifact(calib_path)
+    if measured is None:
+        print("plan_diff: no calibration artifact yet "
+              f"({calib_path}); retry after calibration lands")
+        return 1
+
+    # flagship-shaped search (BERT-base dims, the bench workload)
+    workload = {"n_layers": 12, "hidden": 768, "seq": 512, "batch": 64,
+                "vocab": 30522, "devices": 8}
+    specs = model_layer_specs(workload["n_layers"], workload["hidden"],
+                              workload["seq"], workload["batch"],
+                              workload["vocab"])
+    import dataclasses
+    out = {"workload": workload}
+    for tag, hw in (("estimated", HardwareSpec()), ("measured", measured)):
+        plan = search(specs, workload["devices"], hw=hw, microbatches=4)
+        out[tag] = {"hardware": dataclasses.asdict(hw),
+                    "plan": _summarize(plan, specs)}
+    est = out["estimated"]["plan"]["strategies"]
+    mes = out["measured"]["plan"]["strategies"]
+    out["strategy_changes"] = [
+        {"layer": a["layer"], "estimated": a["strategy"],
+         "measured": b["strategy"]}
+        for a, b in zip(est, mes) if a["strategy"] != b["strategy"]]
+    out.update(provenance(workload))
+
+    path = os.path.join(ROOT, "artifacts", "plan_calibration_diff.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps({"changes": len(out["strategy_changes"]),
+                      "est_time_estimated":
+                          out["estimated"]["plan"]["est_time_s"],
+                      "est_time_measured":
+                          out["measured"]["plan"]["est_time_s"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

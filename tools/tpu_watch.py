@@ -52,6 +52,13 @@ EXTRA_JOBS = (
     ("calibration",
      [sys.executable, os.path.join(ROOT, "tools", "calibrate_tpu.py")],
      os.path.join(ROOT, "artifacts", "tpu_calibration.json"), False, None),
+    # re-search with the measured constants once calibration lands
+    # (exits non-zero until artifacts/tpu_calibration.json exists, so it
+    # retries each window; pure host work — pinned to the CPU backend)
+    ("plan_diff",
+     [sys.executable, os.path.join(ROOT, "tools", "plan_diff.py")],
+     os.path.join(ROOT, "artifacts", "plan_calibration_diff.json"),
+     False, None),
     ("kernel_check", _KC, _KC_ARTIFACT, False, None),
 )
 
