@@ -173,12 +173,18 @@ class SubExecutor:
                 return x
             return jax.tree.map(cast, tree)
 
-        def step(tparams, sparams, opt_states, feeds, key, lrs):
+        def step(tparams, sparams, opt_states, feeds, key, step_idx, lrs):
             with _precision_scope():
                 return _step_inner(tparams, sparams, opt_states, feeds,
-                                   key, lrs)
+                                   key, step_idx, lrs)
 
-        def _step_inner(tparams, sparams, opt_states, feeds, key, lrs):
+        def _step_inner(tparams, sparams, opt_states, feeds, key, step_idx,
+                        lrs):
+            # per-step RNG derivation lives INSIDE the jitted program: an
+            # eager host-side fold_in cost ~280us/step of dispatch (30x a
+            # raw jit call at small step sizes); here it fuses to nothing.
+            # step_idx is a traced scalar, so no per-step retrace.
+            key = jax.random.fold_in(key, step_idx)
             cd = self.ex.compute_dtype
             if cd:  # mixed precision: bf16 inside the step, fp32 masters out
                 sparams = _cast_tree(sparams, cd)
@@ -401,10 +407,10 @@ class SubExecutor:
         lrs = np.asarray(
             [op.optimizer.host_lr(ex.step_counter) for op in self.opt_ops],
             np.float32) if self.opt_ops else np.zeros((0,), np.float32)
-        key = jax.random.fold_in(ex.master_key, ex.step_counter)
 
         outs, new_tparams, updates, new_opt_states = self._jit(
-            tparams, sparams, opt_states, feeds, key, lrs)
+            tparams, sparams, opt_states, feeds, ex.master_key,
+            np.int64(ex.step_counter), lrs)
 
         if ex.bsp == -1 and ex.prefetch:
             # ASP: next-batch pull may overlap the in-flight step AND the
@@ -830,7 +836,8 @@ class Executor:
         """Export the subgraph as a pure jittable function + example args.
 
         Returns ``(fn, example_args)`` where ``fn(tparams, sparams,
-        opt_states, feeds, key, lrs)`` is the exact step the executor jits
+        opt_states, feeds, key, step_idx, lrs)`` is the exact step the
+        executor jits
         (params update + state side-channel included).  Feeds in the example
         args are zeros of the dataloader/placeholder shapes.
         """
@@ -861,7 +868,8 @@ class Executor:
         if sub._jit is None:
             sub._build_step()
         # _step_fn is the raw pure step (the executor's own jit adds donation)
-        return sub._step_fn, (tparams, sparams, opt_states, feeds, key, lrs)
+        return sub._step_fn, (tparams, sparams, opt_states, feeds, key,
+                              np.int64(0), lrs)
 
     def get_batch_num(self, name="default"):
         from ..data.dataloader import DataloaderOp

@@ -48,7 +48,8 @@ class HetuProfiler:
 
     # -- input packing / shape inference -------------------------------------
     def _pack(self, feed_dict):
-        """Assemble (tparams, sparams, feeds, key) exactly like sub.run."""
+        """Assemble (tparams, sparams, feeds, master_key, step_idx)
+        exactly like sub.run (the step folds the key itself)."""
         import jax
         from .graph.executor import _key
         from .data.dataloader import DataloaderOp
@@ -78,15 +79,18 @@ class HetuProfiler:
                 raise ValueError(f"cannot resolve ids for PS embedding {node}")
             val = ex._place_feed(node, node.pull(ids))
             (tparams if sub.grad_ops else sparams)[_key(node)] = val
-        key = jax.random.fold_in(ex.master_key, ex.step_counter)
-        return tparams, sparams, feeds, key
+        # the executor folds per-step RNG INSIDE the jitted program; the
+        # pack mirrors its (master_key, step_idx) calling convention
+        return tparams, sparams, feeds, ex.master_key, \
+            np.int64(ex.step_counter)
 
     def _node_shapes(self, feed_dict):
         """Abstractly evaluate the forward graph → {node: ShapeDtypeStruct}."""
         import jax
 
         sub = self.sub
-        tparams, sparams, feeds, key = self._pack(feed_dict)
+        tparams, sparams, feeds, key, step_idx = self._pack(feed_dict)
+        key = jax.random.fold_in(key, step_idx)
         nodes = [n for n in sub.topo
                  if not hasattr(n, "loss") and n not in sub.opt_ops]
 
@@ -186,12 +190,13 @@ class HetuProfiler:
         sub, ex = self.sub, self.ex
         if sub._jit is None:
             sub._build_step()
-        tparams, sparams, feeds, key = self._pack(feed_dict)
+        tparams, sparams, feeds, key, step_idx = self._pack(feed_dict)
         opt_states = {_key(op): ex.opt_states[op] for op in sub.opt_ops}
         lrs = np.zeros((len(sub.opt_ops),), np.float32)
         # reuse the executor's jitted step — .lower on the same jit object
         # hits jax's compilation cache instead of recompiling
-        return sub._jit.lower(tparams, sparams, opt_states, feeds, key, lrs)
+        return sub._jit.lower(tparams, sparams, opt_states, feeds, key,
+                              step_idx, lrs)
 
     def _compiled(self, feed_dict):
         """Compile (cache-hitting) the executor's jitted step for analysis."""
