@@ -338,3 +338,37 @@ def test_remat_training_parity():
                 for _ in range(4)]
 
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_mixed_precision_bf16_trains_with_f32_masters():
+    """The flagship's compute_dtype path (bench.py bert on TPU): bf16
+    inside the step, fp32 master weights outside, int feeds exempt from
+    the cast.  No other test exercised this end-to-end."""
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu import models
+    from hetu_tpu.models.bert import synthetic_mlm_batch
+
+    cfg = models.BertConfig.tiny(batch_size=4, seq_len=16, vocab_size=64,
+                                 hidden_size=32, intermediate_size=64,
+                                 num_hidden_layers=1,
+                                 hidden_dropout_prob=0.0,
+                                 attention_probs_dropout_prob=0.0)
+    feeds, loss, _ = models.bert_pretrain_graph(cfg)
+    opt = ht.optim.AdamOptimizer(1e-3)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     compute_dtype="bfloat16")
+    ids, tt, labels, attn = synthetic_mlm_batch(cfg)
+    fd = {feeds["input_ids"]: ids, feeds["token_type_ids"]: tt,
+          feeds["masked_lm_labels"]: labels,
+          feeds["attention_mask"]: attn}
+    hist = [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+            for _ in range(10)]
+    assert np.isfinite(hist).all() and hist[-1] < hist[0], hist
+    # master copies must still be fp32 after training steps
+    for n, v in ex.var_values.items():
+        if n.trainable:
+            assert np.asarray(v).dtype == np.float32, (n.name, v.dtype)
+    # fetched loss leaves the step as fp32 (the _cast_tree discipline)
+    out = ex.run("train", feed_dict=fd)[0].asnumpy()
+    assert out.dtype == np.float32
