@@ -18,12 +18,19 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
 sys.path.insert(0, _HERE)
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import models  # noqa: E402
 import hetu_tpu as ht  # noqa: E402
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
     p.add_argument("--model", default="wdl",
                    choices=["wdl", "deepfm", "dcn"])
     p.add_argument("--embed", default="dense",

@@ -4,11 +4,18 @@ Reference parity: ``examples/embedding/gnn`` + ``tests/test_DistGCN``.
 ``--shards N`` runs the row-partitioned SPMD path on an N-way mesh axis.
 """
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import hetu_tpu as ht  # noqa: E402
 from hetu_tpu.gnn import (DistGCN15D, normalized_adjacency,  # noqa
                           partition_edges_by_row)
@@ -32,6 +39,8 @@ def synthetic_graph(rng, n, avg_deg, classes, feat):
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
     p.add_argument("--nodes", type=int, default=256)
     p.add_argument("--shards", type=int, default=1)
     p.add_argument("--steps", type=int, default=40)

@@ -5,11 +5,18 @@ Reference parity: ``examples/moe/test_moe_{base,top,hash,ktop1,sam}.py``
 ``python examples/moe/train_moe.py --gate top2 --ep 4``.
 """
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import hetu_tpu as ht  # noqa: E402
 from hetu_tpu.layers import (Expert, KTop1Gate, MoELayer, SAMGate,  # noqa
                              TopKGate)
@@ -49,6 +56,8 @@ def build_gate(kind, d, tokens, experts, ids_node=None):
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
     p.add_argument("--gate", default="top2",
                    choices=["base", "top1", "top2", "hash", "ktop1", "sam"])
     p.add_argument("--experts", type=int, default=4)

@@ -5,11 +5,18 @@ binary loss; embeddings can live in the host PS store (``--ps``) exactly
 like the CTR examples (HET path, SURVEY.md §3.3).
 """
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import hetu_tpu as ht  # noqa: E402
 from hetu_tpu.layers import Linear  # noqa
 
@@ -46,6 +53,8 @@ def build_ncf(users, items, dim, u_ids, i_ids, use_ps):
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
     p.add_argument("--users", type=int, default=200)
     p.add_argument("--items", type=int, default=100)
     p.add_argument("--dim", type=int, default=16)

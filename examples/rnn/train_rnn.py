@@ -5,17 +5,26 @@ comparisons). Synthetic task: classify the dominant token of a sequence.
 ``python examples/rnn/train_rnn.py --cell lstm``.
 """
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "--cpu" in sys.argv:  # must run before hetu_tpu/jax backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import hetu_tpu as ht  # noqa: E402
 from hetu_tpu.layers import GRU, LSTM, RNN, Embedding, Linear  # noqa
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
     p.add_argument("--cell", default="lstm", choices=["rnn", "lstm", "gru"])
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--batch", type=int, default=64)
