@@ -704,6 +704,20 @@ def _parent_main(args):
             if parsed is not None:
                 parsed.setdefault("error", "TPU backend unavailable")
                 parsed["error"] += f" | last TPU {last_err}"
+                # attach the committed HLO-audit projection so even a
+                # CPU-fallback artifact states what THIS program projects
+                # to on a v5e (compute-leg floor + north-star step time;
+                # artifacts/hlo_audit_cpu.json carries the full audit)
+                try:
+                    audit_path = os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", "hlo_audit_cpu.json")
+                    with open(audit_path) as f:
+                        proj = json.load(f)["configs"][args.config][
+                            "detail"]["v5e_projection"]
+                    parsed.setdefault("extra", {})["v5e_projection"] = proj
+                except (OSError, KeyError, json.JSONDecodeError):
+                    pass
                 print(json.dumps(parsed))
                 return
             last_err += f" | cpu fallback rc={proc.returncode} " \
