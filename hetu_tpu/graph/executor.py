@@ -1072,6 +1072,64 @@ class Executor:
             json.dump(meta, f, indent=1)     # checkpoint
         os.replace(tmp, os.path.join(path, "meta.json"))
 
+    def save_orbax(self, path):
+        """Orbax-format checkpoint — the JAX-ecosystem standard format,
+        as an optional alternative to the native streamed-npy format
+        (``save``); lets orbax-based tooling (inspection, cloud copies,
+        emergency-restore pipelines) consume hetu_tpu state directly.
+
+        The tree is {"params": {name: array}, "opt": {ordinal: named
+        state}, "step": int} — the same name/ordinal identities ``load``
+        uses, so the two formats are semantically interchangeable.
+        Single-process convenience: multiprocess meshes should use
+        ``save`` (its collective fetch + rank-0-write discipline).
+        """
+        import os
+        import jax
+        import orbax.checkpoint as ocp
+        if self._multiprocess:
+            raise NotImplementedError(
+                "save_orbax is single-process; multiprocess meshes use "
+                "save() (collective fetch + rank-0 writes)")
+        self.ps_flush()
+        tree = {
+            "params": {self.var_names[n]: self._fetch_host(v)
+                       for n, v in self.var_values.items()},
+            "opt": {str(i): jax.tree.map(
+                self._fetch_host, self._named_opt_state(op, st))
+                for i, (op, st) in enumerate(self.opt_states.items())},
+            "step": self.step_counter,
+        }
+        ocp.PyTreeCheckpointer().save(os.path.abspath(path), tree,
+                                      force=True)
+
+    def load_orbax(self, path, params_only=False):
+        """Restore a ``save_orbax`` checkpoint (params by name, optimizer
+        state by ordinal; ``params_only=True`` is the warm-start form —
+        see ``load``)."""
+        import os
+        import orbax.checkpoint as ocp
+        import jax
+        tree = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        self.load_dict(tree.get("params", {}))
+        if params_only:
+            return
+        for i, (op, live) in enumerate(list(self.opt_states.items())):
+            named = tree.get("opt", {}).get(str(i))
+            if named is None:
+                continue
+            named_live = self._named_opt_state(op, live)
+            paths, treedef = jax.tree_util.tree_flatten_with_path(
+                named_live)
+            saved = {jax.tree_util.keystr(kp): leaf for kp, leaf in
+                     jax.tree_util.tree_flatten_with_path(named)[0]}
+            leaves = [saved.get(jax.tree_util.keystr(kp), old)
+                      for kp, old in paths]
+            self.opt_states[op] = self._unname_opt_state(
+                op, jax.tree.unflatten(
+                    treedef, [self._place_param(l) for l in leaves]))
+        self.step_counter = int(tree.get("step", 0))
+
     def load(self, path, file=None, consider_splits=False,
              params_only=False):
         """Restore a checkpoint.  ``params_only=True`` is the WARM-START
