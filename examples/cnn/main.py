@@ -15,6 +15,8 @@ import jax  # noqa: E402
 
 if os.environ.get("HETU_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["HETU_PLATFORM"])
+elif "--cpu" in sys.argv:   # same flag as the rest of the cookbook
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import hetu_tpu as ht  # noqa: E402
@@ -26,6 +28,8 @@ logger = logging.getLogger(__name__)
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (= HETU_PLATFORM=cpu)")
     parser.add_argument("--model", type=str, required=True)
     parser.add_argument("--dataset", type=str, default="cifar10")
     parser.add_argument("--batch-size", type=int, default=128)
