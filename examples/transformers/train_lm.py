@@ -7,7 +7,7 @@ per-model scripts, e.g. bert/train_hetu_bert_dp.py:68-69).
     python examples/transformers/train_lm.py --model vit
     python examples/transformers/train_lm.py --model transformer
     python examples/transformers/train_lm.py --model bart|longformer|
-        bigbird|reformer|transfoxl|xlnet|clip|mae   # full 13-family zoo
+        bigbird|reformer|transfoxl|xlnet|clip|mae|swin  # 14-family zoo
 """
 import argparse
 import os
@@ -60,6 +60,11 @@ def build(model, size, batch_size, seq_len, cp_mode=None):
     elif model == "vit":
         cfg = getattr(models.ViTConfig, size)(batch_size=batch_size)
         feeds, loss, logits = models.vit_classify_graph(cfg)
+        imgs, y = models.synthetic_image_batch(cfg)
+        vals = {"images": imgs, "labels": y}
+    elif model == "swin":
+        cfg = getattr(models.SwinConfig, size)(batch_size=batch_size)
+        feeds, loss, logits = models.swin_classify_graph(cfg)
         imgs, y = models.synthetic_image_batch(cfg)
         vals = {"images": imgs, "labels": y}
     elif model == "bart":
@@ -137,6 +142,7 @@ def build(model, size, batch_size, seq_len, cp_mode=None):
 SIZES = {"bert": ["tiny", "base", "large"], "gpt2": ["tiny", "small",
                                                      "medium"],
          "t5": ["tiny", "small"], "vit": ["tiny", "base"],
+         "swin": ["tiny", "base"],
          "transformer": ["tiny"],
          "bart": ["tiny", "base"], "longformer": ["tiny", "base"],
          "bigbird": ["tiny", "base"], "reformer": ["tiny", "base"],
