@@ -42,6 +42,9 @@ from . import layers
 from . import metrics
 from . import tokenizers
 from .profiler import HetuProfiler, CollectiveProfiler
+# reference script compat: ht.NCCLProfiler is the collectives
+# profiler's name there (profiler.py:390); same surface here
+NCCLProfiler = CollectiveProfiler
 from . import autoparallel
 from . import onnx
 from . import gnn

@@ -222,7 +222,7 @@ def test_reference_export_parity_surface():
                  "cpu", "gpu", "rcpu", "rgpu", "array", "sparse_array",
                  "empty", "is_gpu_ctx", "IndexedSlices",
                  "optim", "lr", "init", "data", "layers", "dist",
-                 "HetuProfiler"):
+                 "HetuProfiler", "NCCLProfiler"):
         assert hasattr(ht, name), name
     # COO sparse_array round-trips to dense (reference ndarray.py:477)
     sa = ht.sparse_array([1.0, 2.0], ([0, 1], [1, 0]), (2, 2))
