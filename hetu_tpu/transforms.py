@@ -1,0 +1,6 @@
+"""Import-path parity: the reference exposes transforms at
+``hetu.transforms`` (examples import ``from hetu.transforms import
+Compose, Resize, CenterCrop, Normalize``); the implementations live in
+``hetu_tpu.data.transforms``."""
+from .data.transforms import *          # noqa: F401,F403
+from .data.transforms import __all__    # noqa: F401

@@ -224,6 +224,11 @@ def test_reference_export_parity_surface():
                  "optim", "lr", "init", "data", "layers", "dist",
                  "HetuProfiler", "NCCLProfiler"):
         assert hasattr(ht, name), name
+    # deep import paths reference example scripts use (grep of
+    # /root/reference/examples): hetu.transforms / hetu.launcher.launch
+    from hetu_tpu.transforms import (Compose, Resize,  # noqa: F401
+                                     CenterCrop, Normalize)
+    from hetu_tpu.launcher import launch  # noqa: F401
     # COO sparse_array round-trips to dense (reference ndarray.py:477)
     sa = ht.sparse_array([1.0, 2.0], ([0, 1], [1, 0]), (2, 2))
     np.testing.assert_allclose(sa.asnumpy(), [[0.0, 1.0], [2.0, 0.0]])
