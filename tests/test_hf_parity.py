@@ -526,3 +526,106 @@ def test_t5_encoder_gradients_match_hf():
     np.testing.assert_allclose(ours["t5.shared"],
                                params["shared.weight"].grad.numpy(),
                                rtol=5e-4, atol=1e-6)
+
+
+def test_t5_full_stack_forward_matches_hf():
+    """Encoder-decoder parity: causal (non-bidirectional) relative
+    buckets in the decoder, cross-attention over the encoder memory, and
+    the three-sublayer pre-RMSNorm decoder block — our full T5 stack vs
+    transformers.T5Model.last_hidden_state (which is the UNSCALED decoder
+    output; our seq2seq graph's d_model^-0.5 scale lives after this
+    point, models/t5.py:197)."""
+    from hetu_tpu.models.t5 import T5Config, t5_encoder, t5_decoder
+    from hetu_tpu.graph.node import placeholder_op
+    from hetu_tpu import initializers as init
+    from hetu_tpu import ops as htops
+
+    cfg = T5Config.tiny(batch_size=2, src_len=12, tgt_len=12,
+                        vocab_size=83, d_model=32, d_ff=64, num_heads=2,
+                        num_layers=1, dropout_rate=0.0)
+    rng = np.random.RandomState(8)
+    src_ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    tgt_ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+
+    src = placeholder_op("input_ids", shape=(2, 12), dtype=np.int32)
+    tgt = placeholder_op("decoder_input_ids", shape=(2, 12),
+                         dtype=np.int32)
+    shared = init.truncated_normal((cfg.vocab_size, cfg.d_model), 0.0,
+                                   0.02, name="t5.shared")
+    se = htops.array_reshape_op(htops.embedding_lookup_op(shared, src),
+                                output_shape=(2 * 12, cfg.d_model))
+    te = htops.array_reshape_op(htops.embedding_lookup_op(shared, tgt),
+                                output_shape=(2 * 12, cfg.d_model))
+    mem = t5_encoder(cfg, se, name="t5.encoder")
+    dec = t5_decoder(cfg, te, mem, name="t5.decoder")
+    ex = ht.Executor({"fwd": [dec]}, seed=13)
+    ours = ex.run("fwd", feed_dict={src: src_ids, tgt: tgt_ids})[0] \
+        .asnumpy().reshape(2, 12, cfg.d_model)
+    weights = {ex.var_names[n]: np.asarray(v)
+               for n, v in ex.var_values.items()}
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        d_kv=cfg.d_model // cfg.num_heads, d_ff=cfg.d_ff,
+        num_layers=cfg.num_layers, num_decoder_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        relative_attention_num_buckets=cfg.relative_attention_num_buckets,
+        relative_attention_max_distance=cfg.relative_attention_max_distance,
+        dropout_rate=0.0, layer_norm_epsilon=cfg.layer_norm_epsilon,
+        feed_forward_proj="relu")
+    model = transformers.T5Model(hf_cfg)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    def lin(hf, ours_name):
+        # our Linear (in,out) for x @ W; torch nn.Linear (out,in); and
+        # our zero-init biases have no HF counterpart (T5 has none)
+        np.testing.assert_array_equal(
+            weights.get(ours_name + ".bias", np.zeros(1)), 0.0)
+        return {hf + ".weight": t(ours_name + ".weight").T}
+
+    sd = {"shared.weight": t("t5.shared"),
+          "encoder.embed_tokens.weight": t("t5.shared"),
+          "decoder.embed_tokens.weight": t("t5.shared"),
+          "encoder.final_layer_norm.weight": t("t5.encoder.ln_f.scale"),
+          "decoder.final_layer_norm.weight": t("t5.decoder.ln_f.scale"),
+          "encoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+          ".weight": t("t5.encoder.relpos"),
+          "decoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+          ".weight": t("t5.decoder.relpos")}
+    enc, dece = "encoder.block.0.", "decoder.block.0."
+    qe, qd = "t5.encoder.block0.", "t5.decoder.block0."
+    for hf_name, ours_name in [("layer.0.SelfAttention.q", "attn.q"),
+                               ("layer.0.SelfAttention.k", "attn.k"),
+                               ("layer.0.SelfAttention.v", "attn.v"),
+                               ("layer.0.SelfAttention.o", "attn.o")]:
+        sd.update(lin(enc + hf_name, qe + ours_name))
+    sd[enc + "layer.0.layer_norm.weight"] = t(qe + "ln1.scale")
+    sd.update(lin(enc + "layer.1.DenseReluDense.wi", qe + "ffn.wi"))
+    sd.update(lin(enc + "layer.1.DenseReluDense.wo", qe + "ffn.wo"))
+    sd[enc + "layer.1.layer_norm.weight"] = t(qe + "ln2.scale")
+    for hf_name, ours_name in [("layer.0.SelfAttention.q", "self.q"),
+                               ("layer.0.SelfAttention.k", "self.k"),
+                               ("layer.0.SelfAttention.v", "self.v"),
+                               ("layer.0.SelfAttention.o", "self.o"),
+                               ("layer.1.EncDecAttention.q", "cross.q"),
+                               ("layer.1.EncDecAttention.k", "cross.k"),
+                               ("layer.1.EncDecAttention.v", "cross.v"),
+                               ("layer.1.EncDecAttention.o", "cross.o")]:
+        sd.update(lin(dece + hf_name, qd + ours_name))
+    sd[dece + "layer.0.layer_norm.weight"] = t(qd + "ln1.scale")
+    sd[dece + "layer.1.layer_norm.weight"] = t(qd + "ln2.scale")
+    sd.update(lin(dece + "layer.2.DenseReluDense.wi", qd + "ffn.wi"))
+    sd.update(lin(dece + "layer.2.DenseReluDense.wo", qd + "ffn.wo"))
+    sd[dece + "layer.2.layer_norm.weight"] = t(qd + "ln3.scale")
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.from_numpy(src_ids.astype(np.int64)),
+            decoder_input_ids=torch.from_numpy(tgt_ids.astype(np.int64))
+        ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
