@@ -629,3 +629,105 @@ def test_t5_full_stack_forward_matches_hf():
             decoder_input_ids=torch.from_numpy(tgt_ids.astype(np.int64))
         ).last_hidden_state.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+def test_bart_forward_matches_hf():
+    """Post-LN encoder-decoder family: learned positions at BART's
+    offset-2 quirk, embedding layernorm, per-sublayer post-norms, and
+    cross-attention — our full BART vs transformers.BartModel."""
+    from hetu_tpu.models.bart import (BartConfig, bart_encoder,
+                                      bart_decoder, _embed)
+    from hetu_tpu.graph.node import placeholder_op
+    from hetu_tpu import initializers as init
+
+    cfg = BartConfig.tiny(batch_size=2, src_len=10, tgt_len=10,
+                          vocab_size=89, dropout=0.0) \
+        if hasattr(BartConfig, "tiny") else None
+    assert cfg is not None
+    rng = np.random.RandomState(9)
+    src_ids = rng.randint(0, cfg.vocab_size, (2, 10)).astype(np.int32)
+    tgt_ids = rng.randint(0, cfg.vocab_size, (2, 10)).astype(np.int32)
+
+    src = placeholder_op("input_ids", shape=(2, 10), dtype=np.int32)
+    tgt = placeholder_op("decoder_input_ids", shape=(2, 10),
+                         dtype=np.int32)
+    shared = init.truncated_normal((cfg.vocab_size, cfg.d_model), 0.0,
+                                   0.02, name="bart.shared_embed")
+    enc_in = _embed(cfg, shared, src, cfg.src_len, "bart.enc_embed")
+    dec_in = _embed(cfg, shared, tgt, cfg.tgt_len, "bart.dec_embed")
+    memory = bart_encoder(cfg, enc_in, "bart.encoder")
+    hidden = bart_decoder(cfg, dec_in, memory, "bart.decoder")
+    ex = ht.Executor({"fwd": [hidden]}, seed=17)
+    ours = ex.run("fwd", feed_dict={src: src_ids, tgt: tgt_ids})[0] \
+        .asnumpy().reshape(2, 10, cfg.d_model)
+    weights = {ex.var_names[n]: np.asarray(v)
+               for n, v in ex.var_values.items()}
+
+    hf_cfg = transformers.BartConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        encoder_layers=cfg.encoder_layers,
+        decoder_layers=cfg.decoder_layers,
+        encoder_attention_heads=cfg.encoder_attention_heads,
+        decoder_attention_heads=cfg.decoder_attention_heads,
+        encoder_ffn_dim=cfg.encoder_ffn_dim,
+        decoder_ffn_dim=cfg.decoder_ffn_dim,
+        max_position_embeddings=cfg.max_position_embeddings,
+        dropout=0.0, attention_dropout=0.0, activation_dropout=0.0,
+        activation_function="gelu_new", scale_embedding=False)
+    model = transformers.BartModel(hf_cfg)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    def lin(hf, ours_name):
+        return {hf + ".weight": t(ours_name + ".weight").T,
+                hf + ".bias": t(ours_name + ".bias")}
+
+    def ln(hf, ours_name):
+        return {hf + ".weight": t(ours_name + ".scale"),
+                hf + ".bias": t(ours_name + ".bias")}
+
+    sd = {"shared.weight": t("bart.shared_embed"),
+          "encoder.embed_tokens.weight": t("bart.shared_embed"),
+          "decoder.embed_tokens.weight": t("bart.shared_embed"),
+          "encoder.embed_positions.weight": t("bart.enc_embed.pos"),
+          "decoder.embed_positions.weight": t("bart.dec_embed.pos")}
+    sd.update(ln("encoder.layernorm_embedding", "bart.enc_embed.ln"))
+    sd.update(ln("decoder.layernorm_embedding", "bart.dec_embed.ln"))
+    for i in range(cfg.encoder_layers):
+        p, q = f"encoder.layers.{i}.", f"bart.encoder.layer{i}."
+        for hf_name, ours_name in [("self_attn.q_proj", "attn.q"),
+                                   ("self_attn.k_proj", "attn.k"),
+                                   ("self_attn.v_proj", "attn.v"),
+                                   ("self_attn.out_proj", "attn.o"),
+                                   ("fc1", "fc1"), ("fc2", "fc2")]:
+            sd.update(lin(p + hf_name, q + ours_name))
+        sd.update(ln(p + "self_attn_layer_norm", q + "ln1"))
+        sd.update(ln(p + "final_layer_norm", q + "ln2"))
+    for i in range(cfg.decoder_layers):
+        p, q = f"decoder.layers.{i}.", f"bart.decoder.layer{i}."
+        for hf_name, ours_name in [("self_attn.q_proj", "self.q"),
+                                   ("self_attn.k_proj", "self.k"),
+                                   ("self_attn.v_proj", "self.v"),
+                                   ("self_attn.out_proj", "self.o"),
+                                   ("encoder_attn.q_proj", "cross.q"),
+                                   ("encoder_attn.k_proj", "cross.k"),
+                                   ("encoder_attn.v_proj", "cross.v"),
+                                   ("encoder_attn.out_proj", "cross.o"),
+                                   ("fc1", "fc1"), ("fc2", "fc2")]:
+            sd.update(lin(p + hf_name, q + ours_name))
+        sd.update(ln(p + "self_attn_layer_norm", q + "ln1"))
+        sd.update(ln(p + "encoder_attn_layer_norm", q + "ln2"))
+        sd.update(ln(p + "final_layer_norm", q + "ln3"))
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.from_numpy(src_ids.astype(np.int64)),
+            decoder_input_ids=torch.from_numpy(tgt_ids.astype(np.int64)),
+            attention_mask=torch.ones(2, 10, dtype=torch.long),
+            decoder_attention_mask=torch.ones(2, 10, dtype=torch.long)
+        ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-5)
