@@ -22,8 +22,9 @@ class ViTConfig:
                  hidden_size=768, num_hidden_layers=12,
                  num_attention_heads=12, intermediate_size=3072,
                  hidden_dropout_prob=0.0, layer_norm_eps=1e-6,
-                 num_classes=1000, batch_size=8):
+                 num_classes=1000, batch_size=8, pool="mean"):
         assert image_size % patch_size == 0
+        assert pool in ("mean", "cls")
         self.image_size = image_size
         self.patch_size = patch_size
         self.num_channels = num_channels
@@ -35,7 +36,10 @@ class ViTConfig:
         self.layer_norm_eps = layer_norm_eps
         self.num_classes = num_classes
         self.batch_size = batch_size
+        self.pool = pool
         self.num_patches = (image_size // patch_size) ** 2
+        #: sequence length through the encoder (CLS prepends a token)
+        self.seq_len = self.num_patches + (1 if pool == "cls" else 0)
 
     @classmethod
     def base(cls, **kw):
@@ -72,28 +76,38 @@ def _patchify(cfg, images, name):
 
 
 def vit_model(cfg, images, name="vit"):
-    """Returns patch-sequence hidden states (batch*num_patches, hidden)."""
+    """Returns token-sequence hidden states (batch*seq_len, hidden);
+    ``cfg.pool == "cls"`` prepends a learned class token (the HF/original
+    layout — tests/test_hf_parity.py pins it against transformers),
+    ``"mean"`` (default) keeps the token-free mean-pool head."""
+    S = cfg.seq_len
     x = _patchify(cfg, images, name + ".patch")
-    pos = init.truncated_normal((cfg.num_patches, cfg.hidden_size), 0.0, 0.02,
+    pos = init.truncated_normal((S, cfg.hidden_size), 0.0, 0.02,
                                 name=name + ".pos_embed")
     pos_ids = Variable(name + ".pos_ids",
-                       value=np.arange(cfg.num_patches, dtype=np.float32),
+                       value=np.arange(S, dtype=np.float32),
                        trainable=False)
-    pe = ops.embedding_lookup_op(pos, pos_ids)        # (P, hidden)
-    pe = ops.array_reshape_op(pe, output_shape=(1, cfg.num_patches,
-                                                cfg.hidden_size))
+    pe = ops.embedding_lookup_op(pos, pos_ids)        # (S, hidden)
+    pe = ops.array_reshape_op(pe, output_shape=(1, S, cfg.hidden_size))
     x = ops.array_reshape_op(
         x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.hidden_size))
+    if cfg.pool == "cls":
+        cls = init.truncated_normal((1, 1, cfg.hidden_size), 0.0, 0.02,
+                                    name=name + ".cls_token")
+        x = ops.concatenate_op(
+            [ops.broadcast_shape_op(
+                cls, shape=(cfg.batch_size, 1, cfg.hidden_size)), x],
+            axis=1)
     x = x + ops.broadcastto_op(pe, x)
     x = ops.array_reshape_op(
-        x, output_shape=(cfg.batch_size * cfg.num_patches, cfg.hidden_size))
+        x, output_shape=(cfg.batch_size * S, cfg.hidden_size))
     x = ops.dropout_op(x, 1.0 - cfg.hidden_dropout_prob)
     for i in range(cfg.num_hidden_layers):
         ln = f"{name}.layer{i}"
         h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln1")(x)
         mha = MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads,
                                  name=ln + ".attn")
-        x = x + mha(h, cfg.batch_size, cfg.num_patches)
+        x = x + mha(h, cfg.batch_size, S)
         h = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, ln + ".ln2")(x)
         h = Linear(cfg.hidden_size, cfg.intermediate_size, activation="gelu",
                    initializer=init.GenTruncatedNormal(0.0, 0.02),
@@ -106,7 +120,8 @@ def vit_model(cfg, images, name="vit"):
 
 
 def vit_classify_graph(cfg, name="vit"):
-    """Image classification graph: mean-pooled patches → linear head.
+    """Image classification graph: pooled tokens → linear head
+    (``cfg.pool``: mean over patches, or the CLS token).
 
     Returns (feeds dict, loss node, logits node).
     """
@@ -116,8 +131,14 @@ def vit_classify_graph(cfg, name="vit"):
                                              cfg.num_classes))
     x = vit_model(cfg, images, name)
     x = ops.array_reshape_op(
-        x, output_shape=(cfg.batch_size, cfg.num_patches, cfg.hidden_size))
-    pooled = ops.reduce_mean_op(x, [1])
+        x, output_shape=(cfg.batch_size, cfg.seq_len, cfg.hidden_size))
+    if cfg.pool == "cls":
+        pooled = ops.array_reshape_op(
+            ops.slice_op(x, begin=(0, 0, 0),
+                         size=(cfg.batch_size, 1, cfg.hidden_size)),
+            output_shape=(cfg.batch_size, cfg.hidden_size))
+    else:
+        pooled = ops.reduce_mean_op(x, [1])
     logits = Linear(cfg.hidden_size, cfg.num_classes,
                     initializer=init.GenTruncatedNormal(0.0, 0.02),
                     name=name + ".head")(pooled)

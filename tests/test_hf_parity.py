@@ -731,3 +731,78 @@ def test_bart_forward_matches_hf():
             decoder_attention_mask=torch.ones(2, 10, dtype=torch.long)
         ).last_hidden_state.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-5)
+
+
+def test_vit_forward_matches_hf():
+    """Pre-LN vision family with the cls-token layout (pool="cls"): our
+    patchify-as-one-GEMM maps to HF's conv projection by weight reshape
+    (feature order (C, ph, pw) matches the conv kernel layout), the
+    learned CLS token and per-position embeddings line up, and the
+    encoder blocks follow HF ViT's layernorm_before/after structure."""
+    from hetu_tpu.models.vit import ViTConfig, vit_model
+    from hetu_tpu.graph.node import placeholder_op
+
+    cfg = ViTConfig.tiny(batch_size=2, image_size=32, patch_size=8,
+                         hidden_size=32, num_hidden_layers=1,
+                         num_attention_heads=2, intermediate_size=64,
+                         hidden_dropout_prob=0.0, pool="cls")
+    rng = np.random.RandomState(11)
+    imgs = rng.rand(2, 3, 32, 32).astype(np.float32)
+
+    images = placeholder_op("images", shape=(2, 3, 32, 32))
+    seq = vit_model(cfg, images, name="vit")
+    ex = ht.Executor({"fwd": [seq]}, seed=19)
+    ours = ex.run("fwd", feed_dict={images: imgs})[0].asnumpy() \
+        .reshape(2, cfg.seq_len, cfg.hidden_size)
+    weights = {ex.var_names[n]: np.asarray(v)
+               for n, v in ex.var_values.items()}
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_channels=3,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=cfg.layer_norm_eps, hidden_act="gelu_new")
+    model = transformers.ViTModel(hf_cfg, add_pooling_layer=False)
+    model.eval()
+
+    def t(name):
+        return torch.from_numpy(weights[name].astype(np.float32))
+
+    # our Linear (C*p*p, hidden) with (C, ph, pw)-ordered features ==
+    # conv weight (hidden, C, p, p)
+    p = cfg.patch_size
+    conv_w = t("vit.patch.proj.weight").T.reshape(
+        cfg.hidden_size, 3, p, p)
+    sd = {"embeddings.cls_token": t("vit.cls_token"),
+          "embeddings.position_embeddings":
+              t("vit.pos_embed").unsqueeze(0),
+          "embeddings.patch_embeddings.projection.weight": conv_w,
+          "embeddings.patch_embeddings.projection.bias":
+              t("vit.patch.proj.bias"),
+          "layernorm.weight": t("vit.ln_f.scale"),
+          "layernorm.bias": t("vit.ln_f.bias")}
+    for i in range(cfg.num_hidden_layers):
+        pfx, q = f"encoder.layer.{i}.", f"vit.layer{i}."
+        for hf_name, ours_name in [
+                ("attention.attention.query", "attn.q"),
+                ("attention.attention.key", "attn.k"),
+                ("attention.attention.value", "attn.v"),
+                ("attention.output.dense", "attn.o"),
+                ("intermediate.dense", "mlp1"),
+                ("output.dense", "mlp2")]:
+            sd[pfx + hf_name + ".weight"] = t(q + ours_name + ".weight").T
+            sd[pfx + hf_name + ".bias"] = t(q + ours_name + ".bias")
+        for hf_name, ours_name in [("layernorm_before", "ln1"),
+                                   ("layernorm_after", "ln2")]:
+            sd[pfx + hf_name + ".weight"] = t(q + ours_name + ".scale")
+            sd[pfx + hf_name + ".bias"] = t(q + ours_name + ".bias")
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+
+    with torch.no_grad():
+        theirs = model(pixel_values=torch.from_numpy(imgs)
+                       ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
