@@ -408,9 +408,13 @@ class SubExecutor:
             [op.optimizer.host_lr(ex.step_counter) for op in self.opt_ops],
             np.float32) if self.opt_ops else np.zeros((0,), np.float32)
 
+        # step_idx rides as int32: without jax_enable_x64 an int64 input
+        # is silently canonicalized to int32 anyway, and WITH x64 enabled
+        # an int64 would change the traced dtype (and the jit cache key)
+        # between configurations — fold_in only needs 32 bits
         outs, new_tparams, updates, new_opt_states = self._jit(
             tparams, sparams, opt_states, feeds, ex.master_key,
-            np.int64(ex.step_counter), lrs)
+            np.int32(ex.step_counter), lrs)
 
         if ex.bsp == -1 and ex.prefetch:
             # ASP: next-batch pull may overlap the in-flight step AND the
@@ -482,14 +486,20 @@ class SubExecutor:
                         continue
                     raise       # real store failures must surface
                 deadline = _time.monotonic() + ex.ssp_timeout_ms / 1e3
+                # every house store BLOCKS in ssp_sync now (native
+                # condvar, dist server-side condition, and the numpy
+                # fallback's threading.Condition — all declare
+                # ssp_blocking=True) — one wait over the remaining
+                # budget, no 5 ms host polling.  The default stays False
+                # so an unknown store with a report-only ssp_sync gets
+                # the polled path instead of a hot spin
                 blocking = getattr(store, "ssp_blocking", False)
                 while True:
                     left_ms = (deadline - _time.monotonic()) * 1e3
                     if blocking:
-                        # one condition-variable wait over the remaining
-                        # budget (looped only if the store caps a single
-                        # wait below the requested timeout).  Never pass
-                        # 0: both blocking stores read timeout_ms<=0 as
+                        # looped only if the store caps a single wait
+                        # below the requested timeout.  Never pass 0:
+                        # blocking stores read timeout_ms<=0 as
                         # wait-FOREVER (ps_store.cc clk_cv.wait; dist
                         # lr=-1.0), which would defeat the watchdog
                         ok = left_ms > 0 and store.ssp_sync(
@@ -773,7 +783,17 @@ class Executor:
         if self.mesh is None and isinstance(val, jax.Array):
             # pre-placed device feed (the bench fast path): re-dispatching
             # device_put on a committed array costs ~55us/step for nothing
-            return val
+            # — but ONLY when it already lives on the default backend; an
+            # array parked on another platform (cpu feed into a tpu step)
+            # must still be transferred here, not at dispatch time
+            try:
+                on_default = all(d.platform == jax.default_backend()
+                                 for d in val.devices())
+            except Exception:
+                on_default = False
+            if on_default:
+                return val
+            return jax.device_put(val)
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             if node.sharding is not None:  # explicit ht.dispatch on a feed
@@ -871,9 +891,11 @@ class Executor:
         key = jax.random.key(self.seed)
         if sub._jit is None:
             sub._build_step()
-        # _step_fn is the raw pure step (the executor's own jit adds donation)
+        # _step_fn is the raw pure step (the executor's own jit adds
+        # donation); step_idx is int32 like the live step passes it (the
+        # x64-canonicalization note in SubExecutor.run)
         return sub._step_fn, (tparams, sparams, opt_states, feeds, key,
-                              np.int64(0), lrs)
+                              np.int32(0), lrs)
 
     def get_batch_num(self, name="default"):
         from ..data.dataloader import DataloaderOp
@@ -1079,8 +1101,14 @@ class Executor:
         emergency-restore pipelines) consume hetu_tpu state directly.
 
         The tree is {"params": {name: array}, "opt": {ordinal: named
-        state}, "step": int} — the same name/ordinal identities ``load``
-        uses, so the two formats are semantically interchangeable.
+        state}, "ps": {ordinal: row matrix}, "step": int} — the same
+        name/ordinal identities ``load`` uses, so the two formats are
+        semantically interchangeable for params, optimizer state, the
+        step counter AND the PS embedding rows.  The one asymmetry:
+        server-side PS optimizer slots/versions live only in the native
+        format (``save`` persists full table state through the store's
+        own ``save``); the orbax tree carries the ROW DATA, i.e. a
+        restored Adam PS table warm-starts its server moments.
         Single-process convenience: multiprocess meshes should use
         ``save`` (its collective fetch + rank-0-write discipline).
         """
@@ -1100,18 +1128,47 @@ class Executor:
                 for i, (op, st) in enumerate(self.opt_states.items())},
             "step": self.step_counter,
         }
+        ps = {}
+        for i, node in enumerate(self._ps_table_sites()):
+            if not hasattr(node.store, "get_data"):
+                raise NotImplementedError(
+                    f"save_orbax cannot serialize PS table of "
+                    f"'{node.name}': store "
+                    f"{type(node.store).__name__} exposes no get_data — "
+                    f"use save() (server-side table persistence)")
+            ps[str(i)] = np.asarray(node.store.get_data(node.table))
+        if ps:
+            tree["ps"] = ps
         ocp.PyTreeCheckpointer().save(os.path.abspath(path), tree,
                                       force=True)
 
     def load_orbax(self, path, params_only=False):
         """Restore a ``save_orbax`` checkpoint (params by name, optimizer
-        state by ordinal; ``params_only=True`` is the warm-start form —
-        see ``load``)."""
+        state and PS tables by ordinal; ``params_only=True`` is the
+        warm-start form — like ``load`` it still restores the PS
+        embedding rows, leaving optimizer moments and the step counter
+        fresh)."""
         import os
         import orbax.checkpoint as ocp
         import jax
         tree = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
         self.load_dict(tree.get("params", {}))
+        # PS rows restore in BOTH forms — symmetric with load(), whose
+        # params_only branch also reloads the ps table files
+        for i, node in enumerate(self._ps_table_sites()):
+            rows = (tree.get("ps") or {}).get(str(i))
+            if rows is None:
+                continue     # older checkpoint without a ps subtree
+            if not hasattr(node.store, "set_data"):
+                # mirror save_orbax's loudness: dropping checkpointed
+                # rows on the floor would "warm-start" from fresh
+                # random embeddings with nothing pointing at the restore
+                raise NotImplementedError(
+                    f"load_orbax cannot restore PS table of "
+                    f"'{node.name}': store "
+                    f"{type(node.store).__name__} exposes no set_data — "
+                    f"use load() (server-side table persistence)")
+            node.store.set_data(node.table, np.asarray(rows))
         if params_only:
             return
         for i, (op, live) in enumerate(list(self.opt_states.items())):

@@ -49,9 +49,17 @@ class MultiHeadAttention(BaseLayer):
         rides the flash kernel's O(S) key-mask strip path, and under
         context parallelism shards over the ring/ulysses schedule; a FULL
         per-query mask (XLNet-style permutation masks) shards its query
-        dim over the ring like the bias does; ``bias``: optional additive
-        logit bias node (T5 relative position bias), broadcastable to
-        (B, H, S_q, S_k).
+        dim over the ring like the bias does (swin stores its shift mask
+        (nW, 1, w², w²) and tiles it to the window batch with an
+        on-graph Repeat before calling here); ``bias``: optional
+        additive logit bias node (T5 relative position bias),
+        broadcastable to (B, H, S_q, S_k) — biased attention runs the
+        flash kernel on TPU both locally and through the cp ring.
+
+        Sequence lengths need NOT be 128-multiples: the dispatcher
+        buckets ragged lengths into the kernel (pad → mask → unpad), so
+        ``seq = 384 + r`` stays on the fast path; any genuine fallback
+        is counted in ``hetu_tpu.metrics.flash_fallback_counts()``.
         """
         from ..ops.attention import (ring_attention_op, ulysses_attention_op,
                                      ring_attention_masked_op,

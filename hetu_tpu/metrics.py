@@ -1,8 +1,42 @@
 """Numpy-side metrics (reference ``python/hetu/metrics.py``: AUC:120,
-accuracy:154, precision/recall/F1:220-315)."""
+accuracy:154, precision/recall/F1:220-315) + host-side performance
+counters (flash-attention fallback accounting)."""
 from __future__ import annotations
 
+import collections
+import threading
+
 import numpy as np
+
+# --------------------------------------------------- flash fallback counters
+# The attention dispatchers record WHY a call left the Pallas fast path
+# (backend, gate, shape, mask layout, ring chunking).  Counts are per
+# TRACE, not per step — dispatch happens when jax traces the program, so
+# a counter that keeps climbing across steps means the jit cache is
+# thrashing, and a single nonzero entry means that workload compiled onto
+# the slow path.  Surfaced by ``HetuProfiler.flash_fallbacks()`` and the
+# bench.py attention microbench; ``HETU_REQUIRE_FLASH=1`` turns any
+# recording into a hard failure (ops/attention.py).
+
+_flash_fallbacks = collections.Counter()
+_flash_lock = threading.Lock()
+
+
+def record_flash_fallback(reason):
+    """Count one attention dispatch that fell back off the flash path."""
+    with _flash_lock:
+        _flash_fallbacks[str(reason)] += 1
+
+
+def flash_fallback_counts():
+    """{reason: count} snapshot of recorded fallbacks."""
+    with _flash_lock:
+        return dict(_flash_fallbacks)
+
+
+def reset_flash_fallbacks():
+    with _flash_lock:
+        _flash_fallbacks.clear()
 
 
 def _np(x):

@@ -144,14 +144,14 @@ class _WindowBlock:
         if self.shift:
             mkey = ("mask", res, w, self.shift)
             if mkey not in consts:
-                B, nW = cfg.batch_size, (res // w) ** 2
                 m = _shift_mask(res, res, w, self.shift)    # (nW, w², w²)
-                m = np.broadcast_to(m[None, :, None],
-                                    (B, nW, 1, w * w, w * w))
+                # stored at (nW, 1, w², w²): __call__ tiles it over the
+                # window batch B·nW with an on-graph Repeat (XLA keeps
+                # the repeat lazy), instead of baking a B×-larger
+                # byte-identical constant into the compiled program
                 consts[mkey] = Variable(
                     f"swin.shift_mask.r{res}w{w}s{self.shift}",
-                    value=np.ascontiguousarray(
-                        m.reshape(B * nW, 1, w * w, w * w)),
+                    value=np.ascontiguousarray(m[:, None]),
                     trainable=False)
             self.mask = consts[mkey]
         else:
@@ -191,7 +191,13 @@ class _WindowBlock:
             h = ops.roll_op(h, shift=(-self.shift, -self.shift), axis=(1, 2))
             h = ops.array_reshape_op(h, output_shape=(B * r * r, C))
         h = self._windows(h)
-        h = self.mha(h, nwin, w * w, mask=self.mask, bias=self._bias())
+        mask = None
+        if self.mask is not None:
+            # (nW, 1, w², w²) → (B·nW, 1, w², w²): tile maps flat window
+            # index t = b·nW + w to mask[t % nW] = mask[w], matching
+            # _windows' batch-major (B, nW) flattening
+            mask = ops.repeat_op(self.mask, reps=(B, 1, 1, 1))
+        h = self.mha(h, nwin, w * w, mask=mask, bias=self._bias())
         h = self._unwindows(h)
         if self.shift:
             h = ops.array_reshape_op(h, output_shape=(B, r, r, C))

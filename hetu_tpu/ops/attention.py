@@ -115,12 +115,47 @@ def sdpa_reference(q, k, v, causal=False, scale=None, mask=None, bias=None):
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+def _note_flash_fallback(reason):
+    """Record a dispatch that left the flash fast path — NEVER silent:
+    the reason lands in the ``hetu_tpu.metrics`` counter registry
+    (surfaced by ``HetuProfiler.flash_fallbacks()`` and bench.py), and
+    ``HETU_REQUIRE_FLASH=1`` escalates it to a hard failure so a TPU run
+    that silently compiled onto the einsum path cannot masquerade as a
+    flash measurement."""
+    from ..metrics import record_flash_fallback
+    record_flash_fallback(reason)
+    if os.environ.get("HETU_REQUIRE_FLASH") == "1":
+        raise RuntimeError(
+            f"HETU_REQUIRE_FLASH=1: attention dispatch fell back off the "
+            f"flash path ({reason})")
+
+
+def _causal_bucketable(q, k, causal):
+    """Ragged lengths bucket (pad+mask+unpad) EXCEPT under causal when
+    q/kv lengths differ mod 128 — padding would shift the bottom-right-
+    aligned diagonal (flash_attention raises for that combination)."""
+    return not causal or (q.shape[-2] % 128) == (k.shape[-2] % 128)
+
+
+def _gate_reason(q, k, causal=False):
+    """Why the base gate refuses the flash path (None = it passes)."""
+    be = jax.default_backend()
+    if be != "tpu":
+        return f"backend:{be}"
+    s_q, s_kv = q.shape[-2], k.shape[-2]
+    if s_q < _FLASH_MIN_LEN:
+        return f"below_gate:seq{s_q}<{_FLASH_MIN_LEN}"
+    if not _causal_bucketable(q, k, causal):
+        return f"causal_ragged_mismatch:({s_q},{s_kv})"
+    return None
+
+
 def _use_flash(q, k):
     """One dispatch rule for every flash-capable op (keeps the varlen and
-    dense paths from drifting apart)."""
-    s_q, s_kv = q.shape[-2], k.shape[-2]
-    return (jax.default_backend() == "tpu" and s_q >= _FLASH_MIN_LEN
-            and s_q % 128 == 0 and s_kv % 128 == 0)
+    dense paths from drifting apart).  Ragged (non-128-multiple) lengths
+    no longer disqualify — the kernel entry buckets them."""
+    s_q = q.shape[-2]
+    return jax.default_backend() == "tpu" and s_q >= _FLASH_MIN_LEN
 
 
 def _clipped_blocks(tag, q, k):
@@ -140,11 +175,12 @@ def dispatch_sdpa(q, k, v, causal=False, scale=None):
     empirical gate says it wins, XLA-composed otherwise.  The functional
     entry point for schedules that compose attention themselves (Ulysses'
     full-sequence local step, pipeline stages)."""
-    if _use_flash(q, k):
+    if _use_flash(q, k) and _causal_bucketable(q, k, causal):
         from .pallas.flash_attention import flash_attention
         bq, bk = _clipped_blocks("causal" if causal else "dense", q, k)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=bq, block_k=bk)
+    _note_flash_fallback(_gate_reason(q, k, causal) or "dispatch_gate")
     return sdpa_reference(q, k, v, causal=causal, scale=scale)
 
 
@@ -170,22 +206,38 @@ def _split_mask_kinds(mask, q):
     return None, mask
 
 
+def _broadcastable_extra(q, k, x):
+    """Shape check for a mask/bias the kernel's broadcast-group loader
+    supports: (1|B, 1|H, 1|S_q, S_kv)."""
+    b, h = q.shape[:2]
+    return x.ndim == 4 and x.shape[0] in (1, b) \
+        and x.shape[1] in (1, h) \
+        and x.shape[2] in (1, q.shape[2]) and x.shape[3] == k.shape[2]
+
+
 def _flash_maskable(q, k, mask):
     """Mask shapes the kernel's broadcast-group loader supports."""
     if not _use_flash(q, k):
         return False
     if mask is None:
         return True
-    b, h = q.shape[:2]
-    return mask.ndim == 4 and mask.shape[0] in (1, b) \
-        and mask.shape[1] in (1, h) \
-        and mask.shape[2] in (1, q.shape[2]) and mask.shape[3] == k.shape[2]
+    return _broadcastable_extra(q, k, mask)
+
+
+def _masked_reason(q, k, causal, mask, what="mask"):
+    """Fallback reason for a masked/biased dispatch (None = flash-able)."""
+    r = _gate_reason(q, k, causal)
+    if r is not None:
+        return r
+    if mask is not None and not _broadcastable_extra(q, k, mask):
+        return f"{what}_shape:{tuple(mask.shape)}"
+    return None
 
 
 def dispatch_sdpa_masked(q, k, v, mask, causal=False, scale=None):
     """Backend-dispatched masked attention (functional entry — Ulysses'
     full-sequence local step with a padding mask)."""
-    if _flash_maskable(q, k, mask):
+    if _flash_maskable(q, k, mask) and _causal_bucketable(q, k, causal):
         from .pallas.flash_attention import flash_attention
         km, fm = _split_mask_kinds(mask, q)
         # the key-mask strip path (flagship) uses ITS OWN measured blocks
@@ -194,6 +246,8 @@ def dispatch_sdpa_masked(q, k, v, mask, causal=False, scale=None):
             bq, bk = _clipped_blocks("kmask", q, k)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                key_mask=km, mask=fm, block_q=bq, block_k=bk)
+    _note_flash_fallback(_masked_reason(q, k, causal, mask)
+                         or "dispatch_gate")
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask)
 
 
@@ -208,10 +262,12 @@ def dispatch_sdpa_bias(q, k, v, bias, causal=False, scale=None):
     """Backend-dispatched attention with an additive logit bias — flash
     kernel when the gate and broadcast shape allow, XLA-composed otherwise
     (the functional entry for Ulysses' full-sequence local step)."""
-    if _flash_maskable(q, k, bias):
+    if _flash_maskable(q, k, bias) and _causal_bucketable(q, k, causal):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                bias=bias)
+    _note_flash_fallback(_masked_reason(q, k, causal, bias, what="bias")
+                         or "dispatch_gate")
     return sdpa_reference(q, k, v, causal=causal, scale=scale, bias=bias)
 
 
@@ -227,11 +283,15 @@ def dispatch_sdpa_masked_bias(q, k, v, mask, bias, causal=False,
                               scale=None):
     """Backend-dispatched masked+biased attention (functional entry —
     the non-cp fallbacks of the masked CP ops and Ulysses' local step)."""
-    if _flash_maskable(q, k, mask) and _flash_maskable(q, k, bias):
+    if _flash_maskable(q, k, mask) and _flash_maskable(q, k, bias) \
+            and _causal_bucketable(q, k, causal):
         from .pallas.flash_attention import flash_attention
         km, fm = _split_mask_kinds(mask, q)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                key_mask=km, mask=fm, bias=bias)
+    _note_flash_fallback(_masked_reason(q, k, causal, mask)
+                         or _masked_reason(q, k, causal, bias, what="bias")
+                         or "dispatch_gate")
     return sdpa_reference(q, k, v, causal=causal, scale=scale, mask=mask,
                           bias=bias)
 
@@ -249,13 +309,14 @@ sdpa_masked_bias_op = def_op("ScaledDotProductAttentionMaskedBias",
 def _sdpa_varlen(c, q, k, v, lengths, causal=False, scale=None):
     """Padding-masked attention: keys >= lengths[b] are invisible.
 
-    TPU + aligned shapes → the Pallas flash kernel's ragged path (no
-    FLOPs spent on fully-masked key blocks); otherwise the jnp reference
-    with a built column mask."""
-    if _use_flash(q, k):
+    TPU → the Pallas flash kernel's lengths path (no FLOPs spent on
+    fully-masked key blocks; ragged shapes bucket); otherwise the jnp
+    reference with a built column mask."""
+    if _use_flash(q, k) and _causal_bucketable(q, k, causal):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                lengths=lengths)
+    _note_flash_fallback(_gate_reason(q, k, causal) or "dispatch_gate")
     s_kv = k.shape[-2]
     cols = jnp.arange(s_kv)[None, None, None, :]
     mask = cols < lengths.astype(jnp.int32)[:, None, None, None]

@@ -48,6 +48,22 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+#: flash-legal sequence lengths are multiples of this (the Mosaic lane
+#: width); ragged lengths are padded UP to the next bucket (128/256/384/…)
+FLASH_BUCKET = 128
+
+
+def flash_bucket(s):
+    """Smallest flash-legal (bucketed) length >= ``s``."""
+    return -(-int(s) // FLASH_BUCKET) * FLASH_BUCKET
+
+
+def _pad_seq(x, axis, pad, value=0.0):
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
 
 
 # ------------------------------------------------------------- index maps
@@ -624,17 +640,64 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
     ``bias``: optional additive logit bias, same broadcast menu,
     differentiable (T5 relative position bias).
     With none of these the kernels compile the original dense
-    straight-line code with zero masking overhead.  Requires S divisible
-    by the block size (the ``sdpa_op`` dispatcher falls back to the
-    XLA-composed reference otherwise).  ``interpret=True`` runs the Pallas
-    interpreter so CPU CI exercises the same kernel code.
+    straight-line code with zero masking overhead.  Ragged (non-128-
+    multiple) sequence lengths are BUCKETED: padded up to the next
+    flash-legal bucket (128/256/384/…), the pad keys masked through the
+    kernel's existing lengths/key-mask strip path, and the output sliced
+    back to the caller's length — ``seq=384+r`` stays on the fast path.
+    The one unbucketable case is causal CROSS-attention whose lengths
+    differ mod 128 (padding would shift the bottom-right-aligned
+    diagonal); that raises, and the dispatcher falls back with an
+    explicit ``flash_fallback_reason``.  ``interpret=True`` runs the
+    Pallas interpreter so CPU CI exercises the same kernel code.
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
-    if s_q % 128 or s_kv % 128:
+    pad_q = flash_bucket(s_q) - s_q
+    pad_k = flash_bucket(s_kv) - s_kv
+    if causal and pad_q != pad_k:
+        # padding q and kv by different amounts would move the kernel's
+        # kv_off diagonal against the reference's tril(s_kv - s_q)
         raise ValueError(
-            f"flash_attention needs seq lengths divisible by 128, got "
-            f"({s_q}, {s_kv}) — use sdpa_reference for ragged shapes")
+            f"causal flash attention cannot bucket lengths ({s_q}, {s_kv})"
+            f" — they differ mod {FLASH_BUCKET}, so padding would shift "
+            f"the bottom-right-aligned diagonal")
+    s_q_orig = s_q
+    if pad_q or pad_k:
+        q = _pad_seq(q, 2, pad_q)
+        k = _pad_seq(k, 2, pad_k)
+        v = _pad_seq(v, 2, pad_k)
+        if pad_k:
+            # pad KEYS must be invisible: ``lengths`` already masks cols
+            # >= lengths[b] <= s_kv; a given key_mask/mask extends with
+            # invalid columns; with no key validity input at all, the pad
+            # rides the O(1) SMEM lengths path (fully-padded key blocks
+            # are pruned, not computed)
+            if key_mask is not None:
+                km = jnp.asarray(key_mask)
+                km = _pad_seq(km, km.ndim - 1, pad_k,
+                              value=jnp.zeros((), km.dtype))
+                key_mask = km
+            if mask is not None and jnp.ndim(mask) == 4:
+                m = jnp.asarray(mask)
+                m = _pad_seq(m, 3, pad_k, value=jnp.zeros((), m.dtype))
+                mask = m
+            if lengths is None and key_mask is None and mask is None:
+                lengths = jnp.full((b,), s_kv, jnp.int32)
+            if bias is not None and jnp.ndim(bias) == 4:
+                bias = _pad_seq(jnp.asarray(bias, jnp.float32), 3, pad_k)
+        if pad_q:
+            # pad QUERY rows compute garbage that is sliced off below;
+            # their kernel inputs only need legal shapes
+            if mask is not None and jnp.ndim(mask) == 4 \
+                    and mask.shape[2] != 1:
+                mask = _pad_seq(jnp.asarray(mask), 2, pad_q,
+                                value=jnp.zeros((), jnp.asarray(mask).dtype))
+            if bias is not None and jnp.ndim(bias) == 4 \
+                    and bias.shape[2] != 1:
+                bias = _pad_seq(jnp.asarray(bias, jnp.float32), 2, pad_q)
+        s_q += pad_q
+        s_kv += pad_k
     block_q = block_q or min(DEFAULT_BLOCK_Q, s_q)
     block_k = block_k or min(DEFAULT_BLOCK_K, s_kv)
     if s_q % block_q or s_kv % block_k:
@@ -677,4 +740,7 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
     out = _flash(q3, k3, v3, len3, kmask2, kbias3, fmask3, bias3, scale,
                  causal, gmode_mask, gmode_bias, gmode_kbias, h, block_q,
                  block_k, interpret)
-    return out.reshape(b, h, s_q, d)
+    out = out.reshape(b, h, s_q, d)
+    if s_q != s_q_orig:
+        out = out[:, :, :s_q_orig]    # unpad: bucketing is caller-invisible
+    return out

@@ -54,13 +54,18 @@ def ring_attention_local(q, k, v, bias=None, key_mask=None, mask=None,
     import jax.numpy as jnp
     from jax import lax
 
-    from .ring_flash import flash_ring_supported, ring_flash_attention_local
-    if flash_ring_supported(q, k, bias=bias):
+    from .ring_flash import flash_ring_reason, ring_flash_attention_local
+    reason = flash_ring_reason(q, k)
+    if reason is None:
         # per-step Pallas flash kernel + LSE merge (TPU; the einsum ring
-        # below is the reference path and the CPU/odd-shape fallback)
+        # below is the reference path and the CPU/odd-shape fallback).
+        # Bias rides the kernel too — no einsum detour for T5-style
+        # relative-position-bias workloads under context parallelism.
         return ring_flash_attention_local(
-            q, k, v, key_mask=key_mask, mask=mask, axis_name=axis_name,
-            causal=causal, scale=scale)
+            q, k, v, bias=bias, key_mask=key_mask, mask=mask,
+            axis_name=axis_name, causal=causal, scale=scale)
+    from ..ops.attention import _note_flash_fallback
+    _note_flash_fallback(f"ring:{reason}")
 
     S = lax.psum(1, axis_name)
     r = lax.axis_index(axis_name)

@@ -21,10 +21,13 @@ module is pure orchestration under ``jax.custom_vjp`` (pallas_call has no
 autodiff — the ring IS the vjp).  CPU CI runs the same code with
 ``interpret=True``.
 
-Supported: dense, causal, key-padding masks, full per-query masks.
-Additive bias stays on the einsum ring (its gradient needs per-chunk
-column accumulation that is not worth a second code path until a workload
-demands it) — the dispatcher in :mod:`ring_attention` falls back.
+Supported: dense, causal, key-padding masks, full per-query masks, AND
+additive bias (T5 relative-position bias under context parallelism): the
+bias keeps its KEY dim full locally like the masks, each ring step slices
+the resident chunk's bias columns into the kernel (dense blocks, or the
+O(S) key-strip path for row-broadcast biases), and the backward writes
+each step's ``dbias`` column slice back into the local bias cotangent —
+so a biased workload no longer falls off to the einsum ring.
 """
 from __future__ import annotations
 
@@ -34,8 +37,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pallas.flash_attention import (_broadcast_group, _f0, _flash_bwd,
-                                          _flash_fwd)
+from ..ops.pallas.flash_attention import (_broadcast_group, _classify_group,
+                                          _f0, _flash_bwd, _flash_fwd,
+                                          _group_reduce)
 
 _NEG = -1e30
 
@@ -57,29 +61,45 @@ def _masks_for_chunk(key_mask, fmask, src, sc, b, h):
     return kmask2, fmask3, gmode
 
 
+def _bias_for_chunk(bias, src, sc, b, h):
+    """Slice the resident chunk's bias columns into kernel storage:
+    ``(kbias3, bias3, gmode)`` — a (·, ·, 1, S_kv) row-broadcast bias
+    rides the kernel's O(S) key-strip path, anything else the dense
+    blockwise path (the same routing ``flash_attention`` applies)."""
+    blk = _chunk_cols(bias, src, sc, 3).astype(jnp.float32)
+    if bias.shape[2] == 1 and sc != 1:
+        gmode = _classify_group(blk, b, h, sc, sc, "bias")
+        return blk.reshape(-1, 1, sc), None, gmode
+    bias3, gmode = _broadcast_group(blk, b, h, sc, sc, "bias")
+    return None, bias3, gmode
+
+
 def _ring_perm(axis_name, S):
     return [(i, (i + 1) % S) for i in range(S)]
 
 
-def _fwd_step(q3, kc3, vc3, kmask2, fmask3, gmode, scale, causal_flag,
-              h, blocks, interpret):
+def _fwd_step(q3, kc3, vc3, kmask2, kbias3, fmask3, bias3, gmodes, scale,
+              causal_flag, h, blocks, interpret):
     """branch body: flash forward on one resident chunk → (o, lse)."""
     bq, bk = blocks
-    return _flash_fwd(q3, kc3, vc3, None, kmask2, None, fmask3, None,
-                      scale, causal_flag, gmode, "one", "one", h, bq, bk,
-                      interpret)
+    gmode_mask, gmode_bias, gmode_kbias = gmodes
+    return _flash_fwd(q3, kc3, vc3, None, kmask2, kbias3, fmask3, bias3,
+                      scale, causal_flag, gmode_mask, gmode_bias,
+                      gmode_kbias, h, bq, bk, interpret)
 
 
-def ring_flash_attention_local(q, k, v, key_mask=None, mask=None,
+def ring_flash_attention_local(q, k, v, bias=None, key_mask=None, mask=None,
                                axis_name="cp", causal=False, scale=None,
                                block_q=None, block_k=None,
                                interpret=False):
     """Flash-kernel ring attention — call INSIDE shard_map over ``cp``.
 
     Same contract as :func:`ring_attention_local` (q/k/v local chunks
-    [B, H, Sc, D]; ``key_mask`` [1|B, S_kv] full-key local; ``mask``
-    [1|B, 1|H, Sc|1, S_kv] query-sharded/full-key local), minus ``bias``.
-    Sc and D must satisfy the kernel's 128-divisibility.
+    [B, H, Sc, D]; ``bias`` [1|B, 1|H, Sc|1, S_kv] query-sharded/full-key
+    local additive bias, differentiable; ``key_mask`` [1|B, S_kv]
+    full-key local; ``mask`` [1|B, 1|H, Sc|1, S_kv] query-sharded/
+    full-key local).  Sc and D must satisfy the kernel's
+    128-divisibility.
     """
     B, H, Sc, D = q.shape
     sc_val = float(scale) if scale is not None else 1.0 / (D ** 0.5)
@@ -93,22 +113,25 @@ def ring_flash_attention_local(q, k, v, key_mask=None, mask=None,
         fm = jnp.broadcast_to(
             jnp.asarray(mask),
             (mask.shape[0], mask.shape[1], Sc, mask.shape[3]))
+    if bias is not None and bias.ndim != 4:
+        raise ValueError(f"ring-flash bias must be 4-D "
+                         f"(1|B, 1|H, Sc|1, S_kv), got {bias.shape}")
 
-    return _ring_flash(q, k, v, km, fm, axis_name, bool(causal), sc_val,
-                       blocks, B, H, Sc, D, bool(interpret))
+    return _ring_flash(q, k, v, km, fm, bias, axis_name, bool(causal),
+                       sc_val, blocks, B, H, Sc, D, bool(interpret))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
-                                                    11, 12, 13))
-def _ring_flash(q, k, v, km, fm, axis_name, causal, scale, blocks,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11,
+                                                    12, 13, 14))
+def _ring_flash(q, k, v, km, fm, bias, axis_name, causal, scale, blocks,
                 B, H, Sc, D, interpret):
-    out, _ = _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal,
+    out, _ = _ring_flash_fwd_impl(q, k, v, km, fm, bias, axis_name, causal,
                                   scale, blocks, B, H, Sc, D, interpret)
     return out
 
 
-def _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal, scale, blocks,
-                         B, H, Sc, D, interpret):
+def _ring_flash_fwd_impl(q, k, v, km, fm, bias, axis_name, causal, scale,
+                         blocks, B, H, Sc, D, interpret):
     S = lax.psum(1, axis_name)
     r = lax.axis_index(axis_name)
     perm = _ring_perm(axis_name, S)
@@ -123,11 +146,19 @@ def _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal, scale, blocks,
         kc3 = kc.reshape(B * H, Sc, D)
         vc3 = vc.reshape(B * H, Sc, D)
         kmask2, fmask3, gmode = _masks_for_chunk(km, fm, src, Sc, B, H)
+        kbias3 = bias3 = None
+        gmode_bias = gmode_kbias = "one"
+        if bias is not None:
+            kbias3, bias3, gb = _bias_for_chunk(bias, src, Sc, B, H)
+            gmode_kbias = gb if kbias3 is not None else "one"
+            gmode_bias = gb if bias3 is not None else "one"
+        gmodes = (gmode, gmode_bias, gmode_kbias)
 
         def dense_or_causal(flag):
             def f(_):
-                return _fwd_step(q3, kc3, vc3, kmask2, fmask3, gmode,
-                                 scale, flag, H, blocks, interpret)
+                return _fwd_step(q3, kc3, vc3, kmask2, kbias3, fmask3,
+                                 bias3, gmodes, scale, flag, H, blocks,
+                                 interpret)
             return f
 
         def skipped(_):
@@ -164,16 +195,17 @@ def _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal, scale, blocks,
     return out, lse_g
 
 
-def _ring_flash_vjp_fwd(q, k, v, km, fm, axis_name, causal, scale, blocks,
-                        B, H, Sc, D, interpret):
-    out, lse_g = _ring_flash_fwd_impl(q, k, v, km, fm, axis_name, causal,
-                                      scale, blocks, B, H, Sc, D, interpret)
-    return out, (q, k, v, km, fm, out, lse_g)
+def _ring_flash_vjp_fwd(q, k, v, km, fm, bias, axis_name, causal, scale,
+                        blocks, B, H, Sc, D, interpret):
+    out, lse_g = _ring_flash_fwd_impl(q, k, v, km, fm, bias, axis_name,
+                                      causal, scale, blocks, B, H, Sc, D,
+                                      interpret)
+    return out, (q, k, v, km, fm, bias, out, lse_g)
 
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, blocks, B, H, Sc, D,
                         interpret, res, do):
-    q, k, v, km, fm, out, lse_g = res
+    q, k, v, km, fm, bias, out, lse_g = res
     # fully-masked rows carry the 2·_NEG LSE sentinel; fed raw into the
     # kernel's p = exp(s − lse) it overflows to inf and NaNs the whole
     # chunk's dk/dv.  Re-pin those rows to lse=0: their s entries are all
@@ -193,31 +225,60 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, blocks, B, H, Sc, D,
     kc, vc = k, v
     dkc = jnp.zeros_like(k, dtype=jnp.float32)
     dvc = jnp.zeros_like(v, dtype=jnp.float32)
+    # the bias cotangent stays LOCAL: its query rows belong to this device
+    # and each ring step owns a disjoint column slice, written back below
+    # (shard_map's transpose psums the broadcast dims across the ring)
+    dbias = None if bias is None else jnp.zeros(bias.shape, jnp.float32)
     for t in range(S):
         src = (r - t) % S
         kc3 = kc.reshape(B * H, Sc, D)
         vc3 = vc.reshape(B * H, Sc, D)
         kmask2, fmask3, gmode = _masks_for_chunk(km, fm, src, Sc, B, H)
+        kbias3 = bias3 = None
+        gmode_bias = gmode_kbias = "one"
+        if bias is not None:
+            kbias3, bias3, gb = _bias_for_chunk(bias, src, Sc, B, H)
+            gmode_kbias = gb if kbias3 is not None else "one"
+            gmode_bias = gb if bias3 is not None else "one"
+        db_shape = None if bias is None else bias.shape[:3] + (Sc,)
 
         def run(flag):
             def f(_):
-                dqi, dki, dvi, _db, _dkb = _flash_bwd(
-                    q3, kc3, vc3, None, kmask2, None, fmask3, None,
-                    out3, lse_g, do3, scale, flag, gmode, "one", "one",
-                    H, blocks[0], blocks[1], interpret)
-                return dqi, dki, dvi
+                dqi, dki, dvi, dbi, dkbi = _flash_bwd(
+                    q3, kc3, vc3, None, kmask2, kbias3, fmask3, bias3,
+                    out3, lse_g, do3, scale, flag, gmode, gmode_bias,
+                    gmode_kbias, H, blocks[0], blocks[1], interpret)
+                if bias is None:
+                    return dqi, dki, dvi
+                # reduce the per-(b·h) kernel grads over the broadcast
+                # group → this chunk's column slice of the local bias
+                raw = dbi if bias3 is not None else dkbi
+                gmd = gmode_bias if bias3 is not None else gmode_kbias
+                dbc = _group_reduce(raw, gmd, B, H, db_shape, jnp.float32)
+                return dqi, dki, dvi, dbc
             return f
 
         def skipped(_):
-            return (jnp.zeros_like(q3), jnp.zeros_like(q3),
-                    jnp.zeros_like(q3))
+            zs = (jnp.zeros_like(q3), jnp.zeros_like(q3),
+                  jnp.zeros_like(q3))
+            if bias is not None:
+                zs = zs + (jnp.zeros(db_shape, jnp.float32),)
+            return zs
 
         if causal:
             branch = jnp.where(src == r, 2, jnp.where(src < r, 1, 0))
-            dqi, dki, dvi = lax.switch(branch, [skipped, run(False),
-                                                run(True)], operand=None)
+            res_t = lax.switch(branch, [skipped, run(False), run(True)],
+                               operand=None)
         else:
-            dqi, dki, dvi = run(False)(None)
+            res_t = run(False)(None)
+        if bias is not None:
+            dqi, dki, dvi, dbc = res_t
+            # each src is visited exactly once, so the column slice is a
+            # plain write, not an accumulate
+            dbias = lax.dynamic_update_slice_in_dim(dbias, dbc, src * Sc,
+                                                    axis=3)
+        else:
+            dqi, dki, dvi = res_t
 
         dq = dq + dqi.astype(jnp.float32)
         dkc = dkc + dki.astype(jnp.float32).reshape(B, H, Sc, D)
@@ -233,20 +294,34 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, blocks, B, H, Sc, D,
     return (dq.astype(q.dtype).reshape(B, H, Sc, D),
             dkc.astype(k.dtype), dvc.astype(v.dtype),
             None if km is None else _f0(km),
-            None if fm is None else _f0(fm))
+            None if fm is None else _f0(fm),
+            None if bias is None else dbias.astype(bias.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
-def flash_ring_supported(q, k, bias=None, backend=None):
-    """Gate: the flash ring needs kernel-legal CHUNK sequence lengths
-    (both local chunks divisible by the 128 block) and no bias."""
-    if bias is not None:
-        return False
-    ok_shapes = q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
+def flash_ring_reason(q, k, backend=None):
+    """None when the flash ring can run, else why it cannot: the ring
+    needs kernel-legal CHUNK lengths (both local chunks divisible by the
+    128 block — ragged GLOBAL lengths should be bucketed before sharding)
+    on a TPU backend.  Bias is NOT a disqualifier any more — it rides the
+    kernel's dense/key-strip bias paths per ring step."""
     be = backend or jax.default_backend()
-    return ok_shapes and be == "tpu"
+    if be != "tpu":
+        return f"backend:{be}"
+    if q.shape[-2] % 128 or k.shape[-2] % 128:
+        return (f"ring_chunk_not_128_divisible:"
+                f"({q.shape[-2]},{k.shape[-2]})")
+    return None
 
 
-__all__ = ["ring_flash_attention_local", "flash_ring_supported"]
+def flash_ring_supported(q, k, bias=None, backend=None):
+    """Gate predicate over :func:`flash_ring_reason` (``bias`` kept for
+    signature compatibility — biased workloads are supported now)."""
+    del bias
+    return flash_ring_reason(q, k, backend=backend) is None
+
+
+__all__ = ["ring_flash_attention_local", "flash_ring_supported",
+           "flash_ring_reason"]

@@ -80,9 +80,10 @@ class HetuProfiler:
             val = ex._place_feed(node, node.pull(ids))
             (tparams if sub.grad_ops else sparams)[_key(node)] = val
         # the executor folds per-step RNG INSIDE the jitted program; the
-        # pack mirrors its (master_key, step_idx) calling convention
+        # pack mirrors its (master_key, step_idx:int32) calling convention
+        # (int32 keeps the traced dtype identical with and without x64)
         return tparams, sparams, feeds, ex.master_key, \
-            np.int64(ex.step_counter)
+            np.int32(ex.step_counter)
 
     def _node_shapes(self, feed_dict):
         """Abstractly evaluate the forward graph → {node: ShapeDtypeStruct}."""
@@ -224,6 +225,17 @@ class HetuProfiler:
         upcasts bf16 dots and drops donation; tools/hlo_audit.py reads
         this for the program-level checks)."""
         return self._lowered(feed_dict).as_text()
+
+    @staticmethod
+    def flash_fallbacks():
+        """{reason: count} of attention dispatches that LEFT the Pallas
+        flash fast path (``hetu_tpu.metrics`` registry).  Counts are per
+        trace, not per step — any nonzero entry means some compiled
+        program runs einsum attention; pair with ``hlo_text`` (custom-call
+        evidence) to pin which.  ``HETU_REQUIRE_FLASH=1`` makes these
+        hard failures instead of counters."""
+        from .metrics import flash_fallback_counts
+        return flash_fallback_counts()
 
     def memory_stats(self):
         """Per-device memory stats (reference polls pynvml)."""

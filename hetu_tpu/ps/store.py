@@ -267,38 +267,51 @@ class EmbeddingStore:
         if self._lib:
             self._lib.hetu_ps_ssp_init(self._h, n_workers)
         else:
+            import threading
             self._clocks = np.zeros(n_workers, np.int64)
+            self._clock_cv = threading.Condition()
         self.ssp_ready = True
 
     def clock(self, worker):
         if self._lib:
             self._lib.hetu_ps_clock(self._h, worker)
         else:
-            self._clocks[worker] += 1
+            with self._clock_cv:
+                self._clocks[worker] += 1
+                self._clock_cv.notify_all()
 
     def clock_value(self, worker):
         """This worker's current SSP clock (testing/monitoring)."""
         if self._lib:
             return int(self._lib.hetu_ps_clock_value(self._h, worker))
-        return int(self._clocks[worker])
+        with self._clock_cv:
+            return int(self._clocks[worker])
 
-    @property
-    def ssp_blocking(self):
-        """True when ssp_sync really BLOCKS on the native condvar until
-        the bound holds (one wait, no host polling); the numpy fallback
-        reports the condition immediately and callers must poll."""
-        return bool(self._lib)
+    #: every store flavour blocks now: native condvar (ps_store.cc),
+    #: distributed server-side condition (dist_store), and the numpy
+    #: fallback's threading.Condition below — callers never host-poll
+    ssp_blocking = True
 
     def ssp_sync(self, worker, staleness, timeout_ms=0):
         """Block until this worker is within ``staleness`` clocks of the
-        slowest worker. Returns False on timeout.  NOTE: the numpy
-        fallback cannot block — it reports the condition immediately
-        (callers that need to wait poll it, e.g. the executor's SSP
-        loop; see ``ssp_blocking``)."""
+        slowest worker.  Returns False on timeout; ``timeout_ms <= 0``
+        waits forever (native-parity semantics — executor callers always
+        pass a finite watchdog budget)."""
         if self._lib:
             return self._lib.hetu_ps_ssp_sync(
                 self._h, worker, staleness, timeout_ms) == 0
-        return bool(self._clocks[worker] - self._clocks.min() <= staleness)
+
+        def ok():
+            return bool(self._clocks[worker] - self._clocks.min()
+                        <= staleness)
+
+        with self._clock_cv:
+            # one condition-variable wait, notified by every clock() tick
+            # — replaces the executor-side 5 ms polling loop the old
+            # report-only fallback forced (matching the native and
+            # distributed stores)
+            return self._clock_cv.wait_for(
+                ok, None if timeout_ms <= 0 else timeout_ms / 1e3)
 
     def __del__(self):
         if getattr(self, "_lib", None) and getattr(self, "_h", None):

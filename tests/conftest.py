@@ -17,6 +17,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# newer jax defaults this ON; the parity tests (single-device vs sharded
+# with dropout RNG inside shard_map) assume sharding-invariant random
+# bits, which is exactly what the partitionable threefry gives
+jax.config.update("jax_threefry_partitionable", True)
 
 
 def pytest_configure(config):
@@ -29,3 +33,10 @@ def pytest_configure(config):
         "markers",
         "timeout(seconds): intended wall-clock budget; enforced by the "
         "tests' own subprocess deadlines, not by a pytest plugin")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budgeted run (-m 'not slow'); "
+        "the full unfiltered suite still runs these — heavyweight "
+        "end-to-end/interpret-mode parity tests whose core coverage a "
+        "cheaper sibling already provides, plus multiprocess launcher "
+        "tests that need more CPU than the 1.5-core CI box offers")

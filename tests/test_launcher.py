@@ -80,6 +80,7 @@ def _free_port():
 
 
 @pytest.mark.timeout(180)
+@pytest.mark.slow
 def test_launch_two_local_processes(tmp_path):
     from hetu_tpu import launcher
     from hetu_tpu.context import DistConfig
@@ -152,6 +153,7 @@ MP_EXEC_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.timeout(240)
+@pytest.mark.slow
 def test_multiprocess_executor_dp_parity(tmp_path):
     """The FULL Executor over a mesh spanning 2 real processes (4 virtual
     devices each): global-array feeds/params, dp8 psum across process
@@ -269,6 +271,7 @@ HYBRID_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.timeout(240)
+@pytest.mark.slow
 def test_multiprocess_hybrid_ps_training(tmp_path):
     """The reference's flagship hybrid deployment shape, end-to-end across
     2 real processes: dense params dp-psum'd over the cross-process mesh,
@@ -386,6 +389,7 @@ PP_CP_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.timeout(300)
+@pytest.mark.slow
 def test_multiprocess_pipeline_ring_attention(tmp_path):
     """pp (1F1B pipeline_block) + cp (ring attention) + dp over a mesh
     spanning 2 real processes — the scheduled collectives (ppermute rings,
@@ -498,6 +502,7 @@ SAVE_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.timeout(240)
+@pytest.mark.slow
 def test_multiprocess_save_then_fresh_resume(tmp_path):
     """Executor.save on a cross-process mesh with a tp-sharded param: every
     rank calls save (the allgather fetch is a collective) but only rank 0

@@ -82,10 +82,71 @@ def test_flash_bf16():
                                rtol=2e-2, atol=2e-2)
 
 
-def test_flash_rejects_ragged_seq():
+def test_flash_ragged_bucketing_parity():
+    """Ragged (non-128-multiple) lengths bucket: pad to the next
+    flash-legal length, mask the pad keys through the lengths strip
+    path, unpad — fwd AND grad parity vs the reference at seq=200
+    (bucket 256), the regime the old hard gate silently excluded."""
+    s = 200
+    q, k, v = _rand_qkv(2, 2, s, 32, seed=21)
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        assert out.shape == q.shape
+        ref = sdpa_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    _grad_parity(
+        lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, interpret=True) ** 2),
+        lambda q, k, v: jnp.sum(sdpa_reference(
+            q, k, v, causal=True) ** 2),
+        (q, k, v), "qkv")
+
+
+def test_flash_ragged_roundtrip_matches_manual_pad():
+    """pad → kernel → unpad is EXACT: the bucketed ragged call equals
+    hand-padding to the bucket with an explicit lengths mask and slicing
+    the result (same kernel, same blocks — bitwise)."""
+    from hetu_tpu.ops.pallas.flash_attention import flash_bucket
+    s = 200
+    sp = flash_bucket(s)
+    assert sp == 256
+    q, k, v = _rand_qkv(2, 2, s, 32, seed=22)
+    out = flash_attention(q, k, v, interpret=True)
+    pad = [(0, 0), (0, 0), (0, sp - s), (0, 0)]
+    qp, kp, vp = (jnp.pad(x, pad) for x in (q, k, v))
+    manual = flash_attention(qp, kp, vp,
+                             lengths=jnp.full((2,), s, jnp.int32),
+                             interpret=True)[:, :, :s]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(manual))
+
+
+def test_flash_ragged_with_bias_and_mask():
+    """seq=384+r with additive bias (and a key mask) stays on the kernel
+    path: parity incl. dbias through the pad/unpad wrapper."""
+    s = 421                          # buckets to 512
+    q, k, v = _rand_qkv(1, 2, s, 16, seed=23)
+    rng = np.random.RandomState(23)
+    bias = jnp.asarray(rng.randn(1, 2, s, s).astype(np.float32) * .5)
+    km = jnp.asarray(rng.rand(1, s) > 0.3)
+    out = flash_attention(q, k, v, bias=bias, key_mask=km, interpret=True)
+    ref = sdpa_reference(q, k, v, bias=bias, mask=km[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    _grad_parity(
+        lambda q, k, v, b: jnp.sum(flash_attention(
+            q, k, v, bias=b, key_mask=km, interpret=True) ** 2),
+        lambda q, k, v, b: jnp.sum(sdpa_reference(
+            q, k, v, bias=b, mask=km[:, None, None, :]) ** 2),
+        (q, k, v, bias), ["q", "k", "v", "bias"])
+
+
+def test_flash_causal_ragged_cross_attention_raises():
+    # the ONE unbucketable case: causal cross-attention whose lengths
+    # differ mod 128 (padding would shift the aligned diagonal)
     q, k, v = _rand_qkv(1, 1, 256, 64)
-    with pytest.raises(ValueError):
-        flash_attention(q[:, :, :100], k, v, interpret=True)
+    with pytest.raises(ValueError, match="diagonal"):
+        flash_attention(q[:, :, :100], k, v, causal=True, interpret=True)
 
 
 # ----------------------------------------------------- masked/biased paths
@@ -223,7 +284,7 @@ def test_row_gather_basic():
     np.testing.assert_allclose(np.asarray(out), expect)
 
 
-@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("k", [1, pytest.param(2, marks=pytest.mark.slow)])
 def test_sparse_dispatch_matches_dense(k):
     s, e, d = 64, 8, 32
     cap = 16
@@ -250,7 +311,7 @@ def test_sparse_dispatch_matches_dense(k):
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("k", [1, pytest.param(2, marks=pytest.mark.slow)])
 def test_sparse_moe_grads_match_dense(k):
     s, e, d = 32, 4, 16
     cap = 12
@@ -327,6 +388,7 @@ def test_dedup_rows():
     np.testing.assert_allclose(summed[2], rows_np[3])
 
 
+@pytest.mark.slow
 def test_sparse_moe_layer_trains():
     """SparseMoELayer end-to-end through the graph executor."""
     import hetu_tpu as ht
@@ -431,6 +493,82 @@ def test_flash_gate_artifact_loading(tmp_path, monkeypatch):
     gate, blocks = att._load_flash_gate(default=256)
     assert gate == 256                       # default kept
     assert blocks[(512, "kmask")] == (256, 256)
+
+
+@pytest.mark.parametrize("seq,with_bias", [(384, True), (421, True),
+                                           (421, False)])
+def test_tpu_lowering_contains_pallas_custom_call(seq, with_bias):
+    """Cross-platform TPU lowering of biased / ragged-length attention
+    contains the Pallas (Mosaic) custom-call — the compile-time half of
+    the `flash_in_hlo: true` evidence, assertable without hardware."""
+    import jax.export
+
+    def f(q, k, v, bias):
+        return flash_attention(q, k, v, bias=bias)
+
+    def f_nobias(q, k, v):
+        return flash_attention(q, k, v)
+
+    q = jnp.zeros((1, 2, seq, 64), jnp.float32)
+    if with_bias:
+        bias = jnp.zeros((1, 2, seq, seq), jnp.float32)
+        exp = jax.export.export(jax.jit(f), platforms=["tpu"])(q, q, q,
+                                                               bias)
+    else:
+        exp = jax.export.export(jax.jit(f_nobias), platforms=["tpu"])(
+            q, q, q)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_flash_fallback_reasons_recorded(monkeypatch):
+    """Dispatch fallbacks are COUNTED, never silent: the reason lands in
+    the metrics registry, and HETU_REQUIRE_FLASH=1 escalates to a hard
+    failure."""
+    from hetu_tpu import metrics
+    from hetu_tpu.ops import attention as att
+
+    metrics.reset_flash_fallbacks()
+    q, k, v = _rand_qkv(1, 1, 256, 16, seed=30)
+    att.dispatch_sdpa(q, k, v)              # cpu backend → einsum path
+    counts = metrics.flash_fallback_counts()
+    assert counts.get("backend:cpu", 0) >= 1
+
+    # gate forced open on a "tpu" backend: the remaining blocker (causal
+    # ragged q/kv mod-128 mismatch) gets its own reason — the reason
+    # taxonomy is ordered backend → gate → shape
+    metrics.reset_flash_fallbacks()
+    monkeypatch.setattr(att, "_use_flash", lambda q, k: True)
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    q2, k2, v2 = _rand_qkv(1, 1, 384, 16, seed=30)
+    att.dispatch_sdpa(q2[:, :, :300], k2, v2, causal=True)
+    assert any(r.startswith("causal_ragged_mismatch")
+               for r in metrics.flash_fallback_counts())
+
+    monkeypatch.setenv("HETU_REQUIRE_FLASH", "1")
+    with pytest.raises(RuntimeError, match="HETU_REQUIRE_FLASH"):
+        att.dispatch_sdpa(q2[:, :, :300], k2, v2, causal=True)
+    metrics.reset_flash_fallbacks()
+
+
+def test_swin_window_mask_small_constant_tiles_to_old_layout():
+    """The swin shifted-window mask is stored (nW, 1, w², w²) — B× smaller
+    than the old baked (B·nW, 1, w², w²) constant — and the on-graph
+    Repeat reproduces EXACTLY the old layout (tile maps flat window index
+    t = b·nW + w to mask[w], swin's batch-major flattening)."""
+    from hetu_tpu.models.swin import SwinConfig, _WindowBlock, _shift_mask
+    cfg = SwinConfig.tiny(batch_size=2)
+    blk = _WindowBlock(cfg, cfg.embed_dim, 2, 8, shift=2, name="swb",
+                       consts={})
+    w = blk.w
+    nW = (8 // w) ** 2
+    assert blk.mask._value.shape == (nW, 1, w * w, w * w)
+    # the old (pre-PR) baked constant, reproduced from the same source
+    m = _shift_mask(8, 8, w, blk.shift)
+    old = np.broadcast_to(m[None, :, None],
+                          (2, nW, 1, w * w, w * w)).reshape(
+        2 * nW, 1, w * w, w * w)
+    tiled = np.tile(blk.mask._value, (2, 1, 1, 1))   # what repeat_op lowers to
+    np.testing.assert_array_equal(tiled, old)
 
 
 @pytest.mark.parametrize("bias_shape,causal", [
