@@ -386,6 +386,13 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
 def _child_main(args):
     cpu_fallback = bool(os.environ.get("_HETU_BENCH_FORCE_CPU"))
 
+    if args.config == "chaos":
+        # host-side fault-injection smoke: the dist-store transport and
+        # the recovery loop run on the host either way, so CPU is the
+        # intended backend here — no fallback annotation
+        print(json.dumps(bench_chaos(steps=args.steps or 8)))
+        return
+
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
         # BOTH frameworks on the same workload); only the implicit default
@@ -454,7 +461,8 @@ def _error_result(args, msg):
              "resnet18": ("resnet18_cifar10_step_time", "ms/step"),
              "wdl": ("wdl_criteo_cache_samples_per_sec", "samples/s"),
              "moe": ("moe_ep_tokens_per_sec", "tokens/s"),
-             "attn": ("attn_flash_sweep_tokens_per_sec", "tokens/s")}
+             "attn": ("attn_flash_sweep_tokens_per_sec", "tokens/s"),
+             "chaos": ("chaos_recovery_ms", "ms")}
     metric, unit = names[args.config]
     return {"metric": metric, "value": 0.0, "unit": unit,
             "vs_baseline": 0.0, "error": msg[-2000:]}
@@ -920,10 +928,187 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
     }
 
 
+def bench_chaos(steps=8, kill_step=3):
+    """Fault-injection smoke (ISSUE 2 CI satellite): a short PS training
+    loop under a FIXED chaos schedule — the rank-1 PS server is killed
+    after step ``kill_step`` — measuring detection+recovery wall time and
+    restart count, with loss parity against the uninterrupted run as the
+    correctness gate.  Host-side metric: the dist-store transport and the
+    retry/resume path run on the host whatever the accelerator is."""
+    import glob as _glob
+    import shutil
+    import socket as _socket
+    import tempfile
+
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.graph.executor import Executor
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    from hetu_tpu.ps.dist_store import DistributedStore
+
+    def free_ports(n):
+        socks, ports = [], []
+        for _ in range(n):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
+    def store_pair(ports):
+        endpoints = [("127.0.0.1", p) for p in ports]
+        stores = [DistributedStore(r, 2, endpoints, port=ports[r],
+                                   rpc_timeout=5.0, rpc_retries=2,
+                                   connect_timeout=2.0) for r in range(2)]
+        table = np.random.RandomState(42).normal(
+            0, 0.01, (64, 8)).astype(np.float32)
+        tid = None
+        for r, s in enumerate(stores):
+            tid = s.init_table(64, 8, opt="sgd", lr=0.1, init_scale=0.0)
+            s.local.set_data(tid, table[np.arange(32) * 2 + r])
+        return stores[0], stores[1], tid
+
+    def build(store, tid, **kw):
+        rng = np.random.RandomState(1)
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((store, tid), ids, width=8)
+        w = ht.Variable("w", value=rng.randn(8, 2).astype(np.float32) * .3)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, w), y_), [0])
+        ex = ht.Executor(
+            {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+            seed=0, install_signal_handlers=False, **kw)
+        return ex, ids, y_
+
+    def save_shard1(s1, tid, save_dir, step):
+        # in a real deployment every rank's executor saves its own PS
+        # shard; this single-process smoke mirrors rank 1's shard save
+        ck = os.path.join(save_dir, f"ckpt-{step:08d}")
+        if os.path.isdir(ck):
+            s1.save(tid, os.path.join(ck, "ps0.bin"))
+
+    rng = np.random.RandomState(0)
+    feeds = [(rng.randint(0, 64, 32),
+              np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)])
+             for _ in range(steps)]
+
+    # the smoke measures ITS OWN fixed schedule: an inherited HETU_CHAOS
+    # must not inject into the baseline (the stores' install_from_env
+    # would resurrect it) or contaminate the clean-run counters
+    env_chaos = os.environ.pop("HETU_CHAOS", None)
+    chaos_mod.uninstall()
+
+    # uninterrupted baseline (also proves a clean run records NO faults)
+    reset_faults()
+    s0, s1, tid = store_pair(free_ports(2))
+    ex, ids, y_ = build(s0, tid)
+    base = [float(ex.run("train", feed_dict={ids: f[0], y_: f[1]}
+                         )[0].asnumpy()) for f in feeds]
+    s0.close()
+    s1.close()
+    clean_counters = fault_counts()
+
+    save_dir = tempfile.mkdtemp(prefix="hetu_chaos_bench_")
+    schedule = f"11:kill:ps@rank1:step{kill_step}"
+    reset_faults()
+    prev = chaos_mod.install(chaos_mod.ChaosInjector.from_spec(schedule))
+    ports = free_ports(2)
+    s0, s1, tid = store_pair(ports)
+    recovery_ms, restarts = 0.0, 0
+    losses = [None] * steps
+    t_run0 = time.monotonic()
+    try:
+        ex, ids, y_ = build(s0, tid, auto_save_dir=save_dir,
+                            auto_save_every=1)
+        step = 0
+        while step < steps:
+            try:
+                losses[step] = float(
+                    ex.run("train", feed_dict={ids: feeds[step][0],
+                                               y_: feeds[step][1]}
+                           )[0].asnumpy())
+                step += 1
+                save_shard1(s1, tid, save_dir, step)
+            except RuntimeError:
+                t_fail = time.monotonic()
+                restarts += 1
+                if restarts > 3:
+                    raise
+                cands = [c for c in sorted(
+                    _glob.glob(os.path.join(save_dir, "ckpt-*")),
+                    reverse=True) if Executor._checkpoint_complete(c)]
+                if not cands:
+                    raise RuntimeError(
+                        "chaos recovery: no complete checkpoint to "
+                        "restore from (kill landed before the first "
+                        "auto-save?)")
+                newest = cands[0]
+                endpoints = [("127.0.0.1", p) for p in ports]
+                s1 = DistributedStore(1, 2, endpoints, port=ports[1],
+                                      rpc_timeout=5.0, rpc_retries=2,
+                                      connect_timeout=2.0)
+                s1.init_table(64, 8, opt="sgd", lr=0.1, init_scale=0.0)
+                s1.load(tid, os.path.join(newest, "ps0.bin"))
+                ex, ids, y_ = build(s0, tid, auto_save_dir=save_dir,
+                                    auto_save_every=1)
+                step = ex.resume(save_dir)
+                if step is None:
+                    raise RuntimeError(
+                        "chaos recovery: resume found no loadable "
+                        "checkpoint under " + save_dir)
+                # recovery-time clock stops at the END of the first post-
+                # resume step: detect → restore → prove training moves
+                losses[step] = float(
+                    ex.run("train", feed_dict={ids: feeds[step][0],
+                                               y_: feeds[step][1]}
+                           )[0].asnumpy())
+                step += 1
+                save_shard1(s1, tid, save_dir, step)
+                recovery_ms += (time.monotonic() - t_fail) * 1e3
+        parity = losses == base
+        counters = fault_counts()
+    finally:
+        chaos_mod.install(prev)
+        if env_chaos is not None:
+            os.environ["HETU_CHAOS"] = env_chaos
+        for s in (s0, s1):
+            try:
+                s.close()
+            except Exception:
+                pass
+        shutil.rmtree(save_dir, ignore_errors=True)
+    total_ms = (time.monotonic() - t_run0) * 1e3
+    return {
+        "metric": "chaos_recovery_ms",
+        "value": round(recovery_ms, 1),
+        "unit": "ms",
+        "vs_baseline": 1.0 if parity and restarts else 0.0,
+        "extra": {
+            "baseline_def": "1.0 iff the chaos run's loss trajectory is "
+                            "exactly equal to the uninterrupted run's "
+                            "(and at least one injected failure + "
+                            "recovery actually happened)",
+            **_provenance({"steps": steps, "kill_step": kill_step,
+                           "schedule": schedule}),
+            "restarts": restarts,
+            "total_wall_ms": round(total_ms, 1),
+            "loss_parity": parity,
+            "fault_counters": counters,
+            "clean_run_counters": clean_counters,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="bert",
-                   choices=["bert", "resnet18", "wdl", "moe", "attn"])
+                   choices=["bert", "resnet18", "wdl", "moe", "attn",
+                            "chaos"])
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None,
                    help="bert only: sequence length (default 512 — the "
@@ -940,5 +1125,24 @@ if __name__ == "__main__":
     args = p.parse_args()
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
+    elif args.config == "chaos":
+        # host-side smoke: no TPU probe loop (backend-agnostic metric),
+        # but still a budgeted child so a wedged backend import can't
+        # hang the harness
+        env = dict(os.environ, **{CHILD_ENV_FLAG: "1",
+                                  "_HETU_BENCH_FORCE_CPU": "1"})
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env, capture_output=True, text=True,
+                timeout=min(CHILD_TIMEOUT_S, TOTAL_BUDGET_S))
+            parsed = _parse_child_json(proc.stdout, 0)
+            if parsed is None:
+                parsed = _error_result(
+                    args, f"chaos smoke rc={proc.returncode} "
+                          f"stderr: {proc.stderr[-1500:]}")
+        except subprocess.TimeoutExpired:
+            parsed = _error_result(args, "chaos smoke exceeded wall clock")
+        print(json.dumps(parsed))
     else:
         _parent_main(args)

@@ -1,0 +1,265 @@
+"""Chaos injection — deterministic, seedable fault schedules for the
+fault-tolerance layer (ISSUE 2 tentpole stratum 1).
+
+Real clusters lose PS servers, preempt hosts, and wedge sockets; the PS
+lineage this repo reproduces (SSP bounds, P-Reduce dynamic groups) exists
+*because* of those failures.  This module turns every failure mode into a
+reproducible experiment instead of an anecdote: a :class:`ChaosInjector`
+parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
+
+* **transport faults** — the dist-store client consults :func:`active`
+  before every RPC frame and the injector answers drop / delay /
+  duplicate / wedge with decisions drawn from a seeded RNG (same seed ⇒
+  the exact same fault sequence for the same event order);
+* **process-level kills** — ``kill:ps@rank<r>:step<s>`` stops a
+  registered :class:`~hetu_tpu.ps.dist_store.StoreServer` when the
+  executor reports training step ``s``; ``kill:proc@rank<r>:after<ms>``
+  tells the supervising launcher to kill a child rank after a wall-clock
+  delay (fired at most once per injector).
+
+Spec grammar (everything after the first ``:`` is the comma-separated
+fault list; probabilities in [0, 1], durations in milliseconds)::
+
+    HETU_CHAOS="1234:drop=0.1,delay=0.2:50,dup=0.05,wedge=0.01:2000"
+    HETU_CHAOS="7:kill:ps@rank1:step3"
+    HETU_CHAOS="7:kill:proc@rank0:after250"
+
+Every injected fault increments a named counter in
+:mod:`hetu_tpu.metrics` (``chaos_drop``, ``chaos_kill_ps``, ...) so
+``HetuProfiler.fault_counters()`` shows exactly what the schedule did.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from .metrics import record_fault
+
+#: transport fault kinds a schedule may inject on an outgoing RPC frame
+_TRANSPORT_KINDS = ("drop", "delay", "dup", "wedge")
+
+
+class ChaosSpecError(ValueError):
+    """Malformed ``HETU_CHAOS`` spec (loud: a typo'd schedule silently
+    injecting nothing would make a chaos run indistinguishable from a
+    clean one)."""
+
+
+def _parse_fault(part):
+    part = part.strip()
+    if not part:
+        raise ChaosSpecError("empty fault entry")
+    if part.startswith("kill:"):
+        # kill:ps@rank<r>:step<s>  |  kill:proc@rank<r>:after<ms>
+        try:
+            _, rest = part.split(":", 1)
+            what, where = rest.split("@", 1)
+            target, when = where.split(":", 1)
+            if not target.startswith("rank"):
+                raise ValueError(part)
+            rank = int(target[len("rank"):])
+            if what == "ps" and when.startswith("step"):
+                return {"kind": "kill_ps", "rank": rank,
+                        "step": int(when[len("step"):])}
+            if what == "proc" and when.startswith("after"):
+                return {"kind": "kill_proc", "rank": rank,
+                        "after_ms": float(when[len("after"):])}
+            raise ValueError(part)
+        except (ValueError, IndexError):
+            raise ChaosSpecError(
+                f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>"
+                f" or kill:proc@rank<r>:after<ms>") from None
+    if "=" not in part:
+        raise ChaosSpecError(f"bad fault {part!r}: expected <kind>=<prob>"
+                             f"[:<ms>] or kill:...")
+    kind, val = part.split("=", 1)
+    kind = kind.strip()
+    if kind not in _TRANSPORT_KINDS:
+        raise ChaosSpecError(
+            f"unknown fault kind {kind!r} (known: {_TRANSPORT_KINDS})")
+    ms = 0.0
+    if ":" in val:
+        val, ms_s = val.split(":", 1)
+        ms = float(ms_s)
+    try:
+        prob = float(val)
+    except ValueError:
+        raise ChaosSpecError(f"bad probability in {part!r}") from None
+    if not 0.0 <= prob <= 1.0:
+        raise ChaosSpecError(f"probability {prob} out of [0,1] in {part!r}")
+    if kind in ("delay", "wedge") and ms <= 0:
+        raise ChaosSpecError(f"{kind} needs a duration: {kind}=<p>:<ms>")
+    return {"kind": kind, "prob": prob, "ms": ms}
+
+
+def parse_spec(spec):
+    """``"<seed>:<fault>[,<fault>...]"`` → ``(seed, [fault dicts])``."""
+    if ":" not in spec:
+        raise ChaosSpecError(
+            f"chaos spec {spec!r} missing the '<seed>:' prefix")
+    seed_s, rest = spec.split(":", 1)
+    try:
+        seed = int(seed_s)
+    except ValueError:
+        raise ChaosSpecError(f"bad chaos seed {seed_s!r}") from None
+    faults = [_parse_fault(p) for p in rest.split(",") if p.strip()]
+    if not faults:
+        raise ChaosSpecError(f"chaos spec {spec!r} declares no faults")
+    return seed, faults
+
+
+class ChaosInjector:
+    """One parsed schedule + its RNG stream + its kill registry.
+
+    Determinism contract: probabilistic decisions are drawn from ONE
+    ``random.Random(seed)`` stream in event order — the same seed and the
+    same sequence of :meth:`on_send` calls produce the same action
+    sequence (the determinism test's exact claim).  Multi-threaded
+    transports still get a *reproducible distribution* (the lock
+    serializes draws), single-threaded tests get bitwise repeatability.
+    """
+
+    def __init__(self, seed, faults):
+        self.seed = seed
+        self.faults = list(faults)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._servers = {}          # rank -> StoreServer
+        self._fired = set()         # one-shot kill faults already fired
+        #: per-event action log, kept for the determinism tests; bounded
+        #: so a long chaos run doesn't grow it without limit
+        self.decisions = []
+        self.decisions_cap = 65536
+
+    @classmethod
+    def from_spec(cls, spec):
+        seed, faults = parse_spec(spec)
+        return cls(seed, faults)
+
+    @classmethod
+    def from_env(cls, env_var="HETU_CHAOS"):
+        spec = os.environ.get(env_var, "").strip()
+        return cls.from_spec(spec) if spec else None
+
+    # -- transport faults --------------------------------------------------
+    def on_send(self, peer=None, op=None):
+        """Decide the fate of one outgoing RPC frame.
+
+        Returns ``None`` (send normally) or ``(kind, ms)`` with kind in
+        ``drop`` (never send; the client sees a timeout and retries),
+        ``delay`` (sleep ``ms`` then send), ``dup`` (send the frame twice
+        — the server's (client, seq) dedup must absorb it), ``wedge``
+        (hold the socket ``ms``; the client's op deadline fires).
+        """
+        with self._lock:
+            action = None
+            for f in self.faults:
+                if f["kind"] not in _TRANSPORT_KINDS:
+                    continue
+                # one draw per prob-fault per event: the stream position
+                # depends only on (schedule, event count), never on which
+                # earlier fault happened to trigger
+                hit = self._rng.random() < f["prob"]
+                if hit and action is None:
+                    action = (f["kind"], f["ms"])
+            if len(self.decisions) < self.decisions_cap:
+                self.decisions.append(action)
+            if action is not None:
+                record_fault("chaos_" + action[0])
+            return action
+
+    # -- step-scheduled PS-server kills ------------------------------------
+    def register_server(self, rank, server):
+        """A live PS server volunteers as a kill target for ``kill:ps``."""
+        with self._lock:
+            self._servers[rank] = server
+
+    def on_step(self, step):
+        """Executor hook: fires any ``kill:ps@rank<r>:step<step>`` fault.
+
+        Returns the list of ranks whose server was stopped (empty almost
+        always).  A fault whose target rank has no registered server is
+        LOUD (warning + ``chaos_kill_target_missing`` counter) — a
+        schedule that silently does nothing would make a chaos run
+        indistinguishable from a clean one."""
+        killed, missing = [], []
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if f["kind"] != "kill_ps" or i in self._fired \
+                        or f["step"] != step:
+                    continue
+                self._fired.add(i)
+                server = self._servers.get(f["rank"])
+                if server is not None:
+                    killed.append(f["rank"])
+                elif not self._servers:
+                    # no server registered in this process at all: the
+                    # schedule cannot possibly fire here — loud.  When
+                    # OTHER ranks' servers are registered, the target
+                    # lives in a different process (each process hosts
+                    # its own rank) and fires there: stay quiet.
+                    missing.append(f["rank"])
+        for rank in missing:
+            import warnings
+            record_fault("chaos_kill_target_missing")
+            warnings.warn(f"chaos kill:ps@rank{rank}:step{step} fired but "
+                          f"no server is registered for rank {rank} — "
+                          f"the kill did NOT happen", RuntimeWarning)
+        for rank in killed:         # stop outside the lock: stop() closes
+            record_fault("chaos_kill_ps")        # sockets, may block
+            self._servers[rank].stop()
+        return killed
+
+    # -- launcher-level child kills ----------------------------------------
+    def due_proc_kills(self, elapsed_ms):
+        """Ranks whose ``kill:proc`` delay has elapsed; each fires once."""
+        due = []
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if f["kind"] == "kill_proc" and i not in self._fired \
+                        and elapsed_ms >= f["after_ms"]:
+                    self._fired.add(i)
+                    due.append(f["rank"])
+        for _ in due:
+            record_fault("chaos_kill_proc")
+        return due
+
+
+# ------------------------------------------------------------- active chaos
+_active = None
+_active_lock = threading.Lock()
+
+
+def active():
+    """The process-wide injector, or None (the hot-path check is one
+    global read — a clean run pays nothing)."""
+    return _active
+
+
+def install(injector):
+    """Make ``injector`` the process-wide schedule; returns the previous
+    one so tests can restore it."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, injector
+    return prev
+
+
+def install_from_env(env_var="HETU_CHAOS"):
+    """Install a schedule from the environment if one is set; returns the
+    injector (or None).  Called by the dist-store and launcher entry
+    points so ``HETU_CHAOS=...`` alone activates the harness."""
+    inj = ChaosInjector.from_env(env_var)
+    if inj is not None:
+        install(inj)
+    return inj
+
+
+def uninstall():
+    """Remove the process-wide schedule (test teardown)."""
+    return install(None)
+
+
+__all__ = ["ChaosInjector", "ChaosSpecError", "parse_spec", "active",
+           "install", "install_from_env", "uninstall"]

@@ -345,6 +345,19 @@ class SubExecutor:
     # -- run --------------------------------------------------------------
 
     def run(self, feed_dict, convert_to_numpy_ret_vals=False):
+        # the in-step guard defers a SIGTERM/SIGINT emergency save to the
+        # step boundary: mid-step, var_values/opt_states are being swapped
+        # and a signal-time save could capture a half-updated state
+        ex = self.ex
+        ex._in_step = True
+        try:
+            out = self._run_impl(feed_dict, convert_to_numpy_ret_vals)
+        finally:
+            ex._in_step = False
+        ex._post_step(self.training)
+        return out
+
+    def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False):
         import jax
         ex = self.ex
         if self._jit is None:
@@ -656,6 +669,33 @@ class Executor:
         # remat: recompute activations in backward (jax.checkpoint) —
         # capability analogue of the reference's memory reuse plan
         self.remat = bool(kwargs.pop("remat", False))
+        # preemption-safe auto-checkpointing: every `auto_save_every`
+        # training steps an atomic checkpoint lands under `auto_save_dir`
+        # (keep-last-`auto_save_keep` retention); SIGTERM/SIGINT triggers
+        # one final emergency save.  Env knobs HETU_AUTO_SAVE_{DIR,EVERY,
+        # KEEP} let a launcher turn this on without touching user code.
+        import os as _os
+        self.auto_save_dir = kwargs.pop(
+            "auto_save_dir", _os.environ.get("HETU_AUTO_SAVE_DIR") or None)
+        self.auto_save_every = int(kwargs.pop(
+            "auto_save_every", _os.environ.get("HETU_AUTO_SAVE_EVERY", "0")))
+        self.auto_save_keep = int(kwargs.pop(
+            "auto_save_keep", _os.environ.get("HETU_AUTO_SAVE_KEEP", "3")))
+        # HETU_AUTO_RESUME=1 (set by `heturun --supervise --ckpt-dir`):
+        # restore the newest complete checkpoint at construction, so a
+        # training script that never calls resume() still continues
+        # instead of silently restarting from step 0 on every relaunch
+        self._auto_resume = bool(kwargs.pop(
+            "auto_resume", _os.environ.get("HETU_AUTO_RESUME", "") == "1"))
+        self._in_step = False
+        self._preempt_signum = None
+        self._prev_handlers = {}
+        self._installed_handlers = {}
+        install_handlers = kwargs.pop("install_signal_handlers", None)
+        if install_handlers is None:
+            install_handlers = bool(self.auto_save_dir)
+        if install_handlers and self.auto_save_dir:
+            self._install_signal_handlers()
         self._ps_futures = []
         self._ps_pool = None
         if pipeline is None and getattr(dist_strategy, "schedule", None):
@@ -712,6 +752,9 @@ class Executor:
                     name, fetches, self)
             else:
                 self.subexecutors[name] = SubExecutor(name, fetches, self)
+
+        if self._auto_resume and self.auto_save_dir:
+            self.resume(self.auto_save_dir)
 
     # -- variable init ----------------------------------------------------
 
@@ -943,7 +986,243 @@ class Executor:
             f.result()
         self._ps_futures = []
 
+    # -- fault tolerance: auto-checkpoint, preemption, resume --------------
+
+    def _post_step(self, training):
+        """Step-boundary hooks: periodic auto-save, chaos schedule tick,
+        deferred preemption handling.  Called by SubExecutor.run AFTER the
+        state swap, so everything below sees a consistent step."""
+        if training:
+            if self.auto_save_dir and self.auto_save_every > 0 \
+                    and self.step_counter % self.auto_save_every == 0:
+                self._auto_save()
+            from .. import chaos as _chaos
+            inj = _chaos.active()
+            if inj is not None:
+                # the injected kill lands AFTER this step's auto-save: a
+                # schedule's `kill:ps@rank<r>:step<s>` is reproducibly
+                # "step s completed, then the server died"
+                inj.on_step(self.step_counter)
+        if self._preempt_signum is not None:
+            self._handle_preemption()
+
+    def _install_signal_handlers(self):
+        """SIGTERM/SIGINT → one final emergency save, then the previous
+        disposition.  Main-thread only (signal module constraint); the
+        previous handlers are chained, not clobbered.  The registered
+        handler holds only a WEAK reference to this executor — the signal
+        module must not pin a dead executor (and its full parameter
+        state) in memory; once collected, the handler falls through to
+        the previous disposition."""
+        import signal
+        import threading
+        import weakref
+        if threading.current_thread() is not threading.main_thread():
+            return
+        ref = weakref.ref(self)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev = signal.getsignal(sig)
+
+                def handler(signum, frame, _ref=ref, _prev=prev):
+                    ex = _ref()
+                    if ex is not None:
+                        return ex._on_preempt(signum, frame)
+                    if callable(_prev):
+                        return _prev(signum, frame)
+                    if _prev == signal.SIG_IGN:
+                        return      # honor an explicit prior ignore
+                    if signum == signal.SIGINT:
+                        raise KeyboardInterrupt
+                    raise SystemExit(128 + signum)
+
+                signal.signal(sig, handler)
+                self._prev_handlers[sig] = prev
+                self._installed_handlers[sig] = handler
+            except (ValueError, OSError):  # non-main ctx raced, or exotic
+                pass                       # platform: skip, never crash
+
+    def uninstall_signal_handlers(self):
+        """Restore the previous SIGTERM/SIGINT dispositions (only where
+        this executor's handler is still the installed one — a later
+        executor's handler already chains to ours and must stay)."""
+        import signal
+        for sig, h in list(self._installed_handlers.items()):
+            try:
+                if signal.getsignal(sig) is h:
+                    signal.signal(sig, self._prev_handlers[sig])
+            except (ValueError, OSError):
+                pass
+            self._installed_handlers.pop(sig, None)
+
+    def _on_preempt(self, signum, frame):
+        self._preempt_signum = signum
+        if not self._in_step:
+            self._handle_preemption()
+        # else: the in-flight step finishes; _post_step handles it at the
+        # boundary where params/opt/step are consistent
+
+    def _handle_preemption(self):
+        import signal
+        from ..metrics import record_fault
+        signum, self._preempt_signum = self._preempt_signum, None
+        record_fault("emergency_save")
+        try:
+            # multiprocess: save() runs COLLECTIVE fetches + barriers; a
+            # signal that reached only this rank would deadlock inside
+            # them.  Cross-process preemption safety comes from the
+            # periodic auto-saves (every rank saves at the same step) +
+            # the supervisor relaunch, not from a one-rank handler.
+            if self.auto_save_dir and not self._multiprocess:
+                self._auto_save()
+        finally:
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, None)   # includes default_int_handler
+            elif prev == signal.SIG_IGN:
+                # the process explicitly ignored this signal before we
+                # chained: save-and-continue, not save-and-die
+                pass
+            elif signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            else:
+                raise SystemExit(128 + signum)  # 143 for SIGTERM
+
+    def _auto_save(self):
+        """One atomic checkpoint at the current step under auto_save_dir
+        (idempotent per step) + keep-last-N retention."""
+        import os
+        from ..metrics import record_fault
+        d = self.auto_save_dir
+        if not d:
+            return None
+        final = os.path.join(d, f"ckpt-{self.step_counter:08d}")
+        if not os.path.exists(os.path.join(final, "meta.json")):
+            os.makedirs(d, exist_ok=True)
+            self.save(final)
+            record_fault("auto_save")
+            self._prune_auto_saves()
+        return final
+
+    def _prune_auto_saves(self):
+        import glob
+        import os
+        import shutil
+        import jax
+        if self._multiprocess and jax.process_index() != 0:
+            return                      # rank 0 owns retention
+        cands = sorted(p for p in glob.glob(
+            os.path.join(self.auto_save_dir, "ckpt-*"))
+            if os.path.isdir(p) and not p.endswith((".saving",
+                                                    ".replaced")))
+        complete = [p for p in cands if self._checkpoint_complete(p)]
+        for stale in complete[:-max(1, self.auto_save_keep)]:
+            shutil.rmtree(stale, ignore_errors=True)
+
+    @staticmethod
+    def _checkpoint_complete(path):
+        """A checkpoint is COMPLETE iff its meta.json parses, declares the
+        format, and every file it names exists (with the recorded size,
+        when the manifest carries one).  A preemption mid-save leaves
+        either no meta.json (meta is written last, atomically) or a
+        manifest naming files that are missing/short — both rejected."""
+        import json
+        import glob
+        import os
+        meta_path = os.path.join(path, "meta.json")
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        if not str(meta.get("format", "")).startswith("hetu_tpu.ckpt"):
+            return False
+        manifest = meta.get("manifest", {})
+        names = [os.path.join("params", fn)
+                 for fn in meta.get("params", {}).values()]
+        for entry in meta.get("opt", []):
+            names += [os.path.join("opt", fn)
+                      for fn in entry.get("leaves", {}).values()]
+        for rel in names:
+            fp = os.path.join(path, rel)
+            if not os.path.exists(fp):
+                return False
+            want = manifest.get(rel)
+            if want is not None and os.path.getsize(fp) != want:
+                return False
+        for entry in meta.get("ps_tables", []):
+            # per-rank shard suffixes (".shard<r>") make exact names rank-
+            # dependent; existence of any file for the entry is the check
+            if not glob.glob(os.path.join(path, entry["file"]) + "*"):
+                return False
+        return True
+
+    def resume(self, path_or_dir):
+        """Restore the newest COMPLETE checkpoint for an exact-continuation
+        restart (params, optimizer state, PS rows, dataloader cursors,
+        step counter).
+
+        ``path_or_dir`` is either one checkpoint directory (meta.json
+        inside) or an auto-save directory of ``ckpt-<step>`` entries —
+        the newest complete one wins; incomplete/truncated ones are
+        counted (``ckpt_incomplete_skipped``) and skipped.  A crash
+        between the two renames of an overwriting save can strand the
+        only complete copy at ``<path>.replaced``/``<path>.saving`` —
+        those are probed too (at lower priority than published
+        checkpoints).  Returns the restored step, or None when nothing
+        loadable exists (caller starts fresh)."""
+        import glob
+        import os
+        import warnings as _warnings
+        from ..metrics import record_fault
+
+        def _try(cand, count_incomplete=False):
+            if not os.path.isdir(cand):
+                return False
+            if not self._checkpoint_complete(cand):
+                if count_incomplete:
+                    record_fault("ckpt_incomplete_skipped")
+                    _warnings.warn(f"skipping incomplete checkpoint "
+                                   f"{cand}", RuntimeWarning)
+                return False
+            self.load(cand)
+            record_fault("resume")
+            return True
+
+        # a single checkpoint path, or its rename-crash remnants
+        for cand in (path_or_dir, str(path_or_dir) + ".saving",
+                     str(path_or_dir) + ".replaced"):
+            if os.path.exists(os.path.join(cand, "meta.json")) \
+                    and _try(cand):
+                return self.step_counter
+        if os.path.isdir(path_or_dir):
+            import re
+
+            def order(c):
+                # newest step first; a published dir outranks a stranded
+                # remnant of the SAME step, but a stranded newer step
+                # (complete, just never renamed into place) beats an
+                # older published one — it is the more exact restore
+                m = re.search(r"ckpt-(\d+)", os.path.basename(c))
+                published = not c.endswith((".saving", ".replaced"))
+                return (int(m.group(1)) if m else -1, published)
+
+            for cand in sorted(glob.glob(
+                    os.path.join(path_or_dir, "ckpt-*")),
+                    key=order, reverse=True):
+                # an incomplete .saving remnant is the EXPECTED shape of
+                # a preempted save, not an anomaly worth counting
+                if _try(cand, count_incomplete=not cand.endswith(
+                        (".saving", ".replaced"))):
+                    return self.step_counter
+        return None
+
     def __del__(self):
+        if getattr(self, "_installed_handlers", None):
+            try:
+                self.uninstall_signal_handlers()
+            except Exception:
+                pass
         pool = getattr(self, "_ps_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
@@ -1031,13 +1310,22 @@ class Executor:
         Multiprocess: EVERY rank must call save (tensor fetches are
         collectives and each rank persists its own PS shard) but only rank
         0 writes params/opt/meta — concurrent same-path np.save from
-        several local ranks interleaves and corrupts tensors."""
+        several local ranks interleaves and corrupts tensors.
+
+        Atomicity (preemption-safe): the directory format is assembled in
+        ``<path>.saving`` and PUBLISHED by one rename, with meta.json
+        written last + atomically and carrying a size manifest — a
+        preemption at ANY point leaves either the previous checkpoint at
+        ``path`` untouched or a work dir ``resume`` never considers;
+        never a half-written checkpoint that validates."""
         self.ps_flush()  # ASP pushes must land before persisting
         import json
         import os
+        import shutil
         import jax
         rank0 = not self._multiprocess or jax.process_index() == 0
-        if file is not None:    # legacy single-file blob
+        path = os.path.normpath(path)
+        if file is not None:    # legacy single-file blob (atomic replace)
             os.makedirs(path, exist_ok=True)
             blob = {
                 "params": {self.var_names[n]: self._fetch_host(v)
@@ -1047,19 +1335,35 @@ class Executor:
                 "step": self.step_counter,
             }
             if rank0:
-                with open(os.path.join(path, file), "wb") as f:
+                tmp = os.path.join(path, file + ".tmp")
+                with open(tmp, "wb") as f:
                     pickle.dump(blob, f)
+                os.replace(tmp, os.path.join(path, file))
             return
-        os.makedirs(os.path.join(path, "params"), exist_ok=True)
-        os.makedirs(os.path.join(path, "opt"), exist_ok=True)
+        work = path + ".saving"
+        if rank0 and os.path.exists(work):  # leftovers of a preempted save
+            shutil.rmtree(work)
+        # ranks write PS shards into the SAME work dir: nobody may write
+        # before rank 0's cleanup, and rank 0 must not publish before
+        # everybody finished writing — hence the barriers
+        self._save_barrier("clean")
+        os.makedirs(os.path.join(work, "params"), exist_ok=True)
+        os.makedirs(os.path.join(work, "opt"), exist_ok=True)
         meta = {"format": "hetu_tpu.ckpt.v1", "step": self.step_counter,
                 "seed": self.seed, "params": {}, "opt": [],
-                "ps_tables": []}
+                "ps_tables": [], "manifest": {}}
+
+        def _persist(rel, host_val):
+            fp = os.path.join(work, rel)
+            np.save(fp, host_val)
+            # np.save appends .npy only when missing; rel always has it
+            meta["manifest"][rel] = os.path.getsize(fp)
+
         for i, (n, v) in enumerate(self.var_values.items()):
             fn = f"p{i}.npy"
             hv = self._fetch_host(v)        # collective: all ranks
             if rank0:
-                np.save(os.path.join(path, "params", fn), hv)
+                _persist(os.path.join("params", fn), hv)
             meta["params"][self.var_names[n]] = fn
         for k, (op, st) in enumerate(self.opt_states.items()):
             named = self._named_opt_state(op, st)
@@ -1069,7 +1373,7 @@ class Executor:
                 fn = f"o{k}_{j}.npy"
                 hl = self._fetch_host(leaf)  # collective: all ranks
                 if rank0:
-                    np.save(os.path.join(path, "opt", fn), hl)
+                    _persist(os.path.join("opt", fn), hl)
                 leaves[jax.tree_util.keystr(kpath)] = fn
             meta["opt"].append({"name": op.name, "leaves": leaves})
         for i, node in enumerate(self._ps_table_sites()):
@@ -1082,17 +1386,42 @@ class Executor:
             # replicated by the one-pusher gating), or concurrent ranks
             # would interleave into the same file.
             if hasattr(node.store, "server") or rank0:
-                node.store.save(node.table, os.path.join(path, fn))
+                node.store.save(node.table, os.path.join(work, fn))
             meta["ps_tables"].append({"file": fn, "node": node.name})
         meta["dataloaders"] = [
             {split: dl.state_dict() for split, dl in op.dataloaders.items()}
             for op in self._dataloader_sites()]
-        if not rank0:
+        # meta must land after EVERY rank's writes (PS shards included):
+        # without this barrier a crash could leave a meta.json that
+        # validates next to another rank's still-truncated shard file
+        self._save_barrier("written")
+        if rank0:
+            tmp = os.path.join(work, "meta.json.tmp")
+            with open(tmp, "w") as f:  # meta last + atomic: marks a
+                json.dump(meta, f, indent=1)    # complete checkpoint
+            os.replace(tmp, os.path.join(work, "meta.json"))
+        self._save_barrier("meta")
+        if rank0:
+            if os.path.exists(path):
+                # overwrite: two renames (dirs can't os.replace); a crash
+                # between them leaves the complete old copy at .replaced
+                old = path + ".replaced"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(path, old)
+                os.rename(work, path)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(work, path)
+        self._save_barrier("published")
+
+    def _save_barrier(self, tag):
+        """Cross-rank ordering for the shared-work-dir save protocol."""
+        if not self._multiprocess:
             return
-        tmp = os.path.join(path, "meta.json.tmp")
-        with open(tmp, "w") as f:    # meta last + atomic: marks a complete
-            json.dump(meta, f, indent=1)     # checkpoint
-        os.replace(tmp, os.path.join(path, "meta.json"))
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            f"hetu-save-{tag}-{self.step_counter}")
 
     def save_orbax(self, path):
         """Orbax-format checkpoint — the JAX-ecosystem standard format,

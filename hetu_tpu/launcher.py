@@ -12,6 +12,16 @@ mesh over ICI/DCN. So the launcher's job shrinks to:
 * in-process: :func:`init_distributed` for scripts that want the reference's
   ``worker_init()`` call-shape.
 
+Fault tolerance: :func:`monitor` polls EVERY rank's handle (a remote
+rank's early death can no longer hide behind a serial ``wait()`` on rank
+0) and, on a failed rank, kills the rest — SPMD cannot continue partial.
+``--supervise`` adds the recovery loop: relaunch the whole job with
+exponential backoff and a bounded restart budget, resuming from the
+latest auto-checkpoint (``--ckpt-dir`` exports ``HETU_AUTO_SAVE_DIR`` so
+workers auto-save and ``Executor.resume`` on restart).  A ``HETU_CHAOS``
+schedule with ``kill:proc@rank<r>:after<ms>`` faults is honored inside
+the monitor loop, making launcher-level failures reproducible tests.
+
 CLI: ``python -m hetu_tpu.launcher -c cluster.yml train.py [args...]``.
 """
 from __future__ import annotations
@@ -20,8 +30,11 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 
+from . import chaos as _chaos
 from .context import DistConfig
+from .metrics import record_fault
 
 
 def init_distributed(coordinator=None, num_processes=None, process_id=None):
@@ -76,12 +89,104 @@ def launch(config, script, script_args=(), local_devices=None, ssh=True,
             exports = " ".join(
                 f"{k}={shlex.quote(env[k])}" for k in
                 ("HETU_COORDINATOR", "HETU_NUM_PROCESSES",
-                 "HETU_PROCESS_ID", "XLA_FLAGS") if env.get(k))
+                 "HETU_PROCESS_ID", "XLA_FLAGS",
+                 # fault-tolerance knobs must reach remote ranks too —
+                 # otherwise --supervise --ckpt-dir silently restarts a
+                 # real cluster from scratch instead of resuming
+                 "HETU_AUTO_SAVE_DIR", "HETU_AUTO_SAVE_EVERY",
+                 "HETU_AUTO_SAVE_KEEP", "HETU_AUTO_RESUME", "HETU_CHAOS",
+                 "HETU_HEARTBEAT_MS", "HETU_MAX_FRAME_MB")
+                if env.get(k))
             remote_cmd = " ".join(shlex.quote(a) for a in cmd)
+            # -tt forces a tty so killing the LOCAL ssh client hangs up
+            # the remote session and the remote python dies with it —
+            # monitor()'s kill-the-remaining-ranks contract must reach
+            # the actual remote processes, not just their ssh clients
             procs.append(subprocess.Popen(
-                ["ssh", host,
+                ["ssh", "-tt", host,
                  f"cd {shlex.quote(os.getcwd())} && {exports} {remote_cmd}"]))
     return procs
+
+
+def monitor(procs, poll_s=0.2, chaos=None, log=None):
+    """Watch every rank's Popen until the job resolves.
+
+    Polls ALL handles (the old serial ``wait()`` in rank order could
+    block forever on rank 0 while rank 3 was already dead).  The first
+    nonzero/ signal exit fails the job: the remaining ranks are killed —
+    an SPMD program cannot continue with a partial world — and that exit
+    code is returned.  All-zero exits return 0.
+
+    ``chaos``: an active :class:`~hetu_tpu.chaos.ChaosInjector` whose
+    ``kill:proc@rank<r>:after<ms>`` faults are fired here.
+    """
+    t0 = time.monotonic()
+    live = dict(enumerate(procs))
+    while live:
+        if chaos is not None:
+            for r in chaos.due_proc_kills((time.monotonic() - t0) * 1e3):
+                p = live.get(r)
+                if p is not None and p.poll() is None:
+                    if log:
+                        log(f"chaos: killing rank {r}")
+                    p.kill()
+        for r, p in sorted(live.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del live[r]
+            if rc != 0:
+                if log:
+                    log(f"rank {r} exited rc={rc}; killing "
+                        f"{len(live)} remaining rank(s)")
+                for q in live.values():
+                    if q.poll() is None:
+                        q.kill()
+                for q in live.values():
+                    q.wait()
+                return rc
+        if live:
+            time.sleep(poll_s)
+    return 0
+
+
+def supervise(config, script, script_args=(), local_devices=None, ssh=True,
+              coordinator_port=8476, max_restarts=3, backoff_s=1.0,
+              poll_s=0.2, chaos=None, log=None):
+    """Supervising launcher: launch → monitor → (on failure) kill, back
+    off exponentially, relaunch the whole job — relaunched workers
+    resume from the latest complete auto-checkpoint (with
+    ``HETU_AUTO_SAVE_DIR`` + ``HETU_AUTO_RESUME=1`` exported — as
+    ``main`` does for ``--supervise --ckpt-dir`` — every Executor
+    auto-resumes at construction; scripts may also call
+    ``Executor.resume`` explicitly).  The restart budget is bounded;
+    once exhausted, the first nonzero exit code of the final attempt
+    propagates.
+    """
+    if chaos is None:
+        chaos = _chaos.active() or _chaos.install_from_env()
+    log = log or (lambda msg: print(f"[heturun] {msg}",
+                                    file=sys.stderr, flush=True))
+    attempt = 0
+    while True:
+        procs = launch(config, script, script_args,
+                       local_devices=local_devices, ssh=ssh,
+                       coordinator_port=coordinator_port)
+        rc = monitor(procs, poll_s=poll_s, chaos=chaos, log=log)
+        if rc == 0:
+            if attempt:
+                log(f"job recovered after {attempt} restart(s)")
+            return 0
+        if attempt >= max_restarts:
+            log(f"restart budget ({max_restarts}) exhausted; "
+                f"propagating rc={rc}")
+            return rc
+        delay = backoff_s * (2 ** attempt)
+        attempt += 1
+        record_fault("supervisor_restart")
+        log(f"job failed rc={rc}; restart {attempt}/{max_restarts} in "
+            f"{delay:.1f}s (workers resume from the latest checkpoint)")
+        time.sleep(delay)
 
 
 def main(argv=None):
@@ -95,6 +200,18 @@ def main(argv=None):
                    help="virtual device count per process (CPU testing)")
     p.add_argument("--no-ssh", action="store_true",
                    help="spawn all ranks locally (simulation)")
+    p.add_argument("--supervise", action="store_true",
+                   help="monitor ranks and relaunch the whole job from "
+                        "the latest checkpoint on a rank failure")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="supervision restart budget (default 3)")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   help="base seconds for exponential restart backoff")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="exported to workers as HETU_AUTO_SAVE_DIR: "
+                        "auto-save destination and resume source (also "
+                        "defaults HETU_AUTO_SAVE_EVERY to 100 steps "
+                        "unless the env already sets a cadence)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -104,13 +221,29 @@ def main(argv=None):
     else:
         n = args.num_hosts or 1
         config = DistConfig(num_hosts=n, hosts=["localhost"] * n)
+    if args.ckpt_dir:
+        # _host_env copies os.environ, so every rank inherits it
+        os.environ["HETU_AUTO_SAVE_DIR"] = args.ckpt_dir
+        # a dir with no cadence would never write a checkpoint (Executor
+        # defaults auto_save_every to 0 = off) and every supervised
+        # relaunch would silently restart from step 0 — default the
+        # cadence too; workers/env can still override it
+        os.environ.setdefault("HETU_AUTO_SAVE_EVERY", "100")
+        if args.supervise:
+            # relaunched workers must RESUME, not retrain: executors
+            # built under the supervisor restore the newest complete
+            # checkpoint at construction (no script changes needed)
+            os.environ.setdefault("HETU_AUTO_RESUME", "1")
+    if args.supervise:
+        return supervise(config, args.script, args.script_args,
+                         local_devices=args.local_devices,
+                         ssh=not args.no_ssh,
+                         max_restarts=args.max_restarts,
+                         backoff_s=args.restart_backoff)
     procs = launch(config, args.script, args.script_args,
                    local_devices=args.local_devices,
                    ssh=not args.no_ssh)
-    rc = 0
-    for pr in procs:
-        rc = pr.wait() or rc
-    return rc
+    return monitor(procs, chaos=_chaos.active() or _chaos.install_from_env())
 
 
 if __name__ == "__main__":

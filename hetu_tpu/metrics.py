@@ -1,6 +1,6 @@
 """Numpy-side metrics (reference ``python/hetu/metrics.py``: AUC:120,
 accuracy:154, precision/recall/F1:220-315) + host-side performance
-counters (flash-attention fallback accounting)."""
+counters (flash-attention fallback accounting, fault-tolerance events)."""
 from __future__ import annotations
 
 import collections
@@ -37,6 +37,42 @@ def flash_fallback_counts():
 def reset_flash_fallbacks():
     with _flash_lock:
         _flash_fallbacks.clear()
+
+
+# ------------------------------------------------------ fault-event counters
+# The fault-tolerance layer records every detection/recovery event here so
+# a run can PROVE what happened: transport retries (``ps_rpc_retry``),
+# exhausted peers (``ps_peer_unreachable``), injected chaos
+# (``chaos_drop``/``chaos_kill_ps``/...), dead ranks excluded from a
+# partial-reduce group (``preduce_dead_rank_excluded``), checkpoints
+# written/skipped (``auto_save``, ``emergency_save``,
+# ``ckpt_incomplete_skipped``), resumes (``resume``), and supervisor
+# restarts (``supervisor_restart``).  Invariant (asserted by the chaos
+# tests): every counter EXCEPT the ``auto_save`` bookkeeping records a
+# detected fault or a recovery action, so a clean run reports none of
+# those — and a clean run without auto-checkpointing records nothing at
+# all.  Surfaced by ``HetuProfiler.fault_counters()`` and ``bench.py
+# --config chaos``.
+
+_fault_counts = collections.Counter()
+_fault_lock = threading.Lock()
+
+
+def record_fault(kind, n=1):
+    """Count one fault-tolerance event (detection, injection, recovery)."""
+    with _fault_lock:
+        _fault_counts[str(kind)] += n
+
+
+def fault_counts():
+    """{kind: count} snapshot of recorded fault events."""
+    with _fault_lock:
+        return dict(_fault_counts)
+
+
+def reset_faults():
+    with _fault_lock:
+        _fault_counts.clear()
 
 
 def _np(x):

@@ -24,6 +24,8 @@ import time
 
 import numpy as np
 
+from ..metrics import record_fault
+
 
 class PartialReduce:
     """Controller + SPMD helpers for dynamic-group gradient averaging.
@@ -32,15 +34,51 @@ class PartialReduce:
     active-worker mask for this step. Arrival bookkeeping lives host-side:
     a pluggable ``arrival_fn`` in-process, or the distributed store's SSP
     clocks across processes (:class:`DistPartialReduce`).
+
+    ``alive_fn`` (optional) supplies a liveness mask (1 = rank alive, from
+    e.g. heartbeats): dead ranks are excluded from the group within one
+    wait window — graceful degradation instead of a hung collective — and
+    every exclusion is counted (``preduce_dead_rank_excluded``).
     """
 
     def __init__(self, n_workers, max_wait_ms=100.0, min_workers=2,
-                 arrival_fn=None):
+                 arrival_fn=None, alive_fn=None):
         self.n_workers = n_workers
         self.max_wait_ms = max_wait_ms
         self.min_workers = max(1, min_workers)
         self.arrival_fn = arrival_fn
+        self.alive_fn = alive_fn
         self._arrivals = {}
+
+    def _alive(self, rank):
+        """Liveness mask (own rank always alive — a worker asking for a
+        group is self-evidently not dead); None when liveness is off."""
+        if self.alive_fn is None:
+            return None
+        # copy: the in-place own-rank overwrite below must never mutate
+        # (or crash on a read-only view of) the provider's array
+        alive = np.array(self.alive_fn(),
+                         np.float32)[:self.n_workers].copy()
+        alive[rank] = 1.0
+        return alive
+
+    def _finalize(self, mask, rank, alive):
+        """Own-rank + dead-exclusion + min-workers discipline shared by
+        both group formers."""
+        mask[rank] = 1.0
+        if alive is not None:
+            dead = int((alive == 0).sum())
+            if dead:
+                record_fault("preduce_dead_rank_excluded", dead)
+            mask = mask * alive
+        if mask.sum() < self.min_workers:
+            # degrade to "everyone believed alive", never to ranks known
+            # dead — a full-ones fallback would hang the collective on
+            # exactly the failure liveness just detected
+            mask = np.ones(self.n_workers, np.float32) if alive is None \
+                else alive.copy()
+            mask[rank] = 1.0
+        return mask
 
     # -- host-side group formation ------------------------------------------
     def report_arrival(self, rank, step, t=None):
@@ -55,6 +93,7 @@ class PartialReduce:
         are in; the caller's own rank is always in (reference semantics:
         you are part of whatever group the PS hands you).
         """
+        alive = self._alive(rank)
         if self.arrival_fn is not None:
             mask = np.asarray(self.arrival_fn(step), np.float32)
         else:
@@ -67,10 +106,7 @@ class PartialReduce:
                 for r, t in arr.items():
                     if (t - t0) * 1e3 <= self.max_wait_ms:
                         mask[r] = 1.0
-        mask[rank] = 1.0
-        if mask.sum() < self.min_workers:
-            mask = np.ones(self.n_workers, np.float32)
-        return mask
+        return self._finalize(mask, rank, alive)
 
     # -- SPMD reduction ------------------------------------------------------
     @staticmethod
@@ -108,25 +144,52 @@ class DistPartialReduce(PartialReduce):
     CHANNEL = 1
 
     def __init__(self, store, n_workers=None, max_wait_ms=100.0,
-                 min_workers=2, poll_ms=5.0):
+                 min_workers=2, poll_ms=5.0, heartbeat_deadline_ms=None):
         super().__init__(n_workers or store.world,
                          max_wait_ms=max_wait_ms, min_workers=min_workers)
         self.store = store
         self.poll_ms = poll_ms
+        # liveness: with a deadline set, ranks whose heartbeat on rank 0
+        # is older than this are DEAD — excluded from the group and, more
+        # importantly, not waited for (a dead rank never arrives; waiting
+        # out max_wait_ms for it every step is the hang this kills)
+        self.heartbeat_deadline_ms = heartbeat_deadline_ms
         # idempotent server-side: safe for every rank to call
         store.ssp_init(self.n_workers, channel=self.CHANNEL)
 
     def report_arrival(self, rank, step, t=None):
         self.store.clock(rank, channel=self.CHANNEL)
 
+    def _alive(self, rank):
+        if self.heartbeat_deadline_ms is None:
+            return super()._alive(rank)     # explicit alive_fn still works
+        alive = self.store.alive_mask(
+            self.heartbeat_deadline_ms,
+            n_workers=self.n_workers).astype(np.float32)
+        alive[rank] = 1.0
+        return alive
+
     def get_partner(self, rank, step):
         """Active mask for this step from the shared clock vector.
 
         Assumes one ``report_arrival`` per worker per step, so arrival at
         step s ⇔ clock >= s+1 (every caller's own clock satisfies this
-        the moment it reports)."""
+        the moment it reports).  With liveness enabled, the wait loop
+        only holds for ranks still believed alive: a dead rank stops
+        gating group formation within one wait window."""
         target = step + 1
         deadline = time.monotonic() + self.max_wait_ms / 1e3
+        # liveness is sampled ONCE per group formation: it cannot change
+        # faster than the heartbeat interval, and an alive_mask RPC per
+        # 5 ms poll tick would multiply rank 0's load for nothing (a rank
+        # dying mid-window is excluded at the NEXT step's formation).  A
+        # failing liveness query degrades to liveness-off for this step —
+        # the graceful-degradation path must never itself be the crash.
+        try:
+            alive = self._alive(rank)
+        except RuntimeError:
+            record_fault("alive_mask_unavailable")
+            alive = None
         while True:
             clocks = self.store.clocks(channel=self.CHANNEL)
             if clocks.size < self.n_workers:
@@ -135,13 +198,14 @@ class DistPartialReduce(PartialReduce):
                     f"n_workers={self.n_workers} — ssp_init raced or ran "
                     f"with a smaller world")
             mask = (clocks[:self.n_workers] >= target).astype(np.float32)
-            if mask.sum() >= self.n_workers or time.monotonic() >= deadline:
+            # done = every rank we still wait for (all, or alive-only
+            # under liveness) has arrived
+            done = mask.sum() >= self.n_workers if alive is None \
+                else bool((mask >= alive).all())
+            if done or time.monotonic() >= deadline:
                 break
             time.sleep(self.poll_ms / 1e3)
-        mask[rank] = 1.0
-        if mask.sum() < self.min_workers:
-            mask = np.ones(self.n_workers, np.float32)
-        return mask
+        return self._finalize(mask, rank, alive)
 
 
 def preduce_mean(grad, mask, axis_name="dp"):

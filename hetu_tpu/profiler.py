@@ -237,6 +237,18 @@ class HetuProfiler:
         from .metrics import flash_fallback_counts
         return flash_fallback_counts()
 
+    @staticmethod
+    def fault_counters():
+        """{kind: count} of fault-tolerance events (``hetu_tpu.metrics``
+        registry): transport retries/exhaustions, chaos injections,
+        dead-rank exclusions, auto/emergency saves, resumes, supervisor
+        restarts.  Every entry except the routine ``auto_save``
+        bookkeeping is evidence of a detected fault or a recovery
+        action; a clean run reports none of those (and an empty dict
+        when auto-checkpointing is off)."""
+        from .metrics import fault_counts
+        return fault_counts()
+
     def memory_stats(self):
         """Per-device memory stats (reference polls pynvml)."""
         import jax

@@ -31,6 +31,7 @@ semantics), socket timeouts + reconnect, and dead-peer diagnostics.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import socket
 import struct
@@ -40,9 +41,11 @@ import time
 import numpy as np
 
 from .store import EmbeddingStore
+from .. import chaos as _chaos
+from ..metrics import record_fault
 
 OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
-    OP_SHUTDOWN, OP_CLOCKS = range(1, 9)
+    OP_SHUTDOWN, OP_CLOCKS, OP_HEARTBEAT, OP_ALIVE = range(1, 11)
 
 # op, table, nkeys, lr, payload_width, client rank, client sequence number.
 # (client, seq) lets the server DEDUPLICATE retried pushes: the transport
@@ -71,8 +74,26 @@ def _send_frame(sock, *parts):
     sock.sendall(struct.pack("<q", len(body)) + body)
 
 
+class FrameError(ConnectionError):
+    """Corrupt frame header — framing on this stream is unrecoverable, so
+    it subclasses ConnectionError: the server loop drops the connection
+    and the client retries on a fresh one."""
+
+
+#: hard cap on a decoded frame length; a corrupt/hostile length prefix must
+#: raise a clean protocol error, not ``bytearray(n)`` blowing up (negative)
+#: or a multi-GB allocation.  Configurable: ``HETU_MAX_FRAME_MB``.
+MAX_FRAME_BYTES = int(float(os.environ.get("HETU_MAX_FRAME_MB",
+                                           "1024")) * 1e6)
+
+
 def _recv_frame(sock):
     (n,) = struct.unpack("<q", _recv_exact(sock, 8))
+    if n < 0 or n > MAX_FRAME_BYTES:
+        record_fault("ps_bad_frame")
+        raise FrameError(
+            f"frame length {n} outside [0, {MAX_FRAME_BYTES}] "
+            f"(HETU_MAX_FRAME_MB) — corrupt or hostile peer")
     return _recv_exact(sock, n)
 
 
@@ -84,6 +105,8 @@ class StoreServer:
         self.local, self.world, self.rank = local, world, rank
         self._ssp_lock = threading.Condition()
         self._clocks = {}          # channel -> per-worker clock vector
+        self._hb = {}              # rank -> (monotonic last-seen, step)
+        self._hb_lock = threading.Lock()
         self._applied = {}         # client -> OrderedDict of recent push seqs
         self._applied_lock = threading.Lock()
         self._live_conns = set()
@@ -102,6 +125,12 @@ class StoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            if self._stop:      # raced a concurrent stop(): refuse service
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             self._live_conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
@@ -110,6 +139,13 @@ class StoreServer:
         try:
             while True:
                 body = _recv_frame(conn)
+                if self._stop:
+                    # a stopped server must refuse ALL service, even on a
+                    # connection that slipped past stop() (some platforms
+                    # don't wake a blocked accept on close) — serving
+                    # from a "dead" server would make kill-based fault
+                    # tests pass vacuously
+                    break
                 try:
                     stop = self._handle(conn, body)
                 except (ConnectionError, OSError):
@@ -214,6 +250,30 @@ class StoreServer:
             with self._ssp_lock:
                 v = self._clock_vec(channel).copy()
             _send_frame(conn, b"\x00", v.tobytes())
+        elif op == OP_HEARTBEAT:
+            # liveness ping: rank + current step.  Idempotent (a retried
+            # ping just refreshes the timestamp), so no dedup needed.
+            with self._hb_lock:
+                self._hb[int(keys[0])] = (time.monotonic(), int(keys[1]))
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_ALIVE:
+            # keys=[n_workers], lr carries deadline_ms: int64 mask, 1 iff
+            # the rank pinged within the deadline.  A rank that NEVER
+            # pinged counts alive: liveness only declares death for ranks
+            # it has seen alive (startup stagger — e.g. 30 s of backend
+            # init before the first ping — must not read as death; a
+            # rank that truly never starts is the launcher/supervisor's
+            # failure domain, not the heartbeat's).
+            n = int(keys[0])
+            deadline_s = (lr if lr > 0 else 10_000.0) / 1e3
+            now = time.monotonic()
+            mask = np.zeros(n, np.int64)
+            with self._hb_lock:
+                for r in range(n):
+                    rec = self._hb.get(r)
+                    mask[r] = 1 if rec is None else \
+                        int(now - rec[0] <= deadline_s)
+            _send_frame(conn, b"\x00", mask.tobytes())
         elif op == OP_SHUTDOWN:
             _send_frame(conn, b"\x00\x01")
             return True
@@ -223,6 +283,10 @@ class StoreServer:
 
     def stop(self):
         self._stop = True
+        try:    # shutdown (not just close) wakes a blocked accept() on
+            self._sock.shutdown(socket.SHUT_RDWR)   # platforms where
+        except OSError:                             # close() alone doesn't
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -265,6 +329,13 @@ class DistributedStore:
         self._tables = {}
         self._queue = queue.Queue(maxsize=async_queue)
         self._async_thread = None
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        # HETU_CHAOS=seed:spec activates the chaos harness for every store
+        # in the process; the server registers as a kill:ps target
+        inj = _chaos.active() or _chaos.install_from_env()
+        if inj is not None:
+            inj.register_server(rank, self.server)
 
     # -- connections -------------------------------------------------------
     def _conn(self, peer):
@@ -308,19 +379,41 @@ class DistributedStore:
         last_err = None
         for attempt in range(self.rpc_retries):
             if attempt:
+                record_fault("ps_rpc_retry")
                 time.sleep(min(1.0, 0.2 * attempt))
             try:
+                # chaos harness: the active schedule may drop, delay,
+                # duplicate, or wedge this frame (hetu_tpu.chaos); a clean
+                # run pays one global read
+                inj = _chaos.active()
+                act = inj.on_send(peer, op) if inj is not None else None
+                if act is not None and act[0] == "drop":
+                    raise TimeoutError("chaos: dropped frame")
                 sock, lock = self._conn(peer)
                 with lock:
                     sock.settimeout(op_timeout if op_timeout is not None
                                     else self.rpc_timeout)
+                    if act is not None and act[0] == "delay":
+                        time.sleep(act[1] / 1e3)
+                    elif act is not None and act[0] == "wedge":
+                        # hold the socket past the op deadline's spirit:
+                        # the client sees a timeout and retries fresh
+                        time.sleep(act[1] / 1e3)
+                        raise TimeoutError("chaos: wedged socket")
                     _send_frame(sock, hdr, keys.tobytes(), payload)
+                    if act is not None and act[0] == "dup":
+                        # at-least-once retry simulation: same (client,
+                        # seq) frame twice — the server's dedup window
+                        # must apply non-idempotent ops exactly once
+                        _send_frame(sock, hdr, keys.tobytes(), payload)
+                        _recv_frame(sock)       # discard the dup's ack
                     resp = _recv_frame(sock)
                 break
             except (TimeoutError, ConnectionError, OSError) as e:
                 last_err = e
                 self._drop_conn(peer)
         else:
+            record_fault("ps_peer_unreachable")
             host_, port_ = self.endpoints[peer] or ("?", "?")
             raise RuntimeError(
                 f"PS peer {peer} at {host_}:{port_} unreachable after "
@@ -465,6 +558,47 @@ class DistributedStore:
         raw = self._rpc(0, OP_CLOCKS, 0, np.asarray([channel], np.int64))
         return np.frombuffer(raw, np.int64).copy()
 
+    # -- liveness: heartbeats on rank 0 (the scheduler role) ---------------
+    def heartbeat(self, rank=None, step=0):
+        """Ping rank 0's liveness table with (rank, step)."""
+        w = self.rank if rank is None else rank
+        self._rpc(0, OP_HEARTBEAT, 0,
+                  np.asarray([w, step], np.int64))
+
+    def alive_mask(self, deadline_ms, n_workers=None):
+        """int64 mask over workers: 1 iff the rank heartbeated within
+        ``deadline_ms`` — or never heartbeated at all (liveness only
+        declares death for ranks it has seen alive; see the OP_ALIVE
+        handler).  The liveness feed for partial-reduce dead-rank
+        exclusion."""
+        n = self.world if n_workers is None else n_workers
+        raw = self._rpc(0, OP_ALIVE, 0, np.asarray([n], np.int64),
+                        lr=float(deadline_ms))
+        return np.frombuffer(raw, np.int64).copy()
+
+    def start_heartbeat(self, interval_ms=None, step_fn=None):
+        """Background liveness pings every ``interval_ms`` (env default
+        ``HETU_HEARTBEAT_MS``=500) until ``close``.  ``step_fn`` supplies
+        the step number reported with each ping (e.g. ``lambda:
+        ex.step_counter``).  A failing ping is counted
+        (``heartbeat_send_failed``) and retried next interval — a dead
+        scheduler must not crash the worker from a daemon thread."""
+        if self._hb_thread is not None:
+            return
+        iv = (float(os.environ.get("HETU_HEARTBEAT_MS", "500"))
+              if interval_ms is None else float(interval_ms)) / 1e3
+
+        def beat():
+            while not self._hb_stop.wait(iv):
+                try:
+                    self.heartbeat(step=int(step_fn()) if step_fn else 0)
+                except (RuntimeError, OSError, ConnectionError):
+                    record_fault("heartbeat_send_failed")
+
+        self._hb_thread = threading.Thread(
+            target=beat, daemon=True, name=f"hetu-hb-{self.rank}")
+        self._hb_thread.start()
+
     #: the server side blocks on a condition variable (OP_SSP_SYNC
     #: handler) — one RPC waits out the whole bound, no client polling
     ssp_blocking = True
@@ -490,6 +624,7 @@ class DistributedStore:
         self.local.load(table, f"{path}.shard{self.rank}")
 
     def close(self):
+        self._hb_stop.set()
         self.flush()
         if self._async_thread is not None:
             self._queue.put(None)

@@ -425,3 +425,51 @@ def test_orbax_checkpoint_bitwise_resume(tmp_path):
         seed=0)
     ex3.load_orbax(ckpt, params_only=True)
     assert ex3.step_counter == 0
+
+
+def test_manual_save_is_atomic_with_manifest(tmp_path):
+    """ISSUE 2 satellite: save assembles in <path>.saving and publishes by
+    rename with a size manifest in meta.json — leftovers of a preempted
+    save are cleaned, overwrite keeps the old checkpoint valid until the
+    new one is complete, and truncation is detectable."""
+    import json
+    import os
+    from hetu_tpu.graph.executor import Executor
+
+    x, y_, loss, logits, _ = _mlp_graph()
+    opt = ht.optim.AdamOptimizer(0.01).minimize(loss)
+    ex = ht.Executor({"train": [loss, opt]}, seed=0)
+    xv, yv = _data()
+    ex.run("train", feed_dict={x: xv, y_: yv})
+
+    p = str(tmp_path / "ck")
+    # leftover work dir from a preempted earlier save must not break it
+    os.makedirs(p + ".saving")
+    open(os.path.join(p + ".saving", "junk"), "w").close()
+    ex.save(p)
+    assert not os.path.exists(p + ".saving")
+    assert Executor._checkpoint_complete(p)
+    with open(os.path.join(p, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["manifest"], "manifest missing"
+    for rel, size in meta["manifest"].items():
+        assert os.path.getsize(os.path.join(p, rel)) == size, rel
+
+    # overwrite in place: a second save over the same path publishes the
+    # newer step atomically
+    ex.run("train", feed_dict={x: xv, y_: yv})
+    ex.save(p)
+    with open(os.path.join(p, "meta.json")) as f:
+        assert json.load(f)["step"] == 2
+    assert not os.path.exists(p + ".replaced")
+
+    # truncation (preemption mid-write of a tensor) is detected
+    rel = sorted(meta["manifest"])[0]
+    with open(os.path.join(p, rel), "r+b") as f:
+        f.truncate(3)
+    assert not Executor._checkpoint_complete(p)
+
+    # legacy single-file blob path stays atomic too (tmp + replace)
+    ex.save(str(tmp_path / "legacy"), file="blob.hetu")
+    assert os.path.exists(str(tmp_path / "legacy" / "blob.hetu"))
+    assert not os.path.exists(str(tmp_path / "legacy" / "blob.hetu.tmp"))

@@ -570,3 +570,95 @@ def test_multiprocess_save_then_fresh_resume(tmp_path):
     resumed = round(float(ex.run("train", feed_dict={x: xv, y_: yv}
                                  )[0].asnumpy()), 7)
     np.testing.assert_allclose(resumed, nxt["0"], rtol=2e-5)
+
+
+# ----------------------------------------------- supervising launcher
+# These spawn trivial python children (no jax import), so they stay
+# tier-1 cheap despite being real multiprocess launches.
+
+def _write(tmp_path, name, body):
+    import textwrap as _tw
+    p = tmp_path / name
+    p.write_text(_tw.dedent(body))
+    return str(p)
+
+
+def test_monitor_detects_early_remote_rank_death(tmp_path):
+    """The old main() wait()ed serially in rank order and could block
+    forever on rank 0 while rank 3 was already dead; monitor polls all
+    handles and kills the stragglers."""
+    import time as _time
+    from hetu_tpu import launcher
+    script = _write(tmp_path, "die.py", """
+        import os, sys, time
+        if int(os.environ.get("HETU_PROCESS_ID", "0")) == 1:
+            sys.exit(3)
+        time.sleep(30)
+    """)
+    t0 = _time.monotonic()
+    rc = launcher.main(["--no-ssh", "-n", "2", script])
+    assert rc == 3
+    assert _time.monotonic() - t0 < 20, "serial wait blocked on rank 0"
+
+
+def test_supervise_restarts_until_success(tmp_path):
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    reset_faults()
+    marker = tmp_path / "attempt1.done"
+    script = _write(tmp_path, "flaky.py", f"""
+        import os, sys
+        if int(os.environ.get("HETU_PROCESS_ID", "0")) == 1:
+            if not os.path.exists({str(marker)!r}):
+                open({str(marker)!r}, "w").close()
+                sys.exit(5)        # first attempt: rank 1 dies
+        sys.exit(0)
+    """)
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    rc = launcher.supervise(config, script, max_restarts=2,
+                            backoff_s=0.05, ssh=False,
+                            log=lambda m: None)
+    assert rc == 0
+    assert fault_counts().get("supervisor_restart", 0) == 1
+    reset_faults()
+
+
+def test_supervise_budget_exhausted_propagates_rc(tmp_path):
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    from hetu_tpu.metrics import reset_faults
+    script = _write(tmp_path, "alwaysfail.py", """
+        import sys
+        sys.exit(7)
+    """)
+    config = DistConfig(num_hosts=1, hosts=["localhost"])
+    rc = launcher.supervise(config, script, max_restarts=1,
+                            backoff_s=0.05, ssh=False,
+                            log=lambda m: None)
+    assert rc == 7
+    reset_faults()
+
+
+def test_supervise_chaos_proc_kill_then_recovery(tmp_path):
+    """A HETU_CHAOS kill:proc fault kills rank 0 mid-run (fires once);
+    the supervisor relaunches and the second attempt completes."""
+    from hetu_tpu import launcher
+    from hetu_tpu.chaos import ChaosInjector
+    from hetu_tpu.context import DistConfig
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    reset_faults()
+    script = _write(tmp_path, "sleeper.py", """
+        import time
+        time.sleep(1.5)
+    """)
+    inj = ChaosInjector.from_spec("3:kill:proc@rank0:after300")
+    config = DistConfig(num_hosts=1, hosts=["localhost"])
+    rc = launcher.supervise(config, script, max_restarts=2,
+                            backoff_s=0.05, chaos=inj,
+                            log=lambda m: None)
+    assert rc == 0
+    fc = fault_counts()
+    assert fc.get("chaos_kill_proc", 0) == 1
+    assert fc.get("supervisor_restart", 0) == 1
+    reset_faults()
