@@ -16,7 +16,13 @@ NUM_SPARSE = 26
 
 
 def _embed(ids_node, vocab, dim, mode, lr, name):
-    """Shared embedding: dense variable or PS/cache host table."""
+    """Shared embedding: dense variable or PS/cache host table.
+
+    Modes: ``dense`` (in-graph variable), ``ps`` (direct host store, no
+    cache), ``lru``/``lfu``/``lfuopt`` (native C++ HET cache), and
+    ``vlru``/``vlfu`` (the vectorized numpy HET cache —
+    :class:`hetu_tpu.ps.DistCacheTable` — the batched sparse-RPC path
+    ``bench.py --config wdl --emb-policy`` exercises)."""
     if mode == "dense":
         table = ht.Variable(
             name, initializer=ht.init.GenNormal(0.0, 0.01), shape=(vocab, dim),
@@ -27,7 +33,16 @@ def _embed(ids_node, vocab, dim, mode, lr, name):
         t = store.init_table(vocab, dim, opt="sgd", lr=lr, seed=0,
                              init_scale=0.01)
         return ht.ps_embedding_lookup_op((store, t), ids_node, width=dim)
-    # cache policies: lru / lfu / lfuopt
+    if mode in ("vlru", "vlfu"):
+        from hetu_tpu.ps import DistCacheTable, EmbeddingStore
+        store = EmbeddingStore()
+        t = store.init_table(vocab, dim, opt="sgd", lr=lr, seed=0,
+                             init_scale=0.01)
+        cache = DistCacheTable(store, t, limit=max(vocab // 10, 256),
+                               pull_bound=10, push_bound=10,
+                               policy=mode[1:])
+        return ht.ps_embedding_lookup_op(cache, ids_node, width=dim)
+    # native cache policies: lru / lfu / lfuopt
     cs = ht.CacheSparseTable(limit=max(vocab // 10, 256), length=vocab,
                              width=dim, policy=mode, bound=10, opt="sgd",
                              lr=lr, seed=0)

@@ -34,7 +34,8 @@ def main():
     p.add_argument("--model", default="wdl",
                    choices=["wdl", "deepfm", "dcn"])
     p.add_argument("--embed", default="dense",
-                   choices=["dense", "ps", "lru", "lfu", "lfuopt"])
+                   choices=["dense", "ps", "lru", "lfu", "lfuopt",
+                            "vlru", "vlfu"])
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--vocab", type=int, default=100000)
     p.add_argument("--dim", type=int, default=16)

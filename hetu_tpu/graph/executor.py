@@ -986,6 +986,23 @@ class Executor:
             f.result()
         self._ps_futures = []
 
+    def _flush_ps_caches(self):
+        """Push every embedding cache's accumulated (push-bound-pending)
+        grads to the store.  Save paths call this after :meth:`ps_flush`:
+        PS tables persist SERVER-side, so grads still sitting in a client
+        cache would otherwise be absent from the checkpoint — and lost
+        entirely when a preempted process resumes from it.  Not part of
+        ``ps_flush`` itself: that runs on per-step multiprocess barriers,
+        where a forced flush would defeat ``push_bound``."""
+        flushed = set()
+        for se in self.subexecutors.values():
+            for node in getattr(se, "ps_nodes", []):
+                cache = getattr(node, "cache", None)
+                if cache is not None and id(cache) not in flushed \
+                        and hasattr(cache, "flush"):
+                    flushed.add(id(cache))
+                    cache.flush()
+
     # -- fault tolerance: auto-checkpoint, preemption, resume --------------
 
     def _post_step(self, training):
@@ -1226,10 +1243,24 @@ class Executor:
         pool = getattr(self, "_ps_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
+        closed = set()
         for se in getattr(self, "subexecutors", {}).values():
             pp = getattr(se, "_prefetch_pool", None)
             if pp is not None:
                 pp.shutdown(wait=False)
+            # embedding caches owned by this graph: flush pending grads
+            # and release their resources (CacheSparseTable leaked its
+            # per-table ThreadPoolExecutor without this)
+            for node in getattr(se, "ps_nodes", []):
+                cache = getattr(node, "cache", None)
+                if cache is None or id(cache) in closed \
+                        or not hasattr(cache, "close"):
+                    continue
+                closed.add(id(cache))
+                try:
+                    cache.close()
+                except Exception:
+                    pass
 
     def _opt_rename_maps(self, op):
         """(nodekey→param-name, param-name→nodekey) for one optimizer op —
@@ -1319,7 +1350,8 @@ class Executor:
         ``path`` untouched or a work dir ``resume`` never considers;
         never a half-written checkpoint that validates."""
         self.ps_flush()  # ASP pushes must land before persisting
-        import json
+        self._flush_ps_caches()  # cache-pending grads too: tables persist
+        import json                 # server-side
         import os
         import shutil
         import jax
@@ -1449,6 +1481,7 @@ class Executor:
                 "save_orbax is single-process; multiprocess meshes use "
                 "save() (collective fetch + rank-0 writes)")
         self.ps_flush()
+        self._flush_ps_caches()
         tree = {
             "params": {self.var_names[n]: self._fetch_host(v)
                        for n, v in self.var_values.items()},

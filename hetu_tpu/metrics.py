@@ -75,6 +75,46 @@ def reset_faults():
         _fault_counts.clear()
 
 
+# ------------------------------------------------- cache / sparse-RPC counters
+# The HET embedding cache (``ps/dist_store.py:DistCacheTable``) and the
+# sparse transport (``DistributedStore.pull/push/push_pull``) record their
+# batching wins here: rows served from cache vs refreshed
+# (``emb_cache_hit_rows`` / ``emb_cache_miss_rows``), rows evicted
+# (``emb_cache_evict_rows``), rows pushed and the number of BATCHED push
+# round trips that carried them (``emb_cache_push_rows`` /
+# ``emb_cache_push_rpcs`` — the pre-PR per-key path paid one RPC per row),
+# redundant rows/bytes that client-side ``np.unique`` dedup eliminated
+# BEFORE the shard fanout (``ps_dedup_{pull,push}_{rows,bytes}_saved`` —
+# the saving covers the local shard's share too, so on a w-rank store
+# (w-1)/w of it is wire traffic), and round trips where a fused
+# ``OP_PUSH_PULL`` frame carried both a push and a pull shard
+# (``ps_push_pull_fused_rpcs``).  Invariant (asserted by the tests):
+# only sparse-PS traffic records here, so a clean dense run reports an
+# empty dict.  Surfaced by ``HetuProfiler.cache_counters()`` and
+# ``bench.py --config emb``.
+
+_cache_counts = collections.Counter()
+_cache_lock = threading.Lock()
+
+
+def record_cache(kind, n=1):
+    """Count ``n`` cache/sparse-transport events of ``kind``."""
+    if n:
+        with _cache_lock:
+            _cache_counts[str(kind)] += int(n)
+
+
+def cache_counts():
+    """{kind: count} snapshot of cache/dedup/batching counters."""
+    with _cache_lock:
+        return dict(_cache_counts)
+
+
+def reset_cache_counts():
+    with _cache_lock:
+        _cache_counts.clear()
+
+
 def _np(x):
     return np.asarray(x)
 

@@ -238,6 +238,16 @@ class HetuProfiler:
         return flash_fallback_counts()
 
     @staticmethod
+    def cache_counters():
+        """{kind: count} of HET-cache / sparse-transport batching events
+        (``hetu_tpu.metrics`` registry): cache hit/miss/evict rows, rows
+        per batched push RPC, wire rows+bytes saved by ``np.unique``
+        dedup, fused push+pull round trips.  Only sparse-PS traffic
+        records here — a clean dense run reports an empty dict."""
+        from .metrics import cache_counts
+        return cache_counts()
+
+    @staticmethod
     def fault_counters():
         """{kind: count} of fault-tolerance events (``hetu_tpu.metrics``
         registry): transport retries/exhaustions, chaos injections,

@@ -6,7 +6,10 @@ ps-lite server (ps-lite/include/ps/…) and hetu_cache client
 """
 from .store import EmbeddingStore, default_store
 from .cstable import CacheSparseTable
+from .dist_store import DistCacheTable, DistributedStore
+from .refcache import PerKeyCacheTable
 from .ops import PSEmbeddingLookupOp, ps_embedding_lookup_op
 
 __all__ = ["EmbeddingStore", "default_store", "CacheSparseTable",
+           "DistCacheTable", "DistributedStore", "PerKeyCacheTable",
            "PSEmbeddingLookupOp", "ps_embedding_lookup_op"]

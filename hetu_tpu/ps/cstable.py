@@ -47,6 +47,18 @@ class CacheSparseTable:
                 pull_bound, push_bound)
         self._pool = ThreadPoolExecutor(max_workers=1)  # ordered async ops
 
+    def _ensure_pool(self):
+        """The async pool, revived if a previous ``close()`` shut it down.
+
+        ``Executor.__del__`` closes the caches its graphs reference, but a
+        cache can outlive that executor (shared across graphs, or the
+        executor was rebound mid-experiment) — the next async op then
+        re-spawns the worker instead of dying on a closed pool; an unused
+        closed cache still leaks nothing."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        return self._pool
+
     # -- bounds ------------------------------------------------------------
     def set_pull_bound(self, bound):
         if self._h:
@@ -103,10 +115,10 @@ class CacheSparseTable:
         keys = np.asarray(keys)
         if dest is None:
             dest = np.empty(keys.shape + (self.width,), np.float32)
-        return self._pool.submit(self._lookup_sync, keys, dest)
+        return self._ensure_pool().submit(self._lookup_sync, keys, dest)
 
     def embedding_update(self, keys, grads):
-        return self._pool.submit(self._update_sync, keys, grads)
+        return self._ensure_pool().submit(self._update_sync, keys, grads)
 
     def embedding_push_pull(self, push_keys, grads, pull_keys, dest=None):
         if dest is None:
@@ -116,14 +128,52 @@ class CacheSparseTable:
         def run():
             self._update_sync(push_keys, grads)
             return self._lookup_sync(np.asarray(pull_keys), dest)
-        return self._pool.submit(run)
+        return self._ensure_pool().submit(run)
 
     # -- maintenance -------------------------------------------------------
     def flush(self):
         """Push every dirty cached row to the store (checkpoint barrier)."""
-        self._pool.submit(lambda: None).result()  # drain queue
+        if self._pool is not None:
+            self._pool.submit(lambda: None).result()  # drain queue
         if self._h:
             self._lib.hetu_cache_flush(self._h)
+
+    def close(self):
+        """Flush, then shut the per-table async pool down.  Idempotent.
+
+        Without this every CacheSparseTable leaked its
+        ``ThreadPoolExecutor`` (worker thread + queue) for the process
+        lifetime; ``Executor.__del__``'s teardown calls it for every
+        cache its graphs own, and ``__del__`` covers direct users.
+
+        Teardown traps this must survive (both observed as interpreter
+        hangs): (1) when ``__del__`` fires inside a GC pass, the pool
+        OBJECT may already be collected — its weakref callback woke the
+        worker, which exited — so a drain via ``submit().result()`` would
+        queue a task no thread will ever run and block forever;
+        ``shutdown(wait=True)`` drains pending work when the worker is
+        alive and joins instantly when it's dead.  (2) GC can run ON the
+        pool's own worker thread, where any blocking join deadlocks —
+        detected and degraded to ``wait=False``."""
+        import sys
+        import threading
+        pool = self._pool
+        if pool is None:
+            return
+        self._pool = None
+        if sys.is_finalizing():
+            return      # runtime teardown: joining/flushing segfaults
+        on_own_worker = threading.current_thread() in \
+            getattr(pool, "_threads", ())
+        try:
+            pool.shutdown(wait=not on_own_worker)
+        except Exception:
+            pass        # interpreter already past futures teardown
+        try:
+            if self._h:
+                self._lib.hetu_cache_flush(self._h)
+        except Exception:
+            pass        # native lib may already be unloaded at teardown
 
     def perf(self):
         if not self._h:
@@ -145,8 +195,9 @@ class CacheSparseTable:
 
     def __del__(self):
         try:
+            self.close()
             if getattr(self, "_h", None):
-                self._pool.shutdown(wait=True)
                 self._lib.hetu_cache_destroy(self._h)
+                self._h = None
         except Exception:
             pass

@@ -42,10 +42,14 @@ import numpy as np
 
 from .store import EmbeddingStore
 from .. import chaos as _chaos
-from ..metrics import record_fault
+from ..metrics import record_cache, record_fault
 
 OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
     OP_SHUTDOWN, OP_CLOCKS, OP_HEARTBEAT, OP_ALIVE = range(1, 11)
+#: fused push+pull (reference PsfType kSDPushPull): keys frame carries
+#: ``[npush, push_keys..., pull_keys...]``, payload carries the grads —
+#: one round trip per peer instead of serial push-then-pull
+OP_PUSH_PULL = 11
 
 # op, table, nkeys, lr, payload_width, client rank, client sequence number.
 # (client, seq) lets the server DEDUPLICATE retried pushes: the transport
@@ -55,6 +59,31 @@ OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
 _HDR = struct.Struct("<BiqdIqq")
 #: retried pushes are remembered per client this many ops back
 _DEDUP_WINDOW = 4096
+
+
+def _segment_sum(grads, inv, counts):
+    """Per-unique-key float32 grad sums (the client-side half of wire
+    dedup).  A one-hot CSR matmul when scipy is present — numpy's own
+    scatter-reductions (``ufunc.at``, ``reduceat``) are scalar-dispatched
+    and ~5x slower on the (batch, width) slabs this path moves; scipy
+    ships with jax, so the fallback exists only for exotic builds.
+    Summation association may differ from a per-occurrence loop by
+    float32 rounding; every cache/transport DECISION is value-independent
+    (keys and counters only), so semantics are unaffected."""
+    if counts.size == inv.size:         # all keys distinct: reorder only
+        return np.ascontiguousarray(grads[np.argsort(inv, kind="stable")])
+    try:
+        from scipy import sparse as _sp
+        onehot = _sp.csr_matrix(
+            (np.ones(inv.size, np.float32), inv,
+             np.arange(inv.size + 1, dtype=np.int64)),
+            shape=(inv.size, counts.size))
+        return np.asarray(onehot.T @ grads, np.float32)
+    except ImportError:
+        order = np.argsort(inv, kind="stable")
+        starts = np.zeros(counts.size, np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        return np.add.reduceat(grads[order], starts, axis=0)
 
 
 def _recv_exact(sock, n):
@@ -202,6 +231,20 @@ class StoreServer:
                                       off).reshape(nkeys, width)
                 self.local.push(table, keys // self.world, grads, lr)
             _send_frame(conn, b"\x00\x01")
+        elif op == OP_PUSH_PULL:
+            # fused SDPushPull: apply the push shard, answer the pull shard,
+            # one ack.  The push half is as non-idempotent as OP_PUSH — a
+            # retried frame skips it but still serves the (idempotent) pull.
+            npush = int(keys[0])
+            push_keys = keys[1:1 + npush]
+            pull_keys = keys[1 + npush:]
+            if npush and not self._seen(client, seq):
+                grads = np.frombuffer(body, np.float32, npush * width,
+                                      off).reshape(npush, width)
+                self.local.push(table, push_keys // self.world, grads, lr)
+            out = self.local.pull(table, pull_keys // self.world)
+            _send_frame(conn, b"\x00",
+                        np.ascontiguousarray(out, np.float32).tobytes())
         elif op == OP_VERSIONS:
             v = self.local.versions(table, keys // self.world)
             _send_frame(conn, b"\x00",
@@ -451,12 +494,50 @@ class DistributedStore:
         return self._tables[table][1]
 
     # -- sparse ops (EmbeddingStore API) -----------------------------------
+    # Wire-level dedup: a zipf-skewed CTR batch (2048x26 ids) is MOSTLY
+    # duplicate keys — pull/push collapse to unique keys with ``np.unique``
+    # BEFORE the shard fanout and scatter results back through the inverse
+    # index, so the wire carries each row once.  Semantics are unchanged:
+    # the server already accumulates duplicate keys within one push
+    # (store.py _push_locked / the native core), so pre-summing duplicate
+    # grads client-side yields the identical optimizer step and the same
+    # per-key version bump.  The saved traffic is counted in
+    # ``hetu_tpu.metrics`` (``ps_dedup_*``) — GC3's batching-over-many-
+    # small-messages discipline, applied to the sparse path.
+
+    @staticmethod
+    def _sorted_unique(flat):
+        """True iff already strictly ascending — the HET cache hands over
+        pre-deduped sorted keys, so the wire path skips a re-dedup."""
+        return flat.size <= 1 or bool(np.all(np.diff(flat) > 0))
+
+    def _dedup_grads(self, keys, grads, width):
+        """(unique_keys, per-unique summed grads); counts saved rows."""
+        if self._sorted_unique(keys):
+            return keys, grads
+        uk, inv, counts = np.unique(keys, return_inverse=True,
+                                    return_counts=True)
+        if uk.size < keys.size:
+            record_cache("ps_dedup_push_rows_saved", keys.size - uk.size)
+            record_cache("ps_dedup_push_bytes_saved",
+                         (keys.size - uk.size) * (width * 4 + 8))
+        return uk, _segment_sum(grads, inv, counts)
+
     def pull(self, table, keys):
         keys = np.ascontiguousarray(keys, np.int64)
         flat = keys.reshape(-1)
         rows, width = self._tables[table]
-        out = np.empty((flat.size, width), np.float32)
-        owners = flat % self.world
+        if self._sorted_unique(flat):
+            uk, inv = flat, None
+        else:
+            uk, inv = np.unique(flat, return_inverse=True)
+            if uk.size < flat.size:
+                record_cache("ps_dedup_pull_rows_saved",
+                             flat.size - uk.size)
+                record_cache("ps_dedup_pull_bytes_saved",
+                             (flat.size - uk.size) * (width * 4 + 8))
+        out = np.empty((uk.size, width), np.float32)
+        owners = uk % self.world
         jobs = []
         for r in range(self.world):
             sel = np.nonzero(owners == r)[0]
@@ -464,21 +545,26 @@ class DistributedStore:
                 continue
             if r == self.rank:
                 jobs.append(lambda sel=sel: out.__setitem__(
-                    sel, self.local.pull(table, flat[sel] // self.world)))
+                    sel, self.local.pull(table, uk[sel] // self.world)))
             else:
                 def job(r=r, sel=sel):
-                    raw = self._rpc(r, OP_PULL, table, flat[sel])
+                    raw = self._rpc(r, OP_PULL, table, uk[sel])
                     out[sel] = np.frombuffer(raw, np.float32).reshape(
                         sel.size, width)
                 jobs.append(job)
         self._fanout(jobs)
+        if inv is not None:
+            out = out[inv]
         return out.reshape(keys.shape + (width,))
 
     def push(self, table, keys, grads, lr=-1.0):
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
         rows, width = self._tables[table]
+        if not keys.size:
+            return
         grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
-        owners = keys % self.world
+        uk, acc = self._dedup_grads(keys, grads, width)
+        owners = uk % self.world
         jobs = []
         for r in range(self.world):
             sel = np.nonzero(owners == r)[0]
@@ -486,31 +572,100 @@ class DistributedStore:
                 continue
             if r == self.rank:
                 jobs.append(lambda sel=sel: self.local.push(
-                    table, keys[sel] // self.world, grads[sel], lr))
+                    table, uk[sel] // self.world, acc[sel], lr))
             else:
                 jobs.append(lambda r=r, sel=sel: self._rpc(
-                    r, OP_PUSH, table, keys[sel],
-                    np.ascontiguousarray(grads[sel]).tobytes(), lr, width))
+                    r, OP_PUSH, table, uk[sel],
+                    np.ascontiguousarray(acc[sel]).tobytes(), lr, width))
         self._fanout(jobs)
 
     def push_pull(self, table, push_keys, grads, pull_keys, lr=-1.0):
-        self.push(table, push_keys, grads, lr)
-        return self.pull(table, pull_keys)
+        """Fused SDPushPull: each peer gets ONE ``OP_PUSH_PULL`` round trip
+        carrying its push shard + pull shard (server applies the push
+        before answering the pull), instead of a serial push fanout
+        followed by a pull fanout.  Rows are owner-partitioned, so a pull
+        only ever depends on the pushes riding the same frame."""
+        push_keys = np.ascontiguousarray(push_keys, np.int64).reshape(-1)
+        pull_arr = np.ascontiguousarray(pull_keys, np.int64)
+        pflat = pull_arr.reshape(-1)
+        rows, width = self._tables[table]
+        if not push_keys.size:
+            return self.pull(table, pull_arr)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            push_keys.size, -1)
+        upk, acc = self._dedup_grads(push_keys, grads, width)
+        if self._sorted_unique(pflat):
+            ulk, linv = pflat, None
+        else:
+            ulk, linv = np.unique(pflat, return_inverse=True)
+            record_cache("ps_dedup_pull_rows_saved", pflat.size - ulk.size)
+            record_cache("ps_dedup_pull_bytes_saved",
+                         (pflat.size - ulk.size) * (width * 4 + 8))
+        out = np.empty((ulk.size, width), np.float32)
+        powners = upk % self.world
+        lowners = ulk % self.world
+        jobs = []
+        for r in range(self.world):
+            psel = np.nonzero(powners == r)[0]
+            lsel = np.nonzero(lowners == r)[0]
+            if not psel.size and not lsel.size:
+                continue
+            if r == self.rank:
+                def local_job(psel=psel, lsel=lsel):
+                    if psel.size:
+                        self.local.push(table, upk[psel] // self.world,
+                                        acc[psel], lr)
+                    if lsel.size:
+                        out[lsel] = self.local.pull(
+                            table, ulk[lsel] // self.world)
+                jobs.append(local_job)
+            elif psel.size:
+                def fused_job(r=r, psel=psel, lsel=lsel):
+                    frame_keys = np.concatenate(
+                        (np.asarray([psel.size], np.int64),
+                         upk[psel], ulk[lsel]))
+                    raw = self._rpc(
+                        r, OP_PUSH_PULL, table, frame_keys,
+                        np.ascontiguousarray(acc[psel]).tobytes(), lr,
+                        width)
+                    if lsel.size:
+                        out[lsel] = np.frombuffer(raw, np.float32).reshape(
+                            lsel.size, width)
+                        # only a frame that genuinely carried BOTH halves
+                        # counts as a saved round trip
+                        record_cache("ps_push_pull_fused_rpcs", 1)
+                jobs.append(fused_job)
+            else:       # nothing to push at this peer: plain pull
+                def pull_job(r=r, lsel=lsel):
+                    raw = self._rpc(r, OP_PULL, table, ulk[lsel])
+                    out[lsel] = np.frombuffer(raw, np.float32).reshape(
+                        lsel.size, width)
+                jobs.append(pull_job)
+        self._fanout(jobs)
+        if linv is not None:
+            out = out[linv]
+        return out.reshape(pull_arr.shape + (width,))
 
     def versions(self, table, keys):
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
-        out = np.empty(keys.size, np.int64)
-        owners = keys % self.world
+        uk, inv = np.unique(keys, return_inverse=True)
+        out = np.empty(uk.size, np.int64)
+        owners = uk % self.world
+        jobs = []
         for r in range(self.world):
             sel = np.nonzero(owners == r)[0]
             if not sel.size:
                 continue
             if r == self.rank:
-                out[sel] = self.local.versions(table, keys[sel] // self.world)
+                jobs.append(lambda sel=sel: out.__setitem__(
+                    sel, self.local.versions(table, uk[sel] // self.world)))
             else:
-                raw = self._rpc(r, OP_VERSIONS, table, keys[sel])
-                out[sel] = np.frombuffer(raw, np.int64)
-        return out
+                def vjob(r=r, sel=sel):
+                    raw = self._rpc(r, OP_VERSIONS, table, uk[sel])
+                    out[sel] = np.frombuffer(raw, np.int64)
+                jobs.append(vjob)
+        self._fanout(jobs)
+        return out[inv]
 
     # -- ASP: bounded async push (reference asp prefetch path) -------------
     def _async_worker(self):
@@ -640,93 +795,472 @@ class DistributedStore:
 
 
 class DistCacheTable:
-    """HET bounded-staleness cache over a :class:`DistributedStore`
-    (cross-host variant of the native ``CacheSparseTable``; reference
-    ``src/hetu_cache/cache.h:21`` pull_bound_/push_bound_ semantics).
+    """HET bounded-staleness embedding cache — fully vectorized, batch-
+    granular (reference ``src/hetu_cache/cache.h:21`` pull_bound_/
+    push_bound_ semantics; HET VLDB'22).  Works over any store exposing
+    the EmbeddingStore sparse API (:class:`DistributedStore` across hosts,
+    or a plain :class:`~hetu_tpu.ps.store.EmbeddingStore` locally).
 
-    - ``pull_bound``: a cached row may serve at most this many lookups
-      before it must be re-pulled from its owner.
-    - ``push_bound``: local gradient updates accumulate per row and are
-      pushed to the owner once this many are pending (or on ``flush``).
-    - LRU eviction at ``limit`` rows; evicting a dirty row pushes it.
+    Storage is a contiguous ``(limit, width)`` float32 slab plus an
+    open-addressed int64 key→slot hash table in numpy — no per-key Python
+    objects anywhere.  ``lookup``/``update`` are vectorized hit/miss
+    partitions; LRU/LFU eviction picks victims with one ``lexsort`` over
+    per-slot clocks; gradients accumulate via ``np.add.at`` into a dirty
+    slab; and EVERY pending push (miss-refresh, eviction, push-bound
+    overflow, ``flush``) rides ONE batched ``store.push`` — grouped per
+    owner rank by the store's shard fanout — instead of the pre-PR one
+    single-row RPC per dirty key.  A miss-refresh that also has pushes
+    pending fuses both into one ``store.push_pull`` round trip per peer.
+
+    Contract (the per-key reference model in ``refcache.py`` implements
+    the SAME rules — the parity suite holds the two bitwise equal):
+
+    - Decisions are BATCH-granular over the call's sorted unique keys: a
+      key is a HIT iff cached with ``uses < pull_bound``; all its
+      occurrences serve the same row, and ``uses`` grows by the
+      occurrence count.  A refresh (stale or absent) re-pulls the row and
+      restarts ``uses`` at the occurrence count.
+    - ``update`` accumulates per-key grads client-side (``gcnt`` grows by
+      occurrence count); reaching ``push_bound`` pushes the accumulated
+      grad and invalidates the local row (``uses = pull_bound``), as does
+      ``flush``.  Updating an uncached key allocates a grad-only slot
+      whose row never serves (born stale).
+    - Eviction at ``limit``: victims are the smallest ``(last-use tick,
+      key)`` [LRU] or ``(freq, tick, key)`` [LFU] among slots not touched
+      by the current batch; dirty victims join the batched push.  If a
+      single batch's unique keys exceed capacity, the sorted-first keys
+      get slots and the remainder are served (and their grads pushed)
+      uncached.
     """
 
-    def __init__(self, store: DistributedStore, table, limit=1 << 16,
-                 pull_bound=100, push_bound=10, lr=-1.0):
+    _EMPTY, _TOMB = -1, -2
+
+    def __init__(self, store, table, limit=1 << 16,
+                 pull_bound=100, push_bound=10, lr=-1.0, policy="lru"):
         self.store, self.table = store, table
-        self.width = store.width(table)
-        self.limit = limit
-        self.pull_bound, self.push_bound = pull_bound, push_bound
+        self.width = int(store.width(table))
+        self.limit = int(limit)
+        self.pull_bound, self.push_bound = int(pull_bound), int(push_bound)
         self.lr = lr
-        from collections import OrderedDict
-        self._rows = OrderedDict()  # key -> np row, LRU order (O(1) evict)
-        self._uses = {}     # key -> lookups since refresh
-        self._grad = {}     # key -> (accumulated grad, count)
+        policy = policy.lower()
+        if policy not in ("lru", "lfu"):
+            raise ValueError(f"unknown cache policy {policy!r}")
+        self.policy = policy
+        L, w = self.limit, self.width
+        self._data = np.zeros((L, w), np.float32)   # cached rows
+        self._grad = np.zeros((L, w), np.float32)   # pending grad slab
+        self._slotkey = np.full(L, self._EMPTY, np.int64)  # slot -> key
+        self._uses = np.zeros(L, np.int64)     # lookups since refresh
+        self._gcnt = np.zeros(L, np.int64)     # pending update events
+        self._ticks = np.zeros(L, np.int64)    # last-touch clock (LRU)
+        self._freq = np.zeros(L, np.int64)     # touch count (LFU)
+        cap = 1 << max(6, (4 * L - 1).bit_length())   # load factor <= 1/4
+        self._hcap, self._hmask = cap, cap - 1
+        self._hkey = np.full(cap, self._EMPTY, np.int64)
+        self._hslot = np.zeros(cap, np.int64)
+        self._htomb = 0
+        # O(1) slot allocator: popping from the end hands out ascending
+        # slot ids (slot identity is unobservable — victim order ties
+        # break on KEY, never slot)
+        self._freelist = np.arange(L - 1, -1, -1, dtype=np.int64)
+        self._nfree = L
+        self._tick = 0
+        self._lock = threading.RLock()   # executor prefetch thread + main
+        #: (flat, uk, inv, cnt, slots) of the latest lookup — the executor
+        #: and the CTR step always update() the exact ids they just looked
+        #: up, so the batch partition is computed once, not twice
+        self._batch_memo = None
         self.stats = {"lookups": 0, "hits": 0, "evictions": 0, "pushes": 0,
-                      "fetches": 0}
+                      "fetches": 0, "updates": 0, "push_rpcs": 0}
 
-    def _evict_if_needed(self):
-        while len(self._rows) > self.limit:
-            victim, _ = self._rows.popitem(last=False)
-            self._push_key(victim)
-            self._uses.pop(victim, None)
-            self.stats["evictions"] += 1
+    # -- open-addressed int64 hash table (vectorized linear probing) -------
+    def _hash(self, keys):
+        h = keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        h ^= h >> np.uint64(29)
+        return (h & np.uint64(self._hmask)).astype(np.int64)
 
-    def _push_key(self, key):
-        g = self._grad.pop(key, None)
-        if g is not None:
-            self.store.push(self.table, np.asarray([key]), g[0][None, :],
-                            self.lr)
-            self.stats["pushes"] += 1
-
-    def lookup(self, keys):
-        keys = np.asarray(keys, np.int64).reshape(-1)
-        out = np.empty((keys.size, self.width), np.float32)
-        misses = []
-        for i, k in enumerate(keys):
-            k = int(k)
-            self.stats["lookups"] += 1
-            if k in self._rows and self._uses[k] < self.pull_bound:
-                out[i] = self._rows[k]
-                self._uses[k] += 1
-                self._rows.move_to_end(k)
-                self.stats["hits"] += 1
-            else:
-                misses.append((i, k))
-        if misses:
-            mk = np.asarray([k for _, k in misses], np.int64)
-            # a stale row may carry pending local grads — push them first so
-            # the refreshed value includes this worker's own updates
-            for _, k in misses:
-                self._push_key(k)
-            rows = self.store.pull(self.table, mk)
-            self.stats["fetches"] += len(misses)
-            for (i, k), row in zip(misses, rows):
-                out[i] = row
-                self._rows[k] = row.copy()
-                self._rows.move_to_end(k)
-                self._uses[k] = 1
-            self._evict_if_needed()
+    def _find(self, ukeys):
+        """Slot for each (unique) key, -1 if absent — every probe round
+        advances ALL still-unresolved keys one step at once."""
+        out = np.full(ukeys.size, -1, np.int64)
+        if not ukeys.size:
+            return out
+        pend = np.arange(ukeys.size)
+        h = self._hash(ukeys)
+        while pend.size:
+            hk = self._hkey[h]
+            found = hk == ukeys[pend]
+            if found.any():
+                out[pend[found]] = self._hslot[h[found]]
+            stop = found | (hk == self._EMPTY)   # TOMB keeps probing
+            keep = ~stop
+            if not keep.any():
+                break
+            pend = pend[keep]
+            h = (h[keep] + 1) & self._hmask
         return out
 
-    def update(self, keys, grads):
-        keys = np.asarray(keys, np.int64).reshape(-1)
-        grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
-        for k, g in zip(keys, grads):
-            k = int(k)
-            acc, cnt = self._grad.get(k, (np.zeros(self.width, np.float32), 0))
-            acc = acc + g
-            cnt += 1
-            if cnt >= self.push_bound:
-                self.store.push(self.table, np.asarray([k]), acc[None, :],
-                                self.lr)
-                self.stats["pushes"] += 1
-                self._grad.pop(k, None)
-                # local cached copy is now stale relative to the server
-                self._uses[k] = self.pull_bound
+    def _hinsert(self, ukeys, slots):
+        """Insert absent unique keys; conflicting claims on one free cell
+        are resolved per round (first claimant wins, rest re-probe)."""
+        if not ukeys.size:
+            return
+        pend = np.arange(ukeys.size)
+        h = self._hash(ukeys)
+        while pend.size:
+            hk = self._hkey[h]
+            usable = (hk == self._EMPTY) | (hk == self._TOMB)
+            if usable.any():
+                upos, first = np.unique(h[usable], return_index=True)
+                winners = np.flatnonzero(usable)[first]
+                wcells = h[winners]
+                self._htomb -= int((self._hkey[wcells] == self._TOMB).sum())
+                self._hkey[wcells] = ukeys[pend[winners]]
+                self._hslot[wcells] = slots[pend[winners]]
+                keep = np.ones(pend.size, bool)
+                keep[winners] = False
+                pend, h = pend[keep], h[keep]
+            h = (h + 1) & self._hmask
+
+    def _hdelete(self, ukeys):
+        """Tombstone present unique keys (chains through them survive)."""
+        if not ukeys.size:
+            return
+        pend = np.arange(ukeys.size)
+        h = self._hash(ukeys)
+        while pend.size:
+            hk = self._hkey[h]
+            found = hk == ukeys[pend]
+            if found.any():
+                self._hkey[h[found]] = self._TOMB
+                self._htomb += int(found.sum())
+            keep = ~(found | (hk == self._EMPTY))
+            if not keep.any():
+                break
+            pend, h = pend[keep], h[keep]
+            h = (h + 1) & self._hmask
+
+    def _maybe_rehash(self):
+        if self._htomb <= self._hcap // 4:
+            return
+        self._hkey.fill(self._EMPTY)
+        self._htomb = 0
+        occ = np.flatnonzero(self._slotkey >= 0)
+        self._hinsert(self._slotkey[occ], occ)
+
+    # -- slot allocation + vectorized victim selection ---------------------
+    def _pick_victims(self, occ, n_ev):
+        """The ``n_ev`` worst occupied slots under the policy's total
+        order — LRU ``(tick, key)``, LFU ``(freq, tick, key)`` — via
+        argpartition on the primary clock with a deterministic lexsort
+        refinement of the boundary ties (a full lexsort of 10^6 occupied
+        slots per batch would dominate the whole lookup)."""
+        if n_ev >= occ.size:
+            return occ
+        prim = self._ticks[occ] if self.policy == "lru" \
+            else self._freq[occ]
+        part = np.argpartition(prim, n_ev - 1)[:n_ev]
+        thresh = prim[part].max()
+        sure = part[prim[part] < thresh]
+        ties = np.flatnonzero(prim == thresh)
+        if self.policy == "lru":
+            order = np.argsort(self._slotkey[occ[ties]], kind="stable")
+        else:
+            order = np.lexsort((self._slotkey[occ[ties]],
+                                self._ticks[occ[ties]]))
+        chosen = ties[order[:n_ev - sure.size]]
+        return occ[np.concatenate((sure, chosen))]
+
+    def _plan_slots(self, newkeys, protect_slots):
+        """PLAN slots for absent unique (sorted) ``newkeys``: free slots
+        first, then LRU/LFU victims among slots not in ``protect_slots``
+        (the current batch's own slots) — overflow beyond capacity stays
+        -1 (uncacheable).  Pure read: nothing is committed until
+        :meth:`_commit_slots`, so the fallible store round trip can sit
+        between plan and commit without ever leaving torn cache state.
+        The O(limit) protect mask + occupancy scan is built only when
+        eviction is actually needed."""
+        slots = np.full(newkeys.size, -1, np.int64)
+        take = min(newkeys.size, self._nfree)
+        if take:
+            slots[:take] = self._freelist[self._nfree - take:
+                                          self._nfree][::-1]
+        need = newkeys.size - take
+        evslots = evkeys = np.empty(0, np.int64)
+        if need > 0:
+            protect = np.zeros(self.limit, bool)
+            protect[protect_slots] = True
+            occ = np.flatnonzero((self._slotkey >= 0) & ~protect)
+            n_ev = min(need, occ.size)
+            if n_ev > 0:
+                evslots = self._pick_victims(occ, n_ev)
+                evkeys = self._slotkey[evslots].copy()
+                slots[take:take + n_ev] = evslots
+        return slots, take, evslots, evkeys
+
+    def _plan_dirty(self, slot_sel):
+        """(dirty_slots, their keys, grad copies) among ``slot_sel`` —
+        the push payload is copied out so the slab mutates only after the
+        push round trip succeeds."""
+        dirty = slot_sel[self._gcnt[slot_sel] > 0]
+        if not dirty.size:
+            return dirty, None, None
+        return dirty, self._slotkey[dirty].copy(), self._grad[dirty].copy()
+
+    def _commit_slots(self, newkeys, plan):
+        """Apply a :meth:`_plan_slots` plan: pop the freelist, tombstone +
+        reset victims, register the new keys.  Returns the registered
+        (keys, slots)."""
+        slots, take, evslots, evkeys = plan
+        self._nfree -= take
+        if evslots.size:
+            self._hdelete(evkeys)
+            self._grad[evslots] = 0.0
+            self._gcnt[evslots] = 0
+            self.stats["evictions"] += int(evslots.size)
+            record_cache("emb_cache_evict_rows", int(evslots.size))
+        reg = slots >= 0
+        regk, regs = newkeys[reg], slots[reg]
+        self._slotkey[regs] = regk
+        self._hinsert(regk, regs)
+        self._freq[regs] = 0
+        return regk, regs
+
+    def _flush_to_store(self, push_keys, push_grads, pull_keys=None):
+        """ONE batched store round trip for everything pending: the push
+        list (concatenated, already per-unique-key accumulated) and, when
+        ``pull_keys`` is given, the refresh pull — fused into a single
+        ``push_pull`` per peer when the store supports it.  Counters
+        record only after the round trip succeeds."""
+        rows = None
+        if push_keys:
+            pk = np.concatenate(push_keys)
+            pg = np.concatenate(push_grads)
+            order = np.argsort(pk, kind="stable")   # deterministic wire
+            pk, pg = pk[order], pg[order]
+            if pull_keys is not None and hasattr(self.store, "push_pull"):
+                rows = self.store.push_pull(self.table, pk, pg, pull_keys,
+                                            self.lr)
             else:
-                self._grad[k] = (acc, cnt)
+                self.store.push(self.table, pk, pg, self.lr)
+            self.stats["pushes"] += int(pk.size)
+            self.stats["push_rpcs"] += 1
+            record_cache("emb_cache_push_rows", int(pk.size))
+            record_cache("emb_cache_push_rpcs", 1)
+        if rows is None and pull_keys is not None:
+            rows = self.store.pull(self.table, pull_keys)
+        return rows
+
+    # -- core ops ----------------------------------------------------------
+    def lookup(self, keys):
+        keys = np.ascontiguousarray(keys, np.int64)
+        with self._lock:
+            out = self._lookup_locked(keys.reshape(-1))
+        return out.reshape(keys.shape + (self.width,))
+
+    def _lookup_locked(self, flat):
+        self._tick += 1
+        self._batch_memo = None
+        self.stats["lookups"] += int(flat.size)
+        if not flat.size:
+            return np.empty((0, self.width), np.float32)
+        uk, inv, cnt = np.unique(flat, return_inverse=True,
+                                 return_counts=True)
+        slots = self._find(uk)
+        present = slots >= 0
+        hit = np.zeros(uk.size, bool)
+        hit[present] = self._uses[slots[present]] < self.pull_bound
+        rows_out = np.empty((uk.size, self.width), np.float32)
+        refresh = ~hit
+        if refresh.any():
+            rkeys = uk[refresh]
+            rslots = slots[refresh].copy()
+            push_keys, push_grads = [], []
+            # stale rows keep their slots; their pending grads must land
+            # BEFORE the re-pull so the refreshed value includes them —
+            # payloads are COPIES, the slab clears only on success
+            stale = rslots >= 0
+            dirty, dkeys, dgrads = self._plan_dirty(rslots[stale])
+            if dirty.size:
+                push_keys.append(dkeys)
+                push_grads.append(dgrads)
+            absent = ~stale
+            plan = None
+            if absent.any():
+                plan = self._plan_slots(rkeys[absent], slots[present])
+                ev_dirty, evk, evg = self._plan_dirty(plan[2])
+                if ev_dirty.size:
+                    push_keys.append(evk)
+                    push_grads.append(evg)
+                rslots[absent] = plan[0]
+            # the ONLY fallible step: one fused round trip.  A transport
+            # failure raises with the cache untouched — no key registered
+            # for a row that was never filled, no pending grad lost
+            rows = self._flush_to_store(push_keys, push_grads, rkeys)
+            self.stats["fetches"] += int(rkeys.size)
+            if dirty.size:
+                self._grad[dirty] = 0.0
+                self._gcnt[dirty] = 0
+            if plan is not None:
+                self._commit_slots(rkeys[absent], plan)
+            cached = rslots >= 0
+            if cached.all():            # common case: no overflow spill
+                cs, rows_c, cnt_r = rslots, rows, cnt[refresh]
+            else:
+                cs, rows_c = rslots[cached], rows[cached]
+                cnt_r = cnt[refresh][cached]
+            self._data[cs] = rows_c
+            self._uses[cs] = cnt_r
+            self._ticks[cs] = self._tick
+            self._freq[cs] += cnt_r
+            rows_out[refresh] = rows
+            self._maybe_rehash()
+            slots = slots.copy()
+            slots[refresh] = rslots
+        # hit bookkeeping commits AFTER the fallible round trip: a raised
+        # lookup must not burn pull_bound budget (or count hits) for rows
+        # that were never served
+        n_hit_rows = int(cnt[hit].sum())
+        self.stats["hits"] += n_hit_rows
+        record_cache("emb_cache_hit_rows", n_hit_rows)
+        record_cache("emb_cache_miss_rows", int(flat.size) - n_hit_rows)
+        if hit.any():
+            hs = slots[hit]
+            self._uses[hs] += cnt[hit]
+            self._ticks[hs] = self._tick
+            self._freq[hs] += cnt[hit]
+            rows_out[hit] = self._data[hs]
+        self._batch_memo = (flat, uk, inv, cnt, slots)
+        return rows_out[inv]
+
+    def update(self, keys, grads):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        if not keys.size:
+            return
+        grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size,
+                                                                -1)
+        with self._lock:
+            self._update_locked(keys, grads)
+
+    def _update_locked(self, flat, grads):
+        self._tick += 1
+        memo, self._batch_memo = self._batch_memo, None
+        self.stats["updates"] += int(flat.size)
+        if not flat.size:
+            return
+        if memo is not None and memo[0].size == flat.size \
+                and np.array_equal(memo[0], flat):
+            # the immediately-preceding lookup partitioned this exact
+            # batch; nothing mutated in between (same lock)
+            _, uk, inv, cnt, slots = memo
+            slots = slots.copy()
+        else:
+            uk, inv, cnt = np.unique(flat, return_inverse=True,
+                                     return_counts=True)
+            slots = self._find(uk)
+        acc = _segment_sum(grads, inv, cnt)
+        present = slots >= 0
+        push_keys, push_grads = [], []
+        absent = ~present
+        plan = None
+        if absent.any():
+            plan = self._plan_slots(uk[absent], slots[present])
+            ev_dirty, evk, evg = self._plan_dirty(plan[2])
+            if ev_dirty.size:
+                push_keys.append(evk)
+                push_grads.append(evg)
+            slots[absent] = plan[0]
+        cached = slots >= 0
+        if cached.all():
+            cs, acc_c, cnt_c = slots, acc, cnt
+        else:
+            cs, acc_c, cnt_c = slots[cached], acc[cached], cnt[cached]
+            # capacity overflow: these keys' grads go straight out with
+            # the same batched push (early push is within the bound)
+            push_keys.append(uk[~cached])
+            push_grads.append(acc[~cached])
+        # push-bound overflow computed on the HYPOTHETICAL post-batch
+        # counts; payloads are fresh sums, the slab commits only after
+        # the push lands, so a failed round trip leaves the CACHE
+        # unapplied and a caller retry is exactly-once against a
+        # single-shard store.  (Across a multi-peer fanout the push is
+        # at-least-once on a partial failure — per-peer acks land
+        # independently, the reference ps-lite semantics.)  Slots
+        # PLANNED for new keys still hold their victim's uncommitted
+        # gcnt/grad — a fresh key starts from zero, not from those
+        fresh = None
+        if plan is not None:
+            # over uk: absent keys that got a slot this batch
+            fresh = (absent & (slots >= 0))[cached] if not cached.all() \
+                else absent
+        prior_gcnt = self._gcnt[cs] if fresh is None \
+            else np.where(fresh, 0, self._gcnt[cs])
+        new_gcnt = prior_gcnt + cnt_c
+        exceed = new_gcnt >= self.push_bound
+        if exceed.any():
+            es = cs[exceed]
+            pgrads = self._grad[es] + acc_c[exceed]
+            if fresh is not None and fresh[exceed].any():
+                pgrads[fresh[exceed]] = acc_c[exceed][fresh[exceed]]
+            push_keys.append(uk[cached][exceed])
+            push_grads.append(pgrads)
+        # the ONLY fallible step: one batched push round trip
+        self._flush_to_store(push_keys, push_grads)
+        if plan is not None:
+            regk, regs = self._commit_slots(uk[absent], plan)
+            # grad-only slots: the row was never pulled, so it must never
+            # serve — born stale
+            self._data[regs] = 0.0
+            self._uses[regs] = self.pull_bound
+        self._grad[cs] += acc_c
+        self._gcnt[cs] = new_gcnt
+        self._ticks[cs] = self._tick
+        self._freq[cs] += cnt_c
+        if exceed.any():
+            self._grad[es] = 0.0
+            self._gcnt[es] = 0
+            self._uses[es] = self.pull_bound   # server is ahead: stale
+        self._maybe_rehash()
 
     def flush(self):
-        for k in list(self._grad):
-            self._push_key(k)
+        """Push every pending accumulated grad (ONE batched push) and
+        invalidate the pushed rows (checkpoint barrier)."""
+        with self._lock:
+            d = np.flatnonzero((self._slotkey >= 0) & (self._gcnt > 0))
+            if d.size:
+                d = d[np.argsort(self._slotkey[d], kind="stable")]
+                self._flush_to_store([self._slotkey[d].copy()],
+                                     [self._grad[d].copy()])
+                self._grad[d] = 0.0
+                self._gcnt[d] = 0
+                self._uses[d] = self.pull_bound
+
+    def close(self):
+        """Flush pending grads; safe to call repeatedly / at teardown.
+
+        During interpreter finalization the flush is SKIPPED: pushing
+        through numpy/ctypes while the runtime is being torn down
+        segfaults (observed via ``Executor.__del__`` at process exit),
+        and pending grads are bounded-staleness state — anything that
+        must be durable goes through an explicit ``flush``/checkpoint
+        from live code (``Executor.save`` already calls ``ps_flush``)."""
+        import sys
+        if sys.is_finalizing():
+            return
+        try:
+            self.flush()
+        except Exception:
+            pass    # store already closed at teardown
+
+    def perf(self):
+        """Counter snapshot + read hit rate (CacheSparseTable.perf parity:
+        the HET cache's citable number)."""
+        with self._lock:
+            d = dict(self.stats)
+            d["size"] = int((self._slotkey >= 0).sum())
+        d["hit_rate"] = (d["hits"] / d["lookups"]) if d["lookups"] else 0.0
+        return d
+
+    def __len__(self):
+        with self._lock:
+            return int((self._slotkey >= 0).sum())

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..graph.node import PlaceholderOp
 from .cstable import CacheSparseTable
+from .dist_store import DistCacheTable
 from .store import EmbeddingStore, default_store
 
 
@@ -33,7 +34,7 @@ class PSEmbeddingLookupOp(PlaceholderOp):
         self.inputs = []           # leaf: ids resolved host-side per step
         self.ids_node = ids_node
         self._last_ids = None
-        if isinstance(table, CacheSparseTable):
+        if isinstance(table, (CacheSparseTable, DistCacheTable)):
             self.cache = table
             self.store, self.table = table.store, table.table
             self.width = table.width
@@ -49,8 +50,13 @@ class PSEmbeddingLookupOp(PlaceholderOp):
     # host-side pull/push used by the executor around the jitted step
     def pull_rows(self, ids):
         """Stateless row pull — safe on a background prefetch thread (does
-        NOT touch ``_last_ids``, which the in-flight step's push needs)."""
+        NOT touch ``_last_ids``, which the in-flight step's push needs).
+        Cache-backed lookups mutate only cache bookkeeping, which is
+        internally locked; a prefetch-thread lookup observes the same
+        bounded staleness the cache already grants."""
         ids = np.asarray(ids, np.int64)
+        if isinstance(self.cache, DistCacheTable):
+            return self.cache.lookup(ids)
         if self.cache is not None:
             dest = np.empty(ids.shape + (self.cache.width,), np.float32)
             return self.cache._lookup_sync(ids, dest)
@@ -67,7 +73,9 @@ class PSEmbeddingLookupOp(PlaceholderOp):
         may have overwritten it by then)."""
         if ids is None:
             return
-        if self.cache is not None:
+        if isinstance(self.cache, DistCacheTable):
+            self.cache.update(ids, grads)
+        elif self.cache is not None:
             self.cache._update_sync(ids, grads)
         else:
             self.store.push(self.table, ids, grads)

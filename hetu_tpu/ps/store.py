@@ -13,11 +13,38 @@ A pure-numpy fallback covers environments without a C++ toolchain.
 """
 from __future__ import annotations
 
+import json
+import struct
+
 import numpy as np
 
 from .build import get_lib
 
 _OPT_IDS = {"sgd": 0, "momentum": 1, "nesterov": 2, "adagrad": 3, "adam": 4}
+
+#: v3 numpy-table checkpoint: magic + JSON header + raw array bytes,
+#: streamed in bounded chunks (a 10^7x64 table must checkpoint without a
+#: full in-memory copy — ``np.savez`` materialises each array's bytes)
+_V3_MAGIC = b"HETUPS3\n"
+_V3_CHUNK = 1 << 26          # 64 MB per write/readinto slice
+
+
+def _write_chunked(f, arr):
+    """Stream a C-contiguous array to ``f`` without copying it whole."""
+    mv = memoryview(arr).cast("B")
+    for off in range(0, len(mv), _V3_CHUNK):
+        f.write(mv[off:off + _V3_CHUNK])
+
+
+def _read_chunked(f, arr):
+    """Stream bytes from ``f`` straight into ``arr``'s buffer."""
+    mv = memoryview(arr).cast("B")
+    off = 0
+    while off < len(mv):
+        n = f.readinto(mv[off:off + _V3_CHUNK])
+        if not n:
+            raise IOError(f"truncated v3 table checkpoint at byte {off}")
+        off += n
 
 
 class _NumpyTable:
@@ -123,12 +150,24 @@ class EmbeddingStore:
             return out
         return self._np_tables[table].data.copy()
 
+    def rows(self, table):
+        """Row count of ``table`` (reference PSAgent table metadata)."""
+        if self._lib:
+            return int(self._lib.hetu_ps_rows(self._h, table))
+        return int(self._np_tables[table].data.shape[0])
+
+    def width(self, table):
+        """Embedding width of ``table`` — gives the cache clients one
+        accessor that works for both this store and DistributedStore."""
+        if self._lib:
+            return int(self._lib.hetu_ps_width(self._h, table))
+        return int(self._np_tables[table].data.shape[1])
+
     def _check_keys(self, table, keys):
         if keys.size == 0:
             return
         lo, hi = int(keys.min()), int(keys.max())
-        rows = (self._lib.hetu_ps_rows(self._h, table) if self._lib
-                else self._np_tables[table].data.shape[0])
+        rows = self.rows(table)
         if lo < 0 or hi >= rows:
             raise IndexError(
                 f"embedding key out of range: [{lo}, {hi}] vs table rows "
@@ -223,40 +262,71 @@ class EmbeddingStore:
     # -- persistence (SaveParam/LoadParam parity) --------------------------
     def save(self, table, path):
         """Full table state: data + optimizer slots + versions (a resumed
-        Adam table with zeroed moments silently diverges)."""
+        Adam table with zeroed moments silently diverges).
+
+        Numpy fallback writes the streamed v3 format: arrays go to disk in
+        bounded 64 MB slices straight off their buffers, so checkpointing
+        a multi-GB table needs no full in-memory copy (``np.savez``
+        materialised each array's bytes — 2.5 GB of transient RSS for the
+        10^7x64 table).  The native core already streams via fwrite."""
         if self._lib:
             rc = self._lib.hetu_ps_save(self._h, table, path.encode())
             if rc:
                 raise IOError(f"ps save failed rc={rc}")
         else:
             t = self._np_tables[table]
-            blobs = {"data": t.data, "version": t.version}
+            blobs = [("data", t.data), ("version", t.version)]
             for name in ("s0", "s1", "t"):
                 if getattr(t, name) is not None:
-                    blobs[name] = getattr(t, name)
-            # write through a handle: np.save*(str) appends a suffix to
-            # extension-less names, breaking the caller's path contract
+                    blobs.append((name, getattr(t, name)))
+            header = json.dumps({"arrays": [
+                {"name": n, "dtype": str(a.dtype), "shape": list(a.shape)}
+                for n, a in blobs]}).encode()
             with open(path, "wb") as f:
-                np.savez(f, **blobs)
+                f.write(_V3_MAGIC)
+                f.write(struct.pack("<q", len(header)))
+                f.write(header)
+                for _, a in blobs:
+                    _write_chunked(f, a)
 
     def load(self, table, path):
         if self._lib:
             rc = self._lib.hetu_ps_load(self._h, table, path.encode())
             if rc:
                 raise IOError(f"ps load failed rc={rc}")
-        else:
-            t = self._np_tables[table]
-            with open(path, "rb") as f:
-                head = f.read(2)
-            if head == b"PK":      # npz archive: v2 full state
-                blobs = np.load(path)
-                t.data[:] = blobs["data"]
-                t.version[:] = blobs["version"]
-                for name in ("s0", "s1", "t"):
-                    if name in blobs and getattr(t, name) is not None:
-                        getattr(t, name)[:] = blobs[name]
-            else:                  # v1 file: bare .npy of the data
-                t.data[:] = np.load(path)
+            return
+        t = self._np_tables[table]
+        with open(path, "rb") as f:
+            head = f.read(8)
+            if head == _V3_MAGIC:  # v3: streamed chunked format
+                (hlen,) = struct.unpack("<q", f.read(8))
+                meta = json.loads(f.read(hlen).decode())
+                for spec in meta["arrays"]:
+                    target = {"data": t.data, "version": t.version,
+                              "s0": t.s0, "s1": t.s1, "t": t.t}.get(
+                                  spec["name"])
+                    nbytes = (int(np.prod(spec["shape"]))
+                              * np.dtype(spec["dtype"]).itemsize)
+                    if target is None:
+                        f.seek(nbytes, 1)   # slot this table doesn't keep
+                        continue
+                    if (list(target.shape) != list(spec["shape"])
+                            or str(target.dtype) != spec["dtype"]):
+                        raise IOError(
+                            f"v3 checkpoint array {spec['name']} is "
+                            f"{spec['shape']}:{spec['dtype']}, table wants "
+                            f"{list(target.shape)}:{target.dtype}")
+                    _read_chunked(f, target)
+                return
+        if head[:2] == b"PK":      # npz archive: v2 full state
+            blobs = np.load(path)
+            t.data[:] = blobs["data"]
+            t.version[:] = blobs["version"]
+            for name in ("s0", "s1", "t"):
+                if name in blobs and getattr(t, name) is not None:
+                    getattr(t, name)[:] = blobs[name]
+        else:                      # v1 file: bare .npy of the data
+            t.data[:] = np.load(path)
 
     # -- SSP (bounded staleness barrier) ----------------------------------
     #: set by ssp_init — the native clock/ssp_sync entry points index the
