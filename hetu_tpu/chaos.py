@@ -15,7 +15,14 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   registered :class:`~hetu_tpu.ps.dist_store.StoreServer` when the
   executor reports training step ``s``; ``kill:proc@rank<r>:after<ms>``
   tells the supervising launcher to kill a child rank after a wall-clock
-  delay (fired at most once per injector).
+  delay (fired at most once per injector);
+* **replica-role kills** — with PS shard replication
+  (``replication=2``), ``kill:primary@shard<s>:step<n>`` stops whichever
+  registered server currently SERVES shard ``s`` at step ``n`` (resolved
+  at fire time, so after a failover it targets the promoted ex-backup),
+  and ``kill:backup@shard<s>:step<n>`` stops the server that HOLDS shard
+  ``s`` without serving it — the two sides of the failover window the
+  replication tests must straddle.
 
 Spec grammar (everything after the first ``:`` is the comma-separated
 fault list; probabilities in [0, 1], durations in milliseconds)::
@@ -23,6 +30,8 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="1234:drop=0.1,delay=0.2:50,dup=0.05,wedge=0.01:2000"
     HETU_CHAOS="7:kill:ps@rank1:step3"
     HETU_CHAOS="7:kill:proc@rank0:after250"
+    HETU_CHAOS="7:kill:primary@shard1:step3"
+    HETU_CHAOS="7:kill:backup@shard1:step3"
 
 Every injected fault increments a named counter in
 :mod:`hetu_tpu.metrics` (``chaos_drop``, ``chaos_kill_ps``, ...) so
@@ -51,11 +60,20 @@ def _parse_fault(part):
     if not part:
         raise ChaosSpecError("empty fault entry")
     if part.startswith("kill:"):
-        # kill:ps@rank<r>:step<s>  |  kill:proc@rank<r>:after<ms>
+        # kill:ps@rank<r>:step<s> | kill:proc@rank<r>:after<ms>
+        # | kill:{primary,backup}@shard<s>:step<n>  (replica-role kills,
+        #   resolved against the live serving/holding sets at fire time)
         try:
             _, rest = part.split(":", 1)
             what, where = rest.split("@", 1)
             target, when = where.split(":", 1)
+            if what in ("primary", "backup"):
+                if not (target.startswith("shard")
+                        and when.startswith("step")):
+                    raise ValueError(part)
+                return {"kind": f"kill_{what}",
+                        "shard": int(target[len("shard"):]),
+                        "step": int(when[len("step"):])}
             if not target.startswith("rank"):
                 raise ValueError(part)
             rank = int(target[len("rank"):])
@@ -68,8 +86,9 @@ def _parse_fault(part):
             raise ValueError(part)
         except (ValueError, IndexError):
             raise ChaosSpecError(
-                f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>"
-                f" or kill:proc@rank<r>:after<ms>") from None
+                f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>,"
+                f" kill:proc@rank<r>:after<ms>, or "
+                f"kill:{{primary,backup}}@shard<s>:step<n>") from None
     if "=" not in part:
         raise ChaosSpecError(f"bad fault {part!r}: expected <kind>=<prob>"
                              f"[:<ms>] or kill:...")
@@ -175,41 +194,78 @@ class ChaosInjector:
         with self._lock:
             self._servers[rank] = server
 
+    def _resolve_role_kill(self, fault):
+        """The registered server currently filling the fault's replica
+        role: ``kill_primary`` → the one SERVING the shard, ``kill_backup``
+        → one HOLDING it without serving.  Resolved at fire time, so after
+        an earlier failover ``kill:primary`` targets the promoted
+        ex-backup — the double-failure schedules need exactly that."""
+        shard = fault["shard"]
+        for rank, srv in sorted(self._servers.items()):
+            if getattr(srv, "_stop", False):
+                continue
+            serves = getattr(srv, "serves", None)
+            holds = getattr(srv, "holds", None)
+            if serves is None or holds is None:
+                continue
+            if fault["kind"] == "kill_primary" and serves(shard):
+                return rank, srv
+            if fault["kind"] == "kill_backup" and holds(shard) \
+                    and not serves(shard):
+                return rank, srv
+        return None, None
+
     def on_step(self, step):
-        """Executor hook: fires any ``kill:ps@rank<r>:step<step>`` fault.
+        """Executor hook: fires any step-scheduled server kill —
+        ``kill:ps@rank<r>:step<s>`` and the replica-role forms
+        ``kill:{primary,backup}@shard<s>:step<n>``.
 
         Returns the list of ranks whose server was stopped (empty almost
-        always).  A fault whose target rank has no registered server is
+        always).  A fault whose target has no registered server is
         LOUD (warning + ``chaos_kill_target_missing`` counter) — a
         schedule that silently does nothing would make a chaos run
         indistinguishable from a clean one."""
         killed, missing = [], []
         with self._lock:
             for i, f in enumerate(self.faults):
-                if f["kind"] != "kill_ps" or i in self._fired \
-                        or f["step"] != step:
+                if i in self._fired or f.get("step") != step \
+                        or f["kind"] not in ("kill_ps", "kill_primary",
+                                             "kill_backup"):
                     continue
                 self._fired.add(i)
-                server = self._servers.get(f["rank"])
-                if server is not None:
-                    killed.append(f["rank"])
-                elif not self._servers:
-                    # no server registered in this process at all: the
-                    # schedule cannot possibly fire here — loud.  When
-                    # OTHER ranks' servers are registered, the target
-                    # lives in a different process (each process hosts
-                    # its own rank) and fires there: stay quiet.
-                    missing.append(f["rank"])
-        for rank in missing:
+                if f["kind"] == "kill_ps":
+                    server = self._servers.get(f["rank"])
+                    if server is not None:
+                        killed.append((f["rank"], server, "chaos_kill_ps"))
+                    elif not self._servers:
+                        # no server registered in this process at all: the
+                        # schedule cannot possibly fire here — loud.  When
+                        # OTHER ranks' servers are registered, the target
+                        # lives in a different process (each process hosts
+                        # its own rank) and fires there: stay quiet.
+                        missing.append(f"kill:ps@rank{f['rank']}")
+                else:
+                    rank, server = self._resolve_role_kill(f)
+                    if server is not None:
+                        killed.append((rank, server,
+                                       "chaos_" + f["kind"]))
+                    elif not self._servers:
+                        # same quiet/loud split as kill_ps: with OTHER
+                        # servers registered the role is presumably
+                        # filled in a different process and fires there
+                        role = f["kind"][len("kill_"):]
+                        missing.append(
+                            f"kill:{role}@shard{f['shard']}")
+        for what in missing:
             import warnings
             record_fault("chaos_kill_target_missing")
-            warnings.warn(f"chaos kill:ps@rank{rank}:step{step} fired but "
-                          f"no server is registered for rank {rank} — "
-                          f"the kill did NOT happen", RuntimeWarning)
-        for rank in killed:         # stop outside the lock: stop() closes
-            record_fault("chaos_kill_ps")        # sockets, may block
-            self._servers[rank].stop()
-        return killed
+            warnings.warn(f"chaos {what}:step{step} fired but no "
+                          f"registered server fills that role — the kill "
+                          f"did NOT happen", RuntimeWarning)
+        for rank, server, counter in killed:  # stop outside the lock:
+            record_fault(counter)             # stop() closes sockets,
+            server.stop()                     # may block
+        return [rank for rank, _, _ in killed]
 
     # -- launcher-level child kills ----------------------------------------
     def due_proc_kills(self, elapsed_ms):

@@ -1007,8 +1007,9 @@ class Executor:
 
     def _post_step(self, training):
         """Step-boundary hooks: periodic auto-save, chaos schedule tick,
-        deferred preemption handling.  Called by SubExecutor.run AFTER the
-        state swap, so everything below sees a consistent step."""
+        PS redundancy repair, deferred preemption handling.  Called by
+        SubExecutor.run AFTER the state swap, so everything below sees a
+        consistent step."""
         if training:
             if self.auto_save_dir and self.auto_save_every > 0 \
                     and self.step_counter % self.auto_save_every == 0:
@@ -1020,8 +1021,31 @@ class Executor:
                 # schedule's `kill:ps@rank<r>:step<s>` is reproducibly
                 # "step s completed, then the server died"
                 inj.on_step(self.step_counter)
+            self._tick_re_replication()
         if self._preempt_signum is not None:
             self._handle_preemption()
+
+    def _tick_re_replication(self):
+        """Background re-replication driver (HETU_PS_REREPLICATE_EVERY
+        steps, 0 = off): after a PS failover left a shard running without
+        its backup, each tick asks every replicated store this executor's
+        graphs use to try restoring redundancy onto the relaunched
+        holder — a still-dead target defers quietly
+        (``ps_re_replicate_deferred``) to the next tick, a repaired shard
+        makes a SECOND failure survivable with no operator action."""
+        import os as _os
+        every = int(_os.environ.get("HETU_PS_REREPLICATE_EVERY", "0"))
+        if every <= 0 or self.step_counter % every != 0:
+            return
+        seen = set()
+        for se in self.subexecutors.values():
+            for node in getattr(se, "ps_nodes", []):
+                store = getattr(node, "store", None)
+                if store is None or id(store) in seen \
+                        or not hasattr(store, "maybe_re_replicate"):
+                    continue
+                seen.add(id(store))
+                store.maybe_re_replicate()
 
     def _install_signal_handlers(self):
         """SIGTERM/SIGINT → one final emergency save, then the previous

@@ -22,6 +22,14 @@ workers auto-save and ``Executor.resume`` on restart).  A ``HETU_CHAOS``
 schedule with ``kill:proc@rank<r>:after<ms>`` faults is honored inside
 the monitor loop, making launcher-level failures reproducible tests.
 
+PS replication (``--ps-replication 2`` → ``HETU_PS_REPLICATION``)
+changes the failure policy: a dead rank's PS shard keeps serving from
+its live backup, so ``--standby`` respawns just that rank as a standby
+(bounded by ``--standby-budget``) instead of killing the job — the
+survivors' shard routers fail over in one RPC timeout and the executors'
+re-replication tick (``HETU_PS_REREPLICATE_EVERY``) re-attaches the
+standby as the fresh backup.
+
 CLI: ``python -m hetu_tpu.launcher -c cluster.yml train.py [args...]``.
 """
 from __future__ import annotations
@@ -65,6 +73,47 @@ def _host_env(config, rank, coordinator_port=8476):
     return env
 
 
+def _launch_rank(config, rank, script, script_args=(), local_devices=None,
+                 ssh=True, coordinator_port=8476, extra_env=None):
+    """Spawn ONE rank's process (also the standby-respawn entry point:
+    a replicated-PS cluster relaunches a dead rank solo while the
+    survivors keep training against the promoted replicas)."""
+    host = config.hosts[rank]
+    env = _host_env(config, rank, coordinator_port=coordinator_port)
+    if extra_env:
+        env.update(extra_env)
+    if local_devices:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{local_devices}").strip()
+    cmd = [sys.executable, script, *script_args]
+    if host in ("localhost", "127.0.0.1") or not ssh:
+        return subprocess.Popen(cmd, env=env)
+    import shlex
+    exports = " ".join(
+        f"{k}={shlex.quote(env[k])}" for k in
+        ("HETU_COORDINATOR", "HETU_NUM_PROCESSES",
+         "HETU_PROCESS_ID", "XLA_FLAGS",
+         # fault-tolerance knobs must reach remote ranks too —
+         # otherwise --supervise --ckpt-dir silently restarts a
+         # real cluster from scratch instead of resuming
+         "HETU_AUTO_SAVE_DIR", "HETU_AUTO_SAVE_EVERY",
+         "HETU_AUTO_SAVE_KEEP", "HETU_AUTO_RESUME", "HETU_CHAOS",
+         "HETU_HEARTBEAT_MS", "HETU_MAX_FRAME_MB",
+         # PS replication knobs: every rank must agree on the topology
+         "HETU_PS_REPLICATION", "HETU_RPC_BACKOFF_MS",
+         "HETU_PS_REREPLICATE_EVERY", "HETU_PS_STANDBY")
+        if env.get(k))
+    remote_cmd = " ".join(shlex.quote(a) for a in cmd)
+    # -tt forces a tty so killing the LOCAL ssh client hangs up
+    # the remote session and the remote python dies with it —
+    # monitor()'s kill-the-remaining-ranks contract must reach
+    # the actual remote processes, not just their ssh clients
+    return subprocess.Popen(
+        ["ssh", "-tt", host,
+         f"cd {shlex.quote(os.getcwd())} && {exports} {remote_cmd}"])
+
+
 def launch(config, script, script_args=(), local_devices=None, ssh=True,
            coordinator_port=8476):
     """Run ``script`` on every host in the cluster config.
@@ -74,41 +123,14 @@ def launch(config, script, script_args=(), local_devices=None, ssh=True,
     command line (the reference pushes env the same way, runner.py:203-255).
     Returns the list of Popen handles.
     """
-    procs = []
-    for rank, host in enumerate(config.hosts):
-        env = _host_env(config, rank, coordinator_port=coordinator_port)
-        if local_devices:
-            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                                f" --xla_force_host_platform_device_count="
-                                f"{local_devices}").strip()
-        cmd = [sys.executable, script, *script_args]
-        if host in ("localhost", "127.0.0.1") or not ssh:
-            procs.append(subprocess.Popen(cmd, env=env))
-        else:
-            import shlex
-            exports = " ".join(
-                f"{k}={shlex.quote(env[k])}" for k in
-                ("HETU_COORDINATOR", "HETU_NUM_PROCESSES",
-                 "HETU_PROCESS_ID", "XLA_FLAGS",
-                 # fault-tolerance knobs must reach remote ranks too —
-                 # otherwise --supervise --ckpt-dir silently restarts a
-                 # real cluster from scratch instead of resuming
-                 "HETU_AUTO_SAVE_DIR", "HETU_AUTO_SAVE_EVERY",
-                 "HETU_AUTO_SAVE_KEEP", "HETU_AUTO_RESUME", "HETU_CHAOS",
-                 "HETU_HEARTBEAT_MS", "HETU_MAX_FRAME_MB")
-                if env.get(k))
-            remote_cmd = " ".join(shlex.quote(a) for a in cmd)
-            # -tt forces a tty so killing the LOCAL ssh client hangs up
-            # the remote session and the remote python dies with it —
-            # monitor()'s kill-the-remaining-ranks contract must reach
-            # the actual remote processes, not just their ssh clients
-            procs.append(subprocess.Popen(
-                ["ssh", "-tt", host,
-                 f"cd {shlex.quote(os.getcwd())} && {exports} {remote_cmd}"]))
-    return procs
+    return [_launch_rank(config, rank, script, script_args,
+                         local_devices=local_devices, ssh=ssh,
+                         coordinator_port=coordinator_port)
+            for rank in range(len(config.hosts))]
 
 
-def monitor(procs, poll_s=0.2, chaos=None, log=None):
+def monitor(procs, poll_s=0.2, chaos=None, log=None, standby=None,
+            standby_budget=3):
     """Watch every rank's Popen until the job resolves.
 
     Polls ALL handles (the old serial ``wait()`` in rank order could
@@ -117,11 +139,19 @@ def monitor(procs, poll_s=0.2, chaos=None, log=None):
     an SPMD program cannot continue with a partial world — and that exit
     code is returned.  All-zero exits return 0.
 
+    ``standby``: a ``rank -> Popen`` respawner (``--standby``, PS
+    replication deployments).  A dead rank then does NOT fail the job:
+    the survivors' shard routers have already failed over to the
+    replicas, so the rank is relaunched solo as a standby — the
+    executors' re-replication tick re-attaches it as the fresh backup.
+    At most ``standby_budget`` respawns; past that, normal kill-all.
+
     ``chaos``: an active :class:`~hetu_tpu.chaos.ChaosInjector` whose
     ``kill:proc@rank<r>:after<ms>`` faults are fired here.
     """
     t0 = time.monotonic()
     live = dict(enumerate(procs))
+    spawned = 0
     while live:
         if chaos is not None:
             for r in chaos.due_proc_kills((time.monotonic() - t0) * 1e3):
@@ -136,6 +166,15 @@ def monitor(procs, poll_s=0.2, chaos=None, log=None):
                 continue
             del live[r]
             if rc != 0:
+                if standby is not None and spawned < standby_budget:
+                    spawned += 1
+                    record_fault("standby_spawn")
+                    if log:
+                        log(f"rank {r} exited rc={rc}; spawning standby "
+                            f"({spawned}/{standby_budget}) — survivors "
+                            f"keep serving from the promoted replicas")
+                    live[r] = standby(r)
+                    continue
                 if log:
                     log(f"rank {r} exited rc={rc}; killing "
                         f"{len(live)} remaining rank(s)")
@@ -152,7 +191,8 @@ def monitor(procs, poll_s=0.2, chaos=None, log=None):
 
 def supervise(config, script, script_args=(), local_devices=None, ssh=True,
               coordinator_port=8476, max_restarts=3, backoff_s=1.0,
-              poll_s=0.2, chaos=None, log=None):
+              poll_s=0.2, chaos=None, log=None, standby=False,
+              standby_budget=3):
     """Supervising launcher: launch → monitor → (on failure) kill, back
     off exponentially, relaunch the whole job — relaunched workers
     resume from the latest complete auto-checkpoint (with
@@ -168,11 +208,22 @@ def supervise(config, script, script_args=(), local_devices=None, ssh=True,
     log = log or (lambda msg: print(f"[heturun] {msg}",
                                     file=sys.stderr, flush=True))
     attempt = 0
+    respawn = None
+    if standby:
+        def respawn(rank):
+            # the replacement announces itself as a STANDBY: its server
+            # holds its shards but serves nothing until re-replication
+            # re-attaches it (a promoted ex-backup is the live truth)
+            return _launch_rank(config, rank, script, script_args,
+                                local_devices=local_devices, ssh=ssh,
+                                coordinator_port=coordinator_port,
+                                extra_env={"HETU_PS_STANDBY": "1"})
     while True:
         procs = launch(config, script, script_args,
                        local_devices=local_devices, ssh=ssh,
                        coordinator_port=coordinator_port)
-        rc = monitor(procs, poll_s=poll_s, chaos=chaos, log=log)
+        rc = monitor(procs, poll_s=poll_s, chaos=chaos, log=log,
+                     standby=respawn, standby_budget=standby_budget)
         if rc == 0:
             if attempt:
                 log(f"job recovered after {attempt} restart(s)")
@@ -212,6 +263,18 @@ def main(argv=None):
                         "auto-save destination and resume source (also "
                         "defaults HETU_AUTO_SAVE_EVERY to 100 steps "
                         "unless the env already sets a cadence)")
+    p.add_argument("--ps-replication", type=int, default=None,
+                   help="exported to workers as HETU_PS_REPLICATION: 2 "
+                        "keeps a live backup of every PS shard on the "
+                        "next rank (failover instead of restart)")
+    p.add_argument("--standby", action="store_true",
+                   help="with PS replication: respawn a dead rank solo "
+                        "as a standby instead of failing the whole job "
+                        "(survivors serve from the promoted replicas; "
+                        "re-replication re-attaches the standby)")
+    p.add_argument("--standby-budget", type=int, default=3,
+                   help="max solo respawns before falling back to the "
+                        "kill-all policy (default 3)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -221,6 +284,13 @@ def main(argv=None):
     else:
         n = args.num_hosts or 1
         config = DistConfig(num_hosts=n, hosts=["localhost"] * n)
+    if args.ps_replication is not None:
+        # _host_env copies os.environ, so every rank inherits the topology
+        os.environ["HETU_PS_REPLICATION"] = str(args.ps_replication)
+        if args.standby:
+            # a respawned standby must try to re-attach by itself even if
+            # the training script never touches the knob
+            os.environ.setdefault("HETU_PS_REREPLICATE_EVERY", "10")
     if args.ckpt_dir:
         # _host_env copies os.environ, so every rank inherits it
         os.environ["HETU_AUTO_SAVE_DIR"] = args.ckpt_dir
@@ -239,11 +309,22 @@ def main(argv=None):
                          local_devices=args.local_devices,
                          ssh=not args.no_ssh,
                          max_restarts=args.max_restarts,
-                         backoff_s=args.restart_backoff)
+                         backoff_s=args.restart_backoff,
+                         standby=args.standby,
+                         standby_budget=args.standby_budget)
     procs = launch(config, args.script, args.script_args,
                    local_devices=args.local_devices,
                    ssh=not args.no_ssh)
-    return monitor(procs, chaos=_chaos.active() or _chaos.install_from_env())
+    respawn = None
+    if args.standby:
+        def respawn(rank):
+            return _launch_rank(config, rank, args.script, args.script_args,
+                                local_devices=args.local_devices,
+                                ssh=not args.no_ssh,
+                                extra_env={"HETU_PS_STANDBY": "1"})
+    return monitor(procs,
+                   chaos=_chaos.active() or _chaos.install_from_env(),
+                   standby=respawn, standby_budget=args.standby_budget)
 
 
 if __name__ == "__main__":

@@ -43,16 +43,24 @@ def reset_flash_fallbacks():
 # The fault-tolerance layer records every detection/recovery event here so
 # a run can PROVE what happened: transport retries (``ps_rpc_retry``),
 # exhausted peers (``ps_peer_unreachable``), injected chaos
-# (``chaos_drop``/``chaos_kill_ps``/...), dead ranks excluded from a
-# partial-reduce group (``preduce_dead_rank_excluded``), checkpoints
-# written/skipped (``auto_save``, ``emergency_save``,
-# ``ckpt_incomplete_skipped``), resumes (``resume``), and supervisor
-# restarts (``supervisor_restart``).  Invariant (asserted by the chaos
-# tests): every counter EXCEPT the ``auto_save`` bookkeeping records a
-# detected fault or a recovery action, so a clean run reports none of
-# those — and a clean run without auto-checkpointing records nothing at
+# (``chaos_drop``/``chaos_kill_ps``/``chaos_kill_primary``/...), dead
+# ranks excluded from a partial-reduce group
+# (``preduce_dead_rank_excluded``), checkpoints written/skipped
+# (``auto_save``, ``emergency_save``, ``ckpt_incomplete_skipped``),
+# resumes (``resume``), supervisor restarts (``supervisor_restart``),
+# standby respawns (``standby_spawn``), and the PS replication plane:
+# client-side failovers (``ps_failover`` detected, ``ps_failover_promoted``
+# rerouted, ``ps_failover_failed`` both copies gone,
+# ``ps_failover_primary_reported_alive`` possible partition), server-side
+# promotions (``ps_promoted``), op-log forward breakage
+# (``repl_forward_failed``), and redundancy repair (``ps_re_replicated``
+# / ``ps_re_replicate_deferred`` / ``ps_re_replicate_failed``).
+# Invariant (asserted by the chaos + replication tests): every counter
+# EXCEPT the ``auto_save`` bookkeeping records a detected fault or a
+# recovery action, so a clean run — replicated or not — reports none of
+# those, and a clean run without auto-checkpointing records nothing at
 # all.  Surfaced by ``HetuProfiler.fault_counters()`` and ``bench.py
-# --config chaos``.
+# --config chaos`` / ``--config failover``.
 
 _fault_counts = collections.Counter()
 _fault_lock = threading.Lock()

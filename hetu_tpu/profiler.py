@@ -252,9 +252,13 @@ class HetuProfiler:
         """{kind: count} of fault-tolerance events (``hetu_tpu.metrics``
         registry): transport retries/exhaustions, chaos injections,
         dead-rank exclusions, auto/emergency saves, resumes, supervisor
-        restarts.  Every entry except the routine ``auto_save``
-        bookkeeping is evidence of a detected fault or a recovery
-        action; a clean run reports none of those (and an empty dict
+        restarts, and the PS replication plane — shard failovers and
+        promotions (``ps_failover*``/``ps_promoted``), op-log forward
+        breakage (``repl_forward_failed``), redundancy repair
+        (``ps_re_replicated``/``ps_re_replicate_*``), standby respawns.
+        Every entry except the routine ``auto_save`` bookkeeping is
+        evidence of a detected fault or a recovery action; a clean run —
+        replicated or not — reports none of those (and an empty dict
         when auto-checkpointing is off)."""
         from .metrics import fault_counts
         return fault_counts()

@@ -27,12 +27,42 @@ one-pusher gating — and RDMA presumes NIC hardware this runtime does not
 manage.  What IS kept from ps-lite's transport: at-least-once retries
 with (client, seq) dedup for pushes AND clock ticks (``resender.h``
 semantics), socket timeouts + reconnect, and dead-peer diagnostics.
+
+Live shard replication (``replication=2``, ps-lite's sketched server-side
+replication done properly): shard ``s`` keeps a bitwise-identical backup
+on rank ``(s+1) % world`` via seq-ordered op-log forwarding — the serving
+server mirrors every state-mutating frame (``OP_PUSH``, the push half of
+``OP_PUSH_PULL``, ``OP_SET_DATA``, heartbeat writes for shard 0) to the
+backup over ``OP_REPLICATE`` *before* acking the client, under one
+replication lock so the backup applies ops in primary apply order.  The
+forwarded frame carries the ORIGINAL (client, seq) header, so the
+backup's dedup window absorbs the promotion-window retry: a push the
+primary ack'd-then-died-on, retried against the promoted backup, applies
+exactly once.  Client-side, ``_rpc`` exhaustion against a shard's
+serving rank no longer raises: the shard router promotes the backup
+(``OP_PROMOTE``, idempotent), re-routes the in-flight fanout, and counts
+``ps_failover*`` events — a killed parameter server costs one RPC
+timeout, zero restarts, zero lost steps.  ``re_replicate`` restores
+redundancy onto a relaunched holder (``OP_INIT`` replica tables, then an
+``OP_SYNC`` chunked snapshot reusing the v3 streamed checkpoint format,
+then op-log catch-up) so a second failure is survivable.
+
+Failure model: FAIL-STOP.  A replica that stops answering is assumed
+DEAD (process gone, state gone) — the deployment this serves runs both
+copies inside one pod's hosts, where an unreachable peer is a dead
+peer.  Under a true network partition a backup that missed forwards
+stays alive with stale state; nothing marks it stale remotely, so a
+later primary death could promote it (``repl_forward_failed`` in the
+fault counters is the tell, and ``tools/ps_fsck.py`` makes the
+divergence checkable).  Partition-tolerant promotion (sync epochs
+acknowledged end-to-end) is future work — detectable today, not silent.
 """
 from __future__ import annotations
 
 import itertools
 import os
 import queue
+import random
 import socket
 import struct
 import threading
@@ -40,7 +70,7 @@ import time
 
 import numpy as np
 
-from .store import EmbeddingStore
+from .store import EmbeddingStore, _OPT_IDS, _OPT_NAMES, _V3_CHUNK
 from .. import chaos as _chaos
 from ..metrics import record_cache, record_fault
 
@@ -50,15 +80,36 @@ OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
 #: ``[npush, push_keys..., pull_keys...]``, payload carries the grads —
 #: one round trip per peer instead of serial push-then-pull
 OP_PUSH_PULL = 11
+#: replication plane (see module docstring): mirror a mutating frame to a
+#: backup; promote a backup to serving; create a replica table; set a
+#: shard's full slab; snapshot-transfer for re-replication; state digest
+OP_REPLICATE = 12
+OP_PROMOTE = 13
+OP_INIT = 14
+OP_SET_DATA = 15
+OP_SYNC = 16
+OP_SYNC_PUT = 17
+OP_CHECKSUM = 18
 
-# op, table, nkeys, lr, payload_width, client rank, client sequence number.
+# op, table, nkeys, lr, payload_width, client rank, client sequence
+# number, shard (-1 = the receiving server's own primary shard).
 # (client, seq) lets the server DEDUPLICATE retried pushes: the transport
 # retries are at-least-once (the reference's ps-lite ``resender.h`` keeps
 # the same ack+dedup discipline), and double-applying a gradient push would
-# silently corrupt training.
-_HDR = struct.Struct("<BiqdIqq")
+# silently corrupt training.  The shard field routes a frame to the right
+# replica after a failover moved serving away from the home rank.
+_HDR = struct.Struct("<BiqdIqqq")
 #: retried pushes are remembered per client this many ops back
 _DEDUP_WINDOW = 4096
+
+
+def _next_backoff(base, prev, cap, rng):
+    """Decorrelated-jitter retry delay (AWS architecture-blog formula):
+    ``min(cap, uniform(base, 3*prev))``.  Unlike the old linear ramp, no
+    two workers sleep the same schedule — a fleet retrying a just-killed
+    primary spreads out instead of stampeding the promoted backup in
+    lockstep.  Split out so the schedule is unit-testable."""
+    return min(cap, rng.uniform(base, 3.0 * max(base, prev)))
 
 
 def _segment_sum(grads, inv, counts):
@@ -127,11 +178,22 @@ def _recv_frame(sock):
 
 
 class StoreServer:
-    """Serves one process's shard over TCP (the reference server role)."""
+    """Serves one process's shard over TCP (the reference server role).
+
+    With ``replication=2`` this server additionally HOLDS (but does not
+    serve) a bitwise replica of shard ``(rank-1) % world``, kept in sync
+    by the op-log frames its primary forwards (``OP_REPLICATE``), and its
+    own primary shard's mutations are mirrored to rank ``(rank+1) %
+    world`` before each ack.  ``OP_PROMOTE`` flips a held replica to
+    serving after the primary dies.  Forwarding rides the owning
+    :class:`DistributedStore`'s client transport via :attr:`rpc_fn`.
+    """
 
     def __init__(self, local: EmbeddingStore, world: int, rank: int,
-                 host="127.0.0.1", port=0):
+                 host="127.0.0.1", port=0, replication=1, standby=False):
         self.local, self.world, self.rank = local, world, rank
+        self.replication = int(replication)
+        self.standby = bool(standby)
         self._ssp_lock = threading.Condition()
         self._clocks = {}          # channel -> per-worker clock vector
         self._hb = {}              # rank -> (monotonic last-seen, step)
@@ -139,6 +201,43 @@ class StoreServer:
         self._applied = {}         # client -> OrderedDict of recent push seqs
         self._applied_lock = threading.Lock()
         self._live_conns = set()
+        # -- replication state (all guarded by _repl_lock where it matters)
+        #: shard -> store holding that shard's rows on this server
+        self._stores = {rank: local}
+        self._ntables = {rank: 0}  # shard -> tables created (idempotent init)
+        #: shards this server ANSWERS for.  A STANDBY (a relaunched
+        #: replacement for a dead rank) starts serving NOTHING: its home
+        #: shard's promoted ex-backup is the live truth, and claiming to
+        #: serve an empty copy would let a role-resolved chaos kill (or a
+        #: stale client) pick the wrong server.  It serves only after
+        #: re-replication + an explicit OP_PROMOTE.
+        standby = bool(standby and self.replication >= 2)
+        self._serving = set() if standby else {rank}
+        #: shards whose local copy may be PROMOTED into serving.  Table
+        #: count alone cannot distinguish synced-from-primary from
+        #: freshly-seed-initialized: a standby whose own training script
+        #: calls init_table has the right table COUNT but step-0 data —
+        #: promoting that would silently reset the shard.  A normal
+        #: bring-up is promotable from the start (deterministic seeded
+        #: init + the op-log keeps the backup bitwise-identical); a
+        #: standby earns promotability only when an OP_SYNC snapshot
+        #: completes (_sync_put loads the last table).
+        self._promotable = set() if standby \
+            else {rank, (rank - 1) % world} if self.replicable else {rank}
+        self._fwd_ok = {}          # shard -> live forwarding enabled
+        self._oplog = {}           # shard -> buffered frames during OP_SYNC
+        self._sync_parts = {}      # (shard, table) -> received snapshot chunks
+        #: ordered apply+forward: the backup must see ops in primary apply
+        #: order, so {apply locally; mirror} is one critical section
+        self._repl_lock = threading.RLock()
+        #: set by the owning DistributedStore — forwards/syncs ride the
+        #: client transport: rpc_fn(peer, op, table, keys, payload=...)
+        self.rpc_fn = None
+        if self.replicable:
+            backup_of = (rank - 1) % world
+            self._stores[backup_of] = EmbeddingStore()
+            self._ntables[backup_of] = 0
+            self._fwd_ok[rank] = True
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -147,6 +246,44 @@ class StoreServer:
         self._stop = False
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+
+    # -- replication topology ----------------------------------------------
+    @property
+    def replicable(self):
+        return self.replication >= 2 and self.world >= 2
+
+    def serves(self, shard):
+        """True iff this server currently ANSWERS for ``shard``."""
+        return shard in self._serving
+
+    def holds(self, shard):
+        """True iff this server keeps a copy of ``shard`` (serving or
+        standby backup) — the chaos kill-backup target predicate."""
+        return shard in self._stores
+
+    def register_table(self, shard):
+        """Owner bookkeeping for a table created directly on ``local``."""
+        with self._repl_lock:
+            self._ntables[shard] = self._ntables.get(shard, 0) + 1
+
+    def _fwd_target(self, shard):
+        """The OTHER holder of ``shard`` in the k=2 ring: its deterministic
+        backup rank when we are the home primary, the home rank when we
+        are the promoted backup."""
+        return (shard + 1) % self.world if self.rank == shard else shard
+
+    def _store_serving(self, shard):
+        """(store, shard) serving ``shard`` (-1 = our home shard), or a
+        client-visible error — a stale route hitting a non-serving holder
+        must get a LOUD 'not served' answer the router can fail over on,
+        never silently read a possibly-stale replica."""
+        if shard < 0:
+            shard = self.rank
+        if shard not in self._serving:
+            raise RuntimeError(
+                f"shard {shard} not served by rank {self.rank} "
+                f"(serving {sorted(self._serving)})")
+        return self._stores[shard], shard
 
     def _accept_loop(self):
         while not self._stop:
@@ -216,39 +353,373 @@ class StoreServer:
                 f"ssp_init(n_workers, channel={channel}) first")
         return v
 
+    # -- op-log forwarding (the replication write path) --------------------
+    def _forward(self, shard, body):
+        """Mirror one already-applied mutating frame to ``shard``'s other
+        holder.  MUST be called under ``_repl_lock`` (same critical
+        section as the local apply), so the backup receives the op-log in
+        primary apply order over one ordered connection.  During an
+        ``OP_SYNC`` snapshot transfer the frame is buffered instead and
+        drained after the snapshot lands (op-log catch-up).  A forward
+        failure degrades to unreplicated serving (availability over
+        redundancy) until ``re_replicate`` restores the backup."""
+        log = self._oplog.get(shard)
+        if log is not None:
+            log.append(bytes(body))
+            return
+        if not self._fwd_ok.get(shard):
+            return
+        try:
+            if self.rpc_fn is None:
+                raise RuntimeError("replication transport not attached")
+            self.rpc_fn(self._fwd_target(shard), OP_REPLICATE, 0,
+                        np.asarray([shard], np.int64), payload=bytes(body))
+        except Exception as e:
+            self._fwd_ok[shard] = False
+            record_fault("repl_forward_failed")
+            import warnings
+            warnings.warn(
+                f"rank {self.rank}: op-log forward for shard {shard} to "
+                f"rank {self._fwd_target(shard)} failed "
+                f"({type(e).__name__}: {e}) — shard now serves "
+                f"UNREPLICATED until re_replicate()", RuntimeWarning)
+
+    def _apply_push(self, shard, store, table, keys, grads, lr, body):
+        """Serving-side push: apply + mirror atomically (see _forward)."""
+        if not self.replicable:
+            store.push(table, keys // self.world, grads, lr)
+            return
+        with self._repl_lock:
+            store.push(table, keys // self.world, grads, lr)
+            self._forward(shard, body)
+
+    def _apply_set_data(self, shard, store, table, arr, body):
+        if not self.replicable:
+            store.set_data(table, arr)
+            return
+        with self._repl_lock:
+            store.set_data(table, arr)
+            self._forward(shard, body)
+
+    def _apply_replicated(self, shard, inner):
+        """Replay one forwarded frame against the HELD (non-serving)
+        replica of ``shard``.  Ordering comes from the sender (one
+        connection, forwards serialized under its _repl_lock), so no lock
+        is needed here beyond the table's own; dedup registers the
+        ORIGINAL (client, seq) so the promotion-window retry of an
+        ack'd-then-died push is recognised as already applied."""
+        iop, itable, inkeys, ilr, iwidth, iclient, iseq, _ = \
+            _HDR.unpack_from(inner)
+        ioff = _HDR.size
+        ikeys = np.frombuffer(inner, np.int64, inkeys, ioff)
+        ioff += inkeys * 8
+        if iop == OP_HEARTBEAT:
+            # mirrored liveness write (shard-0 replication): restamp with
+            # OUR monotonic clock — timestamps don't travel across hosts
+            with self._hb_lock:
+                self._hb[int(ikeys[0])] = (time.monotonic(), int(ikeys[1]))
+            return
+        if iop == OP_SSP_INIT:
+            # mirrored scheduler state (shard-0 replication): the SSP
+            # barrier must survive rank-0 death like the liveness table
+            n, channel = int(ikeys[0]), int(ikeys[1])
+            with self._ssp_lock:
+                cur = self._clocks.get(channel)
+                if cur is None or cur.size != n:
+                    self._clocks[channel] = np.zeros(n, np.int64)
+            return
+        if iop == OP_CLOCK:
+            channel = int(ikeys[1]) if inkeys > 1 else 0
+            worker = int(ikeys[0])
+            if not self._seen(iclient, iseq):
+                with self._ssp_lock:
+                    v = self._clocks.get(channel)
+                    if v is None or v.size <= worker:
+                        # a re-attached standby can see ticks before any
+                        # client re-runs ssp_init — grow instead of
+                        # breaking the whole forward stream
+                        nv = np.zeros(max(self.world, worker + 1),
+                                      np.int64)
+                        if v is not None:
+                            nv[:v.size] = v
+                        v = self._clocks[channel] = nv
+                    v[worker] += 1
+                    self._ssp_lock.notify_all()
+            return
+        store = self._stores.get(shard)
+        if store is None:
+            raise RuntimeError(
+                f"rank {self.rank} holds no replica of shard {shard}")
+        if iop == OP_PUSH:
+            if not self._seen(iclient, iseq):
+                grads = np.frombuffer(inner, np.float32, inkeys * iwidth,
+                                      ioff).reshape(inkeys, iwidth)
+                store.push(itable, ikeys // self.world, grads, ilr)
+        elif iop == OP_PUSH_PULL:
+            npush = int(ikeys[0])
+            if npush and not self._seen(iclient, iseq):
+                grads = np.frombuffer(inner, np.float32, npush * iwidth,
+                                      ioff).reshape(npush, iwidth)
+                store.push(itable, ikeys[1:1 + npush] // self.world,
+                           grads, ilr)
+        elif iop == OP_SET_DATA:
+            n = (len(inner) - ioff) // 4
+            store.set_data(itable, np.frombuffer(
+                inner, np.float32, n, ioff).reshape(-1, iwidth))
+        else:
+            raise RuntimeError(f"op {iop} is not replicable")
+
+    def _init_replica_table(self, shard, table, local_rows, width, opt_id,
+                            seed, lr, beta1, beta2, eps, init_scale):
+        """Create table ``table`` in the held copy of ``shard`` with the
+        SAME init parameters as the primary (deterministic seeded init ⇒
+        bitwise-identical starting state).  Idempotent per table id —
+        retried/raced OP_INIT frames are absorbed."""
+        store = self._stores.get(shard)
+        if store is None:
+            raise RuntimeError(
+                f"rank {self.rank} is not a replica holder for shard "
+                f"{shard} (replication={self.replication})")
+        with self._repl_lock:
+            have = self._ntables.get(shard, 0)
+            if table < have:
+                return               # idempotent re-init
+            if table > have:
+                raise RuntimeError(
+                    f"out-of-order replica init: table {table} before "
+                    f"{have} on shard {shard}")
+            tid = store.init_table(
+                local_rows, width, opt=_OPT_NAMES[opt_id], lr=lr,
+                beta1=beta1, beta2=beta2, eps=eps, seed=seed,
+                init_scale=init_scale)
+            assert tid == table, (tid, table)
+            self._ntables[shard] = table + 1
+
+    def _promote(self, shard, want_tables):
+        """Serve ``shard`` from our held replica (idempotent).  Refuses
+        when we don't hold the shard, hold fewer tables than the client
+        expects, or the copy was never synced (a standby's self-created
+        tables have the right COUNT but seed-initialized data —
+        promoting that would silently reset the shard to step 0 instead
+        of raising a loud both-copies-gone outage)."""
+        with self._repl_lock:
+            if shard in self._serving:
+                return
+            if not self.replicable:
+                raise RuntimeError(
+                    f"rank {self.rank} runs unreplicated "
+                    f"(replication={self.replication}) — cannot promote "
+                    f"shard {shard}")
+            store = self._stores.get(shard)
+            if store is None or self._ntables.get(shard, 0) < want_tables:
+                raise RuntimeError(
+                    f"rank {self.rank} replica of shard {shard} has "
+                    f"{self._ntables.get(shard, 0)}/{want_tables} tables "
+                    f"— not promotable")
+            if shard not in self._promotable and want_tables > 0:
+                raise RuntimeError(
+                    f"rank {self.rank} copy of shard {shard} was never "
+                    f"synced from the serving replica — not promotable")
+            self._serving.add(shard)
+            # the old primary is presumed dead: no forwarding until
+            # re_replicate() attaches a fresh backup
+            self._fwd_ok[shard] = False
+            record_fault("ps_promoted")
+
+    def _sync_to(self, shard, target):
+        """Re-replication source half: snapshot every table of ``shard``
+        (the store's own streamed save format — v3 chunked for the numpy
+        fallback), push it to ``target`` in bounded ``OP_SYNC_PUT``
+        frames, then drain the op-log buffered during the transfer and
+        resume live forwarding.  Mutations are blocked only for the
+        snapshot-to-disk and the drain, not the transfer; the transfer
+        streams chunk-by-chunk off the temp files so peak RSS stays one
+        chunk, never a table copy (the v3 format's whole point)."""
+        import tempfile
+        if shard not in self._serving:
+            raise RuntimeError(
+                f"rank {self.rank} does not serve shard {shard} — "
+                f"only the serving replica can source a sync")
+        if not self.replicable:
+            raise RuntimeError("replication disabled on this server")
+        if target != self._fwd_target(shard):
+            raise RuntimeError(
+                f"shard {shard}: rank {target} is not its replica slot "
+                f"(expected {self._fwd_target(shard)})")
+        store = self._stores[shard]
+        ntabs = self._ntables.get(shard, 0)
+        paths = []
+        with self._repl_lock:
+            if self._fwd_ok.get(shard):
+                return               # redundancy already live: no-op
+            if self._oplog.get(shard) is not None:
+                raise RuntimeError(
+                    f"shard {shard}: sync already in progress")
+            self._fwd_ok[shard] = False
+            self._oplog[shard] = []
+            for tid in range(ntabs):
+                fd, path = tempfile.mkstemp(prefix="hetu_ps_sync_")
+                os.close(fd)
+                paths.append(path)
+                store.save(tid, path)
+        try:
+            chunk = min(_V3_CHUNK, max(1 << 20, MAX_FRAME_BYTES // 2))
+            for tid, path in enumerate(paths):
+                size = os.path.getsize(path)
+                nch = max(1, -(-size // chunk))
+                with open(path, "rb") as f:
+                    for ci in range(nch):
+                        self.rpc_fn(
+                            target, OP_SYNC_PUT, tid,
+                            np.asarray([shard, ci, nch, size, ntabs],
+                                       np.int64),
+                            payload=f.read(chunk))
+            with self._repl_lock:
+                for frame in self._oplog.pop(shard, []):
+                    self.rpc_fn(target, OP_REPLICATE, 0,
+                                np.asarray([shard], np.int64),
+                                payload=frame)
+                self._fwd_ok[shard] = True
+            record_fault("ps_re_replicated")
+        except Exception:
+            with self._repl_lock:
+                self._oplog.pop(shard, None)
+                self._fwd_ok[shard] = False
+            record_fault("ps_re_replicate_failed")
+            raise
+        finally:
+            for path in paths:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def _sync_put(self, shard, table, ci, nch, total, ntabs, payload):
+        """Re-replication sink half: append snapshot chunks straight to a
+        temp file (bounded RSS) and load the completed table via the
+        store's own load path.  Once every one of the shard's ``ntabs``
+        tables has landed, the copy becomes PROMOTABLE.  Chunks arrive in
+        order (one connection); a retried chunk is idempotent."""
+        import tempfile
+        store = self._stores.get(shard)
+        if store is None:
+            raise RuntimeError(
+                f"rank {self.rank} holds no replica of shard {shard}")
+        if shard in self._serving and shard != self.rank:
+            raise RuntimeError(
+                f"rank {self.rank} already SERVES shard {shard} — "
+                f"refusing a snapshot that would overwrite live state")
+        part = self._sync_parts.get((shard, table))
+        if part is None:
+            fd, path = tempfile.mkstemp(prefix="hetu_ps_sync_")
+            os.close(fd)
+            part = self._sync_parts[(shard, table)] = {
+                "path": path, "next": 0}
+        if ci < part["next"]:
+            return                   # retried chunk
+        if ci != part["next"]:
+            raise RuntimeError(
+                f"sync chunk gap: got {ci}, expected {part['next']}")
+        with open(part["path"], "ab") as f:
+            f.write(payload)
+        part["next"] = ci + 1
+        if part["next"] < nch:
+            return
+        del self._sync_parts[(shard, table)]
+        try:
+            if os.path.getsize(part["path"]) != total:
+                raise RuntimeError(
+                    f"sync snapshot truncated: "
+                    f"{os.path.getsize(part['path'])}/{total} bytes")
+            store.load(table, part["path"])
+        finally:
+            try:
+                os.unlink(part["path"])
+            except OSError:
+                pass
+        with self._repl_lock:
+            done = self._sync_parts.setdefault(("loaded", shard), set())
+            done.add(table)
+            if len(done) >= ntabs:
+                del self._sync_parts[("loaded", shard)]
+                self._promotable.add(shard)
+
     def _handle(self, conn, body):
-        op, table, nkeys, lr, width, client, seq = _HDR.unpack_from(body)
+        op, table, nkeys, lr, width, client, seq, shard = \
+            _HDR.unpack_from(body)
         off = _HDR.size
         keys = np.frombuffer(body, np.int64, nkeys, off)
         off += nkeys * 8
         if op == OP_PULL:
-            out = self.local.pull(table, keys // self.world)
+            store, shard = self._store_serving(shard)
+            out = store.pull(table, keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(out, np.float32).tobytes())
         elif op == OP_PUSH:
+            store, shard = self._store_serving(shard)
             if not self._seen(client, seq):
                 grads = np.frombuffer(body, np.float32, nkeys * width,
                                       off).reshape(nkeys, width)
-                self.local.push(table, keys // self.world, grads, lr)
+                self._apply_push(shard, store, table, keys, grads, lr, body)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_PUSH_PULL:
             # fused SDPushPull: apply the push shard, answer the pull shard,
             # one ack.  The push half is as non-idempotent as OP_PUSH — a
             # retried frame skips it but still serves the (idempotent) pull.
+            store, shard = self._store_serving(shard)
             npush = int(keys[0])
             push_keys = keys[1:1 + npush]
             pull_keys = keys[1 + npush:]
             if npush and not self._seen(client, seq):
                 grads = np.frombuffer(body, np.float32, npush * width,
                                       off).reshape(npush, width)
-                self.local.push(table, push_keys // self.world, grads, lr)
-            out = self.local.pull(table, pull_keys // self.world)
+                self._apply_push(shard, store, table, push_keys, grads, lr,
+                                 body)
+            out = store.pull(table, pull_keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(out, np.float32).tobytes())
         elif op == OP_VERSIONS:
-            v = self.local.versions(table, keys // self.world)
+            store, shard = self._store_serving(shard)
+            v = store.versions(table, keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(v, np.int64).tobytes())
+        elif op == OP_SET_DATA:
+            store, shard = self._store_serving(shard)
+            n = (len(body) - off) // 4
+            arr = np.frombuffer(body, np.float32, n, off).reshape(-1, width)
+            self._apply_set_data(shard, store, table, arr, body)
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_REPLICATE:
+            self._apply_replicated(int(keys[0]), body[off:])
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_PROMOTE:
+            self._promote(int(keys[0]), int(keys[1]))
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_INIT:
+            # keys=[local_rows, width, opt_id, seed]; payload packs the
+            # float init params (NaN init_scale = store default)
+            p = struct.unpack_from("<5d", body, off)
+            self._init_replica_table(
+                shard, table, int(keys[0]), int(keys[1]), int(keys[2]),
+                int(keys[3]), p[0], p[1], p[2], p[3],
+                None if p[4] != p[4] else p[4])
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_SYNC:
+            self._sync_to(int(keys[0]), int(keys[1]))
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_SYNC_PUT:
+            self._sync_put(int(keys[0]), table, int(keys[1]), int(keys[2]),
+                           int(keys[3]), int(keys[4]), body[off:])
+            _send_frame(conn, b"\x00\x01")
+        elif op == OP_CHECKSUM:
+            # full-state digest of ANY held copy (serving or standby) —
+            # tools/ps_fsck.py compares primary vs backup for divergence
+            s = self.rank if shard < 0 else shard
+            store = self._stores.get(s)
+            if store is None:
+                raise RuntimeError(
+                    f"rank {self.rank} holds no copy of shard {s}")
+            _send_frame(conn, b"\x00", store.state_digest(table).encode())
         elif op == OP_SSP_INIT:
             n, channel = int(keys[0]), int(keys[1])
             with self._ssp_lock:
@@ -258,16 +729,24 @@ class StoreServer:
                 cur = self._clocks.get(channel)
                 if cur is None or cur.size != n:
                     self._clocks[channel] = np.zeros(n, np.int64)
+            if self.replicable and 0 in self._serving:
+                with self._repl_lock:
+                    self._forward(0, body)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_CLOCK:
             # clock ticks are as non-idempotent as pushes: a retried tick
             # whose ack was lost must not double-increment (it would fake
-            # an arrival and let stale peers past the SSP bound)
+            # an arrival and let stale peers past the SSP bound).  Like
+            # heartbeats, the scheduler's clock vectors ride shard 0's
+            # replication so the SSP barrier survives rank-0 death.
             channel = int(keys[1]) if nkeys > 1 else 0
             if not self._seen(client, seq):
                 with self._ssp_lock:
                     self._clock_vec(channel)[int(keys[0])] += 1
                     self._ssp_lock.notify_all()
+                if self.replicable and 0 in self._serving:
+                    with self._repl_lock:
+                        self._forward(0, body)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_SSP_SYNC:
             worker, staleness = int(keys[0]), int(keys[1])
@@ -298,6 +777,13 @@ class StoreServer:
             # ping just refreshes the timestamp), so no dedup needed.
             with self._hb_lock:
                 self._hb[int(keys[0])] = (time.monotonic(), int(keys[1]))
+            # the failure DETECTOR must itself survive failure: liveness
+            # state rides shard 0's replication ring, so rank 0's backup
+            # holds a live alive_mask when rank 0 dies (the backup
+            # restamps with its own monotonic clock on apply)
+            if self.replicable and 0 in self._serving:
+                with self._repl_lock:
+                    self._forward(0, body)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_ALIVE:
             # keys=[n_workers], lr carries deadline_ms: int64 mask, 1 iff
@@ -308,13 +794,17 @@ class StoreServer:
             # rank that truly never starts is the launcher/supervisor's
             # failure domain, not the heartbeat's).
             n = int(keys[0])
+            # keys=[n, 1] requests STRICT mode: never-pinged counts dead
+            # (the failover cross-check wants positive evidence of life,
+            # not the benefit of the doubt the exclusion path grants)
+            strict = nkeys > 1 and bool(keys[1])
             deadline_s = (lr if lr > 0 else 10_000.0) / 1e3
             now = time.monotonic()
             mask = np.zeros(n, np.int64)
             with self._hb_lock:
                 for r in range(n):
                     rec = self._hb.get(r)
-                    mask[r] = 1 if rec is None else \
+                    mask[r] = (0 if strict else 1) if rec is None else \
                         int(now - rec[0] <= deadline_s)
             _send_frame(conn, b"\x00", mask.tobytes())
         elif op == OP_SHUTDOWN:
@@ -352,15 +842,41 @@ class DistributedStore:
 
     def __init__(self, rank, world, endpoints=None, host="127.0.0.1",
                  port=0, async_queue=64, rpc_timeout=60.0, rpc_retries=3,
-                 connect_timeout=10.0):
+                 connect_timeout=10.0, replication=None, standby=None):
         self.rank, self.world = rank, world
+        # standby (env HETU_PS_STANDBY, set by the launcher's solo-respawn
+        # path): this process replaces a dead rank — its server holds its
+        # shards but serves nothing until re-replication re-attaches it
+        if standby is None:
+            standby = os.environ.get("HETU_PS_STANDBY", "") == "1"
+        # replication=k (env default HETU_PS_REPLICATION): 1 = today's
+        # single-copy topology, 2 = every shard keeps a live backup on the
+        # next rank (see the module docstring).  world=1 has nowhere to
+        # put a backup, so it silently degrades to 1.
+        if replication is None:
+            replication = int(os.environ.get("HETU_PS_REPLICATION", "1"))
+        replication = int(replication)
+        if not 1 <= replication <= 2:
+            raise ValueError(
+                f"replication={replication} unsupported: 1 (off) or 2 "
+                f"(primary + one ring backup)")
+        self.replication = replication if world >= 2 else 1
         self.local = EmbeddingStore()
-        self.server = StoreServer(self.local, world, rank, host, port)
+        self.server = StoreServer(self.local, world, rank, host, port,
+                                  replication=self.replication,
+                                  standby=standby)
         self.endpoints = list(endpoints) if endpoints else [None] * world
         self.endpoints[rank] = (host, self.server.port)
         self.rpc_timeout = rpc_timeout
         self.rpc_retries = max(1, rpc_retries)
         self.connect_timeout = connect_timeout
+        # retry backoff: exponential with decorrelated jitter (see
+        # _next_backoff) so a worker fleet never stampedes a promoted
+        # backup in lockstep; base is env-tunable
+        self._backoff_base = float(
+            os.environ.get("HETU_RPC_BACKOFF_MS", "50")) / 1e3
+        self._backoff_cap = 1.0
+        self._backoff_rng = random.Random()
         # seq base = time_ns: strictly increasing across process restarts,
         # so a relaunched worker's sequences can never collide with its
         # predecessor's entries still in the server dedup window
@@ -370,10 +886,19 @@ class DistributedStore:
         self._connect_lock = threading.Lock()  # guards the conn dicts
         self._pool = None                      # lazy RPC fan-out pool
         self._tables = {}
+        self._table_init_kw = {}   # tid -> init kwargs (replica re-init)
+        #: shard -> rank currently serving it; failover flips an entry to
+        #: the shard's other replica holder.  Every client converges
+        #: independently (promote is idempotent).
+        self._route = list(range(world))
+        self._failed_over = set()  # shards running without redundancy
         self._queue = queue.Queue(maxsize=async_queue)
         self._async_thread = None
         self._hb_thread = None
         self._hb_stop = threading.Event()
+        # the server's op-log forwards / sync transfers ride this client's
+        # transport (persistent sockets, timeouts, retries)
+        self.server.rpc_fn = self._rpc
         # HETU_CHAOS=seed:spec activates the chaos harness for every store
         # in the process; the server registers as a kill:ps target
         inj = _chaos.active() or _chaos.install_from_env()
@@ -406,24 +931,33 @@ class DistributedStore:
                     pass
 
     def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0,
-             op_timeout=None):
+             op_timeout=None, shard=-1, seq=None, record=True,
+             retries=None):
         """One request/response against ``peer``'s shard.
 
         Transport discipline (reference ``ps-lite/src/resender.h``): every
         socket op carries a timeout, a failed op drops the connection and
-        retries on a fresh one with backoff (the same (client, seq) header
-        lets the server dedup a retried PUSH whose ack was lost), and
-        exhausted retries raise a *diagnosable* RuntimeError naming the
-        peer — never a raw OSError or an unbounded blocking recv (the
-        executor's SSP-watchdog discipline applied to the transport)."""
+        retries on a fresh one with decorrelated-jitter backoff (the same
+        (client, seq) header lets the server dedup a retried PUSH whose
+        ack was lost), and exhausted retries raise a *diagnosable*
+        RuntimeError naming the peer — never a raw OSError or an
+        unbounded blocking recv (the executor's SSP-watchdog discipline
+        applied to the transport).  ``seq`` may be pinned by the caller so
+        a failover retry of the SAME logical op against the promoted
+        backup is recognised by its dedup window (see _rpc_shard)."""
         keys = np.ascontiguousarray(keys, np.int64)
         hdr = _HDR.pack(op, table, keys.size, lr, width, self.rank,
-                        next(self._seq))
+                        next(self._seq) if seq is None else seq, shard)
         last_err = None
-        for attempt in range(self.rpc_retries):
+        delay = 0.0
+        for attempt in range(self.rpc_retries if retries is None
+                             else max(1, retries)):
             if attempt:
-                record_fault("ps_rpc_retry")
-                time.sleep(min(1.0, 0.2 * attempt))
+                if record:
+                    record_fault("ps_rpc_retry")
+                delay = _next_backoff(self._backoff_base, delay,
+                                      self._backoff_cap, self._backoff_rng)
+                time.sleep(delay)
             try:
                 # chaos harness: the active schedule may drop, delay,
                 # duplicate, or wedge this frame (hetu_tpu.chaos); a clean
@@ -456,7 +990,8 @@ class DistributedStore:
                 last_err = e
                 self._drop_conn(peer)
         else:
-            record_fault("ps_peer_unreachable")
+            if record:
+                record_fault("ps_peer_unreachable")
             host_, port_ = self.endpoints[peer] or ("?", "?")
             raise RuntimeError(
                 f"PS peer {peer} at {host_}:{port_} unreachable after "
@@ -467,6 +1002,70 @@ class DistributedStore:
             raise RuntimeError(
                 f"PS rank {peer} error: {resp[1:].decode(errors='replace')}")
         return resp[1:]
+
+    # -- shard routing + client-side failover ------------------------------
+    @staticmethod
+    def _failover_worthy(err):
+        """Exhausted transport (peer dead/wedged) or a stale route hitting
+        a non-serving holder; application errors must still raise."""
+        msg = str(err)
+        return "unreachable" in msg or "not served" in msg
+
+    def _rpc_shard(self, shard, op, table, keys, payload=b"", lr=-1.0,
+                   width=0, op_timeout=None):
+        """Shard-addressed RPC: routes to the rank currently serving
+        ``shard`` and, with ``replication>=2``, turns an unreachable
+        primary into a transparent failover — promote the backup, flip
+        the route, retry THE SAME frame (pinned seq → the backup's dedup
+        window keeps an ack'd-then-died push exactly-once)."""
+        seq = next(self._seq)
+        try:
+            return self._rpc(self._route[shard], op, table, keys, payload,
+                             lr, width, op_timeout, shard=shard, seq=seq)
+        except RuntimeError as e:
+            if self.replication < 2 or not self._failover_worthy(e):
+                raise
+            alt = self._failover(shard, err=e)
+            return self._rpc(alt, op, table, keys, payload, lr, width,
+                             op_timeout, shard=shard, seq=seq)
+
+    def _failover(self, shard, err=None):
+        """Promote ``shard``'s other replica holder and re-route.  Raises
+        (chaining the transport error) when the backup is unreachable or
+        not promotable — both copies gone is a real outage."""
+        dead = self._route[shard]
+        alt = (shard + 1) % self.world if dead == shard else shard
+        record_fault("ps_failover")
+        # best-effort liveness cross-check: telemetry only — the exhausted
+        # retry budget IS the detector, but a mask that still believes the
+        # peer alive flags a possible partition in the failover artifact.
+        # One cheap, counter-silent attempt with a short deadline: in a
+        # double failure (rank 0 dead too) this probe must not stack the
+        # full retry budget on top of the recovery path.
+        if shard != 0:
+            try:
+                hb_ms = float(os.environ.get("HETU_HEARTBEAT_MS", "500"))
+                raw = self._rpc(self._route[0], OP_ALIVE, 0,
+                                np.asarray([self.world, 1], np.int64),
+                                lr=3.0 * hb_ms,
+                                op_timeout=min(2.0, self.rpc_timeout),
+                                record=False, retries=1)
+                if np.frombuffer(raw, np.int64)[dead]:
+                    record_fault("ps_failover_primary_reported_alive")
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+        try:
+            self._rpc(alt, OP_PROMOTE, 0,
+                      np.asarray([shard, len(self._tables)], np.int64))
+        except (RuntimeError, OSError, ConnectionError) as e2:
+            record_fault("ps_failover_failed")
+            raise RuntimeError(
+                f"shard {shard}: serving rank {dead} unreachable AND "
+                f"backup rank {alt} not promotable ({e2})") from err
+        self._route[shard] = alt
+        self._failed_over.add(shard)
+        record_fault("ps_failover_promoted")
+        return alt
 
     def _fanout(self, jobs):
         """Run per-peer jobs concurrently (one in-flight RPC per peer)."""
@@ -482,16 +1081,105 @@ class DistributedStore:
             f.result()
 
     # -- tables ------------------------------------------------------------
+    def _shard_rows(self, rows, shard):
+        return (rows - shard + self.world - 1) // self.world
+
     def _local_rows(self, rows):
-        return (rows - self.rank + self.world - 1) // self.world
+        return self._shard_rows(rows, self.rank)
 
     def init_table(self, rows, width, **kw):
         tid = self.local.init_table(self._local_rows(rows), width, **kw)
+        self.server.register_table(self.rank)
         self._tables[tid] = (rows, width)
+        self._table_init_kw[tid] = dict(kw)
+        if self.replication >= 2:
+            # mirror-init our shard's backup with the SAME parameters:
+            # seeded init is deterministic, so both copies start bitwise
+            # identical and the forwarded op-log keeps them that way
+            self._replica_init(tid, self.rank,
+                               (self.rank + 1) % self.world, patient=True)
         return tid
+
+    def _replica_init(self, tid, shard, target, patient=False):
+        """OP_INIT ``shard``'s table ``tid`` on ``target`` (idempotent).
+
+        ``patient``: table creation at cluster bring-up races the
+        backup's server bind (processes start in arbitrary order), so the
+        init path keeps knocking for a bounded startup grace instead of
+        failing on the first connection refusal.  Re-replication probes
+        stay impatient — a dead standby should defer fast."""
+        rows, width = self._tables[tid]
+        kw = self._table_init_kw.get(tid, {})
+        scale = kw.get("init_scale")
+        keys = np.asarray([self._shard_rows(rows, shard), width,
+                           _OPT_IDS[kw.get("opt", "sgd")],
+                           int(kw.get("seed", 0))], np.int64)
+        payload = struct.pack(
+            "<5d", float(kw.get("lr", 0.01)), float(kw.get("beta1", 0.9)),
+            float(kw.get("beta2", 0.999)), float(kw.get("eps", 1e-7)),
+            float("nan") if scale is None else float(scale))
+        deadline = time.monotonic() + max(3 * self.connect_timeout, 15.0)
+        while True:
+            try:
+                return self._rpc(target, OP_INIT, tid, keys, payload,
+                                 shard=shard, record=not patient)
+            except RuntimeError:
+                if not patient or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
 
     def width(self, table):
         return self._tables[table][1]
+
+    def set_data(self, table, arr):
+        """Scatter a full ``(rows, width)`` array across every shard — and
+        through each shard's replication path, so a replicated cluster
+        seeded this way starts with bitwise-identical primary/backup
+        copies (``s.local.set_data`` would seed only the local primary)."""
+        rows, width = self._tables[table]
+        arr = np.ascontiguousarray(arr, np.float32)
+        if arr.shape != (rows, width):
+            raise ValueError(f"set_data shape {arr.shape} != "
+                             f"({rows}, {width})")
+        jobs = []
+        for s in range(self.world):
+            part = np.ascontiguousarray(arr[s::self.world])
+            if self._route[s] == self.rank and self.server.serves(s):
+                jobs.append(lambda s=s, part=part:
+                            self._local_set_data(s, table, part))
+            else:
+                jobs.append(lambda s=s, part=part: self._rpc_shard(
+                    s, OP_SET_DATA, table, np.zeros(0, np.int64),
+                    part.tobytes(), width=width))
+        self._fanout(jobs)
+
+    # -- serving-local apply (replication-ordered) -------------------------
+    # Ops against a shard WE serve skip the wire but must still ride the
+    # op-log: the server's apply+forward critical section is the single
+    # ordering point for a shard's mutations, whether they arrived over
+    # TCP or from this process's own client.
+    def _local_store(self, shard):
+        return self.server._stores[shard]
+
+    def _local_push(self, shard, table, keys, grads, lr):
+        keys = np.ascontiguousarray(keys, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        body = None
+        if self.server.replicable:
+            body = _HDR.pack(OP_PUSH, table, keys.size, lr, grads.shape[1],
+                             self.rank, next(self._seq), shard) \
+                + keys.tobytes() + grads.tobytes()
+        self.server._apply_push(shard, self._local_store(shard), table,
+                                keys, grads, lr, body)
+
+    def _local_set_data(self, shard, table, part):
+        body = None
+        if self.server.replicable:
+            body = _HDR.pack(OP_SET_DATA, table, 0, -1.0, part.shape[1],
+                             self.rank, next(self._seq), shard) \
+                + part.tobytes()
+        self.server._apply_set_data(shard, self._local_store(shard), table,
+                                    part, body)
 
     # -- sparse ops (EmbeddingStore API) -----------------------------------
     # Wire-level dedup: a zipf-skewed CTR batch (2048x26 ids) is MOSTLY
@@ -539,16 +1227,17 @@ class DistributedStore:
         out = np.empty((uk.size, width), np.float32)
         owners = uk % self.world
         jobs = []
-        for r in range(self.world):
-            sel = np.nonzero(owners == r)[0]
+        for s in range(self.world):
+            sel = np.nonzero(owners == s)[0]
             if not sel.size:
                 continue
-            if r == self.rank:
-                jobs.append(lambda sel=sel: out.__setitem__(
-                    sel, self.local.pull(table, uk[sel] // self.world)))
+            if self._route[s] == self.rank and self.server.serves(s):
+                jobs.append(lambda s=s, sel=sel: out.__setitem__(
+                    sel, self._local_store(s).pull(
+                        table, uk[sel] // self.world)))
             else:
-                def job(r=r, sel=sel):
-                    raw = self._rpc(r, OP_PULL, table, uk[sel])
+                def job(s=s, sel=sel):
+                    raw = self._rpc_shard(s, OP_PULL, table, uk[sel])
                     out[sel] = np.frombuffer(raw, np.float32).reshape(
                         sel.size, width)
                 jobs.append(job)
@@ -566,16 +1255,16 @@ class DistributedStore:
         uk, acc = self._dedup_grads(keys, grads, width)
         owners = uk % self.world
         jobs = []
-        for r in range(self.world):
-            sel = np.nonzero(owners == r)[0]
+        for s in range(self.world):
+            sel = np.nonzero(owners == s)[0]
             if not sel.size:
                 continue
-            if r == self.rank:
-                jobs.append(lambda sel=sel: self.local.push(
-                    table, uk[sel] // self.world, acc[sel], lr))
+            if self._route[s] == self.rank and self.server.serves(s):
+                jobs.append(lambda s=s, sel=sel: self._local_push(
+                    s, table, uk[sel], acc[sel], lr))
             else:
-                jobs.append(lambda r=r, sel=sel: self._rpc(
-                    r, OP_PUSH, table, uk[sel],
+                jobs.append(lambda s=s, sel=sel: self._rpc_shard(
+                    s, OP_PUSH, table, uk[sel],
                     np.ascontiguousarray(acc[sel]).tobytes(), lr, width))
         self._fanout(jobs)
 
@@ -605,27 +1294,26 @@ class DistributedStore:
         powners = upk % self.world
         lowners = ulk % self.world
         jobs = []
-        for r in range(self.world):
-            psel = np.nonzero(powners == r)[0]
-            lsel = np.nonzero(lowners == r)[0]
+        for s in range(self.world):
+            psel = np.nonzero(powners == s)[0]
+            lsel = np.nonzero(lowners == s)[0]
             if not psel.size and not lsel.size:
                 continue
-            if r == self.rank:
-                def local_job(psel=psel, lsel=lsel):
+            if self._route[s] == self.rank and self.server.serves(s):
+                def local_job(s=s, psel=psel, lsel=lsel):
                     if psel.size:
-                        self.local.push(table, upk[psel] // self.world,
-                                        acc[psel], lr)
+                        self._local_push(s, table, upk[psel], acc[psel], lr)
                     if lsel.size:
-                        out[lsel] = self.local.pull(
+                        out[lsel] = self._local_store(s).pull(
                             table, ulk[lsel] // self.world)
                 jobs.append(local_job)
             elif psel.size:
-                def fused_job(r=r, psel=psel, lsel=lsel):
+                def fused_job(s=s, psel=psel, lsel=lsel):
                     frame_keys = np.concatenate(
                         (np.asarray([psel.size], np.int64),
                          upk[psel], ulk[lsel]))
-                    raw = self._rpc(
-                        r, OP_PUSH_PULL, table, frame_keys,
+                    raw = self._rpc_shard(
+                        s, OP_PUSH_PULL, table, frame_keys,
                         np.ascontiguousarray(acc[psel]).tobytes(), lr,
                         width)
                     if lsel.size:
@@ -636,8 +1324,8 @@ class DistributedStore:
                         record_cache("ps_push_pull_fused_rpcs", 1)
                 jobs.append(fused_job)
             else:       # nothing to push at this peer: plain pull
-                def pull_job(r=r, lsel=lsel):
-                    raw = self._rpc(r, OP_PULL, table, ulk[lsel])
+                def pull_job(s=s, lsel=lsel):
+                    raw = self._rpc_shard(s, OP_PULL, table, ulk[lsel])
                     out[lsel] = np.frombuffer(raw, np.float32).reshape(
                         lsel.size, width)
                 jobs.append(pull_job)
@@ -652,16 +1340,17 @@ class DistributedStore:
         out = np.empty(uk.size, np.int64)
         owners = uk % self.world
         jobs = []
-        for r in range(self.world):
-            sel = np.nonzero(owners == r)[0]
+        for s in range(self.world):
+            sel = np.nonzero(owners == s)[0]
             if not sel.size:
                 continue
-            if r == self.rank:
-                jobs.append(lambda sel=sel: out.__setitem__(
-                    sel, self.local.versions(table, uk[sel] // self.world)))
+            if self._route[s] == self.rank and self.server.serves(s):
+                jobs.append(lambda s=s, sel=sel: out.__setitem__(
+                    sel, self._local_store(s).versions(
+                        table, uk[sel] // self.world)))
             else:
-                def vjob(r=r, sel=sel):
-                    raw = self._rpc(r, OP_VERSIONS, table, uk[sel])
+                def vjob(s=s, sel=sel):
+                    raw = self._rpc_shard(s, OP_VERSIONS, table, uk[sel])
                     out[sel] = np.frombuffer(raw, np.int64)
                 jobs.append(vjob)
         self._fanout(jobs)
@@ -698,27 +1387,35 @@ class DistributedStore:
     # clocks live on their own channel — sharing one vector double-
     # incremented per step and broke preduce's 'arrival at step s ⇔
     # clock >= s+1' assumption (round-3 advisor finding).
+    # SSP scheduler state is SHARD-0 traffic like the heartbeats: with
+    # replication>=2 every clock tick / channel init is mirrored to shard
+    # 0's backup (dedup'd under the same (client, seq)), so the barrier
+    # itself fails over with the rest of the shard.
     def ssp_init(self, n_workers, channel=0):
         """Idempotent per (channel, size): every rank may call it."""
-        self._rpc(0, OP_SSP_INIT, 0,
-                  np.asarray([n_workers, channel], np.int64))
+        self._rpc_shard(0, OP_SSP_INIT, 0,
+                        np.asarray([n_workers, channel], np.int64))
 
     def clock(self, worker=None, channel=0):
         w = self.rank if worker is None else worker
-        self._rpc(0, OP_CLOCK, 0, np.asarray([w, channel], np.int64))
+        self._rpc_shard(0, OP_CLOCK, 0, np.asarray([w, channel], np.int64))
 
     def clocks(self, channel=0):
         """Every worker's clock value (rank-0 authoritative copy) — the
         arrival feed for partial-reduce group formation."""
-        raw = self._rpc(0, OP_CLOCKS, 0, np.asarray([channel], np.int64))
+        raw = self._rpc_shard(0, OP_CLOCKS, 0,
+                              np.asarray([channel], np.int64))
         return np.frombuffer(raw, np.int64).copy()
 
     # -- liveness: heartbeats on rank 0 (the scheduler role) ---------------
+    # Routed as SHARD-0 traffic: with replication>=2 the rank-0 server
+    # mirrors every heartbeat write to shard 0's backup, so the failure
+    # detector itself fails over — alive_mask survives rank-0 death.
     def heartbeat(self, rank=None, step=0):
-        """Ping rank 0's liveness table with (rank, step)."""
+        """Ping the liveness table (rank 0, or its promoted backup)."""
         w = self.rank if rank is None else rank
-        self._rpc(0, OP_HEARTBEAT, 0,
-                  np.asarray([w, step], np.int64))
+        self._rpc_shard(0, OP_HEARTBEAT, 0,
+                        np.asarray([w, step], np.int64))
 
     def alive_mask(self, deadline_ms, n_workers=None):
         """int64 mask over workers: 1 iff the rank heartbeated within
@@ -727,8 +1424,8 @@ class DistributedStore:
         handler).  The liveness feed for partial-reduce dead-rank
         exclusion."""
         n = self.world if n_workers is None else n_workers
-        raw = self._rpc(0, OP_ALIVE, 0, np.asarray([n], np.int64),
-                        lr=float(deadline_ms))
+        raw = self._rpc_shard(0, OP_ALIVE, 0, np.asarray([n], np.int64),
+                              lr=float(deadline_ms))
         return np.frombuffer(raw, np.int64).copy()
 
     def start_heartbeat(self, interval_ms=None, step_fn=None):
@@ -764,19 +1461,104 @@ class DistributedStore:
         # deadline must outlive the requested wait (timeout_ms=0 means
         # "wait for stragglers" — bounded here at 600s rather than forever,
         # so a dead scheduler still surfaces as a diagnosable error)
-        raw = self._rpc(0, OP_SSP_SYNC, 0,
-                        np.asarray([w, staleness, channel], np.int64),
-                        lr=timeout_ms / 1e3 if timeout_ms else -1.0,
-                        op_timeout=(timeout_ms / 1e3 + 30.0) if timeout_ms
-                        else 600.0)
+        raw = self._rpc_shard(0, OP_SSP_SYNC, 0,
+                              np.asarray([w, staleness, channel], np.int64),
+                              lr=timeout_ms / 1e3 if timeout_ms else -1.0,
+                              op_timeout=(timeout_ms / 1e3 + 30.0)
+                              if timeout_ms else 600.0)
         return raw == b"\x01"
 
+    # -- re-replication (redundancy repair after a failover) ---------------
+    def re_replicate(self, shard=None):
+        """Restore ``replication=2`` redundancy for ``shard`` (default:
+        every shard this client failed over): re-create the replica
+        tables on the shard's vacant holder (``OP_INIT``, idempotent),
+        then have the serving replica stream a chunked snapshot and drain
+        its op-log catch-up (``OP_SYNC``/``OP_SYNC_PUT``).  After this, a
+        SECOND failure of the shard is survivable — the router promotes
+        the freshly attached copy."""
+        if self.replication < 2:
+            raise RuntimeError("re_replicate needs replication >= 2")
+        shards = sorted(self._failed_over) if shard is None else [shard]
+        for s in shards:
+            serving = self._route[s]
+            target = s if serving != s else (s + 1) % self.world
+            for tid in sorted(self._tables):
+                self._replica_init(tid, s, target)
+            if serving == self.rank:
+                self.server._sync_to(s, target)
+            else:
+                self._rpc(serving, OP_SYNC, 0,
+                          np.asarray([s, target], np.int64),
+                          op_timeout=max(self.rpc_timeout, 600.0))
+            self._failed_over.discard(s)
+
+    def re_replicate_async(self, shard=None):
+        """Background :meth:`re_replicate`; failures surface as the
+        ``ps_re_replicate_failed`` counter + a warning, not a crash."""
+        def run():
+            try:
+                self.re_replicate(shard)
+            except (RuntimeError, OSError, ConnectionError) as e:
+                import warnings
+                warnings.warn(f"background re-replication failed: {e}",
+                              RuntimeWarning)
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"hetu-resync-{self.rank}")
+        t.start()
+        return t
+
+    def maybe_re_replicate(self):
+        """Opportunistic redundancy repair (the executor's step-hook
+        driver, ``HETU_PS_REREPLICATE_EVERY``): for each shard running
+        without a backup — one this client failed over, or one OUR server
+        serves whose op-log forwarding broke (the backup died) — try one
+        re-replication; a still-dead target defers quietly to the next
+        tick.  Returns True iff any shard was repaired."""
+        if self.replication < 2:
+            return False
+        pending = set(self._failed_over)
+        srv = self.server
+        if srv.replicable:
+            for s in list(srv._serving):
+                if not srv._fwd_ok.get(s) and srv._oplog.get(s) is None:
+                    pending.add(s)
+        if not pending:
+            return False
+        repaired = False
+        for s in sorted(pending):
+            try:
+                self.re_replicate(s)
+                repaired = True
+            except (RuntimeError, OSError, ConnectionError):
+                record_fault("ps_re_replicate_deferred")
+        return repaired
+
+    def table_checksum(self, table, shard, rank=None):
+        """Full-state digest of ``shard``'s copy of ``table`` held on
+        ``rank`` (default: the serving rank) — the live divergence
+        detector behind ``tools/ps_fsck.py --verify``."""
+        peer = self._route[shard] if rank is None else rank
+        if peer == self.rank:
+            return self.server._stores[shard].state_digest(table)
+        raw = self._rpc(peer, OP_CHECKSUM, table, np.zeros(0, np.int64),
+                        shard=shard)
+        return raw.decode()
+
     # -- shard persistence (reference per-server SaveParam) ----------------
+    # Shard files are named by SHARD, not by rank, and cover every shard
+    # this server currently SERVES: after a failover the promoted server
+    # checkpoints the shard it adopted (otherwise post-failover
+    # auto-saves would silently omit the adopted shard's live state),
+    # and a not-yet-synced standby serves nothing — so its executor's
+    # auto-save can never overwrite a shard file with seed-init data.
     def save(self, table, path):
-        self.local.save(table, f"{path}.shard{self.rank}")
+        for shard in sorted(self.server._serving):
+            self.server._stores[shard].save(table, f"{path}.shard{shard}")
 
     def load(self, table, path):
-        self.local.load(table, f"{path}.shard{self.rank}")
+        for shard in sorted(self.server._serving):
+            self.server._stores[shard].load(table, f"{path}.shard{shard}")
 
     def close(self):
         self._hb_stop.set()
@@ -785,7 +1567,11 @@ class DistributedStore:
             self._queue.put(None)
         for peer in list(self._conns):
             try:
-                self._rpc(peer, OP_SHUTDOWN, 0, np.zeros(0, np.int64))
+                # best-effort goodbye: an already-dead peer during an
+                # ordered teardown is not a FAULT — don't record one
+                self._rpc(peer, OP_SHUTDOWN, 0, np.zeros(0, np.int64),
+                          op_timeout=min(5.0, self.rpc_timeout),
+                          record=False, retries=1)
             except (OSError, RuntimeError, ConnectionError):
                 pass     # peer already gone; _rpc dropped the conn
             self._drop_conn(peer)

@@ -12,6 +12,14 @@ the HET cache when given a :class:`CacheSparseTable`) right before the step
 and pushes the dense row-gradient straight after, so the device never holds
 the full table — that is the trillion-parameter capability path
 (reference README.md:19).
+
+Fault transparency: this op carries NO failover logic on purpose.  With a
+replicated :class:`~hetu_tpu.ps.dist_store.DistributedStore`
+(``replication=2``) a killed shard primary is absorbed one layer down —
+the store's shard router promotes the backup and re-routes inside the
+same ``pull``/``push`` call, so the graph op, the HET cache's
+transactional paths (plan → one fallible round trip → commit), and the
+executor's step loop all run unchanged through a PS failure.
 """
 from __future__ import annotations
 

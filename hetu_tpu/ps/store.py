@@ -14,6 +14,7 @@ A pure-numpy fallback covers environments without a C++ toolchain.
 from __future__ import annotations
 
 import json
+import os
 import struct
 
 import numpy as np
@@ -21,6 +22,7 @@ import numpy as np
 from .build import get_lib
 
 _OPT_IDS = {"sgd": 0, "momentum": 1, "nesterov": 2, "adagrad": 3, "adam": 4}
+_OPT_NAMES = {v: k for k, v in _OPT_IDS.items()}
 
 #: v3 numpy-table checkpoint: magic + JSON header + raw array bytes,
 #: streamed in bounded chunks (a 10^7x64 table must checkpoint without a
@@ -327,6 +329,42 @@ class EmbeddingStore:
                     getattr(t, name)[:] = blobs[name]
         else:                      # v1 file: bare .npy of the data
             t.data[:] = np.load(path)
+
+    def state_digest(self, table, chunk=_V3_CHUNK):
+        """sha256 hex digest over the table's FULL state — data slab,
+        optimizer moments, per-row versions — streamed in bounded slices
+        (never a whole-table copy).  Two replicas that applied the same
+        op-log agree bitwise iff their digests agree, so this is the
+        replica-divergence detector behind ``OP_CHECKSUM`` and
+        ``tools/ps_fsck.py``.  Native tables digest their streamed save
+        file (same full-state coverage); compare like flavours only."""
+        import hashlib
+        h = hashlib.sha256()
+        if self._lib:
+            import tempfile
+            fd, path = tempfile.mkstemp(prefix="hetu_ps_digest_")
+            os.close(fd)
+            try:
+                self.save(table, path)
+                with open(path, "rb") as f:
+                    while True:
+                        b = f.read(chunk)
+                        if not b:
+                            break
+                        h.update(b)
+            finally:
+                os.unlink(path)
+            return h.hexdigest()
+        t = self._np_tables[table]
+        with t._lock:   # a mid-push digest would tear data vs moments
+            for name in ("data", "version", "s0", "s1", "t"):
+                a = getattr(t, name)
+                if a is None:
+                    continue
+                mv = memoryview(np.ascontiguousarray(a)).cast("B")
+                for off in range(0, len(mv), chunk):
+                    h.update(mv[off:off + chunk])
+        return h.hexdigest()
 
     # -- SSP (bounded staleness barrier) ----------------------------------
     #: set by ssp_init — the native clock/ssp_sync entry points index the

@@ -73,9 +73,51 @@ def test_chaos_spec_errors_are_loud():
                 "7:drop=1.5",            # prob out of range
                 "7:delay=0.5",           # delay without duration
                 "7:kill:ps@rank1",       # kill without step
+                "7:kill:primary@rank1:step3",   # role kill needs shard
+                "7:kill:backup@shard1",         # role kill without step
                 "x:drop=0.5"):           # non-int seed
         with pytest.raises(chaos.ChaosSpecError):
             chaos.parse_spec(bad)
+
+
+def test_chaos_replica_role_kill_specs_parse():
+    _, faults = chaos.parse_spec(
+        "7:kill:primary@shard1:step3,kill:backup@shard0:step2")
+    assert faults[0] == {"kind": "kill_primary", "shard": 1, "step": 3}
+    assert faults[1] == {"kind": "kill_backup", "shard": 0, "step": 2}
+
+
+def test_chaos_role_kills_resolve_serving_and_holding_servers():
+    """kill:primary targets whoever SERVES the shard at fire time;
+    kill:backup targets the non-serving holder — after a failover the
+    same spec form therefore tracks the promoted server (the double-kill
+    schedules in bench --config failover rely on exactly this)."""
+    from hetu_tpu.ps.dist_store import DistributedStore
+    ports = _free_ports(2)
+    endpoints = [("127.0.0.1", p) for p in ports]
+    stores = [DistributedStore(r, 2, endpoints, port=ports[r],
+                               rpc_timeout=5.0, rpc_retries=2,
+                               connect_timeout=2.0, replication=2)
+              for r in range(2)]
+    inj = chaos.ChaosInjector.from_spec(
+        "7:kill:backup@shard0:step1,kill:primary@shard0:step2")
+    for r, s in enumerate(stores):
+        inj.register_server(r, s.server)
+    try:
+        tid = None
+        for s in stores:
+            tid = s.init_table(8, 4, opt="sgd", lr=1.0, init_scale=0)
+        # step 1: shard 0's BACKUP (held, unserved, on rank 1) dies
+        assert inj.on_step(1) == [1]
+        assert stores[1].server._stop and not stores[0].server._stop
+        assert fault_counts().get("chaos_kill_backup", 0) == 1
+        # step 2: shard 0's PRIMARY (serving, rank 0) dies
+        assert inj.on_step(2) == [0]
+        assert stores[0].server._stop
+        assert fault_counts().get("chaos_kill_primary", 0) == 1
+    finally:
+        for s in stores:
+            s.close()
 
 
 def test_chaos_install_from_env(monkeypatch):

@@ -662,3 +662,64 @@ def test_supervise_chaos_proc_kill_then_recovery(tmp_path):
     assert fc.get("chaos_kill_proc", 0) == 1
     assert fc.get("supervisor_restart", 0) == 1
     reset_faults()
+
+
+def test_monitor_standby_respawns_dead_rank_without_killing_job(tmp_path):
+    """PS-replication failure policy: with a ``standby`` respawner the
+    job survives a dead rank — the survivors keep running, the rank is
+    relaunched solo (as HETU_PS_STANDBY=1), and the job still resolves
+    rc=0.  Past the budget the kill-all policy returns."""
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    reset_faults()
+    marker = tmp_path / "died.once"
+    script = _write(tmp_path, "worker.py", f"""
+        import os, sys, time
+        if os.environ.get("HETU_PS_STANDBY") == "1":
+            sys.exit(0)            # the respawned standby finishes clean
+        if int(os.environ.get("HETU_PROCESS_ID", "0")) == 1 \\
+                and not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            sys.exit(9)            # first life of rank 1 dies
+        time.sleep(0.5)
+        sys.exit(0)
+    """)
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    procs = launcher.launch(config, script, ssh=False)
+
+    def respawn(rank):
+        return launcher._launch_rank(config, rank, script, ssh=False,
+                                     extra_env={"HETU_PS_STANDBY": "1"})
+
+    rc = launcher.monitor(procs, poll_s=0.05, standby=respawn,
+                          standby_budget=2, log=lambda m: None)
+    assert rc == 0
+    assert fault_counts().get("standby_spawn", 0) == 1
+    assert fault_counts().get("supervisor_restart", 0) == 0
+    reset_faults()
+
+
+def test_monitor_standby_budget_exhausted_falls_back_to_kill_all(tmp_path):
+    from hetu_tpu import launcher
+    from hetu_tpu.context import DistConfig
+    from hetu_tpu.metrics import reset_faults
+    script = _write(tmp_path, "alwaysdie.py", """
+        import os, sys, time
+        if int(os.environ.get("HETU_PROCESS_ID", "0")) == 1:
+            sys.exit(4)
+        time.sleep(30)
+    """)
+    config = DistConfig(num_hosts=2, hosts=["localhost", "localhost"])
+    procs = launcher.launch(config, script, ssh=False)
+
+    def respawn(rank):
+        return launcher._launch_rank(config, rank, script, ssh=False)
+
+    import time as _time
+    t0 = _time.monotonic()
+    rc = launcher.monitor(procs, poll_s=0.05, standby=respawn,
+                          standby_budget=1, log=lambda m: None)
+    assert rc == 4
+    assert _time.monotonic() - t0 < 20, "kill-all fallback did not fire"
+    reset_faults()
