@@ -64,6 +64,8 @@ from .profiler import HetuProfiler, CollectiveProfiler
 # reference script compat: ht.NCCLProfiler is the collectives
 # profiler's name there (profiler.py:390); same surface here
 NCCLProfiler = CollectiveProfiler
+from . import analysis
+from .analysis import lint, GraphValidationError
 from . import autoparallel
 from . import onnx
 from . import gnn

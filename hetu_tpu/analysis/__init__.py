@@ -1,0 +1,23 @@
+"""Static graph analysis: total shape/dtype inference + lint rules.
+
+``ht.lint(fetches, feeds=...)`` verifies a define-then-run graph BEFORE
+anything compiles: an abstract interpreter (``jax.eval_shape`` over each
+op's own lowering rule) assigns every node a static ``(shape, dtype)`` with
+zero FLOPs, and a registry of lint rules turns graph bugs into diagnostics
+that name the offending node and the user line that created it.
+
+``Executor(validate='warn'|'error'|'off')`` (default ``'warn'``) runs the
+same rules at construction and checks fed values against declared
+placeholder shapes on every ``run()``.
+
+The framework's own static analysis (lock-order, RPC opcode drift, metric
+coverage) lives in ``tools/hetu_lint.py`` — an AST pass gated by
+``tests/test_lint.py``.
+"""
+from .shapes import GraphShapes, abstract_infer_shape, infer_graph
+from .lint import (RULES, Diagnostic, GraphInfo, GraphValidationError,
+                   LintReport, lint, rule)
+
+__all__ = ["GraphShapes", "abstract_infer_shape", "infer_graph",
+           "RULES", "Diagnostic", "GraphInfo", "GraphValidationError",
+           "LintReport", "lint", "rule"]

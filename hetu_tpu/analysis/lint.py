@@ -1,0 +1,482 @@
+"""Graph lint: rule registry + actionable, provenance-carrying diagnostics.
+
+Every rule sees the whole fetch subgraph with its static shapes (from
+:mod:`hetu_tpu.analysis.shapes`) and yields :class:`Diagnostic`s that name
+the offending node AND the user line that created it (``Op.creation_site``)
+— so ``Executor(validate='error')`` fails fast with "your feed disagrees
+with placeholder 'x' created at train.py:42", not an XLA trace dump.
+
+Rule catalog (see README "Static analysis & graph validation"):
+
+* ``uninferable`` (error) — a node's abstract lowering raised
+* ``shape-rule-mismatch`` (error) — hand ``infer_shape`` disagrees with
+  the abstract interpreter
+* ``feed-mismatch`` (error) — fed value shape/dtype disagrees with the
+  placeholder's declaration
+* ``grad-nontrainable`` (error) — gradient requested w.r.t. a
+  non-trainable / non-variable node
+* ``duplicate-var-name`` (warn) — two variables share a checkpoint name
+* ``ps-embedding-width`` (error) — declared embedding width != the PS
+  table's actual width
+* ``mesh-axis`` (warn) — an op / sharding names a mesh axis the
+  executor's mesh does not have (silent fallback / silent replication)
+* ``pipeline-stage`` (error/warn) — pipeline stages don't divide over the
+  'pp' axis; ht.context placement chain fragments
+* ``flash-fallback`` (warn) — attention config statically guaranteed to
+  fall off the Pallas flash path on TPU (ragged causal mod-128,
+  unsupported mask/bias broadcast shape)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..graph.node import Op, PlaceholderOp, format_site
+from ..graph.gradients import GradientOp
+from .shapes import GraphShapes, infer_graph, _normalize_feeds
+
+#: rule name -> callable(GraphInfo) -> iterable[Diagnostic]
+RULES = {}
+
+
+def rule(name):
+    def deco(fn):
+        RULES[name] = fn
+        fn.rule_name = name
+        return fn
+    return deco
+
+
+@dataclass
+class Diagnostic:
+    rule: str
+    severity: str          # 'error' | 'warn'
+    message: str
+    node: object = None    # offending Op, when one exists
+    #: True for analyzer-internal problems (a rule crashed): reported,
+    #: but never escalated to an exception — an analyzer bug must not
+    #: reject a working graph
+    internal: bool = False
+
+    def __str__(self):
+        loc = ""
+        if self.node is not None:
+            loc = (f" [node '{self.node.name}' created at "
+                   f"{format_site(getattr(self.node, 'creation_site', None))}]")
+        return f"{self.severity}[{self.rule}]: {self.message}{loc}"
+
+
+class GraphInfo:
+    """What a lint rule sees: topo + static shapes + executor config."""
+
+    def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
+                 feed_values=None):
+        self.shapes = shapes
+        self.topo = shapes.topo
+        self.feeds = feeds
+        #: {node: actual fed array} for feeds given as VALUES (not bare
+        #: shapes) — lets rules check value-level properties statically
+        self.feed_values = feed_values or {}
+        self.mesh = mesh
+        self.pipeline = pipeline
+
+    def shape(self, node):
+        return self.shapes.shape(node)
+
+    def struct(self, node):
+        return self.shapes.struct(node)
+
+
+class LintReport:
+    """Diagnostics + the shape assignment they were derived from."""
+
+    def __init__(self, shapes: GraphShapes, diagnostics):
+        self.shapes = shapes
+        order = {"error": 0, "warn": 1}
+        self.diagnostics = sorted(diagnostics,
+                                  key=lambda d: order.get(d.severity, 2))
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics if d.severity == "warn"]
+
+    @property
+    def ok(self):
+        return not self.diagnostics
+
+    @property
+    def complete(self):
+        """Every value-producing node got a static (shape, dtype)."""
+        return self.shapes.complete
+
+    def __bool__(self):
+        return self.ok
+
+    def __str__(self):
+        if self.ok:
+            return "lint: clean"
+        return "\n".join(str(d) for d in self.diagnostics)
+
+    def raise_errors(self, all_severities=False):
+        bad = self.diagnostics if all_severities else self.errors
+        bad = [d for d in bad if not d.internal]
+        if bad:
+            raise GraphValidationError(
+                "graph validation failed:\n" +
+                "\n".join(f"  {d}" for d in bad))
+
+
+class GraphValidationError(ValueError):
+    """Raised by ``Executor(validate='error')`` / ``LintReport.raise_errors``."""
+
+
+# --------------------------------------------------------------------- rules
+
+@rule("uninferable")
+def _r_uninferable(gi):
+    for node, why in gi.shapes.failed.items():
+        yield Diagnostic(
+            "uninferable", "error",
+            f"abstract evaluation of {node.op_type} '{node.name}' failed: "
+            f"{why}", node)
+
+
+@rule("shape-rule-mismatch")
+def _r_shape_rule(gi):
+    """Cross-check hand-written shape rules against the interpreter."""
+    for node in gi.topo:
+        if node in gi.shapes.failed or node in gi.shapes.pending \
+                or isinstance(node, (PlaceholderOp, GradientOp)):
+            continue
+        if not _has_hand_rule(node):
+            continue
+        in_shapes = [gi.shape(i) for i in node.inputs]
+        if any(s is None for s in in_shapes):
+            continue
+        try:
+            declared = node.infer_shape(in_shapes)
+        except Exception as e:
+            yield Diagnostic(
+                "shape-rule-mismatch", "error",
+                f"hand shape rule of {node.op_type} '{node.name}' raised "
+                f"{type(e).__name__}: {e}", node)
+            continue
+        if declared is None:
+            continue
+        actual = gi.shape(node)
+        if _norm_shape(declared) != _norm_shape(actual):
+            yield Diagnostic(
+                "shape-rule-mismatch", "error",
+                f"hand shape rule of {node.op_type} '{node.name}' says "
+                f"{_norm_shape(declared)} but its lowering produces "
+                f"{_norm_shape(actual)}", node)
+
+
+def _has_hand_rule(node):
+    if getattr(node, "has_shape_rule", None) is not None:
+        return bool(node.has_shape_rule)   # SimpleOp: explicit shape_fn
+    # other subclasses: an overridden infer_shape method is a hand rule
+    return type(node).infer_shape is not Op.infer_shape
+
+
+def _norm_shape(s):
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_norm_shape(x) if isinstance(x, (tuple, list))
+                     else int(x) for x in s)
+    return s
+
+
+@rule("feed-mismatch")
+def _r_feed(gi):
+    for node, st in gi.feeds.items():
+        if isinstance(st, (tuple, list)):
+            continue  # nested (multi-part) feed: no single shape to check
+        if not isinstance(node, PlaceholderOp):
+            yield Diagnostic(
+                "feed-mismatch", "error",
+                f"feed target '{getattr(node, 'name', node)}' is not a "
+                f"placeholder (op type {getattr(node, 'op_type', '?')})",
+                node if isinstance(node, Op) else None)
+            continue
+        if node.is_variable:
+            yield Diagnostic(
+                "feed-mismatch", "error",
+                f"'{node.name}' is a variable, not a fed placeholder — "
+                f"use executor.load_dict / set_value to change it", node)
+            continue
+        if node.shape is not None and tuple(st.shape) != tuple(node.shape):
+            yield Diagnostic(
+                "feed-mismatch", "error",
+                f"feed for placeholder '{node.name}' has shape "
+                f"{tuple(st.shape)} but the placeholder declares "
+                f"{tuple(node.shape)}", node)
+            continue
+        # dtype: the executor ADOPTS the declared dtype (feeds are cast),
+        # so a kind mismatch is only an error when the cast would destroy
+        # actual values — checkable when the feed was given as values
+        val = gi.feed_values.get(node)
+        if node.dtype is not None and val is not None \
+                and np.issubdtype(np.dtype(node.dtype), np.integer) \
+                and np.issubdtype(np.asarray(val).dtype, np.floating) \
+                and not np.all(np.mod(np.asarray(val), 1.0) == 0):
+            yield Diagnostic(
+                "feed-mismatch", "error",
+                f"feed for placeholder '{node.name}' holds fractional "
+                f"float values but the placeholder declares "
+                f"{np.dtype(node.dtype)} — the executor's dtype adoption "
+                f"would truncate them", node)
+
+
+@rule("grad-nontrainable")
+def _r_grad(gi):
+    for node in gi.topo:
+        if not isinstance(node, GradientOp):
+            continue
+        wrt = node.wrt
+        if not (isinstance(wrt, PlaceholderOp) and wrt.is_variable):
+            yield Diagnostic(
+                "grad-nontrainable", "error",
+                f"gradient requested w.r.t. '{wrt.name}' which is not a "
+                f"variable ({wrt.op_type})", wrt)
+        elif not wrt.trainable:
+            yield Diagnostic(
+                "grad-nontrainable", "error",
+                f"gradient requested w.r.t. NON-TRAINABLE variable "
+                f"'{wrt.name}' — the optimizer would silently train it "
+                f"(mark trainable=True or drop it from the loss params)",
+                wrt)
+
+
+@rule("duplicate-var-name")
+def _r_dup_names(gi):
+    seen = {}
+    for node in gi.topo:
+        if isinstance(node, PlaceholderOp) and node.is_variable:
+            first = seen.setdefault(node.name, node)
+            if first is not node:
+                yield Diagnostic(
+                    "duplicate-var-name", "warn",
+                    f"two variables share checkpoint name '{node.name}' "
+                    f"(first created at "
+                    f"{format_site(first.creation_site)}) — the executor "
+                    f"renames the second to '{node.name}~1', making the "
+                    f"checkpoint identity creation-order-dependent", node)
+
+
+@rule("ps-embedding-width")
+def _r_ps_width(gi):
+    for node in gi.topo:
+        if not getattr(node, "is_ps", False):
+            continue
+        store, table = node.store, node.table
+        if not hasattr(store, "width"):
+            continue
+        try:
+            actual = int(store.width(table))
+        except Exception as e:
+            yield Diagnostic(
+                "ps-embedding-width", "error",
+                f"PS embedding '{node.name}': table {table} is not "
+                f"readable from its store ({type(e).__name__}: {e})", node)
+            continue
+        if node.width is not None and int(node.width) != actual:
+            yield Diagnostic(
+                "ps-embedding-width", "error",
+                f"PS embedding '{node.name}' declares width {node.width} "
+                f"but table {table} has width {actual} — every pulled row "
+                f"would be mis-shaped", node)
+
+
+#: graph ops whose lowering changes behavior based on a named mesh axis;
+#: with a mesh lacking the axis they SILENTLY run the fallback path
+_MESH_AXIS_OPS = {
+    "AllToAll": ("ep",),
+    "HAllToAll": ("ep", "ep_outer", "ep_inner"),
+    "RingAttention": ("cp",),
+    "RingAttentionMasked": ("cp",),
+    "UlyssesAttention": ("cp",),
+    "UlyssesAttentionMasked": ("cp",),
+    "PipelineBlock": ("pp",),
+}
+
+
+@rule("mesh-axis")
+def _r_mesh_axis(gi):
+    if gi.mesh is None:
+        return  # single-device run: fallback paths are the intended paths
+    axes = set(gi.mesh.axis_names)
+    for node in gi.topo:
+        want = _MESH_AXIS_OPS.get(node.op_type)
+        if want and not any(a in axes for a in want):
+            yield Diagnostic(
+                "mesh-axis", "warn",
+                f"{node.op_type} '{node.name}' expects mesh axis "
+                f"'{want[0]}' but the executor mesh has axes "
+                f"{sorted(axes)} — it will silently run its "
+                f"non-distributed fallback", node)
+        spec = getattr(node, "sharding", None)
+        if spec is not None:
+            missing = [a for a in spec
+                       if a is not None and not isinstance(a, tuple)
+                       and a not in axes]
+            if missing:
+                yield Diagnostic(
+                    "mesh-axis", "warn",
+                    f"sharding of '{node.name}' names mesh axes "
+                    f"{missing} absent from the executor mesh "
+                    f"{sorted(axes)} — those dims will be REPLICATED",
+                    node)
+
+
+@rule("pipeline-stage")
+def _r_pipeline(gi):
+    # (a) PipelineBlock stages must divide over the mesh 'pp' axis
+    if gi.mesh is not None and "pp" in gi.mesh.axis_names:
+        pp = gi.mesh.shape["pp"]
+        for node in gi.topo:
+            if node.op_type != "PipelineBlock":
+                continue
+            n = getattr(node, "n_stages", None)
+            if n and pp > 1 and n % pp != 0:
+                yield Diagnostic(
+                    "pipeline-stage", "error",
+                    f"PipelineBlock '{node.name}' has {n} stages over a "
+                    f"'pp' axis of size {pp} — stages must divide evenly "
+                    f"across pipeline ranks", node)
+    # (b) interop placement contiguity: run-length segmentation over topo
+    # order must not fragment (each alternation = one boundary transfer +
+    # a separate jit)
+    segments, prev = [], None
+    for node in gi.topo:
+        if isinstance(node, (PlaceholderOp, GradientOp)) \
+                or node.raw_ctx is None:
+            continue
+        key = repr(node.raw_ctx)
+        if key != prev:
+            segments.append((key, node))
+            prev = key
+    distinct = len({k for k, _ in segments})
+    if distinct and len(segments) > 2 * distinct:
+        first_bounce = segments[distinct][1]
+        yield Diagnostic(
+            "pipeline-stage", "warn",
+            f"ht.context placement fragments into {len(segments)} "
+            f"segments over {distinct} device groups — ops per device "
+            f"are not contiguous in graph order (first bounce at "
+            f"'{first_bounce.name}'); group each stage's ops together",
+            first_bounce)
+
+
+#: attention op types -> (index of k input, index of mask input or None,
+#: index of bias input or None)
+_ATTN_OPS = {
+    "ScaledDotProductAttention": (1, None, None),
+    "ScaledDotProductAttentionVarlen": (1, None, None),
+    "ScaledDotProductAttentionMasked": (1, 3, None),
+    "ScaledDotProductAttentionBias": (1, None, 3),
+    "ScaledDotProductAttentionMaskedBias": (1, 3, 4),
+    "RingAttention": (1, None, 3),
+    "UlyssesAttention": (1, None, 3),
+    "RingAttentionMasked": (1, 3, 4),
+    "UlyssesAttentionMasked": (1, 3, 4),
+}
+
+
+@rule("flash-fallback")
+def _r_flash(gi):
+    """Static predictor of the attention dispatchers'
+    ``flash_fallback_reason``: configs that are GUARANTEED to leave the
+    Pallas fast path on TPU are flagged before anything runs (ragged
+    causal mod-128 bucketing, unsupported mask/bias broadcast shapes)."""
+    from ..ops.attention import (_FLASH_MIN_LEN, _broadcastable_extra,
+                                 _causal_bucketable)
+    for node in gi.topo:
+        spec = _ATTN_OPS.get(node.op_type)
+        if spec is None:
+            continue
+        k_i, m_i, b_i = spec
+        q = gi.struct(node.inputs[0])
+        k = gi.struct(node.inputs[k_i]) if k_i < len(node.inputs) else None
+        if q is None or k is None:
+            continue
+        if q.shape[-2] < _FLASH_MIN_LEN:
+            # below the empirical dispatch gate the einsum path is the
+            # INTENDED path (XLA fusion wins at short seq) — nothing to
+            # warn about
+            continue
+        causal = bool(node.attrs.get("causal", False))
+        if not _causal_bucketable(q, k, causal):
+            yield Diagnostic(
+                "flash-fallback", "warn",
+                f"{node.op_type} '{node.name}': causal attention with "
+                f"ragged lengths (q={q.shape[-2]}, kv={k.shape[-2]}) — "
+                f"{q.shape[-2] % 128} != {k.shape[-2] % 128} (mod 128), "
+                f"so on TPU this falls back to einsum attention "
+                f"(reason 'causal_ragged_mismatch'); pad q/kv to matching "
+                f"mod-128 lengths", node)
+        for what, idx in (("mask", m_i), ("bias", b_i)):
+            if idx is None or idx >= len(node.inputs):
+                continue
+            extra = gi.struct(node.inputs[idx])
+            if extra is not None and hasattr(extra, "shape") \
+                    and not _broadcastable_extra(q, k, extra):
+                yield Diagnostic(
+                    "flash-fallback", "warn",
+                    f"{node.op_type} '{node.name}': {what} shape "
+                    f"{tuple(extra.shape)} is outside the flash kernel's "
+                    f"broadcast support (1|B, 1|H, 1|S_q, S_kv) — on TPU "
+                    f"this falls back to einsum attention (reason "
+                    f"'{what}_shape')", node)
+
+
+# ----------------------------------------------------------------- entry
+
+def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
+         num_microbatches=None, rules=None):
+    """Statically verify a fetch subgraph; returns a :class:`LintReport`.
+
+    ``feeds``: example values (or bare shapes) for placeholders declared
+    without a static shape, e.g. ``ht.lint([loss], feeds={x: (32, 784)})``.
+    ``mesh`` / ``pipeline`` / ``num_microbatches``: the executor
+    configuration the graph will compile under (enables the mesh-axis and
+    pipeline-stage rules, and keeps schedule-sensitive lowering on the
+    same path the executor uses).  ``rules``: optional iterable of rule
+    names to run (default: all registered rules).
+    """
+    if isinstance(fetches, Op):
+        fetches = [fetches]
+    shapes = infer_graph(fetches, feeds=feeds, mesh=mesh, training=training,
+                         num_microbatches=num_microbatches,
+                         pipeline=pipeline)
+    feed_values = {}
+    if feeds:
+        by_name = {n.name: n for n in shapes.topo
+                   if isinstance(n, PlaceholderOp)}
+        for k, v in feeds.items():
+            node = by_name.get(k) if isinstance(k, str) else k
+            if node is not None and hasattr(v, "dtype") \
+                    and hasattr(v, "shape"):
+                feed_values[node] = v
+    gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
+                   mesh=mesh, pipeline=pipeline, feed_values=feed_values)
+    diags = []
+    selected = RULES if rules is None else {
+        name: RULES[name] for name in rules}
+    for name, fn in selected.items():
+        try:
+            diags.extend(fn(gi))
+        except Exception as e:
+            # one rule crashing must not take down the report (the
+            # analyzer can never be the thing that breaks a graph)
+            diags.append(Diagnostic(
+                name, "warn",
+                f"lint rule crashed: {type(e).__name__}: {e} — "
+                f"report it; the rule was skipped", internal=True))
+    return LintReport(shapes, diags)

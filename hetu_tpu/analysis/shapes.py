@@ -1,0 +1,348 @@
+"""Abstract shape/dtype interpretation of fetch subgraphs — zero FLOPs.
+
+The GSPMD lesson (PAPERS.md): whole-graph static propagation of shapes is
+what makes errors *local* — a mis-shaped feed should fail at the node that
+disagrees, not as an opaque XLA tracing error minutes into compilation.
+
+Every op here already carries the ground truth: its ``lower`` rule.
+``jax.eval_shape`` evaluates that rule over ``jax.ShapeDtypeStruct``
+inputs, so every node gets a static ``(shape, dtype)`` without executing
+anything — no hand-written per-op shape rules needed (where hand rules
+exist they are CROSS-CHECKED against this interpreter by the
+``shape-rule-mismatch`` lint).
+
+Two paths:
+
+* :func:`infer_graph` — whole-subgraph inference: one ``eval_shape`` trace
+  over a topo walk (fast path), with a per-node fallback that isolates the
+  failing node when the single trace dies.
+* :func:`abstract_infer_shape` — the ``Op.infer_shape`` fallback: derive
+  one node's output shape from input *shapes only* (dtypes are guessed,
+  float32 first), so legacy shape consumers (ONNX export, planners) see
+  real shapes for every op instead of ``None`` holes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import LowerCtx, PlaceholderOp, topo_sort
+from ..graph.gradients import GradientOp
+
+#: pending reason marker for nodes downstream of a shapeless feed — these
+#: are "unknown until run time", not errors (the run-time feed check in the
+#: executor covers them); FAILED nodes raised during abstract lowering.
+PENDING, FAILED = "pending", "failed"
+
+
+def _shape_of(struct):
+    """Pytree of structs -> pytree of plain shape tuples."""
+    if struct is None:
+        return None
+    if isinstance(struct, (tuple, list)):
+        return tuple(_shape_of(s) for s in struct)
+    return tuple(struct.shape)
+
+
+def _dtype_of(struct):
+    if struct is None:
+        return None
+    if isinstance(struct, (tuple, list)):
+        return tuple(_dtype_of(s) for s in struct)
+    return np.dtype(struct.dtype)
+
+
+def _as_struct(val, default_dtype=np.float32):
+    """array | ShapeDtypeStruct | bare shape tuple -> ShapeDtypeStruct."""
+    import jax
+    if val is None:
+        return None
+    if isinstance(val, jax.ShapeDtypeStruct):
+        return val
+    if hasattr(val, "shape") and hasattr(val, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(val.shape), np.dtype(val.dtype))
+    if isinstance(val, (tuple, list)):
+        if len(val) and isinstance(val[0], (tuple, list)):
+            return tuple(_as_struct(v, default_dtype) for v in val)
+        return jax.ShapeDtypeStruct(tuple(int(d) for d in val),
+                                    np.dtype(default_dtype))
+    if np.isscalar(val):
+        return jax.ShapeDtypeStruct((), np.asarray(val).dtype)
+    raise TypeError(f"cannot derive a ShapeDtypeStruct from {type(val)}")
+
+
+class GraphShapes:
+    """Static ``(shape, dtype)`` assignment for one fetch subgraph.
+
+    ``structs``: node -> ShapeDtypeStruct (or a tuple of them for
+    multi-output ops).  ``pending``: node -> reason, for nodes whose shape
+    depends on a feed with no static shape (resolved at run time, not an
+    error).  ``failed``: node -> reason, for nodes whose abstract lowering
+    raised — a real graph bug, surfaced by the ``uninferable`` lint rule.
+    ``markers``: side-effect nodes (optimizer updates) that produce no
+    tensor value.
+    """
+
+    def __init__(self, topo):
+        self.topo = topo
+        self.structs = {}
+        self.pending = {}
+        self.failed = {}
+        self.markers = []
+
+    @property
+    def complete(self):
+        """Every value-producing node has a static (shape, dtype)."""
+        return not self.pending and not self.failed
+
+    def struct(self, node):
+        return self.structs.get(node)
+
+    def shape(self, node):
+        return _shape_of(self.structs.get(node))
+
+    def dtype(self, node):
+        return _dtype_of(self.structs.get(node))
+
+
+def _normalize_feeds(feeds, topo):
+    """{node-or-name: array/shape/struct} -> {PlaceholderOp: struct}."""
+    out = {}
+    if not feeds:
+        return out
+    by_name = {}
+    for n in topo:
+        if isinstance(n, PlaceholderOp):
+            by_name.setdefault(n.name, n)
+    for k, v in feeds.items():
+        node = by_name.get(k) if isinstance(k, str) else k
+        if node is None:
+            continue
+        dt = getattr(node, "dtype", None) or np.float32
+        out[node] = _as_struct(v, default_dtype=dt)
+    return out
+
+
+def _ps_struct(node, feeds, structs):
+    """PS-embedding leaf: rows for the ids batch -> ids.shape + (width,)."""
+    import jax
+    idn = node.ids_node
+    ids = structs.get(idn) or feeds.get(idn)
+    if ids is None:
+        ids = _leaf_struct(idn, feeds) \
+            if isinstance(idn, PlaceholderOp) else None
+    if ids is None:
+        return None
+    width = node.width
+    if width is None and hasattr(node.store, "width"):
+        width = int(node.store.width(node.table))
+    if width is None:
+        return None
+    return jax.ShapeDtypeStruct(tuple(ids.shape) + (int(width),),
+                                np.float32)
+
+
+def _leaf_struct(node, feeds):
+    """Struct for a placeholder/variable leaf, or None when unknowable."""
+    import jax
+    if node in feeds:
+        st = feeds[node]
+        # feeds dominate for FED placeholders; a declared-shape mismatch
+        # is the feed-mismatch rule's job, not silent adoption
+        if not node.is_variable:
+            return st
+    shape = node.shape
+    if shape is None and hasattr(node, "shape_from"):
+        ref = node.shape_from
+        shape = getattr(ref, "shape", None)
+    if shape is None:
+        return None
+    dt = node.dtype or np.float32
+    if np.dtype(dt) == np.float64:  # executor downcasts f64 feeds/params
+        dt = np.float32
+    return jax.ShapeDtypeStruct(tuple(int(d) for d in shape), np.dtype(dt))
+
+
+def _node_eval(node, in_structs, mesh=None, training=True,
+               num_microbatches=None, pipeline=None):
+    """eval_shape one node's lowering over input structs."""
+    import jax
+    from ..metrics import suppress_perf_counters
+
+    def f(*xs):
+        ctx = LowerCtx(training, jax.random.key(0), mesh,
+                       num_microbatches=num_microbatches, pipeline=pipeline)
+        return node.lower(ctx, *xs)
+
+    with suppress_perf_counters():
+        return jax.eval_shape(f, *in_structs)
+
+
+def infer_graph(fetches, feeds=None, mesh=None, training=True,
+                num_microbatches=None, pipeline=None):
+    """Assign a static ``(shape, dtype)`` to every node of the fetch
+    subgraph without executing it.
+
+    ``feeds``: optional {placeholder-node-or-name: array | shape | struct}
+    supplying shapes for placeholders declared without one.  ``mesh`` /
+    ``num_microbatches`` / ``pipeline``: the executor's configuration,
+    threaded into lowering contexts so schedule-sensitive ops
+    (PipelineBlock, collectives) abstract-evaluate the SAME path they
+    would compile — a different microbatch count could otherwise fail the
+    abstract trace on a graph that compiles fine.
+    """
+    from ..optim.optimizer import OptimizerOp
+
+    if isinstance(fetches, dict):
+        fetches = [n for fl in fetches.values() for n in fl]
+    elif not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    topo = topo_sort([f for f in fetches if f is not None])
+    gs = GraphShapes(topo)
+    feeds = _normalize_feeds(feeds, topo)
+
+    compute = []
+    for node in topo:
+        if isinstance(node, OptimizerOp):
+            gs.markers.append(node)
+        elif isinstance(node, GradientOp):
+            continue  # resolved after its wrt leaf below
+        elif isinstance(node, PlaceholderOp):
+            try:
+                st = _ps_struct(node, feeds, gs.structs) \
+                    if getattr(node, "is_ps", False) \
+                    else _leaf_struct(node, feeds)
+            except Exception as e:  # corrupt store/feed metadata
+                gs.failed[node] = f"{type(e).__name__}: {e}"
+                continue
+            if st is None:
+                gs.pending[node] = (
+                    "no static shape: declare shape= or pass a feed "
+                    "example to ht.lint(feeds=...)")
+            else:
+                gs.structs[node] = st
+        else:
+            compute.append(node)
+
+    # GradientOp mirrors its wrt leaf; do a fixpoint-free single pass
+    # (wrt is always a leaf, resolved above)
+    for node in topo:
+        if isinstance(node, GradientOp):
+            st = gs.structs.get(node.wrt)
+            if st is not None:
+                gs.structs[node] = st
+            else:
+                gs.pending[node] = f"wrt {node.wrt.name} has no static shape"
+
+    # collect the computable set in topo order, propagating pending-ness
+    runnable = []
+    have = set(gs.structs)
+    for node in compute:
+        bad = next((i for i in node.inputs if i not in have), None)
+        if bad is None:
+            runnable.append(node)
+            have.add(node)
+        elif bad in gs.failed:
+            gs.pending[node] = f"input '{bad.name}' failed abstract eval"
+        else:
+            gs.pending[node] = f"input '{bad.name}' has no static shape"
+
+    if runnable:
+        # fast path: ONE eval_shape trace over the whole runnable set
+        import jax
+        from ..metrics import suppress_perf_counters
+        run_set = set(runnable)
+        leaf_nodes = [n for n in topo if n in gs.structs
+                      and n not in run_set]
+
+        def fwd(leaf_vals):
+            ctx = LowerCtx(training, jax.random.key(0), mesh,
+                           num_microbatches=num_microbatches,
+                           pipeline=pipeline)
+            env = dict(zip(leaf_nodes, leaf_vals))
+            outs = {}
+            for node in runnable:
+                env[node] = node.lower(ctx, *[env[i] for i in node.inputs])
+                outs[str(node.id)] = env[node]
+            return outs
+
+        try:
+            with suppress_perf_counters():
+                out = jax.eval_shape(fwd, [gs.structs[n]
+                                           for n in leaf_nodes])
+            for node in runnable:
+                gs.structs[node] = out[str(node.id)]
+        except Exception:
+            # isolate the failing node(s): per-node abstract evaluation,
+            # downstream nodes of a failure flip to pending
+            for node in runnable:
+                bad = next((i for i in node.inputs
+                            if i not in gs.structs), None)
+                if bad is not None:
+                    gs.pending[node] = \
+                        f"input '{bad.name}' could not be inferred"
+                    continue
+                try:
+                    gs.structs[node] = _node_eval(
+                        node, [gs.structs[i] for i in node.inputs],
+                        mesh, training, num_microbatches, pipeline)
+                except Exception as e:
+                    gs.failed[node] = f"{type(e).__name__}: {e}"
+    return gs
+
+
+def _nested(shape):
+    return bool(shape) and isinstance(shape[0], (tuple, list))
+
+
+def _structs_for(input_shapes, dtypes):
+    import jax
+    out = []
+    for s, dt in zip(input_shapes, dtypes):
+        if _nested(s):
+            out.append(tuple(jax.ShapeDtypeStruct(tuple(x), np.float32)
+                             for x in s))
+        else:
+            out.append(jax.ShapeDtypeStruct(tuple(int(d) for d in s),
+                                            np.dtype(dt)))
+    return out
+
+
+def abstract_infer_shape(node, input_shapes, mesh=None):
+    """Best-effort static output shape for ONE node from input shapes only.
+
+    This is the ``Op.infer_shape`` fallback.  Input dtypes are unknown at
+    this API (the legacy rule signature carries shapes only), so a small
+    ladder of guesses is tried: all-float32, then one-int32 flips (index
+    operands: embedding ids, gather indices), then all-int32.  Returns a
+    shape tuple (or tuple of shape tuples for multi-output ops), or
+    ``None`` when the inputs are unknown / the rule needs runtime context.
+    """
+    if input_shapes is None:
+        input_shapes = []
+    input_shapes = list(input_shapes)
+    if any(s is None for s in input_shapes):
+        return None
+    key = tuple(tuple(s) if not _nested(s) else tuple(map(tuple, s))
+                for s in input_shapes)
+    cache = node.__dict__.setdefault("_abs_shape_cache", {})
+    if key in cache:
+        return cache[key]
+    n = len(input_shapes)
+    combos = [[np.float32] * n]
+    for i in range(n):
+        flip = [np.float32] * n
+        flip[i] = np.int32
+        combos.append(flip)
+    if n > 1:
+        combos.append([np.int32] * n)
+    result = None
+    for dts in combos:
+        try:
+            out = _node_eval(node, _structs_for(input_shapes, dts),
+                             mesh, training=False)
+        except Exception:
+            continue
+        result = _shape_of(out)
+        break
+    cache[key] = result
+    return result

@@ -15,7 +15,7 @@ from .cost_model import (HardwareSpec, LayerSpec, MemoryCostModel, Strategy,
                          TimeCostModel, transformer_layer_spec,
                          attention_layer_spec, mlp_layer_spec,
                          embedding_layer_spec, model_layer_specs,
-                         swin_layer_specs)
+                         swin_layer_specs, graph_layer_spec)
 from .search import DPAlg, candidate_strategies, search
 from .plan import ParallelPlan
 

@@ -13,7 +13,7 @@ constants.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass(frozen=True)
@@ -358,8 +358,97 @@ def swin_layer_specs(image_size, patch_size, embed_dim, depths, num_heads,
     return specs
 
 
+#: matmul-family op -> index of the LEFT matrix operand (Addmm/Baddbmm
+#: carry the additive input first)
+_MATMUL_OPS = {"MatrixMult": 0, "Linear": 0, "BatchMatrixMult": 0,
+               "Addmm": 1, "Baddbmm": 1}
+_ATTN_OPS = ("ScaledDotProductAttention", "RingAttention",
+             "UlyssesAttention")
+
+
+def _matmul_flops(node, gs, out_shape):
+    """2·(output elements)·(contracted size) for one matmul-family node,
+    or None when shapes are unknown."""
+    import numpy as np
+    t = node.op_type
+    if t == "Einsum":
+        eq = node.attrs.get("subscripts", "")
+        if "->" not in eq:
+            return None
+        lhs, out = eq.split("->")
+        terms = lhs.split(",")
+        shapes = [gs.shape(i) for i in node.inputs]
+        sizes = {}
+        for term, shp in zip(terms, shapes):
+            if shp is None or len(term) != len(shp):
+                return None
+            sizes.update(zip(term, shp))
+        contracted = [sizes[lab] for lab in set("".join(terms)) - set(out)]
+        if not contracted:
+            return None
+        return 2.0 * float(np.prod(out_shape)) * float(np.prod(contracted))
+    a_idx = _MATMUL_OPS[t]
+    if a_idx >= len(node.inputs):
+        return None
+    a = gs.shape(node.inputs[a_idx])
+    if not a:
+        return None
+    k = a[-2] if node.attrs.get("trans_A", False) else a[-1]
+    return 2.0 * float(np.prod(out_shape)) * float(k)
+
+
+def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
+                     count=1):
+    """Derive a :class:`LayerSpec` from a REAL fetch subgraph.
+
+    Uses the static shape assignment from
+    :func:`hetu_tpu.analysis.infer_graph` (every node's ``(shape, dtype)``
+    with zero FLOPs — no more ``None`` holes), so the cost model prices
+    the graph that will actually compile instead of a hand-derived
+    approximation:
+
+    * ``param_bytes`` — sum over trainable variable leaves,
+    * ``fwd_flops`` — 2·M·N·K over every matmul-family node (attention
+      score/value contractions counted from q/k shapes),
+    * ``act_bytes`` — sum of output bytes over compute nodes (the
+      activation liveset upper bound that remat/pipeline p2p trade in).
+    """
+    import numpy as np
+    from ..analysis.shapes import infer_graph
+    from ..graph.node import PlaceholderOp
+
+    gs = infer_graph(fetches, feeds=feeds)
+    params = flops = acts = 0.0
+    attn = False
+    for node in gs.topo:
+        st = gs.struct(node)
+        if st is None or isinstance(st, (tuple, list)):
+            continue
+        nbytes = float(np.prod(st.shape)) * dtype_bytes if st.shape \
+            else float(dtype_bytes)
+        if isinstance(node, PlaceholderOp):
+            if node.is_variable and getattr(node, "trainable", False):
+                params += nbytes
+            continue
+        acts += nbytes
+        if node.op_type in _MATMUL_OPS or node.op_type == "Einsum":
+            f = _matmul_flops(node, gs, st.shape)
+            if f:
+                flops += f
+        elif node.op_type.startswith(_ATTN_OPS) and len(node.inputs) >= 2:
+            q = gs.shape(node.inputs[0])
+            kv = gs.shape(node.inputs[1])
+            if q and kv:
+                b_h = float(np.prod(q[:-2]))
+                s_q, d = float(q[-2]), float(q[-1])
+                s_kv = float(kv[-2])
+                attn = True
+                flops += 2.0 * 2.0 * b_h * s_q * s_kv * d  # scores + values
+    return LayerSpec(name, params, flops, acts, count=count, attn=attn)
+
+
 __all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
            "TimeCostModel", "transformer_layer_spec",
            "attention_layer_spec", "mlp_layer_spec",
            "embedding_layer_spec", "model_layer_specs",
-           "swin_layer_specs"]
+           "swin_layer_specs", "graph_layer_spec"]

@@ -15,7 +15,6 @@ Standard mesh axes (SURVEY.md §7 design mapping):
 from __future__ import annotations
 
 import contextlib
-import re
 
 import numpy as np
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from .graph.node import Op
-from .ops.base import SimpleOp, def_op
+from .ops.base import def_op
 
 
 # -- sparse matmul (COO edge-list form) --------------------------------------

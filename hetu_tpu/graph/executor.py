@@ -32,7 +32,7 @@ import warnings
 import numpy as np
 
 from .node import Op, PlaceholderOp, LowerCtx, topo_sort
-from .gradients import GradientOp, gradients  # re-export parity
+from .gradients import GradientOp
 from ..ndarray import NDArray
 
 
@@ -360,6 +360,8 @@ class SubExecutor:
     def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False):
         import jax
         ex = self.ex
+        if getattr(ex, "validate", "off") != "off" and feed_dict:
+            ex._check_feeds(self, feed_dict)
         if self._jit is None:
             self._build_step()
 
@@ -669,6 +671,15 @@ class Executor:
         # remat: recompute activations in backward (jax.checkpoint) —
         # capability analogue of the reference's memory reuse plan
         self.remat = bool(kwargs.pop("remat", False))
+        # validate: static graph verification (hetu_tpu.analysis) at
+        # construction + fed-shape checks on every run().  'warn' (default)
+        # reports diagnostics as warnings; 'error' fails fast with the
+        # offending node and its creation site; 'off' skips analysis.
+        self.validate = kwargs.pop("validate", "warn")
+        if self.validate not in ("warn", "error", "off"):
+            raise ValueError(f"validate={self.validate!r}: expected "
+                             "'warn', 'error', or 'off'")
+        self._feed_warned = set()
         # preemption-safe auto-checkpointing: every `auto_save_every`
         # training steps an atomic checkpoint lands under `auto_save_dir`
         # (keep-last-`auto_save_keep` retention); SIGTERM/SIGINT triggers
@@ -753,8 +764,68 @@ class Executor:
             else:
                 self.subexecutors[name] = SubExecutor(name, fetches, self)
 
+        self._validate_graphs()
+
         if self._auto_resume and self.auto_save_dir:
             self.resume(self.auto_save_dir)
+
+    # -- static validation (hetu_tpu.analysis) -----------------------------
+
+    def _validate_graphs(self):
+        """Construction-time graph lint (``validate='warn'|'error'``).
+
+        Rules that need no feed shapes (grad-onto-non-trainable, duplicate
+        checkpoint names, PS table width, mesh-axis validity, pipeline
+        contiguity, static flash-fallback prediction, hand-shape-rule
+        cross-checks) run here, so a broken graph fails at construction
+        with the node name + creation site instead of minutes into XLA
+        tracing.  Fed-value shapes are checked per ``run()``."""
+        if self.validate == "off":
+            return
+        from ..analysis import lint as lint_graph
+        for name, fetches in self.eval_node_dict.items():
+            try:
+                report = lint_graph(fetches, mesh=self.mesh,
+                                    pipeline=self.pipeline,
+                                    num_microbatches=self.num_microbatches)
+            except Exception as e:
+                # the analyzer must never be the thing that breaks a
+                # working graph — report and continue
+                warnings.warn(f"graph lint crashed on subgraph "
+                              f"'{name}': {type(e).__name__}: {e}",
+                              RuntimeWarning)
+                continue
+            if report.diagnostics:
+                if self.validate == "error":
+                    report.raise_errors(all_severities=True)
+                warnings.warn(
+                    f"graph lint found {len(report.diagnostics)} issue(s) "
+                    f"in subgraph '{name}' "
+                    f"(Executor(validate='off') silences):\n{report}",
+                    UserWarning)
+
+    def _check_feeds(self, sub, feed_dict):
+        """Fed values vs declared placeholder shapes/dtypes — the run-time
+        half of ``validate=`` (feeds are only known here)."""
+        from ..analysis.lint import GraphValidationError
+        from .node import format_site
+        for node in sub.feed_nodes:
+            if node not in feed_dict or node.shape is None:
+                continue
+            val = feed_dict[node]
+            shape = tuple(val.shape) if hasattr(val, "shape") \
+                else tuple(np.shape(val))
+            if shape == tuple(node.shape):
+                continue
+            msg = (f"feed for placeholder '{node.name}' has shape "
+                   f"{shape} but the placeholder declares "
+                   f"{tuple(node.shape)} [created at "
+                   f"{format_site(node.creation_site)}]")
+            if self.validate == "error":
+                raise GraphValidationError(msg)
+            if node.id not in self._feed_warned:
+                self._feed_warned.add(node.id)
+                warnings.warn(msg, UserWarning)
 
     # -- variable init ----------------------------------------------------
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .node import Op, PlaceholderOp, LowerCtx
+from .node import PlaceholderOp, LowerCtx
 
 __all__ = ["detect_interop", "InterOpSubExecutor"]
 

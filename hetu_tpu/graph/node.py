@@ -15,10 +15,48 @@ rules as in the reference (``executor.py:1071``); gradients are taken with
 """
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
 
 # Global monotonically increasing id for deterministic topo-order tie-breaking.
 _NODE_COUNTER = 0
+
+#: package root — frames inside it are framework internals, not the user's
+#: graph-building code (provenance wants the USER call site)
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _creation_site(skip=2, max_depth=25):
+    """(filename, lineno, function) of the innermost frame OUTSIDE the
+    hetu_tpu package — the user line that created this node.  Captured on
+    every ``Op.__init__`` so graph diagnostics (``ht.lint``, executor
+    ``validate=``) can say *where* a bad node came from, not just its
+    auto-generated name.  A frame walk (no traceback object) keeps this
+    cheap enough to run unconditionally."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # pragma: no cover - shallow stack
+        return None
+    last = None
+    for _ in range(max_depth):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        last = (fn, f.f_lineno, f.f_code.co_name)
+        if not fn.startswith(_PKG_DIR):
+            return last
+        f = f.f_back
+    return last
+
+
+def format_site(site):
+    """Human-readable creation site ('file:line in func')."""
+    if not site:
+        return "<unknown site>"
+    fn, line, func = site
+    return f"{fn}:{line} in {func}"
 
 
 def _next_id() -> int:
@@ -85,6 +123,8 @@ class Op:
         self.inputs = list(inputs)
         self.attrs = attrs
         self.name = name or f"{self.op_type}_{self.id}"
+        # Provenance: the user line that created this node (diagnostics)
+        self.creation_site = _creation_site()
         # Placement metadata (DeviceGroup / sharding spec); consumed by the
         # distribution layer, ignored in single-device runs.
         from ..context import current_context
@@ -96,8 +136,17 @@ class Op:
         raise NotImplementedError(f"{self.op_type} has no lowering rule")
 
     def infer_shape(self, input_shapes):
-        """Optional static shape rule (used by tests and the planner)."""
-        return None
+        """Static output shape from input shapes.
+
+        Ops without a hand-written rule fall back to the abstract
+        interpreter (:mod:`hetu_tpu.analysis.shapes`): ``jax.eval_shape``
+        of this node's ``lower`` rule over ``ShapeDtypeStruct``s — zero
+        FLOPs, real shapes for EVERY op instead of ``None`` holes.
+        Returns ``None`` only when the inputs are unknown or the lowering
+        cannot be abstractly evaluated outside its runtime context.
+        """
+        from ..analysis.shapes import abstract_infer_shape
+        return abstract_infer_shape(self, input_shapes)
 
     # -- python operator sugar (parity with Node.py:48-109) ---------------
     def __add__(self, other):
@@ -139,7 +188,7 @@ class Op:
         return div_const_op(self, const_attr=1.0 / other)
 
     def __rtruediv__(self, other):
-        from ..ops.arithmetic import div_handle_zero_op, const_div_op
+        from ..ops.arithmetic import const_div_op
         if isinstance(other, Op):  # pragma: no cover
             raise TypeError
         return const_div_op(self, const_attr=other)

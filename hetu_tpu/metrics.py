@@ -4,9 +4,36 @@ counters (flash-attention fallback accounting, fault-tolerance events)."""
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 
 import numpy as np
+
+# ------------------------------------------------------- counter suppression
+# The static analyzer (``hetu_tpu.analysis``) abstractly evaluates op
+# lowering rules with ``jax.eval_shape``; dispatch-time counters (flash
+# fallbacks) must not record those fake traces as real dispatches.
+
+# thread-LOCAL: an abstract trace on one thread must not silence real
+# dispatch recording (or the HETU_REQUIRE_FLASH hard-fail) on another
+_suppress = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_perf_counters():
+    """Scope in which dispatch-time perf counters do not record (used by
+    abstract shape evaluation, which traces lowering rules without running
+    them).  Per-thread: only the analyzing thread is suppressed."""
+    _suppress.depth = getattr(_suppress, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _suppress.depth -= 1
+
+
+def counters_suppressed():
+    """True inside a :func:`suppress_perf_counters` scope (this thread)."""
+    return getattr(_suppress, "depth", 0) > 0
 
 # --------------------------------------------------- flash fallback counters
 # The attention dispatchers record WHY a call left the Pallas fast path
@@ -24,6 +51,8 @@ _flash_lock = threading.Lock()
 
 def record_flash_fallback(reason):
     """Count one attention dispatch that fell back off the flash path."""
+    if counters_suppressed():
+        return  # abstract (eval_shape) trace, not a real dispatch
     with _flash_lock:
         _flash_fallbacks[str(reason)] += 1
 

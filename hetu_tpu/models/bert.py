@@ -12,7 +12,7 @@ from .. import ops
 from .. import initializers as init
 from ..graph.node import Variable
 from ..layers.attention import MultiHeadAttention
-from ..layers.core import Linear, LayerNorm, DropOut, Embedding
+from ..layers.core import Linear, LayerNorm, Embedding
 
 
 class BertConfig:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .context import DLContext, cpu, gpu
+from .context import DLContext, gpu
 
 
 class NDArray:

@@ -12,7 +12,7 @@ import numpy as np
 from ..graph.node import PlaceholderOp
 from ..graph.executor import Executor, topo_sort
 from . import proto
-from .proto import Attribute, Graph, Model, Node, Tensor, ValueInfo
+from .proto import Graph, Model, Node, Tensor, ValueInfo
 
 _EXPORTERS = {}
 

@@ -60,7 +60,11 @@ sin_op = def_op("Sin", lambda c, a: jnp.sin(a), _same)
 cos_op = def_op("Cos", lambda c, a: jnp.cos(a), _same)
 floor_op = def_op("Floor", lambda c, a: jnp.floor(a), _same)
 bool_op = def_op("Bool", lambda c, a: (a != 0).astype(jnp.float32), _same)
-pow_op = def_op("Pow", lambda c, a, p=2.0: jnp.power(a, p), _same)
+# no hand shape rule: Pow is built both as pow_op(a, p=scalar) and (via
+# the ONNX importer) as pow_op(a, b) with a TENSOR exponent — `_same`
+# mis-handled the second form (caught by the shape-rule-mismatch lint);
+# the abstract-interpreter fallback covers both, broadcasting included
+pow_op = def_op("Pow", lambda c, a, p=2.0: jnp.power(a, p))
 clamp_op = def_op("Clamp",
                   lambda c, a, mmin=None, mmax=None: jnp.clip(a, mmin, mmax), _same)
 oneslike_op = def_op("OnesLike", lambda c, a: jnp.ones_like(a), _same)
@@ -72,7 +76,7 @@ where_op = def_op("Where", lambda c, cond, a, b: jnp.where(cond.astype(bool), a,
 where_const_op = def_op(
     "WhereConst",
     lambda c, cond, a, const_attr=0.0: jnp.where(cond.astype(bool), a, const_attr),
-    lambda cond, a: tuple(a))
+    lambda cond, a, **k: tuple(a))
 
 # generators (no tensor inputs)
 full_op = def_op("Full", lambda c, shape=(), fill_value=0.0, dtype=jnp.float32:

@@ -26,9 +26,20 @@ class SimpleOp(Op):
         return self._lower_fn(ctx, *vals, **self.attrs)
 
     def infer_shape(self, input_shapes):
+        if input_shapes and any(s is None for s in input_shapes):
+            return None   # unknown inputs stay unknown (never a crash)
         if self._shape_fn is None:
-            return None
+            # no hand rule: the abstract interpreter derives the shape
+            # from the lowering itself (Op.infer_shape fallback)
+            return super().infer_shape(input_shapes)
         return self._shape_fn(*input_shapes, **self.attrs)
+
+    @property
+    def has_shape_rule(self):
+        """True iff a hand-written shape rule exists (the cross-check in
+        :mod:`hetu_tpu.analysis` only validates HAND rules — comparing
+        the abstract interpreter against itself proves nothing)."""
+        return self._shape_fn is not None
 
 
 class ItemOp(Op):

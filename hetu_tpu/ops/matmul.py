@@ -28,9 +28,21 @@ def _mm(c, a, b, trans_A=False, trans_B=False):
 
 
 def _mm_shape(a, b, trans_A=False, trans_B=False):
-    m = a[1] if trans_A else a[0]
-    n = b[0] if trans_B else b[1]
-    return (m, n)
+    # mirrors the lowering exactly: `.T` REVERSES all axes (not a swap of
+    # the trailing two), and jnp.matmul broadcasts leading batch dims /
+    # promotes 1-D operands — the old 2-D-only rule was caught wrong on
+    # ONNX-imported batched matmuls by the shape-rule-mismatch lint
+    import numpy as np
+    a = tuple(a)[::-1] if trans_A else tuple(a)
+    b = tuple(b)[::-1] if trans_B else tuple(b)
+    if len(a) == 1 and len(b) == 1:
+        return ()
+    if len(b) == 1:
+        return a[:-1]
+    if len(a) == 1:
+        return b[:-2] + (b[-1],)
+    batch = np.broadcast_shapes(a[:-2], b[:-2])
+    return tuple(batch) + (a[-2], b[-1])
 
 
 matmul_op = def_op("MatrixMult", _mm, _mm_shape)

@@ -13,10 +13,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..graph.node import Op, PlaceholderOp, topo_sort
-from ..graph.gradients import gradients, GradientOp
+from ..graph.gradients import gradients
 
 
 class OptimizerOp(Op):

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
 
 from .strategies import Strategy
 

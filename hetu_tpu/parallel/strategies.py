@@ -10,7 +10,6 @@ the reference's OptimizerOp.backward_hook + mpirun launch played
 """
 from __future__ import annotations
 
-import numpy as np
 
 from ..context import make_mesh
 

@@ -14,7 +14,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from .build import get_lib
-from .store import EmbeddingStore, default_store
+from .store import default_store
 
 _POLICY = {"LRU": 0, "LFU": 1, "LFUOPT": 2}
 

@@ -74,22 +74,35 @@ from .store import EmbeddingStore, _OPT_IDS, _OPT_NAMES, _V3_CHUNK
 from .. import chaos as _chaos
 from ..metrics import record_cache, record_fault
 
-OP_PULL, OP_PUSH, OP_VERSIONS, OP_CLOCK, OP_SSP_SYNC, OP_SSP_INIT, \
-    OP_SHUTDOWN, OP_CLOCKS, OP_HEARTBEAT, OP_ALIVE = range(1, 11)
+# Opcodes register through hetu_tpu.ps.opcodes: the registry asserts wire-
+# value uniqueness at import time (runtime twin of the tools/hetu_lint.py
+# protocol check) and names frames in errors/chaos logs via op_name().
+from .opcodes import defop as _defop, frame_repr, op_name
+
+OP_PULL = _defop("OP_PULL", 1)
+OP_PUSH = _defop("OP_PUSH", 2)
+OP_VERSIONS = _defop("OP_VERSIONS", 3)
+OP_CLOCK = _defop("OP_CLOCK", 4)
+OP_SSP_SYNC = _defop("OP_SSP_SYNC", 5)
+OP_SSP_INIT = _defop("OP_SSP_INIT", 6)
+OP_SHUTDOWN = _defop("OP_SHUTDOWN", 7)
+OP_CLOCKS = _defop("OP_CLOCKS", 8)
+OP_HEARTBEAT = _defop("OP_HEARTBEAT", 9)
+OP_ALIVE = _defop("OP_ALIVE", 10)
 #: fused push+pull (reference PsfType kSDPushPull): keys frame carries
 #: ``[npush, push_keys..., pull_keys...]``, payload carries the grads —
 #: one round trip per peer instead of serial push-then-pull
-OP_PUSH_PULL = 11
+OP_PUSH_PULL = _defop("OP_PUSH_PULL", 11)
 #: replication plane (see module docstring): mirror a mutating frame to a
 #: backup; promote a backup to serving; create a replica table; set a
 #: shard's full slab; snapshot-transfer for re-replication; state digest
-OP_REPLICATE = 12
-OP_PROMOTE = 13
-OP_INIT = 14
-OP_SET_DATA = 15
-OP_SYNC = 16
-OP_SYNC_PUT = 17
-OP_CHECKSUM = 18
+OP_REPLICATE = _defop("OP_REPLICATE", 12)
+OP_PROMOTE = _defop("OP_PROMOTE", 13)
+OP_INIT = _defop("OP_INIT", 14)
+OP_SET_DATA = _defop("OP_SET_DATA", 15)
+OP_SYNC = _defop("OP_SYNC", 16)
+OP_SYNC_PUT = _defop("OP_SYNC_PUT", 17)
+OP_CHECKSUM = _defop("OP_CHECKSUM", 18)
 
 # op, table, nkeys, lr, payload_width, client rank, client sequence
 # number, shard (-1 = the receiving server's own primary shard).
@@ -467,7 +480,9 @@ class StoreServer:
             store.set_data(itable, np.frombuffer(
                 inner, np.float32, n, ioff).reshape(-1, iwidth))
         else:
-            raise RuntimeError(f"op {iop} is not replicable")
+            raise RuntimeError(
+                f"{frame_repr(iop, itable, inkeys, client=iclient, seq=iseq)}"
+                f" is not replicable")
 
     def _init_replica_table(self, shard, table, local_rows, width, opt_id,
                             seed, lr, beta1, beta2, eps, init_scale):
@@ -811,7 +826,9 @@ class StoreServer:
             _send_frame(conn, b"\x00\x01")
             return True
         else:
-            raise ValueError(f"unknown opcode {op}")
+            raise ValueError(
+                f"unknown opcode in frame "
+                f"{frame_repr(op, table, nkeys, shard, client, seq)}")
         return False
 
     def stop(self):
@@ -965,7 +982,8 @@ class DistributedStore:
                 inj = _chaos.active()
                 act = inj.on_send(peer, op) if inj is not None else None
                 if act is not None and act[0] == "drop":
-                    raise TimeoutError("chaos: dropped frame")
+                    raise TimeoutError(
+                        f"chaos: dropped {op_name(op)} frame")
                 sock, lock = self._conn(peer)
                 with lock:
                     sock.settimeout(op_timeout if op_timeout is not None
@@ -976,7 +994,8 @@ class DistributedStore:
                         # hold the socket past the op deadline's spirit:
                         # the client sees a timeout and retries fresh
                         time.sleep(act[1] / 1e3)
-                        raise TimeoutError("chaos: wedged socket")
+                        raise TimeoutError(
+                            f"chaos: wedged socket on {op_name(op)}")
                     _send_frame(sock, hdr, keys.tobytes(), payload)
                     if act is not None and act[0] == "dup":
                         # at-least-once retry simulation: same (client,
@@ -995,12 +1014,14 @@ class DistributedStore:
             host_, port_ = self.endpoints[peer] or ("?", "?")
             raise RuntimeError(
                 f"PS peer {peer} at {host_}:{port_} unreachable after "
-                f"{self.rpc_retries} attempts "
+                f"{self.rpc_retries} attempts sending "
+                f"{frame_repr(op, table, keys.size, shard)} "
                 f"({type(last_err).__name__}: {last_err}) — server process "
                 f"dead or wedged")
         if not resp or resp[:1] == b"\x01":
             raise RuntimeError(
-                f"PS rank {peer} error: {resp[1:].decode(errors='replace')}")
+                f"PS rank {peer} error on {op_name(op)}: "
+                f"{resp[1:].decode(errors='replace')}")
         return resp[1:]
 
     # -- shard routing + client-side failover ------------------------------

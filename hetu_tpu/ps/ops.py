@@ -28,7 +28,7 @@ import numpy as np
 from ..graph.node import PlaceholderOp
 from .cstable import CacheSparseTable
 from .dist_store import DistCacheTable
-from .store import EmbeddingStore, default_store
+from .store import default_store
 
 
 class PSEmbeddingLookupOp(PlaceholderOp):

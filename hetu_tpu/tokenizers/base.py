@@ -8,7 +8,6 @@ the TPU lane width) so downstream ``jit`` traces are reused across batches.
 from __future__ import annotations
 
 import json
-import os
 from collections import OrderedDict
 
 import numpy as np

@@ -24,7 +24,7 @@ from collections import OrderedDict
 
 from .algorithms import (CLIP_SPLIT_PATTERN, GPT2_SPLIT_PATTERN,
                          BasicTokenizer, ByteLevelBPE, Unigram, WordLevel,
-                         WordPiece, bytes_to_unicode)
+                         WordPiece)
 from .base import Tokenizer, load_merges_file
 
 

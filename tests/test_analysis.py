@@ -1,0 +1,489 @@
+"""Graph-verifier tests: total static shape/dtype inference over every
+example model family, plus one unit test per lint rule proving it fires on
+a deliberately-broken graph with the node name AND creation site in the
+message (actionable diagnostics, not just detection).
+"""
+import importlib.util
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import models
+from hetu_tpu.analysis import GraphValidationError, infer_graph, lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _assert_total(report, fetches):
+    """Every value-producing node of the subgraph has a static
+    (shape, dtype) — no ``None`` holes — and zero diagnostics."""
+    gs = report.shapes
+    assert report.complete, {n.name: r for n, r in
+                             list(gs.pending.items())[:5] +
+                             list(gs.failed.items())[:5]}
+    markers = set(gs.markers)
+    for node in gs.topo:
+        if node in markers:
+            continue  # optimizer-update side-effect nodes: no tensor value
+        shape = gs.shape(node)
+        dtype = gs.dtype(node)
+        assert shape is not None, f"no shape for {node}"
+        assert dtype is not None, f"no dtype for {node}"
+    assert report.ok, str(report)
+
+
+# ------------------------------------------------- example model families
+
+def test_bert_fully_infers_and_lints_clean():
+    cfg = models.BertConfig.tiny(batch_size=2, seq_len=32)
+    feeds, loss, _ = models.bert_pretrain_graph(cfg)
+    opt = ht.optim.AdamOptimizer(1e-3).minimize(loss)
+    report = lint([loss, opt])
+    _assert_total(report, [loss, opt])
+    assert report.shapes.shape(loss) == ()
+
+
+def test_swin_fully_infers_and_lints_clean():
+    cfg = models.SwinConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.swin_classify_graph(cfg)
+    imgs, y = models.synthetic_image_batch(cfg)
+    opt = ht.optim.AdamOptimizer(1e-3).minimize(loss)
+    report = lint([loss, opt], feeds={feeds["images"]: imgs,
+                                      feeds["labels"]: y})
+    _assert_total(report, [loss, opt])
+
+
+def test_moe_fully_infers_and_lints_clean():
+    from hetu_tpu.layers import Expert, MoELayer, TopKGate
+    x = ht.placeholder_op("x")
+    moe = MoELayer(TopKGate(16, 64, num_experts=4, k=2,
+                            capacity_factor=2.0),
+                   Expert(4, 16, 32))
+    y, aux = moe(x)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(y * y, [1]), [0]) + aux
+    opt = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    xv = np.random.RandomState(0).randn(64, 16).astype(np.float32)
+    report = lint([loss, opt], feeds={x: xv})
+    _assert_total(report, [loss, opt])
+
+
+def test_rnn_fully_infers_and_lints_clean():
+    from hetu_tpu.layers import LSTM, Embedding, Linear
+    B, T, V, H = 8, 16, 32, 64
+    ids = ht.placeholder_op("ids")
+    y = ht.placeholder_op("y")
+    seq = LSTM(H, H)(Embedding(V, H, name="emb")(ids))
+    last = ht.slice_op(seq, begin=[0, T - 1, 0], size=[-1, 1, -1])
+    last = ht.array_reshape_op(last, output_shape=(B, H))
+    logits = Linear(H, 4, name="head")(last)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    opt = ht.optim.AdamOptimizer(1e-3).minimize(loss)
+    report = lint([loss, opt], feeds={ids: np.zeros((B, T), np.int32),
+                                      y: np.zeros((B,), np.int32)})
+    _assert_total(report, [loss, opt])
+    assert report.shapes.shape(logits) == (B, 4)
+
+
+def test_ctr_wdl_ps_fully_infers_and_lints_clean():
+    """WDL with a host-side PS embedding: the PS leaf's shape comes from
+    ids.shape + the table width, verified against the store."""
+    spec = importlib.util.spec_from_file_location(
+        "ctr_models", os.path.join(ROOT, "examples", "ctr", "models.py"))
+    ctr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ctr)
+    B = 32
+    dense = ht.placeholder_op("dense")
+    sparse = ht.placeholder_op("sparse")
+    y_ = ht.placeholder_op("y")
+    loss, pred = ctr.wdl_criteo(dense, sparse, y_, B, vocab=1000, dim=8,
+                                embed_mode="ps", lr=0.01)[:2]
+    opt = ht.optim.SGDOptimizer(0.01).minimize(loss)
+    dv, sv, yv = ctr.synthetic_criteo(B, vocab=1000)
+    report = lint([loss, opt], feeds={dense: dv, sparse: sv, y_: yv})
+    _assert_total(report, [loss, opt])
+
+
+def test_gnn_fully_infers_and_lints_clean():
+    from hetu_tpu.gnn import DistGCN15D, normalized_adjacency
+    rng = np.random.RandomState(2)
+    n, f, hidden, classes = 32, 6, 16, 4
+    edges = rng.randint(0, n, (120, 2))
+    vals, rows, cols = normalized_adjacency(edges, n)
+    v, r, c = (ht.placeholder_op(s) for s in "vrc")
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("yg")
+    logits = DistGCN15D(f, hidden, classes, n, axis=None)(v, r, c, x)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    opt = ht.optim.AdamOptimizer(1e-2).minimize(loss)
+    report = lint([loss, opt], feeds={
+        v: vals, r: rows, c: cols,
+        x: rng.randn(n, f).astype(np.float32),
+        y: np.zeros((n,), np.int32)})
+    _assert_total(report, [loss, opt])
+    assert report.shapes.shape(logits) == (n, classes)
+
+
+# ----------------------------------------------- abstract infer_shape API
+
+def test_infer_shape_fallback_covers_ruleless_ops():
+    """Ops with no hand shape rule derive real shapes from their lowering
+    (no more None holes for planners/ONNX export)."""
+    a = ht.placeholder_op("a", shape=(4, 8))
+    b = ht.placeholder_op("b", shape=(8, 16))
+    att_q = ht.placeholder_op("q", shape=(2, 4, 128, 32))
+    sm = ht.softmax_op(ht.matmul_op(a, b))
+    assert sm.infer_shape([(4, 16)]) == (4, 16)
+    att = ht.sdpa_op(att_q, att_q, att_q, causal=True)
+    assert att.infer_shape([(2, 4, 128, 32)] * 3) == (2, 4, 128, 32)
+    # embedding lookup needs an INT ids operand — the dtype-guess ladder
+    emb = ht.embedding_lookup_op(b, a)
+    assert emb.infer_shape([(100, 16), (4, 8)]) == (4, 8, 16)
+    # unknown inputs stay unknown, not a crash
+    assert sm.infer_shape([None]) is None
+
+
+def test_infer_graph_assigns_gradient_and_marker_nodes():
+    x = ht.placeholder_op("x", shape=(4, 8))
+    w = ht.Variable("w", initializer=ht.init.GenXavierNormal(),
+                    shape=(8, 2))
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    g = ht.gradients(loss, [w])[0]
+    opt = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    gs = infer_graph([loss, g, opt])
+    assert gs.complete
+    assert gs.shape(g) == (8, 2)          # gradient mirrors its wrt
+    assert gs.markers and gs.markers[0].op_type.startswith("Optimizer")
+
+
+def test_graph_layer_spec_from_real_shapes():
+    """The cost model can price a REAL graph via the abstract interpreter
+    (no None holes): 2-layer MLP flops/param bytes match hand math."""
+    from hetu_tpu.autoparallel import graph_layer_spec
+    B, D, H, C = 32, 64, 128, 10
+    x = ht.placeholder_op("x")
+    w1 = ht.Variable("w1", initializer=ht.init.GenXavierNormal(),
+                     shape=(D, H))
+    w2 = ht.Variable("w2", initializer=ht.init.GenXavierNormal(),
+                     shape=(H, C))
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(logits * logits, [1]), [0])
+    spec = graph_layer_spec([loss], feeds={x: (B, D)})
+    assert spec.param_bytes == (D * H + H * C) * 4
+    assert spec.fwd_flops == 2 * B * D * H + 2 * B * H * C
+    assert spec.act_bytes > 0 and not spec.attn
+
+
+def test_graph_layer_spec_addmm_and_transposed_flops():
+    """Review regression: Addmm's left matrix is input[1] (input[0] is the
+    bias) and trans_A reads the contracted dim from the other axis."""
+    from hetu_tpu.autoparallel import graph_layer_spec
+    bias = ht.Variable("b0", initializer=ht.init.GenZeros(), shape=(8,),
+                       trainable=False)
+    a = ht.placeholder_op("a", shape=(4, 16))
+    b = ht.placeholder_op("bm", shape=(16, 8))
+    out = ht.addmm_op(bias, a, b)
+    spec = graph_layer_spec([out])
+    assert spec.fwd_flops == 2 * 4 * 8 * 16, spec.fwd_flops
+    at = ht.placeholder_op("at", shape=(16, 4))
+    out_t = ht.matmul_op(at, b, trans_A=True)
+    spec_t = graph_layer_spec([out_t])
+    assert spec_t.fwd_flops == 2 * 4 * 8 * 16, spec_t.fwd_flops
+    # einsum contraction priced from its subscripts
+    x = ht.placeholder_op("xe", shape=(4, 2, 16))
+    w = ht.Variable("we", initializer=ht.init.GenZeros(), shape=(4, 16, 8))
+    e = ht.einsum_op("ecd,edh->ech", x, w)
+    spec_e = graph_layer_spec([e])
+    assert spec_e.fwd_flops == 2 * (4 * 2 * 8) * 16, spec_e.fwd_flops
+
+
+def test_lint_isolates_rule_crashes_and_nested_feeds():
+    """Review regression: a multi-part feed (list of shapes) must not
+    crash the feed rule, and an analyzer-internal crash surfaces as a
+    non-escalating diagnostic instead of a raw traceback."""
+    from hetu_tpu.analysis import rule as register_rule, RULES
+    x = ht.placeholder_op("xn", shape=(2, 3))
+    out = ht.reduce_sum_op(x, [0, 1])
+    report = lint([out], feeds={x: [(2, 3), (4, 5)]})  # nested feed
+    assert isinstance(report.diagnostics, list)  # no exception
+
+    @register_rule("crashy-test-rule")
+    def _crashy(gi):
+        raise RuntimeError("rule bug")
+    try:
+        report = lint([out])
+        internal = [d for d in report.diagnostics if d.internal]
+        assert internal and "rule bug" in internal[0].message
+        # internal diagnostics never escalate, even under error mode
+        report.raise_errors(all_severities=True)
+    finally:
+        del RULES["crashy-test-rule"]
+
+
+def test_counter_suppression_is_thread_local():
+    import threading
+    from hetu_tpu.metrics import counters_suppressed, suppress_perf_counters
+    seen = {}
+    with suppress_perf_counters():
+        assert counters_suppressed()
+        t = threading.Thread(
+            target=lambda: seen.setdefault("other", counters_suppressed()))
+        t.start()
+        t.join()
+    assert seen["other"] is False
+    assert not counters_suppressed()
+
+
+def test_infer_graph_threads_schedule_context():
+    """Review regression: the abstract LowerCtx carries the executor's
+    num_microbatches/pipeline so schedule-sensitive ops trace the same
+    path they compile."""
+    from hetu_tpu.graph.node import Op
+
+    seen = {}
+
+    class _Probe(Op):
+        op_type = "ScheduleProbe"
+
+        def lower(self, ctx, xv):
+            seen["M"] = ctx.num_microbatches
+            seen["sched"] = ctx.pipeline
+            return xv
+
+    x = ht.placeholder_op("x", shape=(2,))
+    gs = infer_graph([_Probe([x])], num_microbatches=6, pipeline="gpipe")
+    assert gs.complete and seen == {"M": 6, "sched": "gpipe"}
+
+
+# --------------------------------------------------- one test per lint rule
+
+def _assert_names_site(diag_str, node_name):
+    """Diagnostics must carry the node name and THIS file as the creation
+    site — that's what makes them actionable."""
+    assert node_name in diag_str, diag_str
+    assert "test_analysis.py" in diag_str, diag_str
+
+
+def test_rule_feed_mismatch_shape():
+    x = ht.placeholder_op("x_feed", shape=(4, 8))
+    out = ht.reduce_sum_op(x, [0, 1])
+    report = lint([out], feeds={x: np.zeros((5, 8), np.float32)})
+    bad = [d for d in report.diagnostics if d.rule == "feed-mismatch"]
+    assert bad, str(report)
+    _assert_names_site(str(bad[0]), "x_feed")
+
+
+def test_rule_feed_mismatch_fractional_into_int():
+    ids = ht.placeholder_op("int_ids", shape=(4,), dtype=np.int32)
+    out = ht.reduce_sum_op(ids, [0])
+    report = lint([out], feeds={ids: np.full((4,), 0.5, np.float32)})
+    assert any(d.rule == "feed-mismatch" and "truncate" in d.message
+               for d in report.diagnostics), str(report)
+    # integral floats are the house idiom (executor adopts the dtype): ok
+    report = lint([out], feeds={ids: np.ones((4,), np.float32)})
+    assert report.ok, str(report)
+
+
+def test_rule_grad_nontrainable():
+    v = ht.Variable("frozen_v", initializer=ht.init.GenZeros(), shape=(3,),
+                    trainable=False)
+    loss = ht.reduce_sum_op(v * v, [0])
+    g = ht.gradients(loss, [v])[0]
+    report = lint([loss, g])
+    bad = [d for d in report.diagnostics if d.rule == "grad-nontrainable"]
+    assert bad, str(report)
+    _assert_names_site(str(bad[0]), "frozen_v")
+    with pytest.raises(GraphValidationError, match="frozen_v"):
+        ht.Executor({"train": [loss, g]}, validate="error")
+
+
+def test_rule_duplicate_var_name():
+    a = ht.Variable("dup_w", initializer=ht.init.GenZeros(), shape=(2,))
+    b = ht.Variable("dup_w", initializer=ht.init.GenZeros(), shape=(2,))
+    out = ht.reduce_sum_op(a + b, [0])
+    report = lint([out])
+    bad = [d for d in report.diagnostics
+           if d.rule == "duplicate-var-name"]
+    assert bad, str(report)
+    _assert_names_site(str(bad[0]), "dup_w")
+
+
+def test_rule_ps_embedding_width():
+    store = ht.EmbeddingStore()
+    t = store.init_table(100, 16, opt="sgd", lr=0.1, seed=0)
+    ids = ht.placeholder_op("emb_ids", shape=(8,))
+    emb = ht.ps_embedding_lookup_op((store, t), ids, width=32,
+                                    name="bad_width_emb")
+    out = ht.reduce_sum_op(emb, [0, 1])
+    report = lint([out])
+    bad = [d for d in report.diagnostics
+           if d.rule == "ps-embedding-width"]
+    assert bad and "width 32" in bad[0].message \
+        and "width 16" in bad[0].message, str(report)
+    _assert_names_site(str(bad[0]), "bad_width_emb")
+    with pytest.raises(GraphValidationError, match="bad_width_emb"):
+        ht.Executor({"default": [out]}, validate="error")
+
+
+def test_rule_mesh_axis():
+    from hetu_tpu.context import make_mesh
+    mesh = make_mesh({"dp": 2})
+    q = ht.placeholder_op("q", shape=(1, 2, 256, 32))
+    att = ht.ring_attention_op(q, q, q, name="cp_attn")
+    report = lint([att], mesh=mesh)
+    bad = [d for d in report.diagnostics if d.rule == "mesh-axis"]
+    assert bad and "'cp'" in bad[0].message, str(report)
+    _assert_names_site(str(bad[0]), "cp_attn")
+    # with the axis present: clean
+    report = lint([att], mesh=make_mesh({"cp": 2}))
+    assert not [d for d in report.diagnostics if d.rule == "mesh-axis"], \
+        str(report)
+
+
+def test_rule_mesh_axis_sharding_spec():
+    from hetu_tpu.context import make_mesh
+    x = ht.placeholder_op("x", shape=(8, 4))
+    y = ht.relu_op(x, name="sharded_relu")
+    y.sharding = ("ep", None)
+    report = lint([y], mesh=make_mesh({"dp": 2}))
+    bad = [d for d in report.diagnostics if d.rule == "mesh-axis"]
+    assert bad and "REPLICATED" in bad[0].message, str(report)
+
+
+def test_rule_pipeline_stage_divisibility():
+    from hetu_tpu.context import make_mesh
+    mesh = make_mesh({"pp": 2})
+    x = ht.placeholder_op("x", shape=(4, 8))
+    blk = _fake_pipeline_block(x, n_stages=3)
+    report = lint([blk], mesh=mesh)
+    bad = [d for d in report.diagnostics if d.rule == "pipeline-stage"]
+    assert bad and "3 stages" in bad[0].message, str(report)
+
+
+def _fake_pipeline_block(x, n_stages):
+    """Minimal PipelineBlock-shaped node (stage program internals are not
+    what this rule inspects)."""
+    from hetu_tpu.graph.node import Op
+
+    class _Blk(Op):
+        op_type = "PipelineBlock"
+
+        def __init__(self):
+            super().__init__([x], name="bad_pipeline_block")
+            self.n_stages = n_stages
+
+        def lower(self, ctx, xv):
+            return xv
+
+    return _Blk()
+
+
+def test_rule_flash_fallback_ragged_causal():
+    q = ht.placeholder_op("q", shape=(1, 2, 384, 64))
+    k = ht.placeholder_op("k", shape=(1, 2, 273, 64))
+    v = ht.placeholder_op("v", shape=(1, 2, 273, 64))
+    att = ht.sdpa_op(q, k, v, causal=True, name="ragged_attn")
+    report = lint([att])
+    bad = [d for d in report.diagnostics if d.rule == "flash-fallback"]
+    assert bad and "causal_ragged_mismatch" in bad[0].message, str(report)
+    _assert_names_site(str(bad[0]), "ragged_attn")
+    with pytest.raises(GraphValidationError, match="ragged_attn"):
+        ht.Executor({"default": [att]}, validate="error")
+    # matching mod-128 lengths: clean
+    k2 = ht.placeholder_op("k2", shape=(1, 2, 256, 64))
+    v2 = ht.placeholder_op("v2", shape=(1, 2, 256, 64))
+    att2 = ht.sdpa_op(q, k2, v2, causal=True)
+    assert lint([att2]).ok
+
+
+def test_rule_flash_fallback_bad_mask_shape():
+    q = ht.placeholder_op("q", shape=(1, 2, 256, 64))
+    mask = ht.placeholder_op("m", shape=(1, 2, 3, 256))  # S_q dim invalid
+    att = ht.sdpa_masked_op(q, q, q, mask, name="badmask_attn")
+    report = lint([att])
+    bad = [d for d in report.diagnostics if d.rule == "flash-fallback"]
+    assert bad and "mask" in bad[0].message, str(report)
+
+
+def test_rule_shape_rule_mismatch():
+    """A wrong hand shape rule is caught by the cross-check against the
+    abstract interpreter."""
+    from hetu_tpu.ops.base import SimpleOp
+
+    import jax.numpy as jnp
+    x = ht.placeholder_op("x", shape=(4, 8))
+    node = SimpleOp("BadRule", [x], lambda c, a: jnp.sum(a, axis=1),
+                    shape_fn=lambda a: tuple(a),   # WRONG: claims same shape
+                    name="bad_rule_node")
+    report = lint([node])
+    bad = [d for d in report.diagnostics
+           if d.rule == "shape-rule-mismatch"]
+    assert bad, str(report)
+    _assert_names_site(str(bad[0]), "bad_rule_node")
+
+
+def test_rule_uninferable_names_failing_node():
+    from hetu_tpu.graph.node import Op
+
+    class _Boom(Op):
+        op_type = "Boom"
+
+        def lower(self, ctx, xv):
+            raise ValueError("intentionally broken lowering")
+
+    x = ht.placeholder_op("x", shape=(2, 2))
+    node = _Boom([x], name="boom_node")
+    report = lint([node])
+    bad = [d for d in report.diagnostics if d.rule == "uninferable"]
+    assert bad and "intentionally broken" in bad[0].message, str(report)
+    _assert_names_site(str(bad[0]), "boom_node")
+
+
+# ------------------------------------------------- executor validate= modes
+
+def test_executor_validate_error_rejects_bad_feed_shape():
+    x = ht.placeholder_op("x_declared", shape=(4, 8))
+    w = ht.Variable("w", initializer=ht.init.GenXavierNormal(),
+                    shape=(8, 2))
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    ex = ht.Executor({"train": [loss]}, validate="error")
+    with pytest.raises(GraphValidationError) as ei:
+        ex.run("train", feed_dict={x: np.zeros((5, 8), np.float32)})
+    assert "x_declared" in str(ei.value)
+    assert "test_analysis.py" in str(ei.value)  # creation site
+    # correct shape runs
+    out = ex.run("train", feed_dict={x: np.zeros((4, 8), np.float32)})
+    assert np.isfinite(float(out[0].asnumpy()))
+
+
+def test_executor_validate_warn_default_and_off():
+    v = ht.Variable("frozen2", initializer=ht.init.GenZeros(), shape=(3,),
+                    trainable=False)
+    loss = ht.reduce_sum_op(v * v, [0])
+    g = ht.gradients(loss, [v])[0]
+    with warnings.catch_warnings(record=True) as wl:
+        warnings.simplefilter("always")
+        ht.Executor({"train": [loss, g]})  # default: warn
+    assert any("grad-nontrainable" in str(w.message) for w in wl)
+    with warnings.catch_warnings(record=True) as wl:
+        warnings.simplefilter("always")
+        ht.Executor({"train": [loss, g]}, validate="off")
+    assert not any("grad-nontrainable" in str(w.message) for w in wl)
+
+
+def test_executor_validate_rejects_unknown_mode():
+    x = ht.placeholder_op("x", shape=(2,))
+    with pytest.raises(ValueError, match="validate"):
+        ht.Executor({"d": [ht.reduce_sum_op(x, [0])]}, validate="maybe")
+
+
+def test_creation_site_points_at_user_code():
+    node = ht.placeholder_op("site_probe")
+    fn, line, func = node.creation_site
+    assert fn.endswith("test_analysis.py")
+    assert func == "test_creation_site_points_at_user_code"

@@ -1,0 +1,500 @@
+#!/usr/bin/env python
+"""Framework self-lint: static AST analysis of hetu_tpu's own source.
+
+The PS layer (``hetu_tpu/ps/dist_store.py``) is 2k lines of hand-rolled
+concurrency and wire protocol — exactly the code where a refactor silently
+introduces a lock-order inversion or a client/server opcode drift (a frame
+type mirrored by the replication plane but never handled by the server).
+This tool makes those invariants *checked*, not hoped for; it runs in
+tier-1 via ``tests/test_lint.py`` so every future PR is gated on it.
+
+Checks
+------
+1. **lock-order** (``hetu_tpu/ps/``): per class, extract every ``with
+   self._*lock`` acquisition, the lexical nesting between them, and
+   same-class method calls made while holding a lock (propagated to the
+   locks those methods eventually acquire).  Findings: acquisition-order
+   cycles (ABBA deadlocks) and re-entrant acquisition of a non-reentrant
+   ``threading.Lock``.
+2. **opcodes** (``hetu_tpu/ps/``): every ``OP_*`` constant (registry
+   ``defop("OP_X", n)`` calls and plain literal assignments) must have a
+   unique wire value, at least one client SENDER (used as a call
+   argument) and at least one server DISPATCH arm (used in an ``op ==
+   OP_X`` comparison) — catching a mirrored-but-unhandled frame type.
+3. **metrics**: every ``record_*`` counter family in
+   ``hetu_tpu/metrics.py`` must be recorded somewhere in the package,
+   have a snapshot accessor, and that accessor must be surfaced by a
+   ``hetu_tpu/profiler.py`` API — counters nobody can read are dead
+   telemetry.
+4. **style**: unused imports and placeholder-less f-strings (the ruff
+   F401/F541 subset, self-implemented because the container has no ruff;
+   ``pyproject.toml`` carries the equivalent ruff config for
+   environments that do).
+
+Usage: ``python tools/hetu_lint.py [root]`` — prints findings, exits
+non-zero if any.  Every check also takes raw source strings so the test
+suite can prove each detector fires on a synthetic violation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOCK_TOKENS = ("lock", "cond")
+REENTRANT_TYPES = {"RLock", "Condition"}  # Condition defaults to an RLock
+
+
+# --------------------------------------------------------------- lock order
+
+def _lock_attr_of(expr, assigns):
+    """Lock identity of a with-item context expr, or None.
+
+    ``self._x_lock`` -> '_x_lock'; a bare Name resolves through the
+    function's assignments to the self attribute it came from (e.g.
+    ``lock = self._conn_locks.setdefault(...)`` -> '_conn_locks[*]').
+    """
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" \
+            and any(t in expr.attr.lower() for t in LOCK_TOKENS):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        src = assigns.get(expr.id)
+        if src is not None:
+            for sub in ast.walk(src):
+                if isinstance(sub, ast.Attribute) \
+                        and any(t in sub.attr.lower() for t in LOCK_TOKENS):
+                    return sub.attr + "[*]"
+    return None
+
+
+def _name_assigns(func):
+    """name -> value expr for simple assignments inside ``func`` (used to
+    resolve ``with lock:`` back to the self attribute it came from)."""
+    out = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out[el.id] = node.value
+    return out
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method: direct lock acquisitions, nesting edges, and same-class
+    calls made while holding each lock."""
+
+    def __init__(self, assigns):
+        self.assigns = assigns
+        self.held = []
+        self.acquires = set()            # locks acquired anywhere
+        self.edges = set()               # (outer, inner) lexical nesting
+        self.calls = set()               # self.<method>() anywhere
+        self.calls_under = {}            # lock -> {methods called held}
+
+    def visit_With(self, node):
+        ids = [_lock_attr_of(i.context_expr, self.assigns)
+               for i in node.items]
+        ids = [i for i in ids if i is not None]
+        for lid in ids:
+            self.acquires.add(lid)
+            for outer in self.held:
+                self.edges.add((outer, lid))
+        self.held.extend(ids)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in ids:
+            self.held.pop()
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            self.calls.add(fn.attr)
+            for lock in self.held:
+                self.calls_under.setdefault(lock, set()).add(fn.attr)
+        self.generic_visit(node)
+
+
+def _lock_types(cls):
+    """attr -> constructor name for ``self.x = threading.Lock()``-style
+    assignments anywhere in the class body."""
+    out = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" \
+                    and isinstance(node.value, ast.Call):
+                fn = node.value.func
+                ctor = fn.attr if isinstance(fn, ast.Attribute) else \
+                    fn.id if isinstance(fn, ast.Name) else None
+                if ctor in ("Lock", "RLock", "Condition", "Semaphore"):
+                    out[tgt.attr] = ctor
+    return out
+
+
+def check_lock_order(sources):
+    """``{filename: source}`` -> findings.  Builds a per-class lock
+    acquisition-order graph (lexical nesting + held-call propagation) and
+    reports cycles and non-reentrant re-acquisition."""
+    findings = []
+    for fname, src in sources.items():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(f"{fname}: syntax error: {e}")
+            continue
+        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+            types = _lock_types(cls)
+            scans = {}
+            for meth in [n for n in ast.walk(cls)
+                         if isinstance(n, ast.FunctionDef)]:
+                scan = _MethodScan(_name_assigns(meth))
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                scans[meth.name] = scan
+            # eventual acquisitions per method (fixpoint over self-calls)
+            eventual = {m: set(s.acquires) for m, s in scans.items()}
+            changed = True
+            while changed:
+                changed = False
+                for m, s in scans.items():
+                    for callee in s.calls:
+                        extra = eventual.get(callee, set()) - eventual[m]
+                        if extra:
+                            eventual[m] |= extra
+                            changed = True
+            # edge set: lexical nesting + (held lock -> callee's eventual)
+            edges = set()
+            for m, s in scans.items():
+                edges |= s.edges
+                for lock, callees in s.calls_under.items():
+                    for callee in callees:
+                        for inner in eventual.get(callee, set()):
+                            edges.add((lock, inner))
+            # self-edges: re-entry on a non-reentrant lock
+            graph = {}
+            for a, b in edges:
+                if a == b:
+                    base = a.rstrip("[*]")
+                    if types.get(base, "Lock") not in REENTRANT_TYPES:
+                        findings.append(
+                            f"{fname}: {cls.name}: non-reentrant lock "
+                            f"'{a}' acquired while already held "
+                            f"(self-deadlock)")
+                    continue
+                graph.setdefault(a, set()).add(b)
+            # cycle detection (DFS, white/grey/black)
+            color, stack = {}, []
+
+            def dfs(n):
+                color[n] = 1
+                stack.append(n)
+                for nxt in graph.get(n, ()):
+                    if color.get(nxt, 0) == 1:
+                        cyc = stack[stack.index(nxt):] + [nxt]
+                        findings.append(
+                            f"{fname}: {cls.name}: lock acquisition-order "
+                            f"cycle: {' -> '.join(cyc)}")
+                    elif color.get(nxt, 0) == 0:
+                        dfs(nxt)
+                stack.pop()
+                color[n] = 2
+
+            for n in list(graph):
+                if color.get(n, 0) == 0:
+                    dfs(n)
+    return findings
+
+
+# ------------------------------------------------------------------ opcodes
+
+def _opcode_defs(tree, fname, findings):
+    """{name: value} for OP_* definitions: registry defop("OP_X", n) calls
+    and plain literal / range-unpack assignments."""
+    defs = {}
+
+    def add(name, value):
+        if name in defs and defs[name] != value:
+            findings.append(f"{fname}: opcode {name} redefined with a "
+                            f"different value ({defs[name]} -> {value})")
+        defs[name] = value
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        tgt, val = node.targets[0], node.value
+        if isinstance(tgt, ast.Name) and tgt.id.startswith("OP_"):
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                add(tgt.id, val.value)
+            elif isinstance(val, ast.Call) and len(val.args) >= 2 \
+                    and isinstance(val.args[0], ast.Constant) \
+                    and isinstance(val.args[1], ast.Constant):
+                # registry form: OP_X = defop("OP_X", n)
+                if val.args[0].value != tgt.id:
+                    findings.append(
+                        f"{fname}: opcode registry name mismatch: "
+                        f"{tgt.id} = defop({val.args[0].value!r}, ...)")
+                add(tgt.id, int(val.args[1].value))
+        elif isinstance(tgt, ast.Tuple) and all(
+                isinstance(e, ast.Name) and e.id.startswith("OP_")
+                for e in tgt.elts):
+            # OP_A, OP_B, ... = range(lo, hi)
+            if isinstance(val, ast.Call) \
+                    and getattr(val.func, "id", "") == "range":
+                args = [a.value for a in val.args
+                        if isinstance(a, ast.Constant)]
+                if len(args) == len(val.args):
+                    vals = list(range(*args))
+                    for e, v in zip(tgt.elts, vals):
+                        add(e.id, v)
+    return defs
+
+
+def check_opcodes(sources):
+    """``{filename: source}`` -> findings: duplicate wire values, opcodes
+    with no client sender, opcodes with no server dispatch arm."""
+    findings = []
+    defs = {}
+    senders, dispatch = set(), set()
+    for fname, src in sources.items():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(f"{fname}: syntax error: {e}")
+            continue
+        defs.update(_opcode_defs(tree, fname, findings))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name) \
+                            and arg.id.startswith("OP_"):
+                        senders.add(arg.id)
+            elif isinstance(node, ast.Compare):
+                ops = [node.left] + list(node.comparators)
+                if any(isinstance(o, ast.Eq) for o in node.ops):
+                    for o in ops:
+                        if isinstance(o, ast.Name) \
+                                and o.id.startswith("OP_"):
+                            dispatch.add(o.id)
+    by_value = {}
+    for name, value in sorted(defs.items()):
+        if value in by_value:
+            findings.append(
+                f"opcode value collision: {name} and {by_value[value]} "
+                f"both use wire value {value}")
+        by_value.setdefault(value, name)
+    for name in sorted(defs):
+        if name not in senders:
+            findings.append(
+                f"opcode {name} has no client sender (never passed to an "
+                f"RPC call) — dead or drifted protocol arm")
+        if name not in dispatch:
+            findings.append(
+                f"opcode {name} has no server dispatch arm (never "
+                f"compared with ==) — a client can send a frame the "
+                f"server cannot handle")
+    return findings
+
+
+# ------------------------------------------------------------------ metrics
+
+def check_metrics(metrics_src, profiler_src, usage_srcs=None):
+    """Every ``record_*`` family in metrics.py must be recorded somewhere,
+    have a snapshot accessor, and that accessor must be read by
+    profiler.py."""
+    findings = []
+    try:
+        mtree = ast.parse(metrics_src)
+    except SyntaxError as e:
+        return [f"metrics.py: syntax error: {e}"]
+    counters = set()
+    for node in mtree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            ctor = fn.attr if isinstance(fn, ast.Attribute) else \
+                getattr(fn, "id", None)
+            if ctor == "Counter":
+                counters.add(node.targets[0].id)
+
+    def refs(func):
+        return {n.id for n in ast.walk(func)
+                if isinstance(n, ast.Name)} & counters
+
+    recorders, accessors = {}, {}   # func name -> counter vars
+    for node in mtree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        r = refs(node)
+        if not r:
+            continue
+        if node.name.startswith("record_"):
+            recorders[node.name] = r
+        elif not node.name.startswith("reset_") \
+                and not node.name.startswith("_"):
+            accessors[node.name] = r
+
+    prof_names = set()
+    try:
+        for node in ast.walk(ast.parse(profiler_src)):
+            if isinstance(node, ast.Name):
+                prof_names.add(node.id)
+            elif isinstance(node, ast.alias):
+                prof_names.add(node.name.split(".")[0])
+                if node.asname:
+                    prof_names.add(node.asname)
+    except SyntaxError as e:
+        return [f"profiler.py: syntax error: {e}"]
+
+    usage_names = set()
+    for src in (usage_srcs or {}).values():
+        try:
+            for node in ast.walk(ast.parse(src)):
+                if isinstance(node, ast.Name):
+                    usage_names.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    usage_names.add(node.attr)
+        except SyntaxError:
+            continue
+
+    for rec, vars_ in sorted(recorders.items()):
+        acc = [a for a, av in accessors.items() if av & vars_]
+        if not acc:
+            findings.append(
+                f"metrics.py: {rec} records counters {sorted(vars_)} but "
+                f"no accessor function exposes them")
+        elif not any(a in prof_names for a in acc):
+            findings.append(
+                f"metrics.py: counter family of {rec} (accessors "
+                f"{sorted(acc)}) is not surfaced by any profiler.py API")
+        if usage_srcs is not None and rec not in usage_names:
+            findings.append(
+                f"metrics.py: {rec} is never called anywhere in the "
+                f"package — dead counter family")
+    return findings
+
+
+# -------------------------------------------------------------------- style
+
+def check_style(src, fname):
+    """Unused imports (F401) and placeholder-less f-strings (F541) — the
+    'real errors' ruff subset, self-implemented for ruff-less containers.
+    ``__init__.py`` re-export surfaces and ``# noqa`` lines are exempt."""
+    findings = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{fname}: syntax error: {e}"]
+    lines = src.splitlines()
+
+    def noqa(lineno):
+        return lineno - 1 < len(lines) and "noqa" in lines[lineno - 1]
+
+    if not fname.endswith("__init__.py"):
+        imported = {}   # bound name -> (lineno, display)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    bound = al.asname or al.name.split(".")[0]
+                    imported[bound] = (node.lineno, al.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue  # compiler directive, not a binding (ruff too)
+                for al in node.names:
+                    if al.name == "*":
+                        continue
+                    bound = al.asname or al.name
+                    imported[bound] = (node.lineno, al.name)
+        used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+        # names re-exported via __all__ count as used — but ONLY __all__:
+        # matching arbitrary string constants would let any message or
+        # dict key silently disable the check
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)) and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in (node.targets if isinstance(node, ast.Assign)
+                              else [node.target])):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        used.add(sub.value)
+        for bound, (lineno, display) in sorted(imported.items(),
+                                               key=lambda kv: kv[1][0]):
+            if bound not in used and not noqa(lineno):
+                findings.append(
+                    f"{fname}:{lineno}: unused import '{display}' (F401)")
+    # format specs (":.3f") are themselves JoinedStr nodes — exclude them
+    spec_ids = {id(n.format_spec) for n in ast.walk(tree)
+                if isinstance(n, ast.FormattedValue)
+                and n.format_spec is not None}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids \
+                and all(isinstance(v, ast.Constant) for v in node.values) \
+                and not noqa(node.lineno):
+            findings.append(
+                f"{fname}:{node.lineno}: f-string without placeholders "
+                f"(F541)")
+    return findings
+
+
+# -------------------------------------------------------------------- entry
+
+def _read_tree(root, rel):
+    out = {}
+    base = os.path.join(root, rel)
+    for dirpath, _, files in os.walk(base):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if f.endswith(".py"):
+                p = os.path.join(dirpath, f)
+                with open(p, encoding="utf-8") as fh:
+                    out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+def run_all(root=REPO, style_dirs=("hetu_tpu", "tools")):
+    """All checks over the repo; returns the flat findings list."""
+    pkg = _read_tree(root, "hetu_tpu")
+    ps = {k: v for k, v in pkg.items()
+          if k.replace(os.sep, "/").startswith("hetu_tpu/ps/")}
+    findings = []
+    findings += check_lock_order(ps)
+    findings += check_opcodes(ps)
+    metrics_key = os.path.join("hetu_tpu", "metrics.py")
+    profiler_key = os.path.join("hetu_tpu", "profiler.py")
+    findings += check_metrics(pkg[metrics_key], pkg[profiler_key],
+                              {k: v for k, v in pkg.items()
+                               if k != metrics_key})
+    for d in style_dirs:
+        for fname, src in sorted(_read_tree(root, d).items()):
+            findings += check_style(src, fname)
+    return findings
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else REPO
+    findings = run_all(root)
+    for f in findings:
+        print(f"hetu_lint: {f}")
+    if findings:
+        print(f"hetu_lint: {len(findings)} finding(s)")
+        return 1
+    print("hetu_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
