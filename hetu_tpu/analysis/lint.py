@@ -25,6 +25,10 @@ Rule catalog (see README "Static analysis & graph validation"):
 * ``flash-fallback`` (warn) — attention config statically guaranteed to
   fall off the Pallas flash path on TPU (ragged causal mod-128,
   unsupported mask/bias broadcast shape)
+* ``zero-sharding`` (warn) — ``Executor(zero=...)`` requested on a mesh
+  with no usable 'dp' axis (silently replicated), or a slab bucket that
+  needs zero-padding to shard over 'dp' (the ragged params are named;
+  buckets whose total divides evenly are silent)
 """
 from __future__ import annotations
 
@@ -71,7 +75,7 @@ class GraphInfo:
     """What a lint rule sees: topo + static shapes + executor config."""
 
     def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
-                 feed_values=None):
+                 feed_values=None, zero=0):
         self.shapes = shapes
         self.topo = shapes.topo
         self.feeds = feeds
@@ -80,6 +84,8 @@ class GraphInfo:
         self.feed_values = feed_values or {}
         self.mesh = mesh
         self.pipeline = pipeline
+        #: requested ZeRO stage (Executor(zero=...)); 0 = off
+        self.zero = int(zero or 0)
 
     def shape(self, node):
         return self.shapes.shape(node)
@@ -436,19 +442,116 @@ def _r_flash(gi):
                     f"'{what}_shape')", node)
 
 
+@rule("zero-sharding")
+def _r_zero(gi):
+    """ZeRO weight-update sharding preconditions (parallel/zero.py):
+    the plan shards every optimizer param over the mesh 'dp' axis, so a
+    missing/size-1 axis silently degrades to the replicated update, and
+    a bucket whose total element count does not divide ``dp`` falls back
+    to zero-padded sharding (correct, but the pad is wasted collective
+    bytes — ``zero_pad_bytes`` counts it at run time).  The check
+    reproduces the executor's real bucketing, so ragged params absorbed
+    by co-bucketed neighbours do not warn."""
+    if not gi.zero:
+        return
+    from ..optim.optimizer import OptimizerOp
+    from ..parallel.zero import ZERO_AXIS
+    opt_ops = [n for n in gi.topo if isinstance(n, OptimizerOp)]
+    if not opt_ops:
+        return
+    dp = None
+    if gi.mesh is not None and ZERO_AXIS in gi.mesh.axis_names:
+        dp = int(gi.mesh.shape[ZERO_AXIS])
+    if not dp or dp < 2:
+        have = sorted(gi.mesh.axis_names) if gi.mesh is not None else None
+        yield Diagnostic(
+            "zero-sharding", "warn",
+            f"zero={gi.zero} requested but the executor mesh "
+            f"{'has axes ' + str(have) if have else 'is absent'} — no "
+            f"'{ZERO_AXIS}' axis of size >= 2 to shard the weight update "
+            f"over, so the update runs fully REPLICATED (no memory win)",
+            opt_ops[0])
+        return
+    from ..parallel.zero import build_plan, ineligible_reason
+    for op in opt_ops:
+        # the executor's eligibility filter (_build_zero_plans), via the
+        # SHARED predicate zero.ineligible_reason: an ineligible param
+        # makes its WHOLE optimizer fall back to the replicated update —
+        # zero= silently has no effect there, which is exactly what this
+        # rule exists to surface (and building a plan for it would warn
+        # about pad bytes of collectives that will never exist)
+        ineligible = None
+        for p in op.params:
+            dt = getattr(p, "dtype", None) or gi.shapes.dtype(p)
+            why = ineligible_reason(p, dt)
+            if why is not None:
+                ineligible = (p, why)
+                break
+        if ineligible:
+            p, why = ineligible
+            yield Diagnostic(
+                "zero-sharding", "warn",
+                f"zero={gi.zero}: optimizer '{op.name}' stays on the "
+                f"fully REPLICATED update path because parameter "
+                f"'{p.name}' {why} — no ZeRO memory win for its params "
+                f"or moments", p)
+            continue
+        items, by_key = [], {}
+        for i, p in enumerate(op.params):
+            shape = p.shape if getattr(p, "shape", None) is not None \
+                else gi.shape(p)
+            if shape is None:
+                continue
+            dt = getattr(p, "dtype", None) or gi.shapes.dtype(p) \
+                or np.float32
+            key = f"p{i}"
+            items.append((key, tuple(shape), np.dtype(dt).name))
+            by_key[key] = p
+        if not items:
+            continue
+        # reproduce the executor's ACTUAL bucketing (same order, same
+        # byte cap, per-param for LAMB): padding is decided per BUCKET,
+        # so a ragged param co-bucketed with others often shards with
+        # zero waste — warning on numel % dp alone would spam biases and
+        # layernorms about a non-problem
+        plan = build_plan(items, dp, gi.zero,
+                          per_param=bool(getattr(op.optimizer, "lamb",
+                                                 False)))
+        for b in plan.buckets:
+            if not b.pad:
+                continue
+            # pad > 0 guarantees at least one member is ragged: a bucket
+            # of all-divisible params would total a dp multiple itself
+            ragged = [k for k, shape in zip(b.param_keys, b.shapes)
+                      if (int(np.prod(shape, dtype=np.int64))
+                          if shape else 1) % dp]
+            names = [by_key[k].name for k in ragged]
+            pad_bytes = b.pad * np.dtype(b.dtype).itemsize
+            yield Diagnostic(
+                "zero-sharding", "warn",
+                f"ZeRO bucket of {len(b.param_keys)} param(s) "
+                f"({', '.join(repr(n) for n in names[:4])}"
+                f"{', ...' if len(names) > 4 else ''} not divisible by "
+                f"the '{ZERO_AXIS}' axis) totals {b.numel} elements — "
+                f"zero-padded to {b.padded} ({b.pad} wasted elements, "
+                f"{pad_bytes} B per collective; see zero_pad_bytes)",
+                by_key[ragged[0]])
+
+
 # ----------------------------------------------------------------- entry
 
 def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
-         num_microbatches=None, rules=None):
+         num_microbatches=None, rules=None, zero=0):
     """Statically verify a fetch subgraph; returns a :class:`LintReport`.
 
     ``feeds``: example values (or bare shapes) for placeholders declared
     without a static shape, e.g. ``ht.lint([loss], feeds={x: (32, 784)})``.
-    ``mesh`` / ``pipeline`` / ``num_microbatches``: the executor
-    configuration the graph will compile under (enables the mesh-axis and
-    pipeline-stage rules, and keeps schedule-sensitive lowering on the
-    same path the executor uses).  ``rules``: optional iterable of rule
-    names to run (default: all registered rules).
+    ``mesh`` / ``pipeline`` / ``num_microbatches`` / ``zero``: the
+    executor configuration the graph will compile under (enables the
+    mesh-axis, pipeline-stage and zero-sharding rules, and keeps
+    schedule-sensitive lowering on the same path the executor uses).
+    ``rules``: optional iterable of rule names to run (default: all
+    registered rules).
     """
     if isinstance(fetches, Op):
         fetches = [fetches]
@@ -465,7 +568,8 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
                     and hasattr(v, "shape"):
                 feed_values[node] = v
     gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
-                   mesh=mesh, pipeline=pipeline, feed_values=feed_values)
+                   mesh=mesh, pipeline=pipeline, feed_values=feed_values,
+                   zero=zero)
     diags = []
     selected = RULES if rules is None else {
         name: RULES[name] for name in rules}

@@ -36,8 +36,64 @@ from .gradients import GradientOp
 from ..ndarray import NDArray
 
 
-def _key(node):
-    return f"n{node.id}"
+class _ZeroView:
+    """``Executor.var_values`` stand-in for a stage-3 ZeRO parameter: the
+    master bytes live dp-SHARDED inside a bucket slab
+    (``Executor._zero_slabs``), so no full copy of the parameter exists
+    between steps.  ``materialize()`` reconstructs the full host array
+    (checkpointing, eval subgraphs, ``return_tensor_values``)."""
+
+    __slots__ = ("ex", "node", "bucket")
+
+    def __init__(self, ex, node, bucket):
+        self.ex = ex
+        self.node = node
+        self.bucket = bucket
+
+    @property
+    def _index(self):
+        return self.bucket.param_keys.index(self.ex._k(self.node))
+
+    @property
+    def shape(self):
+        return self.bucket.shapes[self._index]
+
+    @property
+    def dtype(self):
+        return np.dtype(self.bucket.dtype)
+
+    def materialize(self):
+        """Full host-side value (gathers the slab; multiprocess-safe).
+        The slab fetch is memoized per step (``Executor._slab_host``):
+        materializing k co-bucketed params costs ONE gather, not k."""
+        from ..parallel.zero import host_unpack_slab
+        slab = self.ex._slab_host(self.bucket)
+        return host_unpack_slab(slab, self.bucket)[self.ex._k(self.node)]
+
+    def __repr__(self):
+        return (f"<ZeroView of '{self.node.name}' shape={self.shape} "
+                f"in slab {self.bucket.key}>")
+
+
+#: process-wide persistent-compilation-cache config (idempotent): jitting
+#: with canonical input keys makes a rebuilt executor's HLO byte-identical,
+#: so pointing jax's disk cache here turns the supervisor's post-restart
+#: recompile into a cache read (``HETU_COMPILE_CACHE_DIR``)
+_compile_cache_dir = None
+
+
+def _configure_compile_cache(path):
+    global _compile_cache_dir
+    if not path or _compile_cache_dir == path:
+        return
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        _compile_cache_dir = path
+    except Exception:
+        pass    # older jax without the knobs: in-process cache still works
 
 
 def _filter_spec(mesh, spec):
@@ -130,7 +186,7 @@ class SubExecutor:
             if isinstance(node, GradientOp) or node in self.opt_ops:
                 continue
             if isinstance(node, PlaceholderOp):
-                k = _key(node)
+                k = self.ex._k(node)
                 if k in tparams:
                     env[node] = tparams[k]
                 elif k in sparams:
@@ -146,15 +202,51 @@ class SubExecutor:
                     env[node],
                     NamedSharding(self.ex.mesh,
                                   _filter_spec(self.ex.mesh, node.sharding)))
-        updates = {_key(n): v for n, v in ctx.state_updates.items()}
+        updates = {self.ex._k(n): v for n, v in ctx.state_updates.items()}
         return env, updates
+
+    def _zero3_plans(self):
+        """[(opt_op, plan)] for this subgraph's stage-3 ZeRO optimizers —
+        the ones whose params enter/leave the step as bucket slabs."""
+        out = []
+        for op in self.opt_ops:
+            plan = self.ex._zero_plans.get(op)
+            if plan is not None and plan.stage >= 3:
+                out.append((op, plan))
+        return out
+
+    def _pack_state(self, materialize=False):
+        """Assemble the step's ``(tparams, sparams)`` inputs.
+
+        Stage-3 ZeRO params ride as their bucket SLABS (keyed by bucket
+        key) when their optimizer runs in this subgraph; a covered param
+        used here *without* its optimizer (an eval subgraph sharing the
+        weights) is materialized to a full replicated value instead.
+        ``materialize=True`` forces full values everywhere (the
+        profiler's forward-only shape evaluation)."""
+        ex = self.ex
+        slabs, slab_nodes = {}, set()
+        if not materialize:
+            for op, plan in self._zero3_plans():
+                for b in plan.buckets:
+                    slabs[b.key] = ex._zero_slabs[b.key]
+                slab_nodes.update(op.params)
+        tparams, sparams = {}, {}
+        for n in self.trainable_vars:
+            if n in slab_nodes:
+                continue
+            tparams[ex._k(n)] = ex._var_value(n)
+        for n in self.state_vars:
+            sparams[ex._k(n)] = ex._var_value(n)
+        tparams.update(slabs)
+        return tparams, sparams
 
     def _build_step(self):
         import jax
 
         fetch_nodes = self.fetches
 
-        ps_keys = [_key(n) for n in self.ps_nodes]
+        ps_keys = [self.ex._k(n) for n in self.ps_nodes]
 
         from contextlib import nullcontext
 
@@ -190,6 +282,22 @@ class SubExecutor:
                 sparams = _cast_tree(sparams, cd)
                 feeds = _cast_tree(feeds, cd)
             if self.grad_ops:
+                # stage-3 ZeRO: params arrive as dp-sharded bucket slabs;
+                # gather them to full shape HERE — at the top of the step,
+                # where XLA's async scheduler overlaps the all-gather of
+                # step N-1's updated params with step N's early compute
+                # (the GC3 overlap discipline; parallel/zero.py docstring)
+                model_params = tparams
+                zero3 = self._zero3_plans()
+                if zero3:
+                    from ..parallel import zero as _zero
+                    model_params = dict(tparams)
+                    for _op, plan in zero3:
+                        for b in plan.buckets:
+                            slab = model_params.pop(b.key)
+                            model_params.update(
+                                _zero.gather_full(slab, b, self.ex.mesh))
+
                 def loss_fn(tp, fd, sp, k):
                     if cd:
                         tp = _cast_tree(tp, cd)
@@ -212,11 +320,11 @@ class SubExecutor:
                 M = self.ex.num_microbatches or 1
                 if self.ex.pipeline and M > 1 and not self.has_pipeline_block:
                     aux_vals, updates, grads = self._microbatched_grads(
-                        loss_fn, tparams, sparams, feeds, key, M)
+                        loss_fn, model_params, sparams, feeds, key, M)
                 else:
                     (loss_val, (aux_vals, updates)), grads = \
                         jax.value_and_grad(loss_fn, has_aux=True)(
-                            tparams, feeds, sparams, key)
+                            model_params, feeds, sparams, key)
                     del loss_val
                 # PS-embedding row-gradients ride the updates side-channel;
                 # the executor pushes them into the host store post-step
@@ -226,16 +334,32 @@ class SubExecutor:
                 new_tparams = dict(tparams)
                 new_opt_states = dict(opt_states)
                 for i, opt_op in enumerate(self.opt_ops):
-                    pk = [_key(v) for v in opt_op.params]
-                    sub_p = {k: new_tparams[k] for k in pk}
+                    pk = [self.ex._k(v) for v in opt_op.params]
                     sub_g = {k: grads[k] for k in pk}
-                    upd, new_opt_states[_key(opt_op)] = opt_op.optimizer.apply(
-                        sub_p, sub_g, opt_states[_key(opt_op)], lrs[i])
+                    plan = self.ex._zero_plans.get(opt_op)
+                    ok = self.ex._k(opt_op)
+                    if plan is None:
+                        sub_p = {k: new_tparams[k] for k in pk}
+                        upd, new_opt_states[ok] = opt_op.optimizer.apply(
+                            sub_p, sub_g, opt_states[ok], lrs[i])
+                    else:
+                        # ZeRO: reduce-scatter the grads, update only this
+                        # replica's 1/dp slice of params+moments, gather
+                        # the params back (stage 3: leave them sharded)
+                        from ..parallel import zero as _zero
+                        if plan.stage >= 3:
+                            src = {b.key: tparams[b.key]
+                                   for b in plan.buckets}
+                        else:
+                            src = {k: new_tparams[k] for k in pk}
+                        upd, new_opt_states[ok] = _zero.apply_sharded(
+                            opt_op.optimizer, plan, src, sub_g,
+                            opt_states[ok], lrs[i], self.ex.mesh)
                     new_tparams.update(upd)
                 outs = []
                 for f, a in zip(fetch_nodes, aux_vals):
                     if isinstance(f, GradientOp):
-                        outs.append(grads[_key(f.wrt)])
+                        outs.append(grads[self.ex._k(f.wrt)])
                     else:
                         outs.append(a)
                 if cd:  # fetched values & state updates leave in fp32
@@ -251,9 +375,14 @@ class SubExecutor:
                 updates = _cast_tree(updates, jnp.float32, src=cd)
             return outs, tparams, updates, opt_states
 
-        # donate params & optimizer state: lets XLA update weights in place
+        # donate params & optimizer state: lets XLA update weights in place.
+        # The jitted step is looked up in the process-wide compiled-step
+        # cache first (graph/step_cache.py): a structurally identical
+        # rebuild (bench re-run, supervisor restart in-process) reuses the
+        # compiled executable instead of retracing.
         self._step_fn = step
-        self._jit = jax.jit(step, donate_argnums=(0, 2))
+        from . import step_cache
+        self._jit = step_cache.lookup_or_build(self, step)
 
     def _microbatched_grads(self, loss_fn, tparams, sparams, feeds, key, M):
         """GPipe-semantics microbatch gradient accumulation.
@@ -278,7 +407,8 @@ class SubExecutor:
         # most common leading dim (ties → larger).
         explicit = self.ex._extra_config.get("microbatch_feeds")
         if explicit:
-            names = {f"n{n.id}" if isinstance(n, Op) else n for n in explicit}
+            names = {self.ex._k(n) if isinstance(n, Op) else n
+                     for n in explicit}
             cand = [v.shape[0] for k, v in feeds.items()
                     if k in names and v.ndim]
         else:
@@ -374,7 +504,7 @@ class SubExecutor:
                 val = feed_dict[node]
             else:
                 raise ValueError(f"missing feed for {node}")
-            feeds[_key(node)] = ex._place_feed(node, val)
+            feeds[ex._k(node)] = ex._place_feed(node, val)
 
         # PS pulls: resolve the ids batch host-side, pull rows (through the
         # HET cache if configured), feed them as leaf params so jax computes
@@ -385,8 +515,8 @@ class SubExecutor:
         ps_vals = {}
         for node in self.ps_nodes:
             idn = node.ids_node
-            if _key(idn) in feeds:
-                ids = np.asarray(feeds[_key(idn)])
+            if ex._k(idn) in feeds:
+                ids = np.asarray(feeds[ex._k(idn)])
             elif idn in feed_dict:
                 ids = np.asarray(feed_dict[idn])
             elif isinstance(idn, DataloaderOp):
@@ -404,10 +534,9 @@ class SubExecutor:
                     node._last_ids = pre_ids
             if rows is None:
                 rows = node.pull(ids)
-            ps_vals[_key(node)] = ex._place_feed(node, rows)
+            ps_vals[ex._k(node)] = ex._place_feed(node, rows)
 
-        tparams = {_key(n): ex.var_values[n] for n in self.trainable_vars}
-        sparams = {_key(n): ex.var_values[n] for n in self.state_vars}
+        tparams, sparams = self._pack_state()
         if self.ps_nodes:
             # only the executor-level microbatch path splits feeds; PS rows
             # are pulled full-batch, so the two are mutually exclusive
@@ -418,7 +547,7 @@ class SubExecutor:
                     "PS embeddings + executor-level pipeline microbatching "
                     "are mutually exclusive (rows are pulled full-batch)")
             (tparams if self.grad_ops else sparams).update(ps_vals)
-        opt_states = {_key(op): ex.opt_states[op] for op in self.opt_ops}
+        opt_states = {ex._k(op): ex.opt_states[op] for op in self.opt_ops}
         lrs = np.asarray(
             [op.optimizer.host_lr(ex.step_counter) for op in self.opt_ops],
             np.float32) if self.opt_ops else np.zeros((0,), np.float32)
@@ -436,7 +565,7 @@ class SubExecutor:
             # async push (bounded-staleness semantics already allow it)
             self._start_ps_prefetch()
         for node in self.ps_nodes:
-            g = updates.pop("psgrad:" + _key(node), None)
+            g = updates.pop("psgrad:" + ex._k(node), None)
             if g is not None:
                 # multiprocess: the host fetch may be a cross-process
                 # COLLECTIVE, so every rank runs it BEFORE the one-pusher
@@ -538,14 +667,30 @@ class SubExecutor:
             # still in flight: np.asarray above only synced the grad) and
             # host-side inter-step time
             self._start_ps_prefetch()
+        # stage-3 ZeRO: updated params come back as dp-sharded slabs —
+        # they replace the slab store, never a full per-param array
+        slab_nodes = set()
+        for opt_op, plan in self._zero3_plans():
+            for b in plan.buckets:
+                ex._zero_slabs[b.key] = new_tparams[b.key]
+                ex._slab_fetch_cache.pop(b.key, None)
+            slab_nodes.update(opt_op.params)
         for n in self.trainable_vars:
-            ex.var_values[n] = new_tparams[_key(n)]
+            if n in slab_nodes or n in ex._zero_covered:
+                # covered params whose optimizer did NOT run here (eval /
+                # grad-only subgraphs sharing stage-3 weights) entered as
+                # transient materializations; writing those back would
+                # DETACH the param from its slab — later steps would keep
+                # updating the slab while var_values served a frozen full
+                # copy to save()/return_tensor_values()
+                continue
+            ex.var_values[n] = new_tparams[ex._k(n)]
         for n in self.state_vars:
-            k = _key(n)
+            k = ex._k(n)
             if k in updates:
                 ex.var_values[n] = updates[k]
         for op in self.opt_ops:
-            ex.opt_states[op] = new_opt_states[_key(op)]
+            ex.opt_states[op] = new_opt_states[ex._k(op)]
         if self.training:
             ex.step_counter += 1
             for op in self.opt_ops:
@@ -639,10 +784,16 @@ class Executor:
                  mesh=None, comm_mode=None, pipeline=None, num_microbatches=None,
                  matmul_precision=None, **kwargs):
         import jax
+        import os as _os
+        _configure_compile_cache(_os.environ.get("HETU_COMPILE_CACHE_DIR"))
         if isinstance(eval_node_dict, dict):
             self.eval_node_dict = dict(eval_node_dict)
         else:
             self.eval_node_dict = {"default": list(eval_node_dict)}
+        # ZeRO-style weight-update sharding (parallel/zero.py): kwarg wins,
+        # then HETU_ZERO, then the strategy's own zero= setting — resolved
+        # to a stage AFTER dist_strategy lands (below)
+        zero_arg = kwargs.pop("zero", None)
         # 'bfloat16' runs fp32 matmuls as single-pass bf16 on the MXU (the
         # TPU mixed-precision fast path); None keeps jax's default
         self.matmul_precision = matmul_precision
@@ -738,18 +889,45 @@ class Executor:
                 d.process_index != jax.process_index()
                 for d in self.mesh.devices.flat)
 
+        from ..parallel import zero as _zero
+        if zero_arg is None:
+            zero_arg = _os.environ.get("HETU_ZERO") or None
+        if zero_arg is None:
+            zero_arg = getattr(dist_strategy, "zero", None) or None
+        self.zero = _zero.resolve_stage(zero_arg)
+
         # materialize variables once, shared across subgraphs
         all_fetches = [n for fl in self.eval_node_dict.values() for n in fl
                        if n is not None]
         self.global_topo = topo_sort(all_fetches)
+        # canonical step-input keys: topo ORDINALS, not process-local node
+        # ids — two structurally identical graphs built in one process get
+        # byte-identical input pytrees, which is what lets the compiled-
+        # step cache (graph/step_cache.py) and jax's persistent compile
+        # cache (HETU_COMPILE_CACHE_DIR) hit across Executor rebuilds
+        self._node_keys = {n: f"t{i}" for i, n in enumerate(self.global_topo)}
         self.var_values = {}
         self._init_variables()
+
+        # ZeRO sharding plans per OptimizerOp (requires a 'dp' mesh axis of
+        # size >= 2; anything else degrades to replicated + a lint warning)
+        self._zero_plans = {}
+        self._zero_slabs = {}     # bucket key -> (dp, width) device slab
+        self._zero_covered = {}   # stage-3 param node -> its ZeroBucket
+        self._slab_fetch_cache = {}   # bucket key -> (device slab, host copy)
+        self._build_zero_plans()
 
         from ..optim.optimizer import OptimizerOp
         self.opt_states = {}
         for node in self.global_topo:
             if isinstance(node, OptimizerOp):
-                tp = {_key(v): self.var_values[v] for v in node.params}
+                plan = self._zero_plans.get(node)
+                if plan is None:
+                    tp = {self._k(v): self.var_values[v]
+                          for v in node.params}
+                else:
+                    # slab-layout state: moments are born dp-sharded
+                    tp = self._init_zero_slabs(node, plan)
                 self.opt_states[node] = node.optimizer.init_state(tp)
 
         # subgraphs whose ops carry ht.context placement run on the
@@ -769,6 +947,136 @@ class Executor:
         if self._auto_resume and self.auto_save_dir:
             self.resume(self.auto_save_dir)
 
+    # -- canonical step-input keys ----------------------------------------
+
+    def _k(self, node):
+        """Canonical (topo-ordinal) step-input key of a graph node."""
+        k = self._node_keys.get(node)
+        return k if k is not None else f"n{node.id}"
+
+    # -- ZeRO weight-update sharding (parallel/zero.py) --------------------
+
+    def _build_zero_plans(self):
+        """One :class:`ZeroPlan` per OptimizerOp when ZeRO is on and the
+        mesh has a 'dp' axis of size >= 2.  An optimizer whose params are
+        not all float arrays (e.g. a PS-backed table riding in the same
+        op), or that owns a param with an EXPLICIT sharding annotation
+        (``ht.dispatch``: model-parallel layouts the dp slab packing —
+        and stage <3's replicated gather — would silently destroy), is
+        left on the replicated update path — a partial plan would
+        silently skip the uncovered params' update."""
+        from ..parallel import zero as _zero
+        if not self.zero or self.mesh is None \
+                or _zero.ZERO_AXIS not in self.mesh.axis_names:
+            return
+        dp = int(self.mesh.shape[_zero.ZERO_AXIS])
+        if dp < 2:
+            return
+        from ..optim.optimizer import OptimizerOp
+        for node in self.global_topo:
+            if not isinstance(node, OptimizerOp) or not node.params:
+                continue
+            items, eligible = [], True
+            for p in node.params:
+                v = self.var_values.get(p)
+                if v is None or isinstance(v, _ZeroView) \
+                        or _zero.ineligible_reason(p, v.dtype) is not None:
+                    eligible = False
+                    break
+                items.append((self._k(p), tuple(v.shape),
+                              np.dtype(v.dtype).name))
+            if not eligible:
+                continue
+            # LAMB's trust ratio needs per-PARAMETER norms: a multi-param
+            # slab would compute one norm for the whole bucket
+            per_param = bool(getattr(node.optimizer, "lamb", False))
+            self._zero_plans[node] = _zero.build_plan(
+                items, dp, self.zero, per_param=per_param,
+                prefix=self._k(node) + ".")
+
+    def _init_zero_slabs(self, op, plan):
+        """Pack ``op``'s params into dp-sharded bucket slabs; at stage 3
+        the slabs BECOME the master copy (var_values swaps to
+        :class:`_ZeroView` stand-ins) — no full param copy persists
+        between steps."""
+        from ..parallel import zero as _zero
+        sh = _zero.slab_sharding(self.mesh)
+        by_key = {self._k(p): p for p in op.params}
+        slabs = {}
+        for b in plan.buckets:
+            host = {k: self._fetch_host(self.var_values[by_key[k]])
+                    for k in b.param_keys}
+            slabs[b.key] = self._global_put(
+                _zero.host_pack_slab(host, b), sh)
+        if plan.stage >= 3:
+            for b in plan.buckets:
+                self._zero_slabs[b.key] = slabs[b.key]
+                for k in b.param_keys:
+                    p = by_key[k]
+                    self._zero_covered[p] = b
+                    self.var_values[p] = _ZeroView(self, p, b)
+        return slabs
+
+    def _var_value(self, node):
+        """Device value of a variable for a step input; a stage-3
+        :class:`_ZeroView` is materialized to a full replicated array
+        (eval subgraphs sharing sharded training weights)."""
+        v = self.var_values[node]
+        if isinstance(v, _ZeroView):
+            return self._place_param(v.materialize(), node)
+        return v
+
+    def _set_vars_host(self, items):
+        """Install full host values for variables (``{node: array}``) —
+        writing THROUGH to the bucket slabs when params' master bytes
+        live sharded (stage-3 ZeRO), so load/load_dict keep the sharded
+        layout.  Batched: each touched slab is fetched and re-placed ONCE
+        no matter how many of its params are set (a per-param round trip
+        would make restoring a 50-param bucket pay 50 full slab
+        gather+scatter trips — and on a multi-process mesh every fetch is
+        a collective)."""
+        from ..parallel import zero as _zero
+        by_bucket = {}
+        for node, val in items.items():
+            b = self._zero_covered.get(node)
+            if b is None:
+                self.var_values[node] = self._place_param(
+                    np.asarray(val), node)
+            else:
+                by_bucket.setdefault(b.key, (b, {}))[1][node] = val
+        for key, (b, vals) in by_bucket.items():
+            slab = np.array(self._fetch_host(self._zero_slabs[key]))
+            flat = slab.reshape(-1)
+            for node, val in vals.items():
+                i = b.param_keys.index(self._k(node))
+                shape = b.shapes[i]
+                size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                flat[b.offsets[i]:b.offsets[i] + size] = \
+                    np.asarray(val, slab.dtype).reshape(-1)
+            self._zero_slabs[key] = self._global_put(
+                slab, _zero.slab_sharding(self.mesh))
+            self._slab_fetch_cache.pop(key, None)
+
+    def _set_var_host(self, node, val):
+        self._set_vars_host({node: val})
+
+    def _slab_host(self, bucket):
+        """Host copy of one stage-3 bucket slab, memoized against the
+        CURRENT device slab: save()/eval packing/return_tensor_values
+        materialize every member of a bucket, and k params in one 4 MB
+        bucket must pay ONE full-slab gather (a cross-process collective
+        on a multiprocess mesh), not k.  The cache invalidates by slab
+        identity — every step and every restore installs a new slab
+        object — and is dropped eagerly on replacement, so at most the
+        current step's materialized buckets live host-side."""
+        cur = self._zero_slabs[bucket.key]
+        slab, host = self._slab_fetch_cache.get(bucket.key, (None, None))
+        if slab is cur:
+            return host
+        host = self._fetch_host(cur)
+        self._slab_fetch_cache[bucket.key] = (cur, host)
+        return host
+
     # -- static validation (hetu_tpu.analysis) -----------------------------
 
     def _validate_graphs(self):
@@ -787,7 +1095,8 @@ class Executor:
             try:
                 report = lint_graph(fetches, mesh=self.mesh,
                                     pipeline=self.pipeline,
-                                    num_microbatches=self.num_microbatches)
+                                    num_microbatches=self.num_microbatches,
+                                    zero=self.zero)
             except Exception as e:
                 # the analyzer must never be the thing that breaks a
                 # working graph — report and continue
@@ -996,10 +1305,9 @@ class Executor:
                         f"feed {node} needs a static shape for export; "
                         "pass shape= to placeholder_op")
                 arr = np.zeros(node.shape, node.dtype or np.float32)
-            feeds[_key(node)] = arr
-        tparams = {_key(n): self.var_values[n] for n in sub.trainable_vars}
-        sparams = {_key(n): self.var_values[n] for n in sub.state_vars}
-        opt_states = {_key(op): self.opt_states[op] for op in sub.opt_ops}
+            feeds[self._k(node)] = arr
+        tparams, sparams = sub._pack_state()
+        opt_states = {self._k(op): self.opt_states[op] for op in sub.opt_ops}
         lrs = np.asarray([op.optimizer.host_lr(0) for op in sub.opt_ops],
                          np.float32)
         key = jax.random.key(self.seed)
@@ -1361,7 +1669,7 @@ class Executor:
         """(nodekey→param-name, param-name→nodekey) for one optimizer op —
         node keys ('n<id>') are process-local; param names are the stable
         checkpoint identity."""
-        fwd = {_key(p): self.var_names[p] for p in op.params}
+        fwd = {self._k(p): self.var_names[p] for p in op.params}
         return fwd, {v: k for k, v in fwd.items()}
 
     @staticmethod
@@ -1408,6 +1716,19 @@ class Executor:
                     sites.append(node)
         return sites
 
+    def _place_opt_leaf(self, op, leaf):
+        """Place a restored optimizer-state leaf: slab-shaped leaves of a
+        ZeRO-planned optimizer go back dp-SHARDED (a replicated restore
+        would silently pay the full moment memory the plan exists to
+        shed); everything else replicates like a param."""
+        plan = self._zero_plans.get(op)
+        if plan is not None and getattr(leaf, "ndim", 0) == 2:
+            from ..parallel import zero as _zero
+            if tuple(leaf.shape) in {(b.dp, b.width) for b in plan.buckets}:
+                return self._global_put(np.asarray(leaf),
+                                        _zero.slab_sharding(self.mesh))
+        return self._place_param(leaf)
+
     def _fetch_host(self, v):
         """Host copy of a (possibly cross-process-sharded) tensor.
 
@@ -1415,6 +1736,8 @@ class Executor:
         arrays (allgather) — every rank must call it, even ranks that then
         discard the result (save gates the file writes on rank 0)."""
         import jax
+        if isinstance(v, _ZeroView):    # stage-3 ZeRO: gather from slab
+            return v.materialize()
         if not self._multiprocess or getattr(v, "is_fully_addressable", True):
             return np.asarray(v)
         if getattr(v, "is_fully_replicated", False):
@@ -1641,7 +1964,7 @@ class Executor:
                       for kp, old in paths]
             self.opt_states[op] = self._unname_opt_state(
                 op, jax.tree.unflatten(
-                    treedef, [self._place_param(l) for l in leaves]))
+                    treedef, [self._place_opt_leaf(op, l) for l in leaves]))
         self.step_counter = int(tree.get("step", 0))
 
     def load(self, path, file=None, consider_splits=False,
@@ -1661,11 +1984,21 @@ class Executor:
             with open(meta_path) as f:
                 meta = json.load(f)
             by_name = {self.var_names[n]: n for n in self.var_values}
+            # streamed one tensor at a time, except stage-3 ZeRO params:
+            # those accumulate and land as ONE slab write per bucket (the
+            # transient host copy is bounded by the slab total)
+            pending = {}
             for name, fn in meta["params"].items():
                 node = by_name.get(name)
-                if node is not None:    # streamed: one tensor at a time
-                    self.var_values[node] = self._place_param(
-                        np.load(os.path.join(path, "params", fn)), node)
+                if node is None:
+                    continue
+                val = np.load(os.path.join(path, "params", fn))
+                if node in self._zero_covered:
+                    pending[node] = val
+                else:
+                    self._set_var_host(node, val)
+            if pending:
+                self._set_vars_host(pending)
             if params_only:
                 entries = {e["file"] for e in meta["ps_tables"]}
                 for i, node in enumerate(self._ps_table_sites()):
@@ -1683,12 +2016,26 @@ class Executor:
                 named_live = self._named_opt_state(op, live)
                 paths, treedef = jax.tree_util.tree_flatten_with_path(
                     named_live)
-                leaves = []
+                leaves, missed = [], []
                 for kpath, old_leaf in paths:
                     fn = entry["leaves"].get(jax.tree_util.keystr(kpath))
+                    if fn is None:
+                        missed.append(jax.tree_util.keystr(kpath))
                     leaves.append(
-                        old_leaf if fn is None else self._place_param(
-                            np.load(os.path.join(path, "opt", fn))))
+                        old_leaf if fn is None else self._place_opt_leaf(
+                            op, np.load(os.path.join(path, "opt", fn))))
+                if missed and entry["leaves"]:
+                    # ZeRO slab state is keyed by bucket layout: loading
+                    # across a zero-stage / graph-structure change finds
+                    # no matching leaves and would otherwise resume with
+                    # FRESH moments silently
+                    warnings.warn(
+                        f"checkpoint optimizer state for '{op.name}': "
+                        f"{len(missed)}/{len(paths)} live leaves absent "
+                        f"from the checkpoint (e.g. {missed[0]}) — "
+                        "keeping existing values. A ZeRO stage or "
+                        "bucket-layout mismatch between save and load "
+                        "resumes with fresh moments.")
                 self.opt_states[op] = self._unname_opt_state(
                     op, jax.tree.unflatten(treedef, leaves))
             entries = {e["file"] for e in meta["ps_tables"]}
@@ -1710,27 +2057,115 @@ class Executor:
         self.load_dict(blob["params"])
         if params_only:
             return
-        by_name = {op.name: op for op in self.opt_states}
-        for name, st in blob.get("opt_states", {}).items():
-            if name in by_name:
-                # optimizer state shards like its params; without per-leaf
-                # node info, restore replicated-or-sharded via the param map
-                # below after params are placed (leaves follow params in the
-                # next jitted step's constraint anyway)
-                self.opt_states[by_name[name]] = jax.tree.map(
-                    self._place_param, st)
+        ops = list(self.opt_states)
+        by_name = {op.name: op for op in ops}
+        blob_states = list(blob.get("opt_states", {}).items())
+        matched = [by_name.get(name) for name, _ in blob_states]
+        if not any(op is not None for op in matched) \
+                and len(blob_states) == len(ops):
+            # auto-generated OptimizerOp names embed a process-global
+            # counter, so a same-process rebuild never name-matches —
+            # fall back to graph order (the dir format's identity)
+            # instead of silently resuming with fresh moments.  Only
+            # when NO name matched: under partial overlap, positionally
+            # installing the leftovers could cross-wire one optimizer's
+            # moments into another
+            matched = ops
+        for op, (name, st) in zip(matched, blob_states):
+            if op is None:
+                continue
+            # slab-shaped leaves of a ZeRO-planned optimizer go back
+            # dp-SHARDED (_place_opt_leaf) — a replicated restore of the
+            # moments would pay the full dp x memory the plan exists to
+            # shed, at exactly the resume moment
+            self.opt_states[op] = jax.tree.map(
+                lambda l, op=op: self._place_opt_leaf(op, l), st)
         self.step_counter = blob.get("step", 0)
 
     def load_dict(self, state_dict):
         by_name = {self.var_names[n]: n for n in self.var_values}
-        for name, val in state_dict.items():
-            if name in by_name:
-                node = by_name[name]
-                self.var_values[node] = self._place_param(np.asarray(val), node)
+        self._set_vars_host({by_name[name]: np.asarray(val)
+                             for name, val in state_dict.items()
+                             if name in by_name})
 
     def return_tensor_values(self):
-        return {self.var_names[n]: np.asarray(v)
+        return {self.var_names[n]: self._fetch_host(v)
                 for n, v in self.var_values.items()}
+
+    def memory_accounting(self):
+        """Per-device byte accounting of the persistent training state —
+        the numbers the ZeRO memory claim is judged on (``bench.py``
+        artifact schema; works on CPU where ``memory_stats`` reports
+        nothing).
+
+        * ``param_bytes_per_device`` — full per-param master arrays
+          (replicated: each device pays all of it).  Stage-3 ZeRO params
+          live in slabs and are counted there instead.
+        * ``zero_slab_bytes_per_device`` — dp-sharded master slabs
+          (each device holds 1/dp, padding included).
+        * ``opt_state_bytes_per_device`` — optimizer moments etc.;
+          dp-sharded leaves count their one-device shard only.
+        * ``grad_bytes_per_device`` — ANALYTIC layout of the transient
+          backward output: full per-param unless the plan pins the grad
+          slab sharded (stage >= 2).
+        * ``live_buffer_bytes_per_device`` — every live jax array's
+          worst-device residency (process-wide).
+        * ``peak_hbm_gb`` — backend-reported peak, None where the
+          backend (XLA-CPU) keeps no stats.
+        """
+        import jax
+
+        def per_dev(arr):
+            if isinstance(arr, _ZeroView):
+                return 0            # master bytes counted under the slab
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                by_dev = {}
+                for s in shards:
+                    by_dev[s.device.id] = \
+                        by_dev.get(s.device.id, 0) + s.data.nbytes
+                return max(by_dev.values())
+            return int(getattr(arr, "nbytes", 0))
+
+        params = sum(per_dev(v) for v in self.var_values.values())
+        slabs = sum(per_dev(v) for v in self._zero_slabs.values())
+        opt = sum(per_dev(leaf) for st in self.opt_states.values()
+                  for leaf in jax.tree_util.tree_leaves(st))
+        grads = 0
+        from ..optim.optimizer import OptimizerOp
+        for node in self.global_topo:
+            if not isinstance(node, OptimizerOp):
+                continue
+            plan = self._zero_plans.get(node)
+            if plan is None:
+                grads += sum(
+                    int(np.prod(p.shape, dtype=np.int64))
+                    * np.dtype(getattr(self.var_values.get(p), "dtype",
+                                       np.float32)).itemsize
+                    for p in node.params if p.shape is not None)
+            else:
+                for b in plan.buckets:
+                    grads += b.nbytes // (plan.dp if plan.stage >= 2 else 1)
+        try:
+            live = sum(per_dev(a) for a in jax.live_arrays())
+        except Exception:
+            live = None
+        peak = None
+        try:
+            st = jax.devices()[0].memory_stats() or {}
+            peak = round(st.get("peak_bytes_in_use", 0) / 2**30, 3) or None
+        except Exception:
+            pass
+        return {
+            "n_devices": len(jax.devices()),
+            "zero_stage": self.zero if self._zero_plans else 0,
+            "param_bytes_per_device": int(params),
+            "zero_slab_bytes_per_device": int(slabs),
+            "opt_state_bytes_per_device": int(opt),
+            "grad_bytes_per_device": int(grads),
+            "live_buffer_bytes_per_device": live,
+            "peak_hbm_gb": peak,
+        }
 
 
 # reference-parity no-op shims (MPI/PS boilerplate not needed under XLA SPMD)

@@ -336,19 +336,19 @@ class InterOpSubExecutor:
             # optimizer update per segment (stays on each device)
             opt_op = self.opt_ops[0] if self.opt_ops else None
             if opt_op is not None:
-                from .executor import _key
                 opt = opt_op.optimizer
                 lr = opt.host_lr(ex.step_counter)
                 state = ex.opt_states.setdefault(
                     opt_op, opt.init_state(
-                        {_key(v): ex.var_values[v] for v in opt_op.params}))
-                p_all = {_key(v): ex.var_values[v] for v in opt_op.params}
-                g_all = {_key(v): grads[v] for v in opt_op.params
+                        {ex._k(v): ex.var_values[v]
+                         for v in opt_op.params}))
+                p_all = {ex._k(v): ex.var_values[v] for v in opt_op.params}
+                g_all = {ex._k(v): grads[v] for v in opt_op.params
                          if v in grads}
                 new_p, new_state = opt.apply(p_all, g_all, state, lr)
                 ex.opt_states[opt_op] = new_state
                 for v in opt_op.params:
-                    ex.var_values[v] = new_p[_key(v)]
+                    ex.var_values[v] = new_p[ex._k(v)]
             ex.step_counter += 1
 
         results = []

@@ -152,6 +152,72 @@ def reset_cache_counts():
         _cache_counts.clear()
 
 
+# ------------------------------------------------- ZeRO weight-update counters
+# The ZeRO sharded-update layer (``parallel/zero.py``) records its
+# collective traffic and padding waste here: grad-slab bytes pinned to the
+# sharded layout (``zero_reduce_scatter_bytes`` — what the partitioner may
+# lower as a reduce-scatter), updated-param bytes gathered back
+# (``zero_all_gather_bytes``), and zero-fill bytes added so ragged shapes
+# shard evenly (``zero_pad_bytes``).  Counts are per TRACE, not per step
+# (the slabs are built when jax traces the program — flash-counter
+# semantics): a count that keeps climbing across steps means the jit cache
+# is thrashing.  Surfaced by ``HetuProfiler.zero_counters()`` and
+# ``bench.py --config zero``; a run without ``zero=`` records nothing.
+
+_zero_counts = collections.Counter()
+_zero_lock = threading.Lock()
+
+
+def record_zero(kind, n=1):
+    """Count ``n`` bytes/events of ZeRO sharded-update traffic."""
+    if counters_suppressed():
+        return  # abstract (eval_shape) trace, not a real build
+    if n:
+        with _zero_lock:
+            _zero_counts[str(kind)] += int(n)
+
+
+def zero_counts():
+    """{kind: bytes} snapshot of ZeRO collective/padding counters."""
+    with _zero_lock:
+        return dict(_zero_counts)
+
+
+def reset_zero_counts():
+    with _zero_lock:
+        _zero_counts.clear()
+
+
+# -------------------------------------------------- compiled-step cache counters
+# The executor's compiled-executable cache (``graph/step_cache.py``) keys a
+# jitted step on (graph signature, mesh, compute_dtype, zero stage) and
+# reuses it across Executor instances; hits skip a full XLA retrace.
+# ``step_cache_hit`` / ``step_cache_miss`` count lookups;
+# ``step_cache_uncachable`` counts graphs whose signature could not be
+# computed (caching skipped, never wrong-cached).  Surfaced by
+# ``HetuProfiler.step_cache_counters()``.
+
+_step_cache_counts = collections.Counter()
+_step_cache_lock = threading.Lock()
+
+
+def record_step_cache(kind, n=1):
+    """Count one compiled-step cache event (hit/miss/uncachable)."""
+    with _step_cache_lock:
+        _step_cache_counts[str(kind)] += n
+
+
+def step_cache_counts():
+    """{kind: count} snapshot of compiled-step cache events."""
+    with _step_cache_lock:
+        return dict(_step_cache_counts)
+
+
+def reset_step_cache_counts():
+    with _step_cache_lock:
+        _step_cache_counts.clear()
+
+
 def _np(x):
     return np.asarray(x)
 

@@ -359,7 +359,9 @@ def export(source, path, name="hetu_graph", feed_shapes=None, opset=20):
         fetches = [f for fs in (s.fetches for s in
                                 source.subexecutors.values())
                    for f in fs if f is not None]
-        var_values = {n: np.asarray(v)
+        # _fetch_host, not np.asarray: stage-3 ZeRO keeps params as
+        # _ZeroView slab stand-ins that must be gathered to full arrays
+        var_values = {n: np.asarray(source._fetch_host(v))
                       for n, v in source.var_values.items()}
     else:
         fetches = list(source)

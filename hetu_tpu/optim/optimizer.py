@@ -8,6 +8,20 @@ fuses with the backward pass (the reference needed hand-fused kernels for
 this).  ``OptimizerOp`` keeps the graph-level contract: ``opt.minimize(loss)``
 returns a fetchable node, and gradient wrapping for data-parallel happens via
 mesh sharding instead of inserted AllReduce ops (``optimizer.py:145-164``).
+
+Layout polymorphism (ZeRO weight-update sharding, ``parallel/zero.py``):
+``apply`` never sees graph nodes — just a dict of same-shaped param/grad
+arrays — so the sharded update feeds it ``(dp, width)`` bucket SLABS
+instead of per-param arrays and the SAME code updates each replica's 1/dp
+slice of state.  That only holds while the update is ELEMENTWISE per dict
+entry (each output element depends only on that element's p/g/state plus
+scalars like ``t``).  An optimizer that couples elements of one parameter
+— LAMB's per-parameter trust-ratio norms — must set ``lamb = True``-style
+markers so the ZeRO planner packs one param per bucket: a multi-param
+slab would blend norms across parameters (the cross-REPLICA half is fine
+— the partitioner turns the sharded slab's ``sum(p*p)`` into a partial
+sum + all-reduce automatically).  New optimizers with cross-element terms
+must do the same or stay off the ZeRO path.
 """
 from __future__ import annotations
 

@@ -7,4 +7,6 @@ from .pipeline import (PipelineParallel, pipeline_block, pipeline_apply,
                        pipedream_schedule, hetpipe_sync_steps)
 from .ring_attention import (ContextParallel, ring_attention,
                              ulysses_attention)
-from .preduce import PartialReduce, preduce_mean
+from .preduce import PartialReduce, preduce_mean, preduce_scatter_mean
+from . import zero
+from .zero import ZeroPlan, ZeroBucket

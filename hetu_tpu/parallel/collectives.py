@@ -46,9 +46,27 @@ def all_gather(x, axis_name, axis=0, tiled=True):
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
-def reduce_scatter(x, axis_name, axis=0):
+def reduce_scatter(x, axis_name, axis=0, tiled=True):
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
-                                tiled=True)
+                                tiled=tiled)
+
+
+# -- implicit-surface PartitionSpecs (GSPMD sharding constraints) -----------
+# The ZeRO weight-update layer (parallel/zero.py) pins its slabs to these
+# specs and lets the SPMD partitioner emit the reduce-scatter / all-gather
+# pair itself — surface 1 of the module docstring, where the collective is
+# IMPLIED by a layout change instead of called explicitly.
+
+def slab_spec(axis_name="dp"):
+    """Spec of a ``(dp, width)`` ZeRO slab: rows sharded over ``axis_name``
+    (each replica holds its own 1/dp slice)."""
+    return P(axis_name, None)
+
+
+def replicated_spec():
+    """Spec of a fully replicated tensor — constraining a slab to this is
+    the implicit all-gather."""
+    return P()
 
 
 def all_to_all(x, axis_name, split_axis=0, concat_axis=0):

@@ -123,6 +123,29 @@ class PartialReduce:
         den = jax.lax.psum(mask, axis_name)
         return jax.tree.map(lambda v: v / den, num)
 
+    @staticmethod
+    def preduce_scatter(grad, mask, axis_name):
+        """Alive-mask mean composed with the ZeRO grad layout: each device
+        receives its own 1/n slice (leading dim) of ``mean_active(grad)``
+        instead of the full mean — ``psum_scatter(mask*g) / psum(mask)``,
+        one reduce-scatter where :meth:`preduce` pays a full all-reduce.
+
+        This is how partial reduce feeds the sharded weight update
+        (parallel/zero.py): the scattered masked mean IS the per-replica
+        grad slice the sharded optimizer consumes, so straggler/dead-rank
+        tolerance and ZeRO memory sharding compose in a single collective.
+        Every leaf's leading dim must divide the axis size (pack/pad via
+        ``zero.pack_slab`` first — its ``(dp, width)`` slabs satisfy this
+        by construction).
+        """
+        import jax
+        num = jax.tree.map(
+            lambda g: jax.lax.psum_scatter(
+                g * mask, axis_name, scatter_dimension=0, tiled=True),
+            grad)
+        den = jax.lax.psum(mask, axis_name)
+        return jax.tree.map(lambda v: v / den, num)
+
 
 class DistPartialReduce(PartialReduce):
     """Multi-process group formation backed by the distributed store's SSP
@@ -213,4 +236,12 @@ def preduce_mean(grad, mask, axis_name="dp"):
     return PartialReduce.preduce(grad, mask, axis_name)
 
 
-__all__ = ["PartialReduce", "DistPartialReduce", "preduce_mean"]
+def preduce_scatter_mean(grad, mask, axis_name="dp"):
+    """Functional alias of :meth:`PartialReduce.preduce_scatter` — the
+    dead-rank-tolerant masked mean delivered in the ZeRO reduce-scatter
+    layout (each device gets its 1/n leading-dim slice)."""
+    return PartialReduce.preduce_scatter(grad, mask, axis_name)
+
+
+__all__ = ["PartialReduce", "DistPartialReduce", "preduce_mean",
+           "preduce_scatter_mean"]

@@ -29,20 +29,28 @@ class Strategy:
 
 
 class DataParallel(Strategy):
-    """Pure data parallelism: batch dim sharded over the 'dp' axis, params
-    replicated; grad allreduce is emitted by XLA from the mean-loss psum.
+    """Pure data parallelism: batch dim sharded over the 'dp' axis; grad
+    allreduce is emitted by XLA from the mean-loss psum.
 
     ``aggregate`` ∈ {allreduce, ps, hybrid} kept for reference API parity
     (simple.py:6); on TPU all three map to ICI collectives for dense params,
     while embeddings marked ``is_embed`` can live in the host store
     (:mod:`hetu_tpu.embedding`) — the hybrid path's equivalent.
+
+    ``zero``: ZeRO-style weight-update sharding stage (0=off, 1=shard
+    optimizer state, 2=+reduce-scattered grads, 3=+dp-sharded master
+    params; :mod:`hetu_tpu.parallel.zero`).  Params are replicated at
+    stages 0-2 and live as dp-sharded bucket slabs at stage 3.  An
+    ``Executor(zero=...)`` kwarg or ``HETU_ZERO`` overrides this.
     """
 
-    def __init__(self, aggregate="allreduce", num_devices=None):
+    def __init__(self, aggregate="allreduce", num_devices=None, zero=None):
         aggregate = (aggregate or "allreduce").lower()
         assert aggregate in ("allreduce", "ps", "hybrid")
         self.aggregate = aggregate
         self.num_devices = num_devices
+        from .zero import resolve_stage
+        self.zero = resolve_stage(zero)
 
     def make_mesh(self):
         import jax

@@ -47,11 +47,13 @@ class HetuProfiler:
         self.warmup = warmup
 
     # -- input packing / shape inference -------------------------------------
-    def _pack(self, feed_dict):
+    def _pack(self, feed_dict, materialize=False):
         """Assemble (tparams, sparams, feeds, master_key, step_idx)
-        exactly like sub.run (the step folds the key itself)."""
-        import jax
-        from .graph.executor import _key
+        exactly like sub.run (the step folds the key itself).
+
+        ``materialize=True`` forces stage-3 ZeRO params to full
+        replicated values instead of bucket slabs — the forward-only
+        abstract shape evaluation needs per-param keys."""
         from .data.dataloader import DataloaderOp
         sub, ex = self.sub, self.ex
         feeds = {}
@@ -62,15 +64,19 @@ class HetuProfiler:
                 val = feed_dict[node]
             else:
                 raise ValueError(f"missing feed for {node}")
-            feeds[_key(node)] = ex._place_feed(node, val)
-        tparams = {_key(n): ex.var_values[n] for n in sub.trainable_vars}
-        sparams = {_key(n): ex.var_values[n] for n in sub.state_vars}
+            feeds[ex._k(node)] = ex._place_feed(node, val)
+        if hasattr(sub, "_pack_state"):   # ZeRO-aware packing (SubExecutor)
+            tparams, sparams = sub._pack_state(materialize=materialize)
+        else:
+            tparams = {ex._k(n): ex.var_values[n]
+                       for n in sub.trainable_vars}
+            sparams = {ex._k(n): ex.var_values[n] for n in sub.state_vars}
         # PS embeddings: pull rows host-side like sub.run does, else the
         # placeholder lookup in _forward falls through to feeds and KeyErrors
         for node in sub.ps_nodes:
             idn = node.ids_node
-            if _key(idn) in feeds:
-                ids = np.asarray(feeds[_key(idn)])
+            if ex._k(idn) in feeds:
+                ids = np.asarray(feeds[ex._k(idn)])
             elif idn in feed_dict:
                 ids = np.asarray(feed_dict[idn])
             elif isinstance(idn, DataloaderOp):
@@ -78,7 +84,7 @@ class HetuProfiler:
             else:
                 raise ValueError(f"cannot resolve ids for PS embedding {node}")
             val = ex._place_feed(node, node.pull(ids))
-            (tparams if sub.grad_ops else sparams)[_key(node)] = val
+            (tparams if sub.grad_ops else sparams)[ex._k(node)] = val
         # the executor folds per-step RNG INSIDE the jitted program; the
         # pack mirrors its (master_key, step_idx:int32) calling convention
         # (int32 keeps the traced dtype identical with and without x64)
@@ -90,7 +96,8 @@ class HetuProfiler:
         import jax
 
         sub = self.sub
-        tparams, sparams, feeds, key, step_idx = self._pack(feed_dict)
+        tparams, sparams, feeds, key, step_idx = self._pack(
+            feed_dict, materialize=True)
         key = jax.random.fold_in(key, step_idx)
         nodes = [n for n in sub.topo
                  if not hasattr(n, "loss") and n not in sub.opt_ops]
@@ -187,12 +194,11 @@ class HetuProfiler:
 
     def _lowered(self, feed_dict):
         """Lower (cache-hitting) the executor's jitted step for analysis."""
-        from .graph.executor import _key
         sub, ex = self.sub, self.ex
         if sub._jit is None:
             sub._build_step()
         tparams, sparams, feeds, key, step_idx = self._pack(feed_dict)
-        opt_states = {_key(op): ex.opt_states[op] for op in sub.opt_ops}
+        opt_states = {ex._k(op): ex.opt_states[op] for op in sub.opt_ops}
         lrs = np.zeros((len(sub.opt_ops),), np.float32)
         # reuse the executor's jitted step — .lower on the same jit object
         # hits jax's compilation cache instead of recompiling
@@ -246,6 +252,30 @@ class HetuProfiler:
         records here — a clean dense run reports an empty dict."""
         from .metrics import cache_counts
         return cache_counts()
+
+    @staticmethod
+    def zero_counters():
+        """{kind: bytes} of ZeRO sharded-update traffic
+        (``hetu_tpu.metrics`` registry): grad-slab bytes pinned to the
+        reduce-scatter layout (``zero_reduce_scatter_bytes``),
+        updated-param bytes all-gathered back (``zero_all_gather_bytes``)
+        and zero-fill padding added so ragged shapes shard evenly
+        (``zero_pad_bytes``).  Per-trace semantics like
+        :meth:`flash_fallbacks`; a run without ``Executor(zero=...)``
+        reports an empty dict."""
+        from .metrics import zero_counts
+        return zero_counts()
+
+    @staticmethod
+    def step_cache_counters():
+        """{kind: count} of compiled-step cache events
+        (``hetu_tpu.metrics`` registry): ``step_cache_hit`` — a jitted
+        step was reused across Executor instances (no retrace),
+        ``step_cache_miss`` — built fresh and stored,
+        ``step_cache_uncachable`` — the graph signature could not be
+        computed so caching was skipped."""
+        from .metrics import step_cache_counts
+        return step_cache_counts()
 
     @staticmethod
     def fault_counters():

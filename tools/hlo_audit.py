@@ -43,6 +43,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 if os.environ.get("_HETU_AUDIT_FORCE_CPU"):
+    # the zero config audits a dp=4 mesh program: the host-device-count
+    # flag must land before the backend initializes (single-device
+    # configs ignore the extra devices — they jit onto device 0)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -119,11 +126,16 @@ def _audit_contractions(lowered_text):
 
 def _audit_aliasing(lowered_text, compiled_text):
     """Donated buffers: counted from the lowered program's aliasing
-    attributes (``tf.aliasing_output`` — program semantics; present on
-    every backend) and cross-checked against the compiled module's
+    attributes — ``tf.aliasing_output`` when jit resolves the alias at
+    lowering (single-device programs) and ``jax.buffer_donor`` when the
+    assignment is deferred to the compiler (mesh-sharded programs, e.g.
+    the ZeRO step: jit marks the donor, XLA pairs it post-SPMD).  Either
+    marker is program-semantics donation, present on every backend; the
+    count is cross-checked against the compiled module's
     input_output_alias (backend honor: XLA-CPU drops donation, the TPU
     runtime applies it)."""
-    lowered = lowered_text.count("tf.aliasing_output")
+    lowered = (lowered_text.count("tf.aliasing_output")
+               + lowered_text.count("jax.buffer_donor"))
     m = re.search(r"input_output_alias=\{([^}]*)\}", compiled_text)
     compiled = m.group(1).count("(") if m else 0
     return lowered, compiled
@@ -252,6 +264,81 @@ def _audit_config(name, backend, args):
     return {"checks": checks, "ok": all(checks.values()), "detail": detail}
 
 
+def _audit_zero(backend, args, dp=4):
+    """ISSUE 6 donation audit: the stage-3 ZeRO step must keep every
+    persistent buffer (bucket slabs + optimizer-state slabs) DONATED and
+    dp-SHARDED — zero spurious full-param copies living between steps.
+
+    Checks:
+      zero_donation        every slab + slab-shaped state leaf is covered
+                           by the program's aliasing pairs
+      zero_state_sharded   every slab-shaped optimizer-state leaf and
+                           every master slab carries PartitionSpec('dp',)
+      zero_gather_in_hlo   the compiled step really all-gathers (params
+                           are NOT stored full between steps)
+      one_entry / no_host_transfers as in the other configs
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+    from hetu_tpu.profiler import HetuProfiler
+
+    if len(jax.devices()) < dp:
+        return {"checks": {}, "ok": True,
+                "detail": {"skipped": f"needs >= {dp} devices, have "
+                                      f"{len(jax.devices())}"}}
+    from bench import build_bert_graph
+    cfg, ex, fd = build_bert_graph(batch_size=4, seq_len=128, size="tiny",
+                                   compute_dtype=None, dp=dp, zero=3)
+    ex.run("train", feed_dict=fd)    # build + prove the live path once
+    prof = HetuProfiler(ex, name="train")
+    lowered = prof.lowered_text(fd)
+    hlo = prof.hlo_text(fd)
+
+    slab_spec = PartitionSpec("dp", None)
+    n_slabs = len(ex._zero_slabs)
+    slabs_sharded = n_slabs > 0 and all(
+        v.sharding.spec == slab_spec for v in ex._zero_slabs.values())
+    state_slab_leaves = [
+        leaf for st in ex.opt_states.values()
+        for leaf in jax.tree_util.tree_leaves(st)
+        if getattr(leaf, "ndim", 0) == 2]
+    state_sharded = bool(state_slab_leaves) and all(
+        leaf.sharding.spec == slab_spec for leaf in state_slab_leaves)
+
+    n_alias_prog, n_alias_compiled = _audit_aliasing(lowered, hlo)
+    persistent = n_slabs + len(state_slab_leaves)
+    host_ops = [op for op in ("infeed", "outfeed", "send(", "recv(")
+                if op in hlo]
+    n_entry = len(re.findall(r"^ENTRY ", hlo, re.MULTILINE))
+    gathers = hlo.count("all-gather")
+    reduces = hlo.count("all-reduce") + hlo.count("reduce-scatter")
+
+    checks = {
+        "one_entry": n_entry == 1,
+        "no_host_transfers": not host_ops,
+        # every persistent ZeRO buffer donated: no second full-size (or
+        # even slab-size) residency for params/moments across steps
+        "zero_donation": n_alias_prog >= persistent > 0,
+        "zero_state_sharded": slabs_sharded and state_sharded,
+        # the gather really happens inside the step — master params are
+        # not stored full anywhere between steps
+        "zero_gather_in_hlo": gathers > 0,
+    }
+    detail = {
+        "workload": {"dp": dp, "batch_size": 4, "seq_len": 128,
+                     "size": "tiny", "zero": 3},
+        "n_slabs": n_slabs,
+        "n_state_slab_leaves": len(state_slab_leaves),
+        "alias_pairs_program": n_alias_prog,
+        "alias_pairs_compiled": n_alias_compiled,
+        "all_gather_ops": gathers,
+        "reduce_ops": reduces,
+        "host_ops_found": host_ops,
+        "memory": ex.memory_accounting(),
+    }
+    return {"checks": checks, "ok": all(checks.values()), "detail": detail}
+
+
 def main():
     import argparse
     import jax
@@ -260,17 +347,19 @@ def main():
 
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="all",
-                   choices=["all"] + list(BUILDERS))
+                   choices=["all", "zero"] + list(BUILDERS))
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--skip-retrace", action="store_true")
     args = p.parse_args()
 
     backend = jax.default_backend()
-    names = list(BUILDERS) if args.config == "all" else [args.config]
+    names = list(BUILDERS) + ["zero"] if args.config == "all" \
+        else [args.config]
     configs = {}
     for name in names:
-        configs[name] = _audit_config(name, backend, args)
+        configs[name] = _audit_zero(backend, args) if name == "zero" \
+            else _audit_config(name, backend, args)
         print(json.dumps({name: configs[name]["checks"],
                           "ok": configs[name]["ok"]}))
 
