@@ -785,6 +785,12 @@ def _child_main(args):
             batch_size=args.batch_size or 8,
             seq_len=args.seq_len or 128)))
         return
+    if args.config == "serve":
+        # host-side serving acceptance: router + batcher + PS transport
+        # run on the host; the jitted forward is tiny (ISSUE 7)
+        print(json.dumps(bench_serve(smoke=args.smoke,
+                                     n_requests=args.steps)))
+        return
 
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
@@ -1704,11 +1710,256 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     }
 
 
+def bench_serve(smoke=True, n_requests=None, seed=0):
+    """ISSUE 7 acceptance: online inference serving under chaos.  A
+    wdl-style CTR model (26 zipf(1.05)-skewed categorical fields through
+    a PS embedding, dense tower, sigmoid click prob) is served by the
+    new ``hetu_tpu.serving`` stack — InferenceExecutor (compile-once per
+    batch bucket) + ServingRouter (bounded queue, adaptive micro-batch)
+    — with the embedding pulled READ-ONLY through ``DistCacheTable``
+    from a 3-rank ``replication=2`` DistributedStore.  The same seeded
+    request stream runs twice: clean, and with a chaos schedule that
+    kills the shard-1 PRIMARY mid-load (``kill:primary@shard1:req<n>``,
+    fired on the router's admission clock).  The kill must be absorbed
+    by client-transparent failover: restarts=0, every request answered,
+    responses BITWISE equal to the clean run, p99 degradation bounded by
+    one rpc_timeout + heartbeat deadline.  Host-side metric: routing,
+    batching and the PS transport run on the host whatever the
+    accelerator is."""
+    import socket as _socket
+
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.metrics import (fault_counts, reset_faults,
+                                  reset_serve_counts, serve_counts)
+    from hetu_tpu.ps.dist_store import DistCacheTable, DistributedStore
+    from hetu_tpu.serving import InferenceExecutor, ServingRouter
+
+    n_requests = int(n_requests or (300 if smoke else 2000))
+    world, dim, n_fields = 3, 8, 26
+    vocab = 26 * 80 if smoke else 26 * 2000       # per-field 80 / 2000
+    rpc_timeout, hb_deadline_ms = 2.0, 1500.0
+    # max_wait_ms is the partial-wave ship deadline AND the packing-
+    # determinism margin (see the wave comment below): full waves ship
+    # on count, so only the two trailing partial waves ever pay it —
+    # 150ms is ~150x the ~1ms wave-submission window a stall would have
+    # to outlast to split a wave, without drowning p99 in deadline time
+    max_batch, max_wait_ms = 64, 150.0
+    kill_req = n_requests // 2
+
+    def free_ports(n):
+        socks, ports = [], []
+        for _ in range(n):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
+    def make_cluster(ports):
+        stores = [DistributedStore(
+            r, world, [("127.0.0.1", p) for p in ports], port=ports[r],
+            rpc_timeout=rpc_timeout, rpc_retries=2, connect_timeout=2.0,
+            replication=2) for r in range(world)]
+        tid = None
+        for s in stores:
+            tid = s.init_table(vocab, dim, opt="sgd", lr=0.1,
+                               init_scale=0.0)
+        table = np.random.RandomState(42).normal(
+            0, 0.01, (vocab, dim)).astype(np.float32)
+        stores[0].set_data(tid, table)   # replicated path: primaries and
+        return stores, tid               # backups bitwise identical
+
+    def build_serving(store, tid):
+        """wdl-style serving graph over a READ-ONLY embedding cache."""
+        dense = ht.placeholder_op("dense")
+        sparse = ht.placeholder_op("sparse", dtype=np.int64)
+        cache = DistCacheTable(store, tid, limit=max(vocab // 2, 256),
+                               policy="lru", read_only=True)
+        emb = ht.ps_embedding_lookup_op(cache, sparse, width=dim)
+        flat = ht.array_reshape_op(emb, (-1, n_fields * dim))
+        x = ht.concat_op(flat, dense, axis=1)
+        h = x
+        rng = np.random.RandomState(7)
+        dims = [n_fields * dim + 13, 32, 1]
+        for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+            w = ht.Variable(f"serve_w{i}",
+                            value=(rng.randn(din, dout) * 0.2
+                                   ).astype(np.float32))
+            h = ht.matmul_op(h, w)
+            if i < len(dims) - 2:
+                h = ht.relu_op(h)
+        prob = ht.sigmoid_op(h)
+        iex = InferenceExecutor([prob], seed=0, validate="error",
+                                buckets=(8, 16, 32, 64))
+        return iex, dense, sparse, cache
+
+    # the seeded stream: zipf(1.05)-skewed ids per field + dense features,
+    # chopped into deterministic waves so both runs pack IDENTICAL
+    # batches (bitwise parity requires each request to run in the same
+    # bucket).  Determinism mechanics: a FULL wave (== max_batch) ships
+    # the moment the count is reached, independent of timing; the two
+    # trailing partial waves ship at the head-of-line deadline, which is
+    # set generously below so a scheduler stall mid-submission cannot
+    # split a wave into differently-bucketed halves between the runs.
+    rng = np.random.RandomState(seed)
+    per_field = vocab // n_fields
+    ranks = np.arange(per_field, dtype=np.float64)
+    p = 1.0 / (ranks + 1.0) ** 1.05
+    p /= p.sum()
+    field = np.stack([rng.choice(per_field, n_requests, p=p)
+                      for _ in range(n_fields)], axis=1)
+    sparse_all = (field + np.arange(n_fields) * per_field).astype(np.int64)
+    dense_all = rng.rand(n_requests, 13).astype(np.float32)
+    waves = [max_batch] * (n_requests // max_batch)
+    rest = n_requests % max_batch
+    if rest > 1:
+        waves += [rest // 2, rest - rest // 2]   # two partial buckets
+    elif rest:
+        waves += [rest]
+
+    env_chaos = os.environ.pop("HETU_CHAOS", None)
+    chaos_mod.uninstall()
+
+    def run_stream(tag):
+        """One full serving run over the stream; returns (responses,
+        per-request latency ms, per-wave wall ms, wave serve_failover
+        deltas, rejections)."""
+        reset_serve_counts()
+        ports = free_ports(world)
+        stores, tid = make_cluster(ports)
+        responses = [None] * n_requests
+        lat_ms = [0.0] * n_requests
+        wave_ms, wave_failover = [], []
+        try:
+            iex, dense, sparse, cache = build_serving(stores[0], tid)
+            router = ServingRouter(iex, max_batch=max_batch,
+                                   max_wait_ms=max_wait_ms,
+                                   queue_limit=n_requests + 8)
+            try:
+                i = 0
+                for wsize in waves:
+                    t0 = time.monotonic()
+                    before = serve_counts().get("serve_failovers", 0)
+                    futs = []
+                    for j in range(i, i + wsize):
+                        t_sub = time.monotonic()
+                        fut = router.submit({dense: dense_all[j],
+                                             sparse: sparse_all[j]})
+                        fut.add_done_callback(
+                            lambda f, j=j, t=t_sub: lat_ms.__setitem__(
+                                j, (time.monotonic() - t) * 1e3))
+                        futs.append((j, fut))
+                    for j, fut in futs:
+                        responses[j] = np.asarray(fut.result(timeout=60)[0])
+                    wave_ms.append((time.monotonic() - t0) * 1e3)
+                    wave_failover.append(
+                        serve_counts().get("serve_failovers", 0) - before)
+                    i += wsize
+            finally:
+                router.close()
+            return (responses, lat_ms, wave_ms, wave_failover,
+                    serve_counts())
+        finally:
+            for s in stores:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    try:
+        # --- clean run: zero fault counters, the parity oracle -----------
+        reset_faults()
+        base_resp, base_lat, base_wave_ms, _, base_serve = \
+            run_stream("clean")
+        clean_counters = fault_counts()
+
+        # --- chaos run: shard-1 primary killed mid-load -------------------
+        schedule = f"11:kill:primary@shard1:req{kill_req}"
+        reset_faults()
+        prev = chaos_mod.install(
+            chaos_mod.ChaosInjector.from_spec(schedule))
+        t0 = time.monotonic()
+        try:
+            resp, lat, wave_ms, wave_failover, serve_ctrs = \
+                run_stream("chaos")
+        finally:
+            chaos_mod.install(prev)
+        total_ms = (time.monotonic() - t0) * 1e3
+        counters = fault_counts()
+    finally:
+        if env_chaos is not None:
+            os.environ["HETU_CHAOS"] = env_chaos
+
+    answered = sum(r is not None for r in resp)
+    bitwise = all(r is not None and b is not None and np.array_equal(r, b)
+                  for r, b in zip(resp, base_resp))
+    recovery_ms = sum(m for m, d in zip(wave_ms, wave_failover) if d)
+    bound_ms = rpc_timeout * 1e3 + hb_deadline_ms
+    qps = n_requests / (sum(wave_ms) / 1e3)
+    base_qps = n_requests / (sum(base_wave_ms) / 1e3)
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q))
+
+    ok = (bitwise and answered == n_requests
+          and counters.get("chaos_kill_primary", 0) == 1
+          and counters.get("ps_failover_promoted", 0) >= 1
+          and serve_ctrs.get("serve_failovers", 0) >= 1
+          and serve_ctrs.get("serve_rejections", 0) == 0
+          and recovery_ms < bound_ms
+          and not clean_counters)
+    return {
+        "metric": "serve_qps",
+        "value": round(base_qps, 1),
+        "unit": "requests/s",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "extra": {
+            "baseline_def": "1.0 iff the chaos run's responses are "
+                            "bitwise equal to the clean run's over the "
+                            "same zipf(1.05) stream, every request was "
+                            "answered with zero restarts and zero "
+                            "rejections, exactly one primary kill was "
+                            "absorbed by >=1 client-transparent failover "
+                            "mid-serve, the failover wave stayed under "
+                            "one rpc_timeout + heartbeat deadline, and "
+                            "the clean run recorded zero fault counters",
+            **_provenance({"n_requests": n_requests, "vocab": vocab,
+                           "dim": dim, "world": world, "replication": 2,
+                           "zipf_a": 1.05, "max_batch": max_batch,
+                           "max_wait_ms": max_wait_ms,
+                           "buckets": [8, 16, 32, 64],
+                           "schedule": schedule, "smoke": bool(smoke)}),
+            "p50_ms": round(pct(base_lat, 50), 2),
+            "p99_ms": round(pct(base_lat, 99), 2),
+            "qps": round(base_qps, 1),
+            "chaos_p50_ms": round(pct(lat, 50), 2),
+            "chaos_p99_ms": round(pct(lat, 99), 2),
+            "chaos_qps": round(qps, 1),
+            "rejections": int(serve_ctrs.get("serve_rejections", 0)),
+            "failover_recovery_ms": round(recovery_ms, 1),
+            "recovery_bound_ms": bound_ms,
+            "restarts": 0,
+            "all_answered": answered == n_requests,
+            "responses_bitwise_equal": bitwise,
+            "serve_counters": serve_ctrs,
+            "clean_serve_counters": base_serve,
+            "fault_counters": counters,
+            "clean_run_counters": clean_counters,
+            "total_wall_ms": round(total_ms, 1),
+            "backend": jax.default_backend(),
+        },
+    }
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
-                            "chaos", "failover", "emb", "zero"])
+                            "chaos", "failover", "emb", "zero", "serve"])
     p.add_argument("--dp", type=int, default=4,
                    help="zero only: data-parallel mesh size (the child "
                         "forces a CPU host-device mesh of >= this)")
@@ -1731,14 +1982,16 @@ if __name__ == "__main__":
     p.add_argument("--smoke", action="store_true",
                    help="emb: 10^5-row smoke config (seconds, CPU) "
                         "instead of the 10^7x64 scale run; failover: "
-                        "the CI-sized double-kill run")
+                        "the CI-sized double-kill run; serve: the "
+                        "300-request CI config (artifacts/"
+                        "serve_smoke.json)")
     p.add_argument("--steps", type=int, default=None,
                    help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
                         "the CPU fallback unless given explicitly)")
     args = p.parse_args()
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
-    elif args.config in ("chaos", "failover", "emb", "zero"):
+    elif args.config in ("chaos", "failover", "emb", "zero", "serve"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness

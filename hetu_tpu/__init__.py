@@ -76,5 +76,7 @@ from .launcher import init_distributed
 from . import ps
 from .ps import (EmbeddingStore, CacheSparseTable, ps_embedding_lookup_op,
                  default_store)
+from . import serving
+from .serving import InferenceExecutor, ServingRouter, ServeRejected
 
 __version__ = "0.1.0"

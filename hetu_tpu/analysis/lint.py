@@ -29,6 +29,13 @@ Rule catalog (see README "Static analysis & graph validation"):
   with no usable 'dp' axis (silently replicated), or a slab bucket that
   needs zero-padding to shard over 'dp' (the ragged params are named;
   buckets whose total divides evenly are silent)
+* ``train-only-op-in-serving`` (error/warn) — only under
+  ``lint(serving=True)`` (the :class:`hetu_tpu.serving.InferenceExecutor`
+  validation path): an optimizer update or gradient node reachable from a
+  serving fetch set is an error (serving must never construct grad or
+  optimizer subgraphs); a dropout node is a warning (it lowers to
+  identity under ``training=False``, but its presence usually means the
+  fetch set was lifted from a training head)
 """
 from __future__ import annotations
 
@@ -75,7 +82,7 @@ class GraphInfo:
     """What a lint rule sees: topo + static shapes + executor config."""
 
     def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
-                 feed_values=None, zero=0):
+                 feed_values=None, zero=0, serving=False):
         self.shapes = shapes
         self.topo = shapes.topo
         self.feeds = feeds
@@ -86,6 +93,9 @@ class GraphInfo:
         self.pipeline = pipeline
         #: requested ZeRO stage (Executor(zero=...)); 0 = off
         self.zero = int(zero or 0)
+        #: True when linting a SERVING fetch set (InferenceExecutor):
+        #: enables the train-only-op-in-serving rule
+        self.serving = bool(serving)
 
     def shape(self, node):
         return self.shapes.shape(node)
@@ -538,10 +548,54 @@ def _r_zero(gi):
                 by_key[ragged[0]])
 
 
+#: op types whose semantics exist only for TRAINING — a serving fetch set
+#: reaching them is either outright wrong (optimizer, gradient: the whole
+#: point of a compile-once inference program is that these subgraphs are
+#: never built) or a smell (dropout: inert under training=False, but its
+#: presence usually means the fetch set was lifted straight off a
+#: training head instead of the model's inference output)
+_TRAIN_ONLY_ERRORS = {"OptimizerUpdate"}
+_TRAIN_ONLY_WARNS = {"Dropout", "Dropout2d"}
+
+
+@rule("train-only-op-in-serving")
+def _r_train_only_serving(gi):
+    """Serving graphs must never construct grad/optimizer subgraphs
+    (``hetu_tpu.serving.InferenceExecutor`` compiles fetch subgraphs
+    without a backward pass; an optimizer or gradient fetch would
+    silently train — or crash — inside the request path)."""
+    if not gi.serving:
+        return
+    for node in gi.topo:
+        if isinstance(node, GradientOp):
+            yield Diagnostic(
+                "train-only-op-in-serving", "error",
+                f"gradient node '{node.name}' (w.r.t. "
+                f"'{getattr(node.wrt, 'name', node.wrt)}') is reachable "
+                f"from a serving fetch set — serving must never build a "
+                f"backward pass; fetch the model's inference output "
+                f"instead", node)
+        elif node.op_type in _TRAIN_ONLY_ERRORS:
+            yield Diagnostic(
+                "train-only-op-in-serving", "error",
+                f"{node.op_type} '{node.name}' is reachable from a "
+                f"serving fetch set — a weight update inside the request "
+                f"path would train the serving replica; drop the "
+                f"optimizer from the serving fetches", node)
+        elif node.op_type in _TRAIN_ONLY_WARNS:
+            yield Diagnostic(
+                "train-only-op-in-serving", "warn",
+                f"{node.op_type} '{node.name}' is reachable from a "
+                f"serving fetch set — it lowers to identity under "
+                f"training=False, but a dropout in an inference graph "
+                f"usually means the fetch set came from a training head",
+                node)
+
+
 # ----------------------------------------------------------------- entry
 
 def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
-         num_microbatches=None, rules=None, zero=0):
+         num_microbatches=None, rules=None, zero=0, serving=False):
     """Statically verify a fetch subgraph; returns a :class:`LintReport`.
 
     ``feeds``: example values (or bare shapes) for placeholders declared
@@ -550,6 +604,10 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
     executor configuration the graph will compile under (enables the
     mesh-axis, pipeline-stage and zero-sharding rules, and keeps
     schedule-sensitive lowering on the same path the executor uses).
+    ``serving=True``: lint the fetches as a SERVING set (enables the
+    train-only-op-in-serving rule — what
+    ``InferenceExecutor(validate=...)`` runs; pair with
+    ``training=False``).
     ``rules``: optional iterable of rule names to run (default: all
     registered rules).
     """
@@ -569,7 +627,7 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
                 feed_values[node] = v
     gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
                    mesh=mesh, pipeline=pipeline, feed_values=feed_values,
-                   zero=zero)
+                   zero=zero, serving=serving)
     diags = []
     selected = RULES if rules is None else {
         name: RULES[name] for name in rules}

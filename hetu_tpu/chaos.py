@@ -22,7 +22,13 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   at fire time, so after a failover it targets the promoted ex-backup),
   and ``kill:backup@shard<s>:step<n>`` stops the server that HOLDS shard
   ``s`` without serving it — the two sides of the failover window the
-  replication tests must straddle.
+  replication tests must straddle.  The ``:req<n>`` form
+  (``kill:primary@shard<s>:req<n>``) schedules the same kill on the
+  SERVING clock instead: it fires once ``n`` requests have been admitted
+  by the online-serving router (:mod:`hetu_tpu.serving`), which reports
+  its admission count through :meth:`ChaosInjector.on_request` — a
+  serving process has no training steps, so "kill the primary mid-load"
+  needs its own trigger.
 
 Spec grammar (everything after the first ``:`` is the comma-separated
 fault list; probabilities in [0, 1], durations in milliseconds)::
@@ -32,6 +38,7 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="7:kill:proc@rank0:after250"
     HETU_CHAOS="7:kill:primary@shard1:step3"
     HETU_CHAOS="7:kill:backup@shard1:step3"
+    HETU_CHAOS="7:kill:primary@shard1:req200"
 
 Every injected fault increments a named counter in
 :mod:`hetu_tpu.metrics` (``chaos_drop``, ``chaos_kill_ps``, ...) so
@@ -61,19 +68,25 @@ def _parse_fault(part):
         raise ChaosSpecError("empty fault entry")
     if part.startswith("kill:"):
         # kill:ps@rank<r>:step<s> | kill:proc@rank<r>:after<ms>
-        # | kill:{primary,backup}@shard<s>:step<n>  (replica-role kills,
-        #   resolved against the live serving/holding sets at fire time)
+        # | kill:{primary,backup}@shard<s>:{step<n>|req<n>}  (replica-
+        #   role kills, resolved against the live serving/holding sets at
+        #   fire time; req<n> fires on the serving router's admission
+        #   clock instead of the training step clock)
         try:
             _, rest = part.split(":", 1)
             what, where = rest.split("@", 1)
             target, when = where.split(":", 1)
             if what in ("primary", "backup"):
-                if not (target.startswith("shard")
-                        and when.startswith("step")):
+                if not target.startswith("shard"):
                     raise ValueError(part)
-                return {"kind": f"kill_{what}",
-                        "shard": int(target[len("shard"):]),
-                        "step": int(when[len("step"):])}
+                shard = int(target[len("shard"):])
+                if when.startswith("step"):
+                    return {"kind": f"kill_{what}", "shard": shard,
+                            "step": int(when[len("step"):])}
+                if when.startswith("req"):
+                    return {"kind": f"kill_{what}", "shard": shard,
+                            "req": int(when[len("req"):])}
+                raise ValueError(part)
             if not target.startswith("rank"):
                 raise ValueError(part)
             rank = int(target[len("rank"):])
@@ -88,7 +101,8 @@ def _parse_fault(part):
             raise ChaosSpecError(
                 f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>,"
                 f" kill:proc@rank<r>:after<ms>, or "
-                f"kill:{{primary,backup}}@shard<s>:step<n>") from None
+                f"kill:{{primary,backup}}@shard<s>:{{step<n>|req<n>}}"
+                ) from None
     if "=" not in part:
         raise ChaosSpecError(f"bad fault {part!r}: expected <kind>=<prob>"
                              f"[:<ms>] or kill:...")
@@ -243,29 +257,65 @@ class ChaosInjector:
                         # OTHER ranks' servers are registered, the target
                         # lives in a different process (each process hosts
                         # its own rank) and fires there: stay quiet.
-                        missing.append(f"kill:ps@rank{f['rank']}")
+                        missing.append(f"kill:ps@rank{f['rank']}"
+                                       f":step{step}")
                 else:
-                    rank, server = self._resolve_role_kill(f)
-                    if server is not None:
-                        killed.append((rank, server,
-                                       "chaos_" + f["kind"]))
-                    elif not self._servers:
-                        # same quiet/loud split as kill_ps: with OTHER
-                        # servers registered the role is presumably
-                        # filled in a different process and fires there
-                        role = f["kind"][len("kill_"):]
-                        missing.append(
-                            f"kill:{role}@shard{f['shard']}")
+                    self._collect_role_kill(
+                        f, f"kill:{f['kind'][len('kill_'):]}"
+                           f"@shard{f['shard']}:step{step}",
+                        killed, missing)
+        return self._finish_kills(killed, missing)
+
+    def _collect_role_kill(self, f, label, killed, missing):
+        """Resolve one already-claimed replica-role fault (caller holds
+        the lock): append its victim to ``killed``, or ``label`` to
+        ``missing`` when NO server at all is registered in this process
+        — the quiet/loud split: with OTHER servers registered the role
+        is presumably filled in a different process and fires there."""
+        rank, server = self._resolve_role_kill(f)
+        if server is not None:
+            killed.append((rank, server, "chaos_" + f["kind"]))
+        elif not self._servers:
+            missing.append(label)
+
+    def _finish_kills(self, killed, missing):
+        """Shared tail of every kill clock: loud counter + warning per
+        unfillable kill (a chaos run that silently does nothing would be
+        indistinguishable from a clean one), then stop each victim
+        OUTSIDE the lock — ``stop()`` closes sockets and may block."""
         for what in missing:
             import warnings
             record_fault("chaos_kill_target_missing")
-            warnings.warn(f"chaos {what}:step{step} fired but no "
-                          f"registered server fills that role — the kill "
-                          f"did NOT happen", RuntimeWarning)
-        for rank, server, counter in killed:  # stop outside the lock:
-            record_fault(counter)             # stop() closes sockets,
-            server.stop()                     # may block
+            warnings.warn(f"chaos {what} fired but no registered server "
+                          f"fills that role — the kill did NOT happen",
+                          RuntimeWarning)
+        for rank, server, counter in killed:
+            record_fault(counter)
+            server.stop()
         return [rank for rank, _, _ in killed]
+
+    # -- request-count-scheduled kills (online serving) --------------------
+    def on_request(self, admitted):
+        """Serving-router hook: fires any replica-role kill scheduled on
+        the ADMISSION clock (``kill:{primary,backup}@shard<s>:req<n>``)
+        once ``admitted`` requests have entered the router — the serving
+        analogue of :meth:`on_step` (a serving process has no training
+        steps to schedule against).  Each fault fires at most once; the
+        same quiet/loud split as on_step applies when no registered
+        server fills the role."""
+        killed, missing = [], []
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if i in self._fired or f.get("req") is None \
+                        or admitted < f["req"] \
+                        or f["kind"] not in ("kill_primary", "kill_backup"):
+                    continue
+                self._fired.add(i)
+                self._collect_role_kill(
+                    f, f"kill:{f['kind'][len('kill_'):]}"
+                       f"@shard{f['shard']}:req{f['req']}",
+                    killed, missing)
+        return self._finish_kills(killed, missing)
 
     # -- launcher-level child kills ----------------------------------------
     def due_proc_kills(self, elapsed_ms):

@@ -102,6 +102,38 @@ def _filter_spec(mesh, spec):
     return PartitionSpec(*[a if a in mesh.axis_names else None for a in spec])
 
 
+def lower_forward(topo, ctx, resolve_leaf, mesh=None, skip=()):
+    """Lower every value-producing node of ``topo`` into one traced
+    environment ``{node: value}``.
+
+    The forward lowering loop, split out of the training SubExecutor's
+    session/run machinery so the serving path
+    (:class:`hetu_tpu.serving.InferenceExecutor`) shares ONE definition of
+    "evaluate this graph" without carrying the train-side state threading:
+    placeholders resolve through ``resolve_leaf(node)``, gradient markers
+    and ``skip`` nodes (optimizer updates, anything train-only) are left
+    out, and sharding annotations become ``with_sharding_constraint``
+    under ``mesh``.  State written during forward (BN running stats)
+    lands in ``ctx.state_updates`` — the training executor commits it,
+    serving discards it (read-only replicas)."""
+    import jax
+    env = {}
+    for node in topo:
+        if isinstance(node, GradientOp) or node in skip:
+            continue
+        if isinstance(node, PlaceholderOp):
+            env[node] = resolve_leaf(node)
+        else:
+            env[node] = node.lower(ctx, *[env[i] for i in node.inputs])
+        if node.sharding is not None and mesh is not None \
+                and not isinstance(node, PlaceholderOp):
+            from jax.sharding import NamedSharding
+            env[node] = jax.lax.with_sharding_constraint(
+                env[node],
+                NamedSharding(mesh, _filter_spec(mesh, node.sharding)))
+    return env
+
+
 class SubExecutor:
     """One fetch-list → one jitted step function."""
 
@@ -177,31 +209,20 @@ class SubExecutor:
 
     def _forward(self, tparams, sparams, feeds, key):
         """Evaluate every non-grad node; returns (env, state_updates)."""
-        import jax
         ctx = LowerCtx(self.training, key, self.ex.mesh,
                        num_microbatches=self.ex.num_microbatches,
                        pipeline=self.ex.pipeline)
-        env = {}
-        for node in self.topo:
-            if isinstance(node, GradientOp) or node in self.opt_ops:
-                continue
-            if isinstance(node, PlaceholderOp):
-                k = self.ex._k(node)
-                if k in tparams:
-                    env[node] = tparams[k]
-                elif k in sparams:
-                    env[node] = sparams[k]
-                else:
-                    env[node] = feeds[k]
-            else:
-                env[node] = node.lower(ctx, *[env[i] for i in node.inputs])
-            if node.sharding is not None and self.ex.mesh is not None \
-                    and not isinstance(node, PlaceholderOp):
-                from jax.sharding import NamedSharding
-                env[node] = jax.lax.with_sharding_constraint(
-                    env[node],
-                    NamedSharding(self.ex.mesh,
-                                  _filter_spec(self.ex.mesh, node.sharding)))
+
+        def resolve(node):
+            k = self.ex._k(node)
+            if k in tparams:
+                return tparams[k]
+            if k in sparams:
+                return sparams[k]
+            return feeds[k]
+
+        env = lower_forward(self.topo, ctx, resolve, mesh=self.ex.mesh,
+                            skip=self.opt_ops)
         updates = {self.ex._k(n): v for n, v in ctx.state_updates.items()}
         return env, updates
 

@@ -41,6 +41,14 @@ import numpy as np
 from ..metrics import record_step_cache
 
 _CACHE = OrderedDict()          # signature -> jitted step
+#: serving executables (hetu_tpu.serving.InferenceExecutor): signature
+#: already folds the bucket in, so one entry pins one (graph, bucket)
+#: compiled program.  Separate from _CACHE because serving graphs MAY be
+#: PS-backed (rows ride as per-call inputs, so the compiled code never
+#: touches the store — the teardown-contract argument that makes PS
+#: training graphs uncachable does not apply) and because a serving fleet
+#: legitimately pins one executable per bucket (own size bound).
+_SERVE_CACHE = OrderedDict()
 _LOCK = threading.Lock()
 
 
@@ -152,11 +160,53 @@ def _mesh_fingerprint(mesh):
     return f"{tuple(mesh.axis_names)}|{tuple(mesh.devices.shape)}|{devs}"
 
 
+def _hash_nodes(h, topo, fetches, key_fn):
+    """Hash the structural graph content shared by the training and
+    serving signatures: the fetch layout + every node's type, canonical
+    key, edges, placeholder declaration, optimizer hypers and attrs.
+    Returns the topo-ordinal map for callers that hash extras.
+
+    Op entries hash as topo ordinals, NOT repr: node reprs embed
+    process-global ids that differ on every structurally identical
+    rebuild, which would guarantee a cache miss for exactly the rebuilds
+    the cache exists for."""
+    from .node import PlaceholderOp
+    from ..optim.optimizer import OptimizerOp
+    ordinal = {n: i for i, n in enumerate(topo)}
+    _feed(h, "fetches",
+          tuple(None if f is None else ordinal.get(f, -1)
+                for f in fetches))
+    for i, node in enumerate(topo):
+        # key_fn(node) is part of the signature: the cached closure
+        # addresses its inputs by the BUILDER's canonical keys, so a
+        # same-shaped subgraph living at different global-topo
+        # ordinals (extra sibling subgraphs) must not hit
+        _feed(h, i, node.op_type, key_fn(node),
+              tuple(ordinal[inp] for inp in node.inputs),
+              node.sharding, getattr(node, "is_ps", False))
+        lf = getattr(node, "_lower_fn", None)
+        if lf is not None:
+            _hash_value(h, lf)
+        if isinstance(node, PlaceholderOp):
+            _feed(h, "ph", node.shape, np.dtype(node.dtype).str
+                  if node.dtype is not None else None,
+                  node.trainable, node.is_variable,
+                  getattr(node, "is_embed", False),
+                  getattr(node, "width", None))
+        if isinstance(node, OptimizerOp):
+            _hash_optimizer(h, node.optimizer)
+        if getattr(node, "index", None) is not None:
+            _feed(h, "idx", node.index)
+        for k in sorted(node.attrs):
+            _feed(h, "attr", k)
+            _hash_value(h, node.attrs[k])
+    return ordinal
+
+
 def signature(sub):
     """Structural fingerprint of one SubExecutor's step, or None when the
     graph contains something content-hashing cannot cover."""
-    from .node import Op, PlaceholderOp
-    from ..optim.optimizer import OptimizerOp
+    from .node import Op
     ex = sub.ex
     h = hashlib.sha256()
     try:
@@ -173,46 +223,41 @@ def signature(sub):
               ex.pipeline, ex.num_microbatches, sub.name, sub.training,
               ex.zero, os.environ.get("HETU_ZERO_BUCKET_MB", ""),
               type(ex.dist_strategy).__name__ if ex.dist_strategy else "")
-        ordinal = {n: i for i, n in enumerate(sub.topo)}
+        ordinal = _hash_nodes(h, sub.topo, sub.fetches, ex._k)
         mf = ex._extra_config.get("microbatch_feeds")
-        # Op entries hash as topo ordinals, NOT repr: node reprs embed
-        # process-global ids that differ on every structurally identical
-        # rebuild, which would guarantee a cache miss for exactly the
-        # rebuilds the cache exists for
         _feed(h, "mbf", None if mf is None else tuple(
             sorted((f"o{ordinal[n]}" if n in ordinal
                     else f"name:{n.name}") if isinstance(n, Op)
                    else str(n) for n in mf)))
-        _feed(h, "fetches",
-              tuple(None if f is None else ordinal.get(f, -1)
-                    for f in sub.fetches))
-        for i, node in enumerate(sub.topo):
-            # ex._k(node) is part of the signature: the cached closure
-            # addresses its inputs by the BUILDER's canonical keys, so a
-            # same-shaped subgraph living at different global-topo
-            # ordinals (extra sibling subgraphs) must not hit
-            _feed(h, i, node.op_type, ex._k(node),
-                  tuple(ordinal[inp] for inp in node.inputs),
-                  node.sharding, getattr(node, "is_ps", False))
-            lf = getattr(node, "_lower_fn", None)
-            if lf is not None:
-                _hash_value(h, lf)
-            if isinstance(node, PlaceholderOp):
-                _feed(h, "ph", node.shape, np.dtype(node.dtype).str
-                      if node.dtype is not None else None,
-                      node.trainable, node.is_variable,
-                      getattr(node, "is_embed", False))
-            if isinstance(node, OptimizerOp):
-                _hash_optimizer(h, node.optimizer)
-            if getattr(node, "index", None) is not None:
-                _feed(h, "idx", node.index)
-            for k in sorted(node.attrs):
-                _feed(h, "attr", k)
-                _hash_value(h, node.attrs[k])
     except _Uncachable:
         return None
     except Exception:
         return None     # a signature bug must never break step building
+    return h.hexdigest()
+
+
+def serve_signature(iex, bucket):
+    """Structural fingerprint of one serving executable: the inference
+    fetch subgraph (PS embedding leaves INCLUDED — their rows ride as
+    per-call inputs, keyed like any feed) + the padded batch bucket +
+    everything that shapes the compiled program (backend, mesh, donation,
+    RNG seed — the serving key is baked into the trace).  A rebuilt
+    :class:`~hetu_tpu.serving.InferenceExecutor` over a structurally
+    identical graph reuses the compiled executable per bucket instead of
+    retracing (the serving analogue of the training step cache; restart
+    reuse across processes rides ``HETU_COMPILE_CACHE_DIR`` exactly like
+    training)."""
+    h = hashlib.sha256()
+    try:
+        import jax
+        _feed(h, "serve-v1", jax.__version__, jax.default_backend(),
+              _mesh_fingerprint(iex.mesh), int(bucket),
+              bool(iex.donate), iex.seed)
+        _hash_nodes(h, iex.topo, iex.fetches, iex._k)
+    except _Uncachable:
+        return None
+    except Exception:
+        return None     # a signature bug must never break serving
     return h.hexdigest()
 
 
@@ -241,10 +286,62 @@ def lookup_or_build(sub, step_fn):
     return fn
 
 
+def _max_serve_entries():
+    """Serving pins one executable per (graph, bucket) — a router over 8
+    buckets must not evict its own working set, so the bound is separate
+    from (and larger than) the training cache's."""
+    try:
+        return max(1, int(os.environ.get("HETU_STEP_CACHE_SERVE_MAX",
+                                         "32")))
+    except ValueError:
+        return 32
+
+
+def lookup_or_build_serve(iex, bucket, infer_fn):
+    """Return a jitted serving step for ``(iex, bucket)``: a cached one
+    when a structurally identical build exists (cross-rebuild reuse),
+    else a fresh ``jax.jit`` (stored for the next build).  Feeds are
+    DONATED (``infer_fn(params, feeds)`` — params are the read-only
+    weights and are never donated)."""
+    import jax
+    from ..metrics import record_serve
+    donate = (1,) if iex.donate else ()
+
+    def build():
+        # the compile-once evidence: recorded HERE, on real builds only
+        # — a cross-rebuild cache hit below builds nothing and must not
+        # inflate the counter the acceptance check compares to the
+        # number of distinct buckets used
+        record_serve("serve_bucket_compiles")
+        return jax.jit(infer_fn, donate_argnums=donate)
+
+    if not enabled():
+        return build()
+    sig = serve_signature(iex, bucket)
+    if sig is None:
+        record_step_cache("step_cache_serve_uncachable")
+        return build()
+    with _LOCK:
+        hit = _SERVE_CACHE.get(sig)
+        if hit is not None:
+            _SERVE_CACHE.move_to_end(sig)
+            record_step_cache("step_cache_serve_hit")
+            return hit
+    fn = build()
+    with _LOCK:
+        record_step_cache("step_cache_serve_miss")
+        _SERVE_CACHE[sig] = fn
+        while len(_SERVE_CACHE) > _max_serve_entries():
+            _SERVE_CACHE.popitem(last=False)
+    return fn
+
+
 def clear():
     """Drop every cached step (tests; frees the pinned builder executors)."""
     with _LOCK:
         _CACHE.clear()
+        _SERVE_CACHE.clear()
 
 
-__all__ = ["signature", "lookup_or_build", "clear", "enabled"]
+__all__ = ["signature", "serve_signature", "lookup_or_build",
+           "lookup_or_build_serve", "clear", "enabled"]

@@ -218,6 +218,51 @@ def reset_step_cache_counts():
         _step_cache_counts.clear()
 
 
+# ------------------------------------------------------- serving counters
+# The online-serving layer (``hetu_tpu.serving``) records its request /
+# batching behaviour here: requests admitted (``serve_requests``) and
+# answered (``serve_responses``), batches dispatched (``serve_batches``)
+# with the TOTAL bucket rows they ran at (``serve_batch_rows`` — real
+# plus padding), of which ``serve_pad_rows`` were padding added to reach
+# a legal bucket (the micro-batcher's waste: real rows =
+# ``serve_batch_rows - serve_pad_rows``), queue-full rejections (``serve_rejections`` — the
+# backpressure path), PS failovers absorbed MID-SERVE
+# (``serve_failovers``), per-bucket executable builds
+# (``serve_bucket_compiles`` — the compile-once claim is exactly "this
+# equals the number of distinct buckets used"), read-only embedding
+# refreshes (``serve_emb_refresh_rows``), and the queue-depth high-water
+# mark (``serve_queue_depth_hw`` — gauge semantics: the recorded value is
+# the MAX ever seen, not a sum).  Surfaced by
+# ``HetuProfiler.serve_counters()`` and ``bench.py --config serve``; a
+# process that never serves reports an empty dict.
+
+_serve_counts = collections.Counter()
+_serve_lock = threading.Lock()
+
+
+def record_serve(kind, n=1):
+    """Count ``n`` serving events of ``kind``; kinds ending in ``_hw``
+    are high-water gauges (the stored value is the max seen)."""
+    kind = str(kind)
+    with _serve_lock:
+        if kind.endswith("_hw"):
+            if n > _serve_counts[kind]:
+                _serve_counts[kind] = int(n)
+        elif n:
+            _serve_counts[kind] += int(n)
+
+
+def serve_counts():
+    """{kind: count} snapshot of serving counters."""
+    with _serve_lock:
+        return dict(_serve_counts)
+
+
+def reset_serve_counts():
+    with _serve_lock:
+        _serve_counts.clear()
+
+
 def _np(x):
     return np.asarray(x)
 

@@ -278,6 +278,22 @@ class HetuProfiler:
         return step_cache_counts()
 
     @staticmethod
+    def serve_counters():
+        """{kind: count} of online-serving events (``hetu_tpu.metrics``
+        registry): requests admitted/answered, batches dispatched with
+        their total bucket rows (``serve_batch_rows``, real plus
+        padding) of which ``serve_pad_rows`` were padding (the micro-
+        batcher's bucket waste), queue-full rejections (backpressure), queue-depth high-water
+        (``serve_queue_depth_hw`` — a max gauge, not a sum), PS
+        failovers absorbed mid-serve, per-bucket executable builds
+        (``serve_bucket_compiles`` — compile-once means this equals the
+        number of distinct buckets used), and read-only embedding
+        refresh rows.  A process that never serves reports an empty
+        dict."""
+        from .metrics import serve_counts
+        return serve_counts()
+
+    @staticmethod
     def fault_counters():
         """{kind: count} of fault-tolerance events (``hetu_tpu.metrics``
         registry): transport retries/exhaustions, chaos injections,

@@ -1638,17 +1638,41 @@ class DistCacheTable:
       single batch's unique keys exceed capacity, the sorted-first keys
       get slots and the remainder are served (and their grads pushed)
       uncached.
+
+    **Read-only serving mode** (``read_only=True`` — what
+    :class:`hetu_tpu.serving.InferenceExecutor` mounts): a pure lookup
+    serves any cached row WITHOUT burning ``pull_bound`` budget, touching
+    the dirty-grad slab, or counting toward ``push_bound`` — the
+    training-mode ``uses`` clock exists to bound staleness *between this
+    client's own writes*, and a serving replica never writes.  ``update``
+    is rejected outright.  Staleness is VERSION-based instead: each fill
+    records the row's server version (one extra batched ``versions``
+    fanout on the miss path only), and :meth:`refresh_stale` — invoked
+    explicitly, or every ``refresh_every`` lookups (asynchronously, on a
+    background thread, so no serving batch pays the sweep in its own
+    latency; :meth:`refresh_join` drains it) — re-pulls exactly the
+    cached rows whose server version advanced (a trainer elsewhere kept
+    writing), in one batched owner-grouped round trip.  Eviction recency
+    (ticks/freq) still advances on read-only lookups: LRU/LFU victim
+    choice needs it.
     """
 
     _EMPTY, _TOMB = -1, -2
 
     def __init__(self, store, table, limit=1 << 16,
-                 pull_bound=100, push_bound=10, lr=-1.0, policy="lru"):
+                 pull_bound=100, push_bound=10, lr=-1.0, policy="lru",
+                 read_only=False, refresh_every=0):
         self.store, self.table = store, table
         self.width = int(store.width(table))
         self.limit = int(limit)
         self.pull_bound, self.push_bound = int(pull_bound), int(push_bound)
         self.lr = lr
+        self.read_only = bool(read_only)
+        #: read-only mode: run a version-based refresh sweep every N
+        #: lookup calls (0 = only when refresh_stale() is called)
+        self.refresh_every = int(refresh_every)
+        self._lookups_since_refresh = 0
+        self._refresh_thread = None   # in-flight async sweep (at most one)
         policy = policy.lower()
         if policy not in ("lru", "lfu"):
             raise ValueError(f"unknown cache policy {policy!r}")
@@ -1661,6 +1685,9 @@ class DistCacheTable:
         self._gcnt = np.zeros(L, np.int64)     # pending update events
         self._ticks = np.zeros(L, np.int64)    # last-touch clock (LRU)
         self._freq = np.zeros(L, np.int64)     # touch count (LFU)
+        #: server version at fill time, maintained in read-only mode
+        #: only (training-mode staleness rides pull_bound instead)
+        self._vers = np.zeros(L, np.int64)
         cap = 1 << max(6, (4 * L - 1).bit_length())   # load factor <= 1/4
         self._hcap, self._hmask = cap, cap - 1
         self._hkey = np.full(cap, self._EMPTY, np.int64)
@@ -1861,9 +1888,157 @@ class DistCacheTable:
     # -- core ops ----------------------------------------------------------
     def lookup(self, keys):
         keys = np.ascontiguousarray(keys, np.int64)
+        sweep = False
         with self._lock:
-            out = self._lookup_locked(keys.reshape(-1))
+            if self.read_only:
+                out = self._lookup_readonly_locked(keys.reshape(-1))
+                if self.refresh_every > 0:
+                    self._lookups_since_refresh += 1
+                    if self._lookups_since_refresh >= self.refresh_every:
+                        self._lookups_since_refresh = 0
+                        sweep = True
+            else:
+                out = self._lookup_locked(keys.reshape(-1))
+        if sweep:
+            self._refresh_async()
         return out.reshape(keys.shape + (self.width,))
+
+    def _lookup_readonly_locked(self, flat):
+        """Pure read-only lookup: a cached row is a hit regardless of
+        ``pull_bound`` (``uses`` budget is never consumed — that clock
+        bounds staleness between this client's own pushes, and a
+        read-only client never pushes), no dirty-slab planning anywhere
+        (the grad slab is untouched by invariant: ``update`` is
+        rejected), and each fill records the row's server version for
+        :meth:`refresh_stale`.  Eviction recency still advances."""
+        self._tick += 1
+        self.stats["lookups"] += int(flat.size)
+        if not flat.size:
+            return np.empty((0, self.width), np.float32)
+        uk, inv, cnt = np.unique(flat, return_inverse=True,
+                                 return_counts=True)
+        slots = self._find(uk)
+        present = slots >= 0
+        rows_out = np.empty((uk.size, self.width), np.float32)
+        miss = ~present
+        if miss.any():
+            mkeys = uk[miss]
+            plan = self._plan_slots(mkeys, slots[present])
+            # the ONLY fallible step: one batched owner-grouped pull (+
+            # one versions fanout over the same keys).  A transport
+            # failure raises with the cache untouched — failover inside
+            # the store's pull is invisible here.  Versions are read
+            # BEFORE the rows: a write landing between the two RPCs then
+            # leaves a version OLDER than the data (refresh_stale re-pulls
+            # once, harmlessly), whereas the reverse order would record a
+            # version NEWER than the data and hide the stale row from
+            # refresh_stale forever
+            vers = self.store.versions(self.table, mkeys) \
+                if hasattr(self.store, "versions") else None
+            rows = self.store.pull(self.table, mkeys)
+            self.stats["fetches"] += int(mkeys.size)
+            self._commit_slots(mkeys, plan)
+            mslots = plan[0]
+            cached = mslots >= 0
+            cs = mslots[cached]
+            self._data[cs] = rows[cached]
+            self._uses[cs] = 0
+            self._ticks[cs] = self._tick
+            self._freq[cs] += cnt[miss][cached]
+            self._vers[cs] = 0 if vers is None else vers[cached]
+            rows_out[miss] = rows
+            self._maybe_rehash()
+            slots = slots.copy()
+            slots[miss] = mslots
+        n_hit_rows = int(cnt[present].sum())
+        self.stats["hits"] += n_hit_rows
+        record_cache("emb_cache_hit_rows", n_hit_rows)
+        record_cache("emb_cache_miss_rows", int(flat.size) - n_hit_rows)
+        if present.any():
+            hs = slots[present]
+            # recency/frequency clocks advance (eviction needs them);
+            # the pull_bound budget (_uses) does NOT
+            self._ticks[hs] = self._tick
+            self._freq[hs] += cnt[present]
+            rows_out[present] = self._data[hs]
+        return rows_out[inv]
+
+    def refresh_stale(self):
+        """Version-based staleness refresh (read-only serving): ONE
+        batched ``versions`` fanout over every cached key, then ONE
+        batched pull of exactly the rows whose server version advanced
+        since fill (a trainer elsewhere kept writing them).  Both store
+        round trips run OUTSIDE the cache lock so concurrent lookups
+        keep serving mid-sweep; the commit re-validates that each slot
+        still holds its key (eviction races skip) and only moves
+        versions FORWARD (a racing miss fill that pulled fresher data
+        wins).  Returns the number of refreshed rows."""
+        if not hasattr(self.store, "versions"):
+            return 0
+        with self._lock:
+            occ = np.flatnonzero(self._slotkey >= 0)
+            if not occ.size:
+                return 0
+            keys = self._slotkey[occ]
+            order = np.argsort(keys, kind="stable")   # deterministic wire
+            keys = keys[order]
+            have = self._vers[occ[order]].copy()
+        vers = np.asarray(self.store.versions(self.table, keys), np.int64)
+        stale = vers > have
+        if not stale.any():
+            return 0
+        sk = keys[stale]
+        rows = np.asarray(self.store.pull(self.table, sk), np.float32)
+        sv = vers[stale]
+        refreshed = 0
+        with self._lock:
+            slots = self._find(sk)
+            live = slots >= 0
+            if live.any():
+                s = slots[live]
+                newer = sv[live] > self._vers[s]
+                s = s[newer]
+                self._data[s] = rows[live][newer]
+                self._vers[s] = sv[live][newer]
+                refreshed = int(s.size)
+        if refreshed:
+            record_cache("emb_cache_refresh_rows", refreshed)
+        return refreshed
+
+    def _refresh_async(self):
+        """Run :meth:`refresh_stale` on a background daemon thread (at
+        most one in flight): the serving batch whose lookup trips the
+        ``refresh_every`` counter must not pay the sweep's store round
+        trips in its own tail latency."""
+        with self._lock:
+            if self._refresh_thread is not None \
+                    and self._refresh_thread.is_alive():
+                return
+            t = threading.Thread(target=self._refresh_quiet, daemon=True,
+                                 name="hetu-emb-refresh")
+            # started INSIDE the lock: a concurrent refresh_join must
+            # never observe (and try to join) a not-yet-started thread,
+            # and a concurrent _refresh_async must never read the
+            # unstarted thread as not-alive and spawn a second sweep
+            t.start()
+            self._refresh_thread = t
+
+    def _refresh_quiet(self):
+        try:
+            self.refresh_stale()
+        except Exception:
+            pass    # best-effort: the next counter trip retries
+
+    def refresh_join(self, timeout=None):
+        """Wait for an in-flight async staleness sweep (deterministic
+        tests, drain-before-shutdown).  Returns True when no sweep is
+        running afterwards."""
+        with self._lock:
+            t = self._refresh_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def _lookup_locked(self, flat):
         self._tick += 1
@@ -1941,6 +2116,11 @@ class DistCacheTable:
         return rows_out[inv]
 
     def update(self, keys, grads):
+        if self.read_only:
+            raise RuntimeError(
+                "DistCacheTable(read_only=True) rejects update(): a "
+                "serving replica must never push gradients — train "
+                "through a read-write cache and serve through this one")
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
         if not keys.size:
             return

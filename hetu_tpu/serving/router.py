@@ -1,0 +1,271 @@
+"""Async request router: bounded queue → adaptive micro-batcher.
+
+Online traffic arrives one request at a time; TPU programs want full,
+legal batches.  The router sits between them:
+
+* **Bounded admission.**  ``submit`` enqueues a request and returns a
+  ``concurrent.futures.Future``.  A full queue REJECTS loudly
+  (:class:`ServeRejected`, counted as ``serve_rejections``) instead of
+  growing without bound — backpressure is the caller's signal to shed
+  load upstream; an unbounded queue just converts overload into
+  unbounded latency and an OOM.
+
+* **Adaptive micro-batching.**  The batcher thread takes the oldest
+  waiting request and keeps collecting until either ``max_batch``
+  requests are waiting or the OLDEST one has waited ``max_wait_ms`` —
+  the deadline is per-batch head-of-line, so a single straggler request
+  ships alone after one wait window instead of stalling forever.  The
+  collected batch is stacked, padded to the smallest legal bucket
+  (``InferenceExecutor.infer``), run as ONE jitted call on the bucket's
+  pinned executable, and the per-row results are scattered back to each
+  request's future.
+
+* **Failure semantics.**  A PS failover inside the batch's pull is
+  absorbed by the store (the batch just takes longer; counted as
+  ``serve_failovers`` via the fault-counter delta).  A genuinely failed
+  batch fails ONLY its own requests' futures — the router keeps serving.
+  ``close()`` rejects whatever is still queued.
+
+Chaos integration: every dispatched batch reports the router's admission
+count to the active :class:`~hetu_tpu.chaos.ChaosInjector`
+(``on_request``), so ``kill:primary@shard<s>:req<n>`` schedules a
+primary kill mid-load — the serving analogue of the step-scheduled kills
+training chaos uses.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..metrics import record_serve
+
+
+class ServeRejected(RuntimeError):
+    """Explicit backpressure: the request was NOT admitted (queue full or
+    router closed) — shed load upstream and retry later."""
+
+
+class _Request:
+    __slots__ = ("feeds", "future", "t_arrival")
+
+    def __init__(self, feeds):
+        self.feeds = feeds
+        self.future = Future()
+        self.t_arrival = time.monotonic()
+
+
+class ServingRouter:
+    """Bounded-queue adaptive micro-batching front end for one
+    :class:`~hetu_tpu.serving.InferenceExecutor` (see module docstring).
+
+    ``max_batch``: largest batch the batcher packs (default: the
+    executor's largest bucket).  ``max_wait_ms``: how long the oldest
+    waiting request may sit before its batch ships part-full.
+    ``queue_limit``: admission bound — beyond it ``submit`` raises
+    :class:`ServeRejected`.  ``refresh_every_batches``: run the read-only
+    embedding staleness sweep every N batches (0 = never — call
+    ``iex.refresh_embeddings()`` yourself).  ``start=False`` builds the
+    router paused (tests exercising the backpressure path); call
+    :meth:`start`.
+    """
+
+    def __init__(self, iex, max_batch=None, max_wait_ms=2.0,
+                 queue_limit=256, refresh_every_batches=0, start=True):
+        self.iex = iex
+        self.max_batch = min(int(max_batch or iex.max_batch),
+                             iex.max_batch)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait_ms = float(max_wait_ms)
+        self.queue_limit = int(queue_limit)
+        self.refresh_every_batches = int(refresh_every_batches)
+        self._q = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._admitted = 0
+        self._batches = 0
+        self._thread = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Start the batcher thread (idempotent)."""
+        with self._cv:
+            if self._thread is not None or self._stop:
+                return self
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="hetu-serve-router")
+            self._thread.start()
+        return self
+
+    def close(self, timeout=None):
+        """Stop the batcher; requests still queued are REJECTED (their
+        futures fail with :class:`ServeRejected`)."""
+        with self._cv:
+            self._stop = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+        for req in pending:
+            # claim first: a caller-cancelled future would otherwise
+            # raise InvalidStateError out of set_exception and abort the
+            # rejection of every later pending request
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    ServeRejected("router closed with the request queued"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def queue_depth(self):
+        with self._cv:
+            return len(self._q)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, feed_dict):
+        """Admit one single-sample request (``{placeholder: array}``
+        WITHOUT the batch dim — the batcher stacks).  Returns a Future
+        resolving to one value per executor fetch (row ``i`` of
+        batch-derived fetches; whole value otherwise).  Raises
+        :class:`ServeRejected` when the queue is full or the router is
+        closed."""
+        req = _Request(feed_dict)
+        with self._cv:
+            if self._stop:
+                raise ServeRejected("router is closed")
+            if len(self._q) >= self.queue_limit:
+                record_serve("serve_rejections")
+                raise ServeRejected(
+                    f"request queue full ({self.queue_limit} waiting) — "
+                    f"shed load upstream and retry")
+            self._q.append(req)
+            self._admitted += 1
+            record_serve("serve_requests")
+            record_serve("serve_queue_depth_hw", len(self._q))
+            self._cv.notify()
+        return req.future
+
+    # -- batching ----------------------------------------------------------
+
+    def _take_batch(self):
+        """Block until work exists, then collect until ``max_batch``
+        requests wait or the OLDEST has hit the ``max_wait_ms``
+        deadline.  Returns (requests, admitted-count snapshot), or None
+        at shutdown."""
+        with self._cv:
+            while not self._q:
+                if self._stop:
+                    return None
+                self._cv.wait(0.05)
+            # the deadline anchors at the oldest request's ARRIVAL, not
+            # at the moment the batcher got back around to the queue — a
+            # request that already waited out a slow previous batch (a
+            # failover pull, a cold compile) ships immediately instead
+            # of waiting up to a second full window
+            deadline = self._q[0].t_arrival + self.max_wait_ms / 1e3
+            while len(self._q) < self.max_batch and not self._stop:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            n = min(len(self._q), self.max_batch)
+            return [self._q.popleft() for _ in range(n)], self._admitted
+
+    def _loop(self):
+        while True:
+            taken = self._take_batch()
+            if taken is None:
+                return
+            reqs, admitted = taken
+            # one malformed request must fail ONLY itself: requests are
+            # grouped by feed schema (keys + shapes + dtypes) and each
+            # group runs as its own sub-batch, so a bad shape or a
+            # missing/unknown key poisons nobody it merely co-arrived
+            # with (heterogeneous-but-valid shapes also just work)
+            groups = {}
+            for r in reqs:
+                groups.setdefault(self._schema(r), []).append(r)
+            for group in groups.values():
+                self._run_batch(group, admitted)
+
+    @staticmethod
+    def _schema(req):
+        try:
+            return tuple(sorted(
+                (n.id, tuple(np.shape(v)), str(np.asarray(v).dtype))
+                for n, v in req.feeds.items()))
+        except Exception:
+            return ("unstackable", id(req))
+
+    def _run_batch(self, reqs, admitted):
+        from ..metrics import fault_counts
+        from .. import chaos as chaos_mod
+        # claim each future (RUNNING) so a caller's later cancel() cannot
+        # race set_result into InvalidStateError and kill this thread;
+        # already-cancelled requests drop out of the batch here
+        reqs = [r for r in reqs
+                if r.future.set_running_or_notify_cancel()]
+        if not reqs:
+            return
+        inj = chaos_mod.active()
+        if inj is not None:
+            # request-count-scheduled kills fire BEFORE the batch runs,
+            # so the kill lands mid-load and THIS batch's pull absorbs
+            # the failover
+            inj.on_request(admitted)
+        n = len(reqs)
+        nodes = list(reqs[0].feeds)
+        try:
+            stacked = {node: np.stack(
+                [np.asarray(r.feeds[node]) for r in reqs], 0)
+                for node in nodes}
+            before = fault_counts().get("ps_failover_promoted", 0)
+            # the executor's scatter plan is STATIC (abstract shapes at
+            # two batch sizes — see _fetch_row_scaling): each request
+            # gets its k per-sample rows of a row-scaled fetch, the
+            # whole value of a batch-invariant (or exact-fit aggregate)
+            # one; no runtime shape guessing to mis-scatter
+            outs, rows_per_req = self.iex.infer_rows(stacked)
+            delta = fault_counts().get("ps_failover_promoted", 0) - before
+            if delta:
+                record_serve("serve_failovers", delta)
+        except Exception as e:    # noqa: BLE001 — each request must learn
+            for r in reqs:        # its fate; the router keeps serving
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        record_serve("serve_responses", n)
+        for i, r in enumerate(reqs):
+            row = []
+            for o, k in zip(outs, rows_per_req):
+                if k is None:
+                    row.append(o)
+                elif k == 1:
+                    row.append(o[i])
+                else:
+                    row.append(o[i * k:(i + 1) * k])
+            r.future.set_result(row)
+        self._batches += 1
+        if self.refresh_every_batches > 0 \
+                and self._batches % self.refresh_every_batches == 0:
+            try:
+                self.iex.refresh_embeddings()
+            except Exception:
+                pass    # a refresh hiccup must not kill the router
+
+
+__all__ = ["ServingRouter", "ServeRejected"]

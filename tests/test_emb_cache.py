@@ -646,3 +646,137 @@ def test_emb_bench_full_scale_10m():
     # save/load never materialise a second full table copy
     assert extra["save"]["peak_rss_delta_mb"] < extra["table_mb"]
     assert extra["load"]["peak_rss_delta_mb"] < extra["table_mb"]
+
+
+# ------------------------------------------------- read-only serving mode
+
+def test_readonly_lookup_parity_and_no_write_bookkeeping():
+    """ISSUE 7 satellite: on an identical pure-lookup trace the read-only
+    cache serves the SAME rows as the training-mode cache, but a pure
+    lookup allocates no dirty-slab entry, never counts toward
+    push_bound, and never burns pull_bound budget (no forced
+    re-fetches)."""
+    rng = np.random.RandomState(0)
+    vocab, dim = 64, 4
+    st_a, ta = _mk_store(vocab, dim)
+    st_b, tb = _mk_store(vocab, dim)
+    train = DistCacheTable(st_a, ta, limit=16, pull_bound=3, push_bound=2)
+    ro = DistCacheTable(st_b, tb, limit=16, pull_bound=3, push_bound=2,
+                        read_only=True)
+    trace = [rng.randint(0, vocab, rng.randint(1, 12)).astype(np.int64)
+             for _ in range(40)]
+    for ids in trace:
+        a = train.lookup(ids)
+        b = ro.lookup(ids)
+        assert np.array_equal(a, b)
+    # no write-side bookkeeping anywhere in the read-only cache
+    assert not ro._gcnt.any(), "pure lookup allocated a dirty slab entry"
+    assert not ro._grad.any()
+    assert ro.stats["pushes"] == 0 and ro.stats["push_rpcs"] == 0
+    # pull_bound budget untouched: a hot key is re-fetched by the
+    # TRAINING cache every pull_bound lookups, never by the read-only one
+    hot = np.asarray([7], np.int64)
+    f0_train, f0_ro = train.stats["fetches"], ro.stats["fetches"]
+    for _ in range(10):
+        train.lookup(hot)
+        ro.lookup(hot)
+    assert train.stats["fetches"] > f0_train, "oracle: training re-fetches"
+    assert ro.stats["fetches"] - f0_ro <= 1, \
+        "read-only lookup burned pull_bound budget"
+
+
+def test_readonly_rejects_update_and_keeps_evicting():
+    st, t = _mk_store(32, 4)
+    ro = DistCacheTable(st, t, limit=8, pull_bound=100, push_bound=2,
+                        read_only=True)
+    with pytest.raises(RuntimeError, match="read_only"):
+        ro.update(np.asarray([1], np.int64), np.ones((1, 4), np.float32))
+    # capacity pressure still evicts (recency clocks advance on RO hits)
+    for lo in range(0, 32, 4):
+        ro.lookup(np.arange(lo, lo + 4, dtype=np.int64))
+    assert ro.stats["evictions"] > 0
+    assert len(ro) <= 8
+
+
+def test_readonly_version_refresh_picks_up_writer():
+    """Version-based staleness: a trainer pushing rows elsewhere advances
+    the server version; refresh_stale() re-pulls EXACTLY the changed
+    cached rows (batched), after which lookups serve the new value."""
+    st, t = _mk_store(32, 4, lr=1.0)
+    ro = DistCacheTable(st, t, limit=16, pull_bound=2, push_bound=2,
+                        read_only=True)
+    ids = np.arange(8, dtype=np.int64)
+    before = ro.lookup(ids)
+    # an external trainer updates rows 2 and 5 (sgd lr=1: row -= grad)
+    g = np.ones((2, 4), np.float32)
+    st.push(t, np.asarray([2, 5], np.int64), g, 1.0)
+    # stale until refreshed (beyond pull_bound: RO mode never re-pulls)
+    assert np.array_equal(ro.lookup(ids), before)
+    assert np.array_equal(ro.lookup(ids), before)
+    refreshed = ro.refresh_stale()
+    assert refreshed == 2
+    after = ro.lookup(ids)
+    expect = before.copy()
+    expect[[2, 5]] -= 1.0
+    assert np.allclose(after, expect)
+    # idempotent: nothing changed since, so nothing re-pulls
+    assert ro.refresh_stale() == 0
+
+
+def test_readonly_refresh_every_autorefresh():
+    st, t = _mk_store(16, 4, lr=1.0)
+    ro = DistCacheTable(st, t, limit=16, read_only=True, refresh_every=3)
+    ids = np.arange(4, dtype=np.int64)
+    before = ro.lookup(ids)
+    st.push(t, np.asarray([1], np.int64), np.ones((1, 4), np.float32), 1.0)
+    ro.lookup(ids)            # 2nd call since construction
+    out = ro.lookup(ids)      # 3rd call: trips the async sweep AFTER serving
+    assert np.array_equal(out, before)
+    assert ro.refresh_join(timeout=10)   # drain the background sweep
+    out = ro.lookup(ids)      # post-sweep: refreshed row visible
+    assert not np.array_equal(out, before)
+    assert out[1][0] == before[1][0] - 1.0
+
+
+def test_readonly_fill_version_read_before_pull_survives_racing_writer():
+    """A writer landing BETWEEN the miss path's two store RPCs must not
+    create an invisible-stale row: versions are read BEFORE the rows, so
+    the recorded version can only be OLDER than the data — refresh_stale
+    then re-pulls (harmlessly) instead of never noticing."""
+    st, t = _mk_store(16, 4, lr=1.0)
+
+    class _RacingStore:
+        """Injects one push between the versions() and pull() calls of a
+        single read-only miss — the exact interleaving of the race."""
+
+        def __init__(self, store, table):
+            self._s, self._t = store, table
+            self.armed = False
+
+        def width(self, table):
+            return self._s.width(table)
+
+        def versions(self, table, keys):
+            v = self._s.versions(table, keys)
+            if self.armed:
+                self.armed = False
+                self._s.push(self._t, np.asarray([3], np.int64),
+                             np.ones((1, 4), np.float32), 1.0)
+            return v
+
+        def pull(self, table, keys):
+            return self._s.pull(table, keys)
+
+    racing = _RacingStore(st, t)
+    ro = DistCacheTable(racing, t, limit=16, read_only=True)
+    racing.armed = True
+    first = ro.lookup(np.asarray([3], np.int64))   # fill races the writer
+    # the pull already observed the post-write row (versions came first)
+    np.testing.assert_array_equal(
+        first[0], np.asarray(st.pull(t, np.asarray([3], np.int64)))[0])
+    # the conservative version makes the sweep re-pull once, then settle
+    assert ro.refresh_stale() == 1
+    assert ro.refresh_stale() == 0
+    now = ro.lookup(np.asarray([3], np.int64))
+    np.testing.assert_array_equal(
+        now[0], np.asarray(st.pull(t, np.asarray([3], np.int64)))[0])
