@@ -10,6 +10,18 @@ knobs that define the metric — canonical; no loose duplicates elsewhere in
 the artifact) and ``workload_hash`` (sha256[:12] of the canonical workload
 JSON).  Artifacts whose own schema already exposes the knobs top-level for
 programmatic consumers (flash_ab's resume check) embed only the hash.
+
+Chaos/robustness artifacts (``chaos``, ``failover``, ``serve``,
+``partition``) additionally follow a shared convention in ``extra``:
+``restarts``/``resumes`` (must be 0 for the transparent-recovery
+configs), ``fault_counters`` (the chaos run's evidence),
+``clean_run_counters`` (must be ``{}``), and loss/response parity flags
+against the clean run.  ``--config partition``
+(``artifacts/partition_smoke.json``) adds the fencing-epoch evidence:
+``fsck_serving_ranks``/``fsck_epochs`` (exactly one serving epoch per
+shard post-heal), ``noheal_lineage_violations`` (the unhealed split
+brain fsck detects), and ``two_cell`` (per-cell admitted/answered/
+rejections through the cross-cell cut plus post-heal fsck convergence).
 """
 import hashlib
 import json

@@ -57,6 +57,21 @@ PROBE_TIMEOUT_S = int(os.environ.get("HETU_BENCH_PROBE_TIMEOUT", "90"))
 CPU_RESERVE_S = int(os.environ.get("HETU_BENCH_CPU_RESERVE", "300"))
 
 
+def _free_ports(n):
+    """``n`` OS-assigned free localhost ports (bind, record, release) —
+    shared by every in-process multi-rank chaos/serving config."""
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
 def _sync(outs):
     """Force completion: remote platforms (axon tunnel) do not honor
     block_until_ready/wait, so read one element back to host — training
@@ -791,6 +806,12 @@ def _child_main(args):
         print(json.dumps(bench_serve(smoke=args.smoke,
                                      n_requests=args.steps)))
         return
+    if args.config == "partition":
+        # host-side partition-tolerance acceptance: chaos partition DSL,
+        # fencing epochs, 2-cell geo-replicated serving (ISSUE 8)
+        print(json.dumps(bench_partition(steps=args.steps or 10,
+                                         smoke=args.smoke)))
+        return
 
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
@@ -870,7 +891,9 @@ def _error_result(args, msg):
              "attn": ("attn_flash_sweep_tokens_per_sec", "tokens/s"),
              "chaos": ("chaos_recovery_ms", "ms"),
              "failover": ("failover_recovery_ms", "ms"),
+             "partition": ("partition_recovery_ms", "ms"),
              "emb": ("emb_cache_rows_per_sec", "rows/s"),
+             "serve": ("serve_qps", "requests/s"),
              "zero": ("zero_opt_state_shrink_vs_replicated", "x")}
     metric, unit = names[args.config]
     return {"metric": metric, "value": 0.0, "unit": unit,
@@ -1356,7 +1379,6 @@ def bench_chaos(steps=8, kill_step=3):
     retry/resume path run on the host whatever the accelerator is."""
     import glob as _glob
     import shutil
-    import socket as _socket
     import tempfile
 
     import jax
@@ -1365,17 +1387,6 @@ def bench_chaos(steps=8, kill_step=3):
     from hetu_tpu.graph.executor import Executor
     from hetu_tpu.metrics import fault_counts, reset_faults
     from hetu_tpu.ps.dist_store import DistributedStore
-
-    def free_ports(n):
-        socks, ports = [], []
-        for _ in range(n):
-            s = _socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
-        return ports
 
     def store_pair(ports):
         endpoints = [("127.0.0.1", p) for p in ports]
@@ -1423,7 +1434,7 @@ def bench_chaos(steps=8, kill_step=3):
 
     # uninterrupted baseline (also proves a clean run records NO faults)
     reset_faults()
-    s0, s1, tid = store_pair(free_ports(2))
+    s0, s1, tid = store_pair(_free_ports(2))
     ex, ids, y_ = build(s0, tid)
     base = [float(ex.run("train", feed_dict={ids: f[0], y_: f[1]}
                          )[0].asnumpy()) for f in feeds]
@@ -1435,7 +1446,7 @@ def bench_chaos(steps=8, kill_step=3):
     schedule = f"11:kill:ps@rank1:step{kill_step}"
     reset_faults()
     prev = chaos_mod.install(chaos_mod.ChaosInjector.from_spec(schedule))
-    ports = free_ports(2)
+    ports = _free_ports(2)
     s0, s1, tid = store_pair(ports)
     recovery_ms, restarts = 0.0, 0
     losses = [None] * steps
@@ -1537,7 +1548,6 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     one rpc_timeout + heartbeat deadline (vs PR 2's kill-everything
     recovery measured in checkpoint-resume minutes).  Host-side metric:
     transport + failover run on the host whatever the accelerator is."""
-    import socket as _socket
 
     import jax
     import hetu_tpu as ht
@@ -1550,17 +1560,6 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     rpc_timeout, hb_deadline_ms = 5.0, 1500.0
     second_kill = steps - 3
     assert second_kill > kill_step + 2, "need room to re-replicate"
-
-    def free_ports(n):
-        socks, ports = [], []
-        for _ in range(n):
-            s = _socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
-        return ports
 
     def make_store(rank, ports, standby=False):
         return DistributedStore(
@@ -1608,7 +1607,7 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
 
     # --- uninterrupted replicated baseline: ZERO fault counters ----------
     reset_faults()
-    stores, tid = make_cluster(free_ports(world))
+    stores, tid = make_cluster(_free_ports(world))
     try:
         ex, ids, y_ = build(stores[0], tid)
         base = [float(ex.run("train", feed_dict={ids: f[0], y_: f[1]}
@@ -1624,7 +1623,7 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     reset_faults()
     os.environ["HETU_PS_REREPLICATE_EVERY"] = "1"
     prev = chaos_mod.install(chaos_mod.ChaosInjector.from_spec(schedule))
-    ports = free_ports(world)
+    ports = _free_ports(world)
     stores, tid = make_cluster(ports)
     standby = None
     losses = [None] * steps
@@ -1726,7 +1725,6 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     one rpc_timeout + heartbeat deadline.  Host-side metric: routing,
     batching and the PS transport run on the host whatever the
     accelerator is."""
-    import socket as _socket
 
     import jax
     import hetu_tpu as ht
@@ -1747,17 +1745,6 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     # to outlast to split a wave, without drowning p99 in deadline time
     max_batch, max_wait_ms = 64, 150.0
     kill_req = n_requests // 2
-
-    def free_ports(n):
-        socks, ports = [], []
-        for _ in range(n):
-            s = _socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
-        return ports
 
     def make_cluster(ports):
         stores = [DistributedStore(
@@ -1829,7 +1816,7 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
         per-request latency ms, per-wave wall ms, wave serve_failover
         deltas, rejections)."""
         reset_serve_counts()
-        ports = free_ports(world)
+        ports = _free_ports(world)
         stores, tid = make_cluster(ports)
         responses = [None] * n_requests
         lat_ms = [0.0] * n_requests
@@ -1955,11 +1942,389 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     }
 
 
+def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
+    """ISSUE 8 acceptance: partition tolerance with fencing epochs.
+
+    Part A (3-rank training): the same seeded run three times — clean,
+    ``partition:rank0|rank1@step<cut>`` without heal, and with
+    ``:heal<m>``.  The partition cuts the training client (rank 0) off
+    shard 1's primary: the client fails over to the ring backup (epoch
+    bump), training continues with ZERO restarts, and losses stay
+    BITWISE equal to the clean run in both chaos variants (every acked
+    write lands on the surviving lineage).  After heal, a stale client
+    (rank 1's own store) writes through the healed stale ex-primary:
+    the op-log forward is epoch-refused by the promoted backup
+    (``ps_epoch_refused``), the ex-primary demotes itself
+    (``ps_demotions``) instead of acking, and the client re-routes the
+    SAME op to the surviving lineage — then epoch-checked
+    re-replication converges both copies, proven by
+    ``ps_fsck(retries=2)``: zero stable divergence and exactly one
+    serving epoch per shard.  The no-heal run documents the detectable
+    split brain fsck sees when nothing converges it.
+
+    Part B (2-cell geo-replicated serving): 4 ranks in two cells, each
+    serving InferenceExecutor traffic through a ServingRouter off a
+    read-only warmed DistCacheTable.  A cross-cell partition leaves
+    BOTH cells answering local reads (rejections=0, errors=0); the east
+    cell promotes a local backup for a missed shard (new lineage);
+    cross-cell re-replication queues (deferred) until heal; at heal the
+    west trainer's first stale write triggers the fence dance and
+    ``CellHead.catch_up`` re-replicates — fsck converges to one lineage.
+
+    Host-side metric: transport, fencing and routing run on the host
+    whatever the accelerator is."""
+
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    from hetu_tpu.ps.dist_store import DistributedStore
+    from tools.ps_fsck import fsck
+
+    world, rows, width = 3, 48, 8
+    rpc_timeout = 5.0
+    assert cut_step < heal_step < steps - 1, "need post-heal steps"
+
+    def make_cluster(ports, nranks=world, nrows=rows, w=width):
+        stores = [DistributedStore(
+            r, nranks, [("127.0.0.1", p) for p in ports], port=ports[r],
+            rpc_timeout=rpc_timeout, rpc_retries=2, connect_timeout=2.0,
+            replication=2) for r in range(nranks)]
+        tid = None
+        for s in stores:
+            tid = s.init_table(nrows, w, opt="sgd", lr=0.1, init_scale=0.0)
+        table = np.random.RandomState(42).normal(
+            0, 0.01, (nrows, w)).astype(np.float32)
+        stores[0].set_data(tid, table)   # replicated seeding path
+        return stores, tid
+
+    def build(store, tid):
+        rng = np.random.RandomState(1)
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((store, tid), ids, width=width)
+        w = ht.Variable("w", value=rng.randn(width, 2).astype(np.float32)
+                        * .3)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, w), y_), [0])
+        ex = ht.Executor(
+            {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+            seed=0, install_signal_handlers=False)
+        return ex, ids, y_
+
+    rng = np.random.RandomState(0)
+    feeds = [(rng.randint(0, rows, 32),
+              np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)])
+             for _ in range(steps)]
+    # the stale-client probe: shard-1-owned keys, ZERO grads — sgd leaves
+    # the values bitwise unchanged, so the probe can ride every variant
+    # without perturbing loss parity while still exercising the write
+    # path (and, post-heal, the fence dance)
+    probe_keys = np.asarray([1, 4], np.int64)
+    probe_grads = np.zeros((2, width), np.float32)
+
+    env_chaos = os.environ.pop("HETU_CHAOS", None)
+    env_tick = os.environ.pop("HETU_PS_REREPLICATE_EVERY", None)
+    chaos_mod.uninstall()
+
+    def run_variant(schedule, heal):
+        """One full training run; returns (losses, per-step ms, events,
+        fault counters, fsck report)."""
+        reset_faults()
+        ports = _free_ports(world)
+        stores, tid = make_cluster(ports)
+        losses, step_ms = [None] * steps, [0.0] * steps
+        events = {"failover_steps": [], "deferred_in_partition": False,
+                  "probe_acked": False, "heal_catchup_ms": 0.0}
+        prev = chaos_mod.install(
+            chaos_mod.ChaosInjector.from_spec(schedule)) if schedule \
+            else chaos_mod.uninstall()
+        try:
+            ex, ids, y_ = build(stores[0], tid)
+            for step in range(steps):
+                before = fault_counts().get("ps_failover_promoted", 0)
+                t0 = time.monotonic()
+                # NO try/except, NO restart: a partitioned primary is
+                # absorbed by failover inside the failing RPC
+                losses[step] = float(
+                    ex.run("train", feed_dict={ids: feeds[step][0],
+                                               y_: feeds[step][1]}
+                           )[0].asnumpy())
+                step_ms[step] = (time.monotonic() - t0) * 1e3
+                if fault_counts().get("ps_failover_promoted", 0) > before:
+                    events["failover_steps"].append(step + 1)
+                if schedule and step + 1 == cut_step + 2:
+                    # mid-partition repair attempt: cross-cut
+                    # re-replication must QUEUE (defer), not crash
+                    d0 = fault_counts().get("ps_re_replicate_deferred", 0)
+                    stores[0].maybe_re_replicate()
+                    events["deferred_in_partition"] = \
+                        fault_counts().get("ps_re_replicate_deferred",
+                                           0) > d0
+                if step + 1 == heal_step and (heal or not schedule):
+                    # the stale client writes through the (in the heal
+                    # variant: healed, still stale-serving) ex-primary —
+                    # clean run: plain replicated write; heal run: the
+                    # fence dance re-routes it to the surviving lineage
+                    t1 = time.monotonic()
+                    stores[1].push(tid, probe_keys, probe_grads)
+                    events["probe_acked"] = True
+                    stores[0].maybe_re_replicate()  # epoch-checked repair
+                    events["heal_catchup_ms"] = \
+                        (time.monotonic() - t1) * 1e3
+            report = fsck([("127.0.0.1", p) for p in ports], n_tables=1,
+                          replication=2, retries=2, retry_wait=0.2)
+            return losses, step_ms, events, fault_counts(), report
+        finally:
+            chaos_mod.install(prev) if schedule else None
+            for s in stores:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    two_cell = None
+    try:
+        base, base_ms, base_ev, clean_counters, base_fsck = \
+            run_variant(None, heal=False)
+        noheal = run_variant(
+            f"13:partition:rank0|rank1@step{cut_step}", heal=False)
+        heal = run_variant(
+            f"13:partition:rank0|rank1@step{cut_step}:heal{heal_step}",
+            heal=True)
+        two_cell = _two_cell_scenario(cut_step, heal_step)
+    finally:
+        chaos_mod.uninstall()
+        if env_chaos is not None:
+            os.environ["HETU_CHAOS"] = env_chaos
+        if env_tick is not None:
+            os.environ["HETU_PS_REREPLICATE_EVERY"] = env_tick
+
+    h_losses, h_ms, h_ev, h_counters, h_fsck = heal
+    n_losses, _, n_ev, n_counters, n_fsck = noheal
+    heal_parity = h_losses == base
+    noheal_parity = n_losses == base
+    one_lineage = all(len(r) == 1
+                      for r in h_fsck["serving_ranks"].values())
+    recovery_ms = sum(h_ms[s - 1] for s in h_ev["failover_steps"]) \
+        + h_ev["heal_catchup_ms"]
+    ok = (heal_parity and noheal_parity
+          and h_ev["probe_acked"]
+          and h_ev["deferred_in_partition"]
+          and h_counters.get("partition_frames_dropped", 0) > 0
+          and h_counters.get("ps_epoch_refused", 0) > 0
+          and h_counters.get("ps_demotions", 0) > 0
+          and h_counters.get("ps_epoch_bumps", 0) > 0
+          and h_counters.get("ps_failover_promoted", 0) >= 1
+          and h_fsck["ok"] and one_lineage
+          and h_fsck["serving_ranks"][1] == [2]
+          and not n_fsck["ok"]          # unhealed split brain is VISIBLE
+          and bool(n_fsck["lineage_violations"])
+          and base_fsck["ok"] and not clean_counters
+          and bool(two_cell) and two_cell["ok"])
+    return {
+        "metric": "partition_recovery_ms",
+        "value": round(recovery_ms, 1),
+        "unit": "ms",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "extra": {
+            "baseline_def": "1.0 iff BOTH partition runs' loss "
+                            "trajectories are bitwise equal to the clean "
+                            "run's (restarts=0, zero lost acked writes), "
+                            "the healed stale ex-primary was epoch-"
+                            "refused and demoted instead of serving, "
+                            "in-partition re-replication deferred, post-"
+                            "heal fsck (retries=2) found zero stable "
+                            "divergence and exactly one serving epoch "
+                            "per shard, the UNHEALED run's split brain "
+                            "stayed fsck-visible, the clean run recorded "
+                            "zero fault counters, and the 2-cell "
+                            "scenario served local reads through the "
+                            "cut (rejections=0) and converged after "
+                            "heal",
+            **_provenance({"steps": steps, "cut_step": cut_step,
+                           "heal_step": heal_step, "world": world,
+                           "replication": 2, "smoke": bool(smoke)}),
+            "restarts": 0,
+            "resumes": 0,
+            "loss_parity_heal": heal_parity,
+            "loss_parity_noheal": noheal_parity,
+            "probe_acked": h_ev["probe_acked"],
+            "failover_steps": h_ev["failover_steps"],
+            "re_replication_deferred_in_partition":
+                h_ev["deferred_in_partition"],
+            "heal_catchup_ms": round(h_ev["heal_catchup_ms"], 1),
+            "step_ms": [round(m, 1) for m in h_ms],
+            "fault_counters": h_counters,
+            "noheal_fault_counters": n_counters,
+            "clean_run_counters": clean_counters,
+            "fsck_ok": h_fsck["ok"],
+            "fsck_retries_used": h_fsck["retries_used"],
+            "fsck_serving_ranks": h_fsck["serving_ranks"],
+            "fsck_epochs": {
+                s: {r: v["epoch"] for r, v in eps.items()}
+                for s, eps in h_fsck["epochs"].items()},
+            "noheal_split_brain_detected":
+                bool(n_fsck["lineage_violations"]) or not n_fsck["ok"],
+            "noheal_lineage_violations": n_fsck["lineage_violations"],
+            "two_cell": two_cell,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+def _two_cell_scenario(cut_step, heal_step):
+    """Part B of ``bench_partition`` (docstring there): 2 cells x 2
+    ranks, replicated store, per-cell read-only serving heads, a
+    deterministic cross-cell partition + heal on a manual step clock."""
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    from hetu_tpu.ps.dist_store import DistCacheTable, DistributedStore
+    from hetu_tpu.serving import (CellHead, CellMap, InferenceExecutor,
+                                  ServingRouter)
+    from tools.ps_fsck import fsck
+
+    vocab, dim, n_fields = 32, 4, 4
+    cells = CellMap({"west": [0, 1], "east": [2, 3]})
+    ports = _free_ports(cells.world)
+    endpoints = [("127.0.0.1", p) for p in ports]
+    reset_faults()
+    stores = [DistributedStore(r, cells.world, endpoints, port=ports[r],
+                               rpc_timeout=2.0, rpc_retries=2,
+                               connect_timeout=2.0, replication=2)
+              for r in range(cells.world)]
+    heads = []
+    try:
+        tid = None
+        for s in stores:
+            tid = s.init_table(vocab, dim, opt="sgd", lr=0.1,
+                               init_scale=0.0)
+        stores[0].set_data(tid, np.random.RandomState(42).normal(
+            0, 0.01, (vocab, dim)).astype(np.float32))
+
+        def make_head(name, store):
+            sparse = ht.placeholder_op(f"ids_{name}", dtype=np.int64)
+            cache = DistCacheTable(store, tid, limit=2 * vocab,
+                                   policy="lru", read_only=True)
+            emb = ht.ps_embedding_lookup_op(cache, sparse, width=dim)
+            flat = ht.array_reshape_op(emb, (-1, n_fields * dim))
+            w = ht.Variable(f"w_{name}", value=(np.random.RandomState(7)
+                            .randn(n_fields * dim, 1) * 0.2
+                            ).astype(np.float32))
+            prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+            iex = InferenceExecutor([prob], seed=0, validate="error",
+                                    buckets=(4, 8))
+            router = ServingRouter(iex, max_batch=8, max_wait_ms=100.0,
+                                   queue_limit=64)
+            return CellHead(name, store, router, cache), sparse
+
+        west, west_ids = make_head("west", stores[0])
+        east, east_ids = make_head("east", stores[2])
+        heads = [west, east]
+        # east leaves two shard-1 keys COLD so the partition exercises
+        # the local-failover path (shard 1's ring backup, rank 2, lives
+        # in east); everything else is warm in both cells
+        cold_east = np.asarray([1, 5], np.int64)     # key % 4 == 1
+        all_keys = np.arange(vocab, dtype=np.int64)
+        west.warm(all_keys)
+        east.warm(np.setdiff1d(all_keys, cold_east))
+
+        rng = np.random.RandomState(3)
+
+        def wave(head, node, ids_batch):
+            return head.serve_wave([{node: ids} for ids in ids_batch])
+
+        def warm_ids(n, forbid=()):
+            pool = np.setdiff1d(all_keys, np.asarray(forbid, np.int64))
+            return [rng.choice(pool, n_fields) for _ in range(n)]
+
+        spec = "17:" + cells.partition_spec("west", "east", cut_step,
+                                            heal_step)
+        inj = chaos_mod.ChaosInjector.from_spec(spec)
+        prev = chaos_mod.install(inj)
+        try:
+            # phase 1 — link up: both cells serve, trainer writes
+            _, w1 = wave(west, west_ids, warm_ids(8))
+            _, e1 = wave(east, east_ids, warm_ids(8, forbid=cold_east))
+            stores[0].push(tid, np.arange(vocab),
+                           rng.standard_normal((vocab, dim))
+                           .astype(np.float32) * 0.1)
+            inj.on_step(cut_step)                    # the link dies
+            # phase 2 — partitioned: warm reads keep serving in BOTH
+            # cells; east also hits its cold shard-1 keys, forcing a
+            # LOCAL failover promotion (new lineage for shard 1)
+            _, w2 = wave(west, west_ids, warm_ids(8))
+            cold_feed = [np.concatenate((cold_east,
+                                         rng.choice(vocab // 2, 2)))]
+            _, e2a = wave(east, east_ids, cold_feed)
+            _, e2b = wave(east, east_ids,
+                          warm_ids(7, forbid=cold_east))
+            # cross-cell re-replication QUEUES while the link is down
+            d0 = fault_counts().get("ps_re_replicate_deferred", 0)
+            east.catch_up()
+            deferred = fault_counts().get("ps_re_replicate_deferred",
+                                          0) > d0
+            inj.on_step(heal_step)                   # the link heals
+            # phase 3 — heal: the west trainer's first write through the
+            # stale ex-primary is epoch-refused + re-routed (the fence
+            # dance); catch-up re-replicates; both cells keep serving
+            stores[0].push(tid, np.asarray([1, 5, 9], np.int64),
+                           np.ones((3, dim), np.float32) * 0.01)
+            east.catch_up()
+            west.catch_up()
+            _, w3 = wave(west, west_ids, warm_ids(8))
+            _, e3 = wave(east, east_ids, warm_ids(8))
+        finally:
+            chaos_mod.install(prev)
+        counters = fault_counts()
+        report = fsck(endpoints, n_tables=1, replication=2, retries=2,
+                      retry_wait=0.2)
+        waves = {"west": [w1, w2, w3], "east": [e1, e2a, e2b, e3]}
+        served_through_cut = all(
+            w["rejections"] == 0 and w["errors"] == 0
+            and w["answered"] == w["admitted"] > 0
+            for w in (w2, e2a, e2b))
+        ok = (served_through_cut and deferred
+              and counters.get("ps_failover_promoted", 0) >= 1
+              and counters.get("ps_epoch_refused", 0) >= 1
+              and counters.get("ps_demotions", 0) >= 1
+              and west.stats["rejections"] == 0
+              and east.stats["rejections"] == 0
+              and report["ok"]
+              and all(len(r) == 1
+                      for r in report["serving_ranks"].values()))
+        return {
+            "ok": ok,
+            "cells": {name: cells.ranks(name) for name in cells.cells},
+            "partition_spec": spec,
+            "served_through_cut": served_through_cut,
+            "re_replication_deferred_in_partition": deferred,
+            "cell_stats": {h.name: h.stats for h in heads},
+            "waves": waves,
+            "fsck_ok": report["ok"],
+            "fsck_serving_ranks": report["serving_ranks"],
+            "fault_counters": counters,
+        }
+    finally:
+        for h in heads:
+            try:
+                h.close()
+            except Exception:
+                pass
+        for s in stores:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
-                            "chaos", "failover", "emb", "zero", "serve"])
+                            "chaos", "failover", "emb", "zero", "serve",
+                            "partition"])
     p.add_argument("--dp", type=int, default=4,
                    help="zero only: data-parallel mesh size (the child "
                         "forces a CPU host-device mesh of >= this)")
@@ -1984,14 +2349,17 @@ if __name__ == "__main__":
                         "instead of the 10^7x64 scale run; failover: "
                         "the CI-sized double-kill run; serve: the "
                         "300-request CI config (artifacts/"
-                        "serve_smoke.json)")
+                        "serve_smoke.json); partition: the CI-sized "
+                        "partition+heal run (artifacts/"
+                        "partition_smoke.json)")
     p.add_argument("--steps", type=int, default=None,
                    help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
                         "the CPU fallback unless given explicitly)")
     args = p.parse_args()
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
-    elif args.config in ("chaos", "failover", "emb", "zero", "serve"):
+    elif args.config in ("chaos", "failover", "emb", "zero", "serve",
+                         "partition"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness

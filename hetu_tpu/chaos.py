@@ -28,7 +28,19 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   by the online-serving router (:mod:`hetu_tpu.serving`), which reports
   its admission count through :meth:`ChaosInjector.on_request` — a
   serving process has no training steps, so "kill the primary mid-load"
-  needs its own trigger.
+  needs its own trigger;
+* **network partitions** —
+  ``partition:rank<a>[+rank<b>...]|rank<c>[+rank<d>...]@step<n>[:heal<m>]``
+  drops every frame BOTH directions between the two rank sets from the
+  moment :meth:`ChaosInjector.on_step` reaches step ``n`` until it
+  reaches ``m`` (omit ``:heal<m>`` for a partition that never heals).
+  Unlike kills, a partition is LEVEL-triggered on the step clock — it
+  is active for a window, never "fired once" — and it is fully
+  deterministic (no RNG draw is consumed), so the same seed reproduces
+  the same partition alongside the same probabilistic fault stream.
+  Each dropped frame counts ``partition_frames_dropped``.  Senders
+  identify themselves via ``on_send(..., src=rank)``; a frame whose
+  sender is unknown (``src=None``) is never partition-dropped.
 
 Spec grammar (everything after the first ``:`` is the comma-separated
 fault list; probabilities in [0, 1], durations in milliseconds)::
@@ -39,10 +51,13 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="7:kill:primary@shard1:step3"
     HETU_CHAOS="7:kill:backup@shard1:step3"
     HETU_CHAOS="7:kill:primary@shard1:req200"
+    HETU_CHAOS="7:partition:rank0|rank1@step3:heal7"
+    HETU_CHAOS="7:partition:rank0+rank1|rank2+rank3@step3"
 
 Every injected fault increments a named counter in
-:mod:`hetu_tpu.metrics` (``chaos_drop``, ``chaos_kill_ps``, ...) so
-``HetuProfiler.fault_counters()`` shows exactly what the schedule did.
+:mod:`hetu_tpu.metrics` (``chaos_drop``, ``chaos_kill_ps``,
+``partition_frames_dropped``, ...) so ``HetuProfiler.fault_counters()``
+shows exactly what the schedule did.
 """
 from __future__ import annotations
 
@@ -62,10 +77,89 @@ class ChaosSpecError(ValueError):
     clean one)."""
 
 
+_PARTITION_GRAMMAR = ("partition:rank<a>[+rank<b>...]|rank<c>[+rank<d>"
+                      "...]@step<n>[:heal<m>]")
+
+
+def _parse_rank_set(side, part):
+    """``rank0+rank2`` -> frozenset({0, 2}); loud on anything else."""
+    ranks = set()
+    for tok in side.split("+"):
+        tok = tok.strip()
+        if not tok.startswith("rank"):
+            raise ChaosSpecError(
+                f"bad partition side {side!r} in {part!r}: expected "
+                f"{_PARTITION_GRAMMAR}")
+        try:
+            ranks.add(int(tok[len("rank"):]))
+        except ValueError:
+            raise ChaosSpecError(
+                f"bad rank {tok!r} in partition fault {part!r}: expected "
+                f"{_PARTITION_GRAMMAR}") from None
+    return frozenset(ranks)
+
+
+def _parse_partition(part):
+    """``partition:<side>|<side>@step<n>[:heal<m>]`` -> fault dict.
+
+    Validated loudly: two non-empty DISJOINT rank sets (an overlapping
+    cut is ill-defined), an integer start step, and — when given — a
+    heal step strictly after the start (a zero-length window would make
+    the chaos run indistinguishable from a clean one)."""
+    body = part[len("partition:"):]
+    try:
+        sides, when = body.split("@", 1)
+        a_s, b_s = sides.split("|", 1)
+    except ValueError:
+        raise ChaosSpecError(
+            f"bad partition fault {part!r}: expected "
+            f"{_PARTITION_GRAMMAR}") from None
+    a, b = _parse_rank_set(a_s, part), _parse_rank_set(b_s, part)
+    if not a or not b:
+        raise ChaosSpecError(
+            f"empty partition side in {part!r}: expected "
+            f"{_PARTITION_GRAMMAR}")
+    if a & b:
+        raise ChaosSpecError(
+            f"partition sides overlap on rank(s) {sorted(a & b)} in "
+            f"{part!r} — a rank cannot sit on both sides of the cut")
+    heal = None
+    if ":" in when:
+        when, heal_s = when.split(":", 1)
+        if not heal_s.startswith("heal"):
+            raise ChaosSpecError(
+                f"bad partition clause {heal_s!r} in {part!r}: expected "
+                f"{_PARTITION_GRAMMAR}")
+        try:
+            heal = int(heal_s[len("heal"):])
+        except ValueError:
+            raise ChaosSpecError(
+                f"bad heal step in {part!r}: expected "
+                f"{_PARTITION_GRAMMAR}") from None
+    if not when.startswith("step"):
+        raise ChaosSpecError(
+            f"bad partition trigger {when!r} in {part!r}: expected "
+            f"{_PARTITION_GRAMMAR}")
+    try:
+        step = int(when[len("step"):])
+    except ValueError:
+        raise ChaosSpecError(
+            f"bad partition step in {part!r}: expected "
+            f"{_PARTITION_GRAMMAR}") from None
+    if heal is not None and heal <= step:
+        raise ChaosSpecError(
+            f"partition heal step {heal} must be after its start step "
+            f"{step} in {part!r}")
+    return {"kind": "partition", "a": a, "b": b, "step": step,
+            "heal": heal}
+
+
 def _parse_fault(part):
     part = part.strip()
     if not part:
         raise ChaosSpecError("empty fault entry")
+    if part.startswith("partition:"):
+        return _parse_partition(part)
     if part.startswith("kill:"):
         # kill:ps@rank<r>:step<s> | kill:proc@rank<r>:after<ms>
         # | kill:{primary,backup}@shard<s>:{step<n>|req<n>}  (replica-
@@ -160,6 +254,12 @@ class ChaosInjector:
         self._lock = threading.Lock()
         self._servers = {}          # rank -> StoreServer
         self._fired = set()         # one-shot kill faults already fired
+        #: the step clock partitions level-trigger on (fed by on_step);
+        #: -1 = the executor never reported a step, so no partition is
+        #: active yet.  Kills keep their own one-shot ``_fired`` set —
+        #: the two clocks share on_step but nothing else (a heal must
+        #: never consume or be consumed by a kill firing).
+        self._now_step = -1
         #: per-event action log, kept for the determinism tests; bounded
         #: so a long chaos run doesn't grow it without limit
         self.decisions = []
@@ -176,7 +276,26 @@ class ChaosInjector:
         return cls.from_spec(spec) if spec else None
 
     # -- transport faults --------------------------------------------------
-    def on_send(self, peer=None, op=None):
+    def _partitioned(self, src, peer):
+        """True iff an ACTIVE partition separates ``src`` from ``peer``
+        (caller holds the lock).  Level-triggered on the on_step clock:
+        active from its start step until its heal step (or forever);
+        symmetric (frames drop both directions); never consumes an RNG
+        draw, so adding a partition to a schedule does not shift the
+        probabilistic fault stream."""
+        if src is None or peer is None:
+            return False
+        for f in self.faults:
+            if f["kind"] != "partition" or self._now_step < f["step"]:
+                continue
+            if f["heal"] is not None and self._now_step >= f["heal"]:
+                continue
+            if (src in f["a"] and peer in f["b"]) \
+                    or (src in f["b"] and peer in f["a"]):
+                return True
+        return False
+
+    def on_send(self, peer=None, op=None, src=None):
         """Decide the fate of one outgoing RPC frame.
 
         Returns ``None`` (send normally) or ``(kind, ms)`` with kind in
@@ -184,6 +303,14 @@ class ChaosInjector:
         ``delay`` (sleep ``ms`` then send), ``dup`` (send the frame twice
         — the server's (client, seq) dedup must absorb it), ``wedge``
         (hold the socket ``ms``; the client's op deadline fires).
+
+        ``src`` is the SENDING rank (transports pass their own rank so
+        partition faults can tell which side of a cut the frame leaves
+        from).  An active partition between ``src`` and ``peer`` drops
+        the frame deterministically — it overrides any probabilistic
+        fault, but the probabilistic draws still happen first so the
+        RNG stream position stays a function of (schedule, event count)
+        alone.
         """
         with self._lock:
             action = None
@@ -196,10 +323,13 @@ class ChaosInjector:
                 hit = self._rng.random() < f["prob"]
                 if hit and action is None:
                     action = (f["kind"], f["ms"])
+            if self._partitioned(src, peer):
+                action = ("drop", 0.0)
+                record_fault("partition_frames_dropped")
+            elif action is not None:
+                record_fault("chaos_" + action[0])
             if len(self.decisions) < self.decisions_cap:
                 self.decisions.append(action)
-            if action is not None:
-                record_fault("chaos_" + action[0])
             return action
 
     # -- step-scheduled PS-server kills ------------------------------------
@@ -230,17 +360,25 @@ class ChaosInjector:
         return None, None
 
     def on_step(self, step):
-        """Executor hook: fires any step-scheduled server kill —
-        ``kill:ps@rank<r>:step<s>`` and the replica-role forms
-        ``kill:{primary,backup}@shard<s>:step<n>``.
+        """Executor hook: advances the step clock partitions level-
+        trigger on (``partition:...@step<n>[:heal<m>]`` activates once
+        the clock reaches ``n`` and heals once it reaches ``m``), then
+        fires any step-scheduled server kill — ``kill:ps@rank<r>:
+        step<s>`` and the replica-role forms ``kill:{primary,backup}@
+        shard<s>:step<n>``.
 
         Returns the list of ranks whose server was stopped (empty almost
         always).  A fault whose target has no registered server is
         LOUD (warning + ``chaos_kill_target_missing`` counter) — a
         schedule that silently does nothing would make a chaos run
-        indistinguishable from a clean one."""
+        indistinguishable from a clean one.  (Partitions are exempt from
+        the one-shot ``_fired`` bookkeeping: they are windows, not
+        events, so replaying a step can re-evaluate them without ever
+        double-firing a kill.)"""
         killed, missing = [], []
         with self._lock:
+            if step > self._now_step:
+                self._now_step = step
             for i, f in enumerate(self.faults):
                 if i in self._fired or f.get("step") != step \
                         or f["kind"] not in ("kill_ps", "kill_primary",

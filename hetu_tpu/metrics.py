@@ -82,8 +82,15 @@ def reset_flash_fallbacks():
 # rerouted, ``ps_failover_failed`` both copies gone,
 # ``ps_failover_primary_reported_alive`` possible partition), server-side
 # promotions (``ps_promoted``), op-log forward breakage
-# (``repl_forward_failed``), and redundancy repair (``ps_re_replicated``
-# / ``ps_re_replicate_deferred`` / ``ps_re_replicate_failed``).
+# (``repl_forward_failed``), redundancy repair (``ps_re_replicated``
+# / ``ps_re_replicate_deferred`` / ``ps_re_replicate_failed``), and the
+# partition-tolerance plane: frames the chaos DSL's partition window
+# dropped (``partition_frames_dropped``), fencing-epoch advances at
+# promotion (``ps_epoch_bumps``), frames refused for carrying a stale or
+# deposed lineage's epoch (``ps_epoch_refused``), stale ex-primaries that
+# stopped serving on learning of a newer lineage (``ps_demotions``), and
+# heartbeat-silent ranks that still answered a direct probe
+# (``ps_unreachable`` — partition, not crash).
 # Invariant (asserted by the chaos + replication tests): every counter
 # EXCEPT the ``auto_save`` bookkeeping records a detected fault or a
 # recovery action, so a clean run — replicated or not — reports none of

@@ -298,14 +298,18 @@ class HetuProfiler:
         """{kind: count} of fault-tolerance events (``hetu_tpu.metrics``
         registry): transport retries/exhaustions, chaos injections,
         dead-rank exclusions, auto/emergency saves, resumes, supervisor
-        restarts, and the PS replication plane — shard failovers and
+        restarts, the PS replication plane — shard failovers and
         promotions (``ps_failover*``/``ps_promoted``), op-log forward
         breakage (``repl_forward_failed``), redundancy repair
-        (``ps_re_replicated``/``ps_re_replicate_*``), standby respawns.
-        Every entry except the routine ``auto_save`` bookkeeping is
-        evidence of a detected fault or a recovery action; a clean run —
-        replicated or not — reports none of those (and an empty dict
-        when auto-checkpointing is off)."""
+        (``ps_re_replicated``/``ps_re_replicate_*``), standby respawns —
+        and the partition-tolerance plane: chaos-partition frame drops
+        (``partition_frames_dropped``), fencing-epoch bumps/refusals
+        (``ps_epoch_bumps``/``ps_epoch_refused``), stale ex-primary
+        demotions (``ps_demotions``), and partitioned-but-alive ranks
+        (``ps_unreachable``).  Every entry except the routine
+        ``auto_save`` bookkeeping is evidence of a detected fault or a
+        recovery action; a clean run — replicated or not — reports none
+        of those (and an empty dict when auto-checkpointing is off)."""
         from .metrics import fault_counts
         return fault_counts()
 

@@ -47,15 +47,28 @@ redundancy onto a relaunched holder (``OP_INIT`` replica tables, then an
 ``OP_SYNC`` chunked snapshot reusing the v3 streamed checkpoint format,
 then op-log catch-up) so a second failure is survivable.
 
-Failure model: FAIL-STOP.  A replica that stops answering is assumed
-DEAD (process gone, state gone) — the deployment this serves runs both
-copies inside one pod's hosts, where an unreachable peer is a dead
-peer.  Under a true network partition a backup that missed forwards
-stays alive with stale state; nothing marks it stale remotely, so a
-later primary death could promote it (``repl_forward_failed`` in the
-fault counters is the tell, and ``tools/ps_fsck.py`` makes the
-divergence checkable).  Partition-tolerant promotion (sync epochs
-acknowledged end-to-end) is future work — detectable today, not silent.
+Failure model: fail-stop AND network partitions, fenced by **epochs**.
+Every shard carries a monotonic fencing epoch, stamped on every
+replication-relevant frame (``OP_PUSH``, ``OP_PUSH_PULL``,
+``OP_SET_DATA``, ``OP_REPLICATE``, ``OP_PROMOTE``, ``OP_SYNC``/
+``OP_SYNC_PUT``/``OP_INIT``) via the wire header.  Promotion bumps the
+shard's epoch (``ps_epoch_bumps``), so after a partition strands a
+still-alive ex-primary, the two lineages are ORDERED: any frame the
+stale lineage sends into the new one — an op-log forward, a snapshot,
+a write relayed for a stale client — is refused with an
+:class:`EpochFenced` error (``ps_epoch_refused``) instead of applied,
+and the refusal teaches the sender the newer epoch.  A healed stale
+ex-primary therefore DEMOTES itself on first contact with the new
+lineage (``ps_demotions``): it stops serving, drops promotability, and
+waits for epoch-checked re-replication instead of acking clients —
+split brain converges to exactly one serving lineage, and no write
+acked by the surviving lineage is lost.  Reads stay UNFENCED on
+purpose: a partitioned cell keeps serving (possibly stale) local reads
+— the HET bounded-staleness contract — while writes are what fencing
+makes safe.  The chaos DSL reproduces the failure deterministically
+(``partition:rank<a>|rank<b>@step<n>[:heal<m>]``), and
+``tools/ps_fsck.py --verify --retries N`` proves post-heal convergence
+(bitwise digests + exactly one serving epoch per shard).
 """
 from __future__ import annotations
 
@@ -103,15 +116,22 @@ OP_SET_DATA = _defop("OP_SET_DATA", 15)
 OP_SYNC = _defop("OP_SYNC", 16)
 OP_SYNC_PUT = _defop("OP_SYNC_PUT", 17)
 OP_CHECKSUM = _defop("OP_CHECKSUM", 18)
+#: shard lineage introspection: (fencing epoch, serving?) of one shard's
+#: copy on the answering server — how ps_fsck asserts a single surviving
+#: lineage and how liveness probes prove a "dead" rank is merely cut off
+OP_EPOCH = _defop("OP_EPOCH", 19)
 
 # op, table, nkeys, lr, payload_width, client rank, client sequence
-# number, shard (-1 = the receiving server's own primary shard).
+# number, shard (-1 = the receiving server's own primary shard), and the
+# sender's fencing EPOCH for that shard (see the module docstring).
 # (client, seq) lets the server DEDUPLICATE retried pushes: the transport
 # retries are at-least-once (the reference's ps-lite ``resender.h`` keeps
 # the same ack+dedup discipline), and double-applying a gradient push would
 # silently corrupt training.  The shard field routes a frame to the right
-# replica after a failover moved serving away from the home rank.
-_HDR = struct.Struct("<BiqdIqqq")
+# replica after a failover moved serving away from the home rank; the
+# epoch field is what lets a server refuse frames from a stale lineage
+# (and lets a stale server discover it was deposed).
+_HDR = struct.Struct("<BiqdIqqqq")
 #: retried pushes are remembered per client this many ops back
 _DEDUP_WINDOW = 4096
 
@@ -171,6 +191,38 @@ class FrameError(ConnectionError):
     """Corrupt frame header — framing on this stream is unrecoverable, so
     it subclasses ConnectionError: the server loop drops the connection
     and the client retries on a fresh one."""
+
+
+class EpochFenced(RuntimeError):
+    """A replication-relevant frame was refused by the fencing epoch.
+
+    ``current`` is the refusing side's epoch for the shard and
+    ``serving`` whether the refusing side still serves it — together
+    they tell the client how to converge: a serving refuser means "you
+    are behind, adopt my epoch and retry here"; a non-serving refuser
+    means "I was deposed (or just demoted myself), adopt the epoch and
+    re-route to the shard's other holder".  The message carries both in
+    a parseable form because the refusal usually crosses the wire as a
+    server-error string."""
+
+    def __init__(self, shard, current, serving):
+        self.shard, self.current, self.serving = \
+            int(shard), int(current), bool(serving)
+        super().__init__(
+            f"shard {shard} epoch_fence cur={int(current)} "
+            f"serving={int(bool(serving))} — frame from a different "
+            f"lineage refused")
+
+
+def _fence_info(err):
+    """(current_epoch, refuser_still_serving) parsed from an epoch-fence
+    refusal — local :class:`EpochFenced` or its over-the-wire string
+    form — or None for any other error."""
+    if isinstance(err, EpochFenced):
+        return err.current, err.serving
+    import re
+    m = re.search(r"epoch_fence cur=(\d+) serving=([01])", str(err))
+    return (int(m.group(1)), bool(int(m.group(2)))) if m else None
 
 
 #: hard cap on a decoded frame length; a corrupt/hostile length prefix must
@@ -237,7 +289,26 @@ class StoreServer:
         #: completes (_sync_put loads the last table).
         self._promotable = set() if standby \
             else {rank, (rank - 1) % world} if self.replicable else {rank}
+        #: shard -> fencing epoch of the lineage our copy belongs to.
+        #: Bumped by promotion, adopted from newer frames (OP_INIT /
+        #: OP_SYNC_PUT / OP_REPLICATE), compared on every replication-
+        #: relevant frame (module docstring).  A fresh server starts at
+        #: 0 and LEARNS the live epoch from re-replication — a standby
+        #: can never leapfrog the serving lineage.
+        self._epochs = {rank: 0}
+        #: LEAF lock for the epoch map — deliberately NOT ``_repl_lock``:
+        #: a primary holds ``_repl_lock`` ACROSS its forward RPC, so the
+        #: receive side of a forward (OP_REPLICATE's epoch gate) must
+        #: never block on the receiver's ``_repl_lock`` or three
+        #: primaries forwarding around the ring deadlock until their
+        #: socket timeouts fire.  ``_epoch_lock`` is never held across
+        #: any RPC (or across ``_repl_lock``).
+        self._epoch_lock = threading.Lock()
         self._fwd_ok = {}          # shard -> live forwarding enabled
+        #: shard -> monotonic time of the last broken-forward lineage
+        #: probe (see _probe_lineage): rate-limits the reachability
+        #: check a degraded primary runs before acking further writes
+        self._fence_probe = {}
         self._oplog = {}           # shard -> buffered frames during OP_SYNC
         self._sync_parts = {}      # (shard, table) -> received snapshot chunks
         #: ordered apply+forward: the backup must see ops in primary apply
@@ -250,6 +321,7 @@ class StoreServer:
             backup_of = (rank - 1) % world
             self._stores[backup_of] = EmbeddingStore()
             self._ntables[backup_of] = 0
+            self._epochs[backup_of] = 0
             self._fwd_ok[rank] = True
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -273,6 +345,79 @@ class StoreServer:
         """True iff this server keeps a copy of ``shard`` (serving or
         standby backup) — the chaos kill-backup target predicate."""
         return shard in self._stores
+
+    def epoch(self, shard):
+        """This server's fencing epoch for ``shard`` (0 if unheld)."""
+        return self._epochs.get(shard, 0)
+
+    def _adopt_epoch(self, shard, epoch):
+        """Advance ``shard``'s epoch to at least ``epoch`` — a locked
+        max-merge, never a plain assignment: two handler threads racing
+        adoptions (e.g. a stalled stale snapshot chunk vs a newer
+        lineage's re-replication) must not let the LOWER epoch win, or
+        the losing lineage's remaining frames would pass the fence."""
+        with self._epoch_lock:
+            if epoch > self._epochs.get(shard, 0):
+                self._epochs[shard] = epoch
+
+    def _fence_or_adopt(self, shard, epoch, refuse_equal_if_serving=False):
+        """The replica-plane epoch gate (OP_REPLICATE / OP_INIT /
+        OP_SYNC_PUT): refuse frames from an OLDER lineage (and, for
+        op-log forwards, an equal-epoch frame aimed at a copy we SERVE
+        — two same-epoch primaries of one shard cannot exist); adopt a
+        NEWER epoch, demoting first when we still thought we served the
+        shard (the healed stale ex-primary's learning moment).  The
+        compare runs under the leaf ``_epoch_lock`` (see its comment:
+        this path sits on the receive side of forwards and must never
+        block on ``_repl_lock``); adoption/demotion are monotone
+        max-merges, so acting on the snapshot after release is safe."""
+        with self._epoch_lock:
+            cur = self._epochs.get(shard, 0)
+            if epoch < cur or (refuse_equal_if_serving and epoch == cur
+                               and shard in self._serving):
+                record_fault("ps_epoch_refused")
+                raise EpochFenced(shard, cur,
+                                  serving=shard in self._serving)
+        if epoch > cur:
+            if shard in self._serving:
+                self._demote(shard, epoch)
+            else:
+                self._adopt_epoch(shard, epoch)
+
+    def _demote(self, shard, new_epoch):
+        """Stop serving ``shard``: a newer lineage exists (we just saw
+        epoch ``new_epoch`` > ours).  The local copy stays on disk but
+        is no longer promotable — it may hold writes the surviving
+        lineage never saw, so promoting it would resurrect the split
+        brain — and forwarding stops (our op-log is the STALE one).
+        Idempotent; callers hold no particular lock (``_repl_lock`` is
+        re-entrant for the under-forward caller)."""
+        self._adopt_epoch(shard, new_epoch)
+        with self._repl_lock:
+            if shard not in self._serving:
+                return
+            self._serving.discard(shard)
+            self._promotable.discard(shard)
+            self._fwd_ok[shard] = False
+            record_fault("ps_demotions")
+
+    def _fence(self, shard, frame_epoch):
+        """Fencing gate for a replication-relevant frame against a shard
+        this server SERVES.  Equal epochs pass.  A NEWER frame epoch
+        means we missed a promotion — demote ourselves and refuse (the
+        caller must not be acked by a deposed lineage).  An OLDER frame
+        epoch is a stale sender — refuse and teach it our epoch.  Must
+        run BEFORE the (client, seq) dedup registration: a refused frame
+        retried at the correct epoch must still apply."""
+        with self._epoch_lock:    # leaf lock: see its init comment
+            cur = self._epochs.get(shard, 0)
+        if frame_epoch == cur:
+            return
+        record_fault("ps_epoch_refused")
+        if frame_epoch > cur:
+            self._demote(shard, frame_epoch)
+            raise EpochFenced(shard, frame_epoch, serving=False)
+        raise EpochFenced(shard, cur, serving=shard in self._serving)
 
     def register_table(self, shard):
         """Owner bookkeeping for a table created directly on ``local``."""
@@ -373,9 +518,13 @@ class StoreServer:
         section as the local apply), so the backup receives the op-log in
         primary apply order over one ordered connection.  During an
         ``OP_SYNC`` snapshot transfer the frame is buffered instead and
-        drained after the snapshot lands (op-log catch-up).  A forward
+        drained after the snapshot lands (op-log catch-up).  A transport
         failure degrades to unreplicated serving (availability over
-        redundancy) until ``re_replicate`` restores the backup."""
+        redundancy) until ``re_replicate`` restores the backup — but an
+        EPOCH-FENCE refusal means the peer belongs to a NEWER lineage
+        (we are a healed stale ex-primary): then this server demotes
+        itself and re-raises, so the handler refuses the client instead
+        of acking a write onto the losing side of a split brain."""
         log = self._oplog.get(shard)
         if log is not None:
             log.append(bytes(body))
@@ -386,8 +535,13 @@ class StoreServer:
             if self.rpc_fn is None:
                 raise RuntimeError("replication transport not attached")
             self.rpc_fn(self._fwd_target(shard), OP_REPLICATE, 0,
-                        np.asarray([shard], np.int64), payload=bytes(body))
+                        np.asarray([shard], np.int64), payload=bytes(body),
+                        epoch=self._epochs.get(shard, 0))
         except Exception as e:
+            fence = _fence_info(e)
+            if fence is not None:
+                self._demote(shard, fence[0])
+                raise EpochFenced(shard, fence[0], serving=False) from e
             self._fwd_ok[shard] = False
             record_fault("repl_forward_failed")
             import warnings
@@ -397,11 +551,47 @@ class StoreServer:
                 f"({type(e).__name__}: {e}) — shard now serves "
                 f"UNREPLICATED until re_replicate()", RuntimeWarning)
 
+    def _probe_lineage(self, shard):
+        """Rate-limited (``HETU_PS_FENCE_PROBE_S``, default 5s) epoch
+        probe of ``shard``'s other holder while our forwarding to it is
+        broken: if it answers with a NEWER epoch, we were deposed while
+        cut off — demote and refuse the in-flight write instead of
+        acking it onto the losing lineage.  An unreachable peer keeps
+        today's degraded-but-available serving (without a quorum a lone
+        primary cannot tell partition from backup death — CAP; the
+        probe bounds how long a HEALED cut stays split-brained)."""
+        interval = float(os.environ.get("HETU_PS_FENCE_PROBE_S", "5"))
+        now = time.monotonic()
+        if now - self._fence_probe.get(shard, -1e9) < interval:
+            return
+        self._fence_probe[shard] = now
+        try:
+            raw = self.rpc_fn(self._fwd_target(shard), OP_EPOCH, 0,
+                              np.asarray([shard], np.int64),
+                              op_timeout=2.0, record=False, retries=1)
+            peer_epoch = struct.unpack("<qq", raw)[0]
+        except Exception:
+            return      # still unreachable/odd: availability wins
+        if peer_epoch > self._epochs.get(shard, 0):
+            self._demote(shard, peer_epoch)
+            raise EpochFenced(shard, peer_epoch, serving=False)
+
+    def _maybe_probe_degraded(self, shard):
+        """When ``shard`` serves with its forwarding broken (and no sync
+        in flight), run the rate-limited deposed-check BEFORE the apply
+        and OUTSIDE ``_repl_lock`` — a probe RPC under the server-wide
+        lock would stall every shard's write plane for the probe
+        timeout, and refusing before the apply also spares the stale
+        copy the refused mutation."""
+        if not self._fwd_ok.get(shard) and self._oplog.get(shard) is None:
+            self._probe_lineage(shard)
+
     def _apply_push(self, shard, store, table, keys, grads, lr, body):
         """Serving-side push: apply + mirror atomically (see _forward)."""
         if not self.replicable:
             store.push(table, keys // self.world, grads, lr)
             return
+        self._maybe_probe_degraded(shard)
         with self._repl_lock:
             store.push(table, keys // self.world, grads, lr)
             self._forward(shard, body)
@@ -410,6 +600,7 @@ class StoreServer:
         if not self.replicable:
             store.set_data(table, arr)
             return
+        self._maybe_probe_degraded(shard)
         with self._repl_lock:
             store.set_data(table, arr)
             self._forward(shard, body)
@@ -421,7 +612,9 @@ class StoreServer:
         is needed here beyond the table's own; dedup registers the
         ORIGINAL (client, seq) so the promotion-window retry of an
         ack'd-then-died push is recognised as already applied."""
-        iop, itable, inkeys, ilr, iwidth, iclient, iseq, _ = \
+        # the inner frame's own epoch is ignored: the OUTER OP_REPLICATE
+        # frame was already fenced against the forwarding primary's epoch
+        iop, itable, inkeys, ilr, iwidth, iclient, iseq, _, _ = \
             _HDR.unpack_from(inner)
         ioff = _HDR.size
         ikeys = np.frombuffer(inner, np.int64, inkeys, ioff)
@@ -485,16 +678,24 @@ class StoreServer:
                 f" is not replicable")
 
     def _init_replica_table(self, shard, table, local_rows, width, opt_id,
-                            seed, lr, beta1, beta2, eps, init_scale):
+                            seed, lr, beta1, beta2, eps, init_scale,
+                            epoch=0):
         """Create table ``table`` in the held copy of ``shard`` with the
         SAME init parameters as the primary (deterministic seeded init ⇒
         bitwise-identical starting state).  Idempotent per table id —
-        retried/raced OP_INIT frames are absorbed."""
+        retried/raced OP_INIT frames are absorbed.
+
+        The frame's ``epoch`` is the re-replication entry point of the
+        fencing protocol: a NEWER epoch on a shard we still serve is how
+        a healed stale ex-primary learns it was deposed (demote, accept
+        the replica role); an OLDER epoch is a stale client trying to
+        re-replicate the wrong lineage (refused)."""
         store = self._stores.get(shard)
         if store is None:
             raise RuntimeError(
                 f"rank {self.rank} is not a replica holder for shard "
                 f"{shard} (replication={self.replication})")
+        self._fence_or_adopt(shard, epoch)
         with self._repl_lock:
             have = self._ntables.get(shard, 0)
             if table < have:
@@ -510,16 +711,28 @@ class StoreServer:
             assert tid == table, (tid, table)
             self._ntables[shard] = table + 1
 
-    def _promote(self, shard, want_tables):
-        """Serve ``shard`` from our held replica (idempotent).  Refuses
-        when we don't hold the shard, hold fewer tables than the client
-        expects, or the copy was never synced (a standby's self-created
-        tables have the right COUNT but seed-initialized data —
-        promoting that would silently reset the shard to step 0 instead
-        of raising a loud both-copies-gone outage)."""
+    def _promote(self, shard, want_tables, want_epoch=0):
+        """Serve ``shard`` from our held replica (idempotent); returns
+        the shard's resulting fencing epoch.  Refuses when we don't hold
+        the shard, hold fewer tables than the client expects, or the
+        copy was never synced (a standby's self-created tables have the
+        right COUNT but seed-initialized data — promoting that would
+        silently reset the shard to step 0 instead of raising a loud
+        both-copies-gone outage).
+
+        A REAL promotion bumps the epoch past both our replica's last
+        known epoch and the promoting client's (``want_epoch`` = client
+        epoch + 1), so the new lineage strictly dominates the old one:
+        the deposed primary's frames are refusable, and every client
+        that promotes concurrently converges on the same epoch (the
+        idempotent path returns the current epoch without bumping)."""
         with self._repl_lock:
+            cur = self._epochs.get(shard, 0)
             if shard in self._serving:
-                return
+                if want_epoch > cur:       # concurrent promoter raced a
+                    cur = want_epoch       # newer lineage onto us: adopt
+                    self._adopt_epoch(shard, cur)
+                return cur
             if not self.replicable:
                 raise RuntimeError(
                     f"rank {self.rank} runs unreplicated "
@@ -535,11 +748,15 @@ class StoreServer:
                 raise RuntimeError(
                     f"rank {self.rank} copy of shard {shard} was never "
                     f"synced from the serving replica — not promotable")
+            new_epoch = max(cur + 1, want_epoch)
+            self._adopt_epoch(shard, new_epoch)
             self._serving.add(shard)
-            # the old primary is presumed dead: no forwarding until
-            # re_replicate() attaches a fresh backup
+            # the old primary is presumed dead (or fenced off): no
+            # forwarding until re_replicate() attaches a fresh backup
             self._fwd_ok[shard] = False
             record_fault("ps_promoted")
+            record_fault("ps_epoch_bumps")
+            return new_epoch
 
     def _sync_to(self, shard, target):
         """Re-replication source half: snapshot every table of ``shard``
@@ -579,6 +796,7 @@ class StoreServer:
                 store.save(tid, path)
         try:
             chunk = min(_V3_CHUNK, max(1 << 20, MAX_FRAME_BYTES // 2))
+            epoch = self._epochs.get(shard, 0)
             for tid, path in enumerate(paths):
                 size = os.path.getsize(path)
                 nch = max(1, -(-size // chunk))
@@ -588,18 +806,26 @@ class StoreServer:
                             target, OP_SYNC_PUT, tid,
                             np.asarray([shard, ci, nch, size, ntabs],
                                        np.int64),
-                            payload=f.read(chunk))
+                            payload=f.read(chunk), epoch=epoch)
             with self._repl_lock:
                 for frame in self._oplog.pop(shard, []):
                     self.rpc_fn(target, OP_REPLICATE, 0,
                                 np.asarray([shard], np.int64),
-                                payload=frame)
+                                payload=frame, epoch=epoch)
                 self._fwd_ok[shard] = True
             record_fault("ps_re_replicated")
-        except Exception:
+        except Exception as e:
             with self._repl_lock:
                 self._oplog.pop(shard, None)
                 self._fwd_ok[shard] = False
+            fence = _fence_info(e)
+            if fence is not None:
+                # the target refused OUR snapshot: it belongs to a newer
+                # lineage, so WE are the stale ex-primary trying to
+                # overwrite the survivor — learn the epoch and demote
+                # instead of retrying this doomed sync every tick
+                self._demote(shard, fence[0])
+                raise EpochFenced(shard, fence[0], serving=False) from e
             record_fault("ps_re_replicate_failed")
             raise
         finally:
@@ -609,17 +835,23 @@ class StoreServer:
                 except OSError:
                     pass
 
-    def _sync_put(self, shard, table, ci, nch, total, ntabs, payload):
+    def _sync_put(self, shard, table, ci, nch, total, ntabs, payload,
+                  epoch=0):
         """Re-replication sink half: append snapshot chunks straight to a
         temp file (bounded RSS) and load the completed table via the
         store's own load path.  Once every one of the shard's ``ntabs``
         tables has landed, the copy becomes PROMOTABLE.  Chunks arrive in
-        order (one connection); a retried chunk is idempotent."""
+        order (one connection); a retried chunk is idempotent.  The
+        snapshot carries the source lineage's epoch: an OLDER epoch is a
+        stale source trying to overwrite us with the losing lineage
+        (refused); a newer one is adopted — and demotes us first if we
+        still thought we served the shard."""
         import tempfile
         store = self._stores.get(shard)
         if store is None:
             raise RuntimeError(
                 f"rank {self.rank} holds no replica of shard {shard}")
+        self._fence_or_adopt(shard, epoch)
         if shard in self._serving and shard != self.rank:
             raise RuntimeError(
                 f"rank {self.rank} already SERVES shard {shard} — "
@@ -660,18 +892,24 @@ class StoreServer:
                 self._promotable.add(shard)
 
     def _handle(self, conn, body):
-        op, table, nkeys, lr, width, client, seq, shard = \
+        op, table, nkeys, lr, width, client, seq, shard, epoch = \
             _HDR.unpack_from(body)
         off = _HDR.size
         keys = np.frombuffer(body, np.int64, nkeys, off)
         off += nkeys * 8
         if op == OP_PULL:
+            # reads are deliberately UNFENCED: a partitioned cell keeps
+            # serving (bounded-staleness) local reads — fencing guards
+            # the write plane, where split-brain divergence is made
             store, shard = self._store_serving(shard)
             out = store.pull(table, keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(out, np.float32).tobytes())
         elif op == OP_PUSH:
             store, shard = self._store_serving(shard)
+            # fence BEFORE the dedup registration: a refused frame
+            # retried at the correct epoch must not read as a duplicate
+            self._fence(shard, epoch)
             if not self._seen(client, seq):
                 grads = np.frombuffer(body, np.float32, nkeys * width,
                                       off).reshape(nkeys, width)
@@ -682,6 +920,7 @@ class StoreServer:
             # one ack.  The push half is as non-idempotent as OP_PUSH — a
             # retried frame skips it but still serves the (idempotent) pull.
             store, shard = self._store_serving(shard)
+            self._fence(shard, epoch)
             npush = int(keys[0])
             push_keys = keys[1:1 + npush]
             pull_keys = keys[1 + npush:]
@@ -700,16 +939,23 @@ class StoreServer:
                         np.ascontiguousarray(v, np.int64).tobytes())
         elif op == OP_SET_DATA:
             store, shard = self._store_serving(shard)
+            self._fence(shard, epoch)
             n = (len(body) - off) // 4
             arr = np.frombuffer(body, np.float32, n, off).reshape(-1, width)
             self._apply_set_data(shard, store, table, arr, body)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_REPLICATE:
-            self._apply_replicated(int(keys[0]), body[off:])
+            # op-log from a STALE lineage (a healed ex-primary that
+            # never heard it was deposed) is refused — which is what
+            # turns its next client ack into a self-demotion
+            s = int(keys[0])
+            self._fence_or_adopt(s, epoch, refuse_equal_if_serving=True)
+            self._apply_replicated(s, body[off:])
             _send_frame(conn, b"\x00\x01")
         elif op == OP_PROMOTE:
-            self._promote(int(keys[0]), int(keys[1]))
-            _send_frame(conn, b"\x00\x01")
+            ep = self._promote(int(keys[0]), int(keys[1]),
+                               int(keys[2]) if nkeys > 2 else 0)
+            _send_frame(conn, b"\x00", struct.pack("<q", ep))
         elif op == OP_INIT:
             # keys=[local_rows, width, opt_id, seed]; payload packs the
             # float init params (NaN init_scale = store default)
@@ -717,15 +963,26 @@ class StoreServer:
             self._init_replica_table(
                 shard, table, int(keys[0]), int(keys[1]), int(keys[2]),
                 int(keys[3]), p[0], p[1], p[2], p[3],
-                None if p[4] != p[4] else p[4])
+                None if p[4] != p[4] else p[4], epoch=epoch)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_SYNC:
+            self._fence(int(keys[0]), epoch)
             self._sync_to(int(keys[0]), int(keys[1]))
             _send_frame(conn, b"\x00\x01")
         elif op == OP_SYNC_PUT:
             self._sync_put(int(keys[0]), table, int(keys[1]), int(keys[2]),
-                           int(keys[3]), int(keys[4]), body[off:])
+                           int(keys[3]), int(keys[4]), body[off:],
+                           epoch=epoch)
             _send_frame(conn, b"\x00\x01")
+        elif op == OP_EPOCH:
+            # lineage introspection (fsck, liveness probes): the fencing
+            # epoch of one shard's copy here + whether we serve it.
+            # Answered for ANY shard (0 if unheld) — the probe must work
+            # against a standby or a demoted holder too.
+            s = self.rank if not nkeys else int(keys[0])
+            _send_frame(conn, b"\x00",
+                        struct.pack("<qq", self._epochs.get(s, 0),
+                                    int(s in self._serving)))
         elif op == OP_CHECKSUM:
             # full-state digest of ANY held copy (serving or standby) —
             # tools/ps_fsck.py compares primary vs backup for divergence
@@ -908,6 +1165,11 @@ class DistributedStore:
         #: the shard's other replica holder.  Every client converges
         #: independently (promote is idempotent).
         self._route = list(range(world))
+        #: shard -> the fencing epoch this client believes is current.
+        #: Advanced by OP_PROMOTE acks and by epoch-fence refusals — a
+        #: refused write teaches the client the surviving lineage before
+        #: the retry (module docstring).
+        self._epoch = [0] * world
         self._failed_over = set()  # shards running without redundancy
         self._queue = queue.Queue(maxsize=async_queue)
         self._async_thread = None
@@ -949,7 +1211,7 @@ class DistributedStore:
 
     def _rpc(self, peer, op, table, keys, payload=b"", lr=-1.0, width=0,
              op_timeout=None, shard=-1, seq=None, record=True,
-             retries=None):
+             retries=None, epoch=0):
         """One request/response against ``peer``'s shard.
 
         Transport discipline (reference ``ps-lite/src/resender.h``): every
@@ -964,7 +1226,8 @@ class DistributedStore:
         backup is recognised by its dedup window (see _rpc_shard)."""
         keys = np.ascontiguousarray(keys, np.int64)
         hdr = _HDR.pack(op, table, keys.size, lr, width, self.rank,
-                        next(self._seq) if seq is None else seq, shard)
+                        next(self._seq) if seq is None else seq, shard,
+                        epoch)
         last_err = None
         delay = 0.0
         for attempt in range(self.rpc_retries if retries is None
@@ -980,7 +1243,8 @@ class DistributedStore:
                 # duplicate, or wedge this frame (hetu_tpu.chaos); a clean
                 # run pays one global read
                 inj = _chaos.active()
-                act = inj.on_send(peer, op) if inj is not None else None
+                act = inj.on_send(peer, op, src=self.rank) \
+                    if inj is not None else None
                 if act is not None and act[0] == "drop":
                     raise TimeoutError(
                         f"chaos: dropped {op_name(op)} frame")
@@ -1032,23 +1296,58 @@ class DistributedStore:
         msg = str(err)
         return "unreachable" in msg or "not served" in msg
 
+    def _note_fence(self, shard, err):
+        """Adopt the surviving lineage an epoch-fence refusal names:
+        advance this client's epoch for ``shard`` and — when the refuser
+        no longer serves (it was deposed or just demoted itself) — flip
+        the route to the shard's other holder and mark the shard for
+        re-replication (the demoted copy is stale by construction)."""
+        cur, serving = _fence_info(err)
+        if cur > self._epoch[shard]:
+            self._epoch[shard] = cur
+        if not serving:
+            dead = self._route[shard]
+            self._route[shard] = (shard + 1) % self.world \
+                if dead == shard else shard
+            self._failed_over.add(shard)
+
     def _rpc_shard(self, shard, op, table, keys, payload=b"", lr=-1.0,
                    width=0, op_timeout=None):
         """Shard-addressed RPC: routes to the rank currently serving
         ``shard`` and, with ``replication>=2``, turns an unreachable
         primary into a transparent failover — promote the backup, flip
         the route, retry THE SAME frame (pinned seq → the backup's dedup
-        window keeps an ack'd-then-died push exactly-once)."""
+        window keeps an ack'd-then-died push exactly-once).  An epoch-
+        fence refusal is handled the same one-retry way: learn the
+        surviving epoch from the refusal, re-route if the refuser was
+        deposed, resend the SAME frame stamped with the new epoch."""
         seq = next(self._seq)
         try:
             return self._rpc(self._route[shard], op, table, keys, payload,
-                             lr, width, op_timeout, shard=shard, seq=seq)
+                             lr, width, op_timeout, shard=shard, seq=seq,
+                             epoch=self._epoch[shard])
+        except RuntimeError as e:
+            if _fence_info(e) is not None:
+                # learn the surviving epoch/route, then fall through to
+                # the SAME send-with-failover discipline below — a fence
+                # refusal must not cost the retry its transparent-
+                # failover safety net (the corrected target can die too)
+                self._note_fence(shard, e)
+            elif self.replication < 2 or not self._failover_worthy(e):
+                raise
+            else:
+                self._failover(shard, err=e)
+        try:
+            return self._rpc(self._route[shard], op, table, keys, payload,
+                             lr, width, op_timeout, shard=shard, seq=seq,
+                             epoch=self._epoch[shard])
         except RuntimeError as e:
             if self.replication < 2 or not self._failover_worthy(e):
                 raise
             alt = self._failover(shard, err=e)
             return self._rpc(alt, op, table, keys, payload, lr, width,
-                             op_timeout, shard=shard, seq=seq)
+                             op_timeout, shard=shard, seq=seq,
+                             epoch=self._epoch[shard])
 
     def _failover(self, shard, err=None):
         """Promote ``shard``'s other replica holder and re-route.  Raises
@@ -1076,13 +1375,21 @@ class DistributedStore:
             except (RuntimeError, OSError, ConnectionError):
                 pass
         try:
-            self._rpc(alt, OP_PROMOTE, 0,
-                      np.asarray([shard, len(self._tables)], np.int64))
+            # want_epoch = our epoch + 1: the promotion must strictly
+            # dominate the lineage we are abandoning, so the deposed
+            # primary's frames become refusable (fencing)
+            raw = self._rpc(alt, OP_PROMOTE, 0,
+                            np.asarray([shard, len(self._tables),
+                                        self._epoch[shard] + 1], np.int64))
         except (RuntimeError, OSError, ConnectionError) as e2:
             record_fault("ps_failover_failed")
             raise RuntimeError(
                 f"shard {shard}: serving rank {dead} unreachable AND "
                 f"backup rank {alt} not promotable ({e2})") from err
+        if len(raw) >= 8:        # the ack names the resulting epoch
+            self._epoch[shard] = max(self._epoch[shard],
+                                     int(np.frombuffer(raw, np.int64,
+                                                       1)[0]))
         self._route[shard] = alt
         self._failed_over.add(shard)
         record_fault("ps_failover_promoted")
@@ -1143,8 +1450,18 @@ class DistributedStore:
         while True:
             try:
                 return self._rpc(target, OP_INIT, tid, keys, payload,
-                                 shard=shard, record=not patient)
-            except RuntimeError:
+                                 shard=shard, record=not patient,
+                                 epoch=self._epoch[shard])
+            except RuntimeError as e:
+                fence = _fence_info(e)
+                if fence is not None:
+                    # the target already belongs to a NEWER lineage (e.g.
+                    # a standby's bring-up mirror-init raced an earlier
+                    # promotion): the replica table exists there — adopt
+                    # the epoch and treat the init as done
+                    if fence[0] > self._epoch[shard]:
+                        self._epoch[shard] = fence[0]
+                    return None
                 if not patient or time.monotonic() >= deadline:
                     raise
                 time.sleep(0.2)
@@ -1188,19 +1505,38 @@ class DistributedStore:
         body = None
         if self.server.replicable:
             body = _HDR.pack(OP_PUSH, table, keys.size, lr, grads.shape[1],
-                             self.rank, next(self._seq), shard) \
+                             self.rank, next(self._seq), shard,
+                             self._epoch[shard]) \
                 + keys.tobytes() + grads.tobytes()
-        self.server._apply_push(shard, self._local_store(shard), table,
-                                keys, grads, lr, body)
+        try:
+            self.server._apply_push(shard, self._local_store(shard), table,
+                                    keys, grads, lr, body)
+        except EpochFenced as e:
+            # our own server just learned it is a deposed lineage (its
+            # op-log forward was epoch-refused) and demoted itself.  The
+            # local apply landed only on the now-demoted, never-again-
+            # promotable copy — resend the op to the surviving lineage,
+            # which never saw it (exactly-once there).
+            self._note_fence(shard, e)
+            self._rpc_shard(shard, OP_PUSH, table, keys,
+                            np.ascontiguousarray(grads).tobytes(), lr,
+                            grads.shape[1])
 
     def _local_set_data(self, shard, table, part):
         body = None
         if self.server.replicable:
             body = _HDR.pack(OP_SET_DATA, table, 0, -1.0, part.shape[1],
-                             self.rank, next(self._seq), shard) \
+                             self.rank, next(self._seq), shard,
+                             self._epoch[shard]) \
                 + part.tobytes()
-        self.server._apply_set_data(shard, self._local_store(shard), table,
-                                    part, body)
+        try:
+            self.server._apply_set_data(shard, self._local_store(shard),
+                                        table, part, body)
+        except EpochFenced as e:
+            self._note_fence(shard, e)       # see _local_push
+            self._rpc_shard(shard, OP_SET_DATA, table,
+                            np.zeros(0, np.int64), part.tobytes(),
+                            width=part.shape[1])
 
     # -- sparse ops (EmbeddingStore API) -----------------------------------
     # Wire-level dedup: a zipf-skewed CTR batch (2048x26 ids) is MOSTLY
@@ -1324,9 +1660,17 @@ class DistributedStore:
                 def local_job(s=s, psel=psel, lsel=lsel):
                     if psel.size:
                         self._local_push(s, table, upk[psel], acc[psel], lr)
-                    if lsel.size:
+                    if not lsel.size:
+                        return
+                    if self.server.serves(s):
                         out[lsel] = self._local_store(s).pull(
                             table, ulk[lsel] // self.world)
+                    else:
+                        # the push's epoch fence just demoted our own
+                        # server: the pull must follow the re-route too
+                        raw = self._rpc_shard(s, OP_PULL, table, ulk[lsel])
+                        out[lsel] = np.frombuffer(raw, np.float32).reshape(
+                            lsel.size, width)
                 jobs.append(local_job)
             elif psel.size:
                 def fused_job(s=s, psel=psel, lsel=lsel):
@@ -1511,7 +1855,8 @@ class DistributedStore:
             else:
                 self._rpc(serving, OP_SYNC, 0,
                           np.asarray([s, target], np.int64),
-                          op_timeout=max(self.rpc_timeout, 600.0))
+                          op_timeout=max(self.rpc_timeout, 600.0),
+                          epoch=self._epoch[s])
             self._failed_over.discard(s)
 
     def re_replicate_async(self, shard=None):
@@ -1565,6 +1910,50 @@ class DistributedStore:
         raw = self._rpc(peer, OP_CHECKSUM, table, np.zeros(0, np.int64),
                         shard=shard)
         return raw.decode()
+
+    def shard_epoch(self, shard, rank=None):
+        """``(epoch, serving)`` of ``shard``'s copy on ``rank`` (default:
+        the rank this client routes the shard to) — the lineage probe
+        behind ``ps_fsck --json`` epochs and the single-surviving-
+        lineage assertion."""
+        peer = self._route[shard] if rank is None else rank
+        if peer == self.rank:
+            return (self.server.epoch(shard), self.server.serves(shard))
+        raw = self._rpc(peer, OP_EPOCH, 0, np.asarray([shard], np.int64))
+        ep, serving = struct.unpack("<qq", raw)
+        return int(ep), bool(serving)
+
+    def liveness_report(self, deadline_ms, n_workers=None):
+        """Classify non-heartbeating ranks as DEAD vs UNREACHABLE.
+
+        ``alive_mask`` (the rank-0 heartbeat table) conflates "the rank
+        died" with "the rank cannot reach rank 0" — under an asymmetric
+        partition those demand opposite reactions (a partitioned rank
+        must be fenced, not respawned over).  For every rank the mask
+        declares dead, this sends ONE cheap direct probe (``OP_EPOCH``,
+        short deadline, counter-silent transport): a rank that answers
+        is recorded as ``unreachable`` (+ the ``ps_unreachable`` fault
+        counter — partition evidence), one that doesn't as ``dead``.
+        The verdict is from THIS client's vantage point: a rank this
+        client also cannot reach stays ``dead`` even if it lives on the
+        far side of a cut."""
+        n = self.world if n_workers is None else int(n_workers)
+        mask = self.alive_mask(deadline_ms, n)
+        report = {"alive": [], "dead": [], "unreachable": []}
+        for r in range(min(n, self.world)):
+            if mask[r]:
+                report["alive"].append(r)
+                continue
+            try:
+                self._rpc(r, OP_EPOCH, 0, np.asarray([r], np.int64),
+                          op_timeout=min(2.0, self.rpc_timeout),
+                          record=False, retries=1)
+            except (RuntimeError, OSError, ConnectionError):
+                report["dead"].append(r)
+            else:
+                report["unreachable"].append(r)
+                record_fault("ps_unreachable")
+        return report
 
     # -- shard persistence (reference per-server SaveParam) ----------------
     # Shard files are named by SHARD, not by rank, and cover every shard

@@ -15,12 +15,20 @@ is the serving half the training executor never had:
 * Read-mostly embedding serving rides
   ``DistCacheTable(read_only=True)`` + PR 4's replicated store: a killed
   shard primary fails over inside the batch's pull with zero restarts.
+* :class:`CellMap` / :class:`CellHead` — geo-replicated serving cells:
+  disjoint rank sets each serving local traffic off the read-only
+  cache, surviving a cross-cell network partition (reads keep flowing,
+  writes are epoch-fenced) and converging via epoch-checked
+  re-replication at heal.
 
 Proven end-to-end by ``bench.py --config serve`` (zipf request stream,
-p50/p99/QPS, chaos primary-kill mid-load with bitwise response parity).
+p50/p99/QPS, chaos primary-kill mid-load with bitwise response parity)
+and ``bench.py --config partition`` (cross-cell partition + heal with
+zero local rejections and post-heal fsck convergence).
 """
+from .cells import CellHead, CellMap
 from .executor import InferenceExecutor, default_buckets
 from .router import ServingRouter, ServeRejected
 
 __all__ = ["InferenceExecutor", "ServingRouter", "ServeRejected",
-           "default_buckets"]
+           "default_buckets", "CellMap", "CellHead"]

@@ -1,0 +1,159 @@
+"""Geo-replicated serving cells (ISSUE 8 tentpole stratum 3).
+
+A *cell* is a rank set that shares a failure domain — a pod, a zone, a
+region.  Real fleets lose the LINK between cells far more often than
+they lose a cell: the deployment this module models keeps every cell
+answering its own traffic through a cross-cell partition and converges
+the write plane when the link heals, riding three existing layers:
+
+* **reads** — each cell serves :class:`~hetu_tpu.serving.InferenceExecutor`
+  traffic through its own :class:`~hetu_tpu.serving.ServingRouter` off a
+  read-only ``DistCacheTable`` (PR 7): warm rows are answered with zero
+  cross-cell frames, so a partition costs cache-miss refreshes, never
+  local availability.  Reads are deliberately unfenced (bounded
+  staleness is the HET contract).
+* **writes** — the fencing epochs of :mod:`hetu_tpu.ps.dist_store`: a
+  cell that promotes a local backup during the partition creates a
+  strictly newer lineage, so when the link heals the stranded ex-primary
+  is refused (``ps_epoch_refused``), demotes itself (``ps_demotions``),
+  and re-replicates — split brain converges to one serving lineage.
+* **chaos** — :meth:`CellMap.partition_spec` emits the
+  ``partition:rankA+...|rankB+...@step<n>[:heal<m>]`` chaos-DSL form for
+  a cross-cell cut, so the whole scenario replays deterministically from
+  one seed (``bench.py --config partition``).
+
+The classes here are thin, deliberately: cells are *names over ranks*
+plus the serving plumbing each cell repeats — the stores, graphs and
+chaos schedule stay with the caller.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .router import ServeRejected
+
+
+class CellMap:
+    """Disjoint, exhaustively tagged rank sets: ``{"west": [0, 1],
+    "east": [2, 3]}``.  Validation is loud — an untagged or doubly
+    tagged rank would silently mis-route a scenario's traffic."""
+
+    def __init__(self, cells):
+        self.cells = {str(name): sorted(int(r) for r in ranks)
+                      for name, ranks in dict(cells).items()}
+        self._cell_of = {}
+        for name, ranks in self.cells.items():
+            if not ranks:
+                raise ValueError(f"cell {name!r} tags no ranks")
+            for r in ranks:
+                if r in self._cell_of:
+                    raise ValueError(
+                        f"rank {r} tagged in both {self._cell_of[r]!r} "
+                        f"and {name!r} — cells must be disjoint")
+                self._cell_of[r] = name
+        self.world = len(self._cell_of)
+        if sorted(self._cell_of) != list(range(self.world)):
+            raise ValueError(
+                f"cells must tag ranks 0..{self.world - 1} exactly once "
+                f"(got {sorted(self._cell_of)})")
+
+    def cell_of(self, rank):
+        """The cell name tagging ``rank``."""
+        return self._cell_of[int(rank)]
+
+    def ranks(self, cell):
+        """The ranks tagged into ``cell``."""
+        return list(self.cells[cell])
+
+    def is_local(self, cell, rank):
+        return self._cell_of.get(int(rank)) == cell
+
+    def partition_spec(self, cell_a, cell_b, step, heal=None):
+        """The chaos-DSL fault for a cross-cell partition:
+        ``partition:rank<a>+...|rank<b>+...@step<n>[:heal<m>]`` — feed it
+        to :class:`~hetu_tpu.chaos.ChaosInjector` (comma-joined with any
+        other faults) and the cut reproduces from the schedule seed."""
+        a = "+".join(f"rank{r}" for r in self.cells[cell_a])
+        b = "+".join(f"rank{r}" for r in self.cells[cell_b])
+        spec = f"partition:{a}|{b}@step{int(step)}"
+        return spec if heal is None else f"{spec}:heal{int(heal)}"
+
+
+class CellHead:
+    """One cell's serving head: the cell-local store client, its
+    read-only embedding cache, and the :class:`ServingRouter` fronting
+    the cell's :class:`InferenceExecutor`.
+
+    Keeps PER-CELL counters (admitted / answered / rejections / errors)
+    so a scenario can assert "the local cell kept serving: rejections=0"
+    without untangling the process-global serving counters shared by
+    every cell in an in-process test."""
+
+    def __init__(self, name, store, router, cache=None):
+        self.name = str(name)
+        self.store = store
+        self.router = router
+        self.cache = cache
+        self.stats = {"admitted": 0, "answered": 0, "rejections": 0,
+                      "errors": 0}
+
+    def warm(self, keys):
+        """Pre-fill the read-only cache with ``keys`` (one batched
+        owner-grouped pull) — a cell warmed over its working set serves
+        it through a partition with zero cross-cell frames."""
+        if self.cache is not None and np.asarray(keys).size:
+            self.cache.lookup(np.asarray(keys, np.int64))
+
+    def serve_wave(self, feeds, timeout=60.0):
+        """Submit every feed dict in ``feeds`` to this cell's router and
+        wait for the answers.  Returns ``(responses, wave_stats)`` where
+        ``responses[i]`` is the request's fetch row list or None (its
+        slot in a rejected/errored wave), and ``wave_stats`` counts this
+        wave's admitted/answered/rejections/errors (also accumulated
+        into :attr:`stats`)."""
+        wave = {"admitted": 0, "answered": 0, "rejections": 0,
+                "errors": 0}
+        futs = []
+        for fd in feeds:
+            try:
+                futs.append(self.router.submit(fd))
+                wave["admitted"] += 1
+            except ServeRejected:
+                futs.append(None)
+                wave["rejections"] += 1
+        responses = [None] * len(feeds)
+        for i, fut in enumerate(futs):
+            if fut is None:
+                continue
+            try:
+                responses[i] = fut.result(timeout=timeout)
+                wave["answered"] += 1
+            except Exception:   # noqa: BLE001 — per-request fate only
+                wave["errors"] += 1
+        for k, v in wave.items():
+            self.stats[k] += v
+        return responses, wave
+
+    def catch_up(self):
+        """Post-heal convergence driver: repair any shard this cell's
+        client failed over (epoch-checked re-replication — the stranded
+        ex-primary demotes and re-syncs) and re-pull whatever cached
+        rows the surviving lineage advanced meanwhile.  Returns
+        ``{"repaired": bool, "refreshed_rows": int}``."""
+        repaired = self.store.maybe_re_replicate() \
+            if getattr(self.store, "replication", 1) >= 2 else False
+        refreshed = 0
+        if self.cache is not None:
+            try:
+                refreshed = self.cache.refresh_stale()
+            except (RuntimeError, OSError, ConnectionError):
+                pass    # best-effort mid-partition: cached rows keep
+                        # serving; the next catch_up retries the sweep
+        return {"repaired": bool(repaired),
+                "refreshed_rows": int(refreshed)}
+
+    def close(self):
+        self.router.close()
+
+
+__all__ = ["CellMap", "CellHead"]

@@ -120,6 +120,117 @@ def test_chaos_role_kills_resolve_serving_and_holding_servers():
             s.close()
 
 
+def test_partition_spec_parses():
+    _, faults = chaos.parse_spec("7:partition:rank0|rank1@step3:heal7")
+    assert faults == [{"kind": "partition", "a": frozenset({0}),
+                       "b": frozenset({1}), "step": 3, "heal": 7}]
+    # multi-rank sides + no heal (a partition that never heals)
+    _, faults = chaos.parse_spec(
+        "7:partition:rank0+rank2|rank1+rank3@step5")
+    assert faults[0]["a"] == frozenset({0, 2})
+    assert faults[0]["b"] == frozenset({1, 3})
+    assert faults[0]["heal"] is None
+    # composes with other fault kinds on one schedule
+    _, faults = chaos.parse_spec(
+        "7:drop=0.1,partition:rank0|rank1@step2:heal4,kill:ps@rank1:step9")
+    assert [f["kind"] for f in faults] == ["drop", "partition", "kill_ps"]
+
+
+def test_partition_spec_errors_are_loud():
+    for bad in ("7:partition:rank0|rank1",           # no @step trigger
+                "7:partition:rank0@step3",           # only one side
+                "7:partition:rank0|rank0@step3",     # overlapping sides
+                "7:partition:rank0+rank1|rank1@step3",
+                "7:partition:|rank1@step3",          # empty side
+                "7:partition:rank0|rank1@step3:heal2",   # heal <= step
+                "7:partition:rank0|rank1@step3:heal3",
+                "7:partition:rankX|rank1@step3",     # bad rank
+                "7:partition:rank0|rank1@stepX",     # bad step
+                "7:partition:rank0|rank1@req3",      # wrong clock
+                "7:partition:rank0|rank1@step3:cure7"):  # bad clause
+        with pytest.raises(chaos.ChaosSpecError, match="partition"):
+            chaos.parse_spec(bad)
+
+
+def test_partition_same_seed_determinism_and_rng_isolation():
+    """A partition consumes NO RNG draw: the probabilistic fault stream
+    of a schedule with a partition is positionally identical to the same
+    schedule without it — before, during, and after the window — so the
+    same seed reproduces the same run either way."""
+    with_p = chaos.ChaosInjector.from_spec(
+        "123:drop=0.3,partition:rank0|rank1@step1:heal3")
+    without = chaos.ChaosInjector.from_spec("123:drop=0.3")
+    assert [with_p.on_send(1, 1, src=0) for _ in range(60)] \
+        == [without.on_send(1, 1, src=0) for _ in range(60)]
+    with_p.on_step(1)
+    during = [with_p.on_send(1, 1, src=0) for _ in range(40)]
+    assert all(a == ("drop", 0.0) for a in during), "cut not absolute"
+    for _ in range(40):
+        without.on_send(1, 1, src=0)     # advance the twin's stream
+    with_p.on_step(3)                    # heal
+    assert [with_p.on_send(1, 1, src=0) for _ in range(60)] \
+        == [without.on_send(1, 1, src=0) for _ in range(60)]
+    # and the whole thing replays bitwise from the same seed
+    a = chaos.ChaosInjector.from_spec(
+        "9:partition:rank0|rank1@step1:heal2")
+    b = chaos.ChaosInjector.from_spec(
+        "9:partition:rank0|rank1@step1:heal2")
+    for inj in (a, b):
+        inj.on_step(1)
+    assert [a.on_send(p % 3, 1, src=0) for p in range(30)] \
+        == [b.on_send(p % 3, 1, src=0) for p in range(30)]
+
+
+def test_partition_heal_clock_isolated_from_kill_clock():
+    """The partition window and the one-shot kill bookkeeping share
+    on_step but nothing else: a kill firing at the cut step neither
+    consumes nor is consumed by the window, healing closes the window
+    without touching kills, and replaying an old step re-fires
+    nothing."""
+    inj = chaos.ChaosInjector.from_spec(
+        "7:partition:rank0|rank1@step2:heal4,kill:ps@rank5:step2")
+    assert inj.on_send(1, 1, src=0) is None      # window not open yet
+    with pytest.warns(RuntimeWarning, match="no registered server"):
+        inj.on_step(2)          # kill fires (loud: no target) + cut opens
+    assert inj.on_send(1, 1, src=0) == ("drop", 0.0)
+    assert inj.on_send(0, 1, src=1) == ("drop", 0.0)   # both directions
+    assert inj.on_send(2, 1, src=0) is None            # outside the cut
+    assert inj.on_send(1, 1) is None           # unknown src never drops
+    inj.on_step(3)
+    assert inj.on_send(1, 1, src=0) == ("drop", 0.0)   # still open
+    inj.on_step(4)                                     # heal
+    assert inj.on_send(1, 1, src=0) is None
+    inj.on_step(2)       # replaying an old step: no re-fire, no re-open
+    assert inj.on_send(1, 1, src=0) is None
+    fc = fault_counts()
+    assert fc.get("partition_frames_dropped", 0) == 3
+    assert fc.get("chaos_kill_target_missing", 0) == 1
+
+
+def test_partition_blocks_then_heals_real_transport():
+    """End to end over the live dist-store transport: once the window
+    opens, every rank0<->rank1 frame drops (the client sees bounded
+    retries then a diagnosable unreachable), and the SAME store works
+    again the moment the window heals — no reconnect ceremony."""
+    s0, s1, tid = _store_pair(_free_ports(2))
+    inj = chaos.ChaosInjector.from_spec(
+        "9:partition:rank0|rank1@step1:heal2")
+    chaos.install(inj)
+    try:
+        key = np.asarray([1], np.int64)              # owned by rank 1
+        before = s0.pull(tid, key)                   # window closed: flows
+        inj.on_step(1)
+        with pytest.raises(RuntimeError, match="unreachable"):
+            s0.pull(tid, key)
+        assert fault_counts().get("partition_frames_dropped", 0) >= 2
+        inj.on_step(2)                               # heal
+        np.testing.assert_array_equal(s0.pull(tid, key), before)
+    finally:
+        chaos.uninstall()
+        s0.close()
+        s1.close()
+
+
 def test_chaos_install_from_env(monkeypatch):
     monkeypatch.setenv("HETU_CHAOS", "9:drop=0.25")
     inj = chaos.install_from_env()

@@ -255,9 +255,11 @@ def test_promotion_window_retry_is_exactly_once():
         stores[1].server.stop()                  # primary dies post-ack
         alt = stores[0]._failover(1)
         assert alt == 2
-        # the retried frame: same seq, promoted backup
+        # the retried frame: same seq, promoted backup, stamped with the
+        # epoch the promotion ack taught the client (what _rpc_shard's
+        # retry does — a stale-epoch retry would be fenced, not deduped)
         stores[0]._rpc(alt, OP_PUSH, tid, keys, grads.tobytes(), 0.1, 8,
-                       shard=1, seq=seq)
+                       shard=1, seq=seq, epoch=stores[0]._epoch[1])
         after = stores[0].pull(tid, np.asarray([1]))[0]
         np.testing.assert_allclose(after, before - 0.1)  # once, not twice
     finally:

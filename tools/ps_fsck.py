@@ -1,4 +1,4 @@
-"""ps_fsck — live replica-divergence checker for the distributed PS.
+"""ps_fsck — live replica-divergence + lineage checker for the PS.
 
 With ``replication=2`` every shard's correctness argument is "the backup
 replayed the primary's op-log, so the copies are bitwise identical" —
@@ -6,22 +6,32 @@ this tool TESTS that claim on a running cluster instead of trusting it.
 For each shard it asks every replica holder (home rank ``s`` and ring
 backup ``(s+1) % world``) for an ``OP_CHECKSUM`` full-state digest — a
 streaming sha256 over the embedding slab, the optimizer moments, and the
-per-row versions (``EmbeddingStore.state_digest``) — and compares.
+per-row versions (``EmbeddingStore.state_digest``) — and compares.  It
+also asks each holder for its ``OP_EPOCH`` (fencing epoch, serving flag)
+and asserts exactly ONE holder serves each shard: after a partition
+heals, two holders both claiming to serve is the split brain the fencing
+protocol exists to converge, and fsck is how a bench or operator proves
+it did.
 
 Usage::
 
     python tools/ps_fsck.py --endpoints 127.0.0.1:5000,127.0.0.1:5001 \
-        --tables 1 [--replication 2] [--verify] [--json]
+        --tables 1 [--replication 2] [--verify] [--retries N] [--json]
 
-``--verify`` exits nonzero on ANY divergence or missing replica, so a CI
-job or an operator cron can gate on it.  A holder that is unreachable or
-answers "holds no copy" is reported per shard; with ``--verify`` that is
-a failure too (redundancy is the thing being checked).
+``--verify`` exits nonzero on any STABLE divergence, missing replica, or
+multi-/zero-lineage shard, so a CI job or an operator cron can gate on
+it.  A holder that is unreachable or answers "holds no copy" is reported
+per shard; with ``--verify`` that is a failure too (redundancy is the
+thing being checked).
 
-Caveat: digests are taken per holder, not under a cluster-wide barrier —
-on a cluster taking live writes a frame can land between the two reads
-and produce a false mismatch.  Quiesce (or re-run: a REAL divergence is
-stable, an in-flight op-log frame is not) before acting on a report.
+Live-cluster caveat + ``--retries``: digests are taken per holder, not
+under a cluster-wide barrier — on a cluster taking live writes a frame
+can land between the two reads and produce a FALSE mismatch.  A real
+divergence is stable; an in-flight op-log frame is not.  ``--retries N``
+re-digests ONLY the diverging (shard, table) pairs up to ``N`` more
+times (brief pause between passes) and keeps a mismatch only if it
+survives every pass — so ``--verify`` stays usable on a cluster that is
+still serving.
 """
 from __future__ import annotations
 
@@ -29,6 +39,7 @@ import argparse
 import json
 import os
 import socket
+import struct
 import sys
 import time
 
@@ -36,27 +47,25 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def checksum(endpoint, shard, table, timeout=10.0):
-    """One OP_CHECKSUM probe: ``("ok", hex_digest)`` or ``("error", why)``.
-
-    Speaks the dist-store frame protocol directly over a throwaway
-    connection — fsck must not need (or perturb) a DistributedStore of
-    its own to audit a cluster."""
-    from hetu_tpu.ps.dist_store import (_HDR, _recv_frame, _send_frame,
-                                        OP_CHECKSUM)
+def _probe(endpoint, op, shard, table=0, keys=b"", timeout=10.0):
+    """One raw request/response against a server — fsck speaks the
+    dist-store frame protocol directly over a throwaway connection so it
+    never needs (or perturbs) a DistributedStore of its own.  Returns
+    ``("ok", payload_bytes)`` or ``("error", why)``."""
+    from hetu_tpu.ps.dist_store import _HDR, _recv_frame, _send_frame
     try:
         s = socket.create_connection(endpoint, timeout=timeout)
     except OSError as e:
         return "error", f"unreachable: {e}"
     try:
         s.settimeout(timeout)
-        hdr = _HDR.pack(OP_CHECKSUM, table, 0, -1.0, 0, -1,
-                        time.time_ns(), shard)
-        _send_frame(s, hdr)
+        hdr = _HDR.pack(op, table, len(keys) // 8, -1.0, 0, -1,
+                        time.time_ns(), shard, 0)
+        _send_frame(s, hdr, keys)
         resp = _recv_frame(s)
         if not resp or resp[:1] == b"\x01":
             return "error", resp[1:].decode(errors="replace")
-        return "ok", resp[1:].decode()
+        return "ok", resp[1:]
     except (OSError, ConnectionError) as e:
         return "error", f"{type(e).__name__}: {e}"
     finally:
@@ -66,40 +75,153 @@ def checksum(endpoint, shard, table, timeout=10.0):
             pass
 
 
-def fsck(endpoints, n_tables, replication=2, timeout=10.0):
-    """Digest every (shard, table) on every replica holder and compare.
+def checksum(endpoint, shard, table, timeout=10.0):
+    """One OP_CHECKSUM probe: ``("ok", hex_digest)`` or ``("error", why)``."""
+    from hetu_tpu.ps.dist_store import OP_CHECKSUM
+    status, val = _probe(endpoint, OP_CHECKSUM, shard, table,
+                         timeout=timeout)
+    return status, val.decode() if status == "ok" else val
+
+
+def shard_epoch(endpoint, shard, timeout=10.0):
+    """One OP_EPOCH probe: ``("ok", (epoch, serving))`` or ``("error",
+    why)`` — which lineage a holder's copy belongs to and whether it
+    still claims to serve it."""
+    from hetu_tpu.ps.dist_store import OP_EPOCH
+    import numpy as np
+    status, val = _probe(endpoint, OP_EPOCH, shard,
+                         keys=np.asarray([shard], np.int64).tobytes(),
+                         timeout=timeout)
+    if status != "ok":
+        return status, val
+    ep, serving = struct.unpack("<qq", val)
+    return "ok", (int(ep), bool(serving))
+
+
+def _digest_cell(endpoints, rank, shard, table, timeout, probe):
+    status, val = probe(endpoints[rank], shard, table, timeout=timeout)
+    return {"status": status, "value": val}
+
+
+def fsck(endpoints, n_tables, replication=2, timeout=10.0, retries=0,
+         retry_wait=0.5, probe=None):
+    """Digest every (shard, table) on every replica holder and compare;
+    probe every holder's fencing epoch and count serving lineages.
 
     ``endpoints``: ``[(host, port)]`` indexed by rank (= home shard).
+    ``retries``: re-digest only still-diverging (shard, table) pairs up
+    to this many extra passes — an in-flight op-log frame clears, a real
+    divergence survives (the report's ``mismatches`` are the stable
+    ones; transients that cleared are counted in ``transient_cleared``).
+    ``probe`` overrides the digest probe (tests inject transients).
     Returns a report dict; ``report["ok"]`` is True iff every shard's
-    copies exist, answer, and agree bitwise."""
+    copies exist, answer, agree bitwise, and exactly one holder serves
+    each shard (a single surviving lineage)."""
+    probe = probe or checksum
     world = len(endpoints)
     holders_of = (lambda s: [s, (s + 1) % world]) if replication >= 2 \
         and world >= 2 else (lambda s: [s])
     report = {"world": world, "replication": replication,
               "tables": n_tables, "shards": {}, "mismatches": [],
-              "errors": []}
+              "errors": [], "epochs": {}, "serving_ranks": {},
+              "lineage_violations": [], "retries_used": 0,
+              "transient_cleared": 0}
+
+    def digest_pair(shard, table):
+        return {rank: _digest_cell(endpoints, rank, shard, table,
+                                   timeout, probe)
+                for rank in holders_of(shard)}
+
+    def diverged(digests):
+        return len({v["value"] for v in digests.values()
+                    if v["status"] == "ok"}) > 1
+
+    def probe_lineage(shard):
+        """Every holder's (epoch, serving) + the sorted serving ranks —
+        exactly one holder may serve a shard (0 is an outage, 2+ a
+        split brain)."""
+        eps = {}
+        for rank in holders_of(shard):
+            status, val = shard_epoch(endpoints[rank], shard,
+                                      timeout=timeout)
+            eps[rank] = {"status": status,
+                         "epoch": val[0] if status == "ok" else None,
+                         "serving": val[1] if status == "ok" else None,
+                         "error": None if status == "ok" else val}
+        serving = sorted(r for r, v in eps.items()
+                         if v["status"] == "ok" and v["serving"])
+        report["epochs"][shard] = eps
+        report["serving_ranks"][shard] = serving
+        return len(serving) != 1
+
+    pending = []                       # (shard, table) pairs to re-check
+    pending_lineage = []               # shards whose lineage looked split
     for shard in range(world):
         per_shard = {}
         for table in range(n_tables):
-            digests = {}
-            for rank in holders_of(shard):
-                status, val = checksum(endpoints[rank], shard, table,
-                                       timeout=timeout)
-                digests[rank] = {"status": status, "value": val}
-                if status != "ok":
-                    report["errors"].append(
-                        {"shard": shard, "table": table, "rank": rank,
-                         "error": val})
-            ok_vals = {v["value"] for v in digests.values()
-                       if v["status"] == "ok"}
-            if len(ok_vals) > 1:
-                report["mismatches"].append(
-                    {"shard": shard, "table": table,
-                     "digests": {r: v["value"] for r, v in digests.items()
-                                 if v["status"] == "ok"}})
+            digests = digest_pair(shard, table)
+            if diverged(digests):
+                pending.append((shard, table))
             per_shard[table] = digests
         report["shards"][shard] = per_shard
-    report["ok"] = not report["mismatches"] and not report["errors"]
+        if probe_lineage(shard):
+            pending_lineage.append(shard)
+
+    # stabilisation passes: only the diverging pairs / split-looking
+    # shards are re-probed, so an in-flight op-log frame or a probe that
+    # landed mid-failover (old primary seen serving an instant before
+    # its demotion) cannot fail --verify — only a STABLE divergence or
+    # split brain survives every pass
+    for _ in range(max(0, retries)):
+        if not pending and not pending_lineage:
+            break
+        report["retries_used"] += 1
+        time.sleep(retry_wait)
+        still = []
+        for shard, table in pending:
+            digests = digest_pair(shard, table)
+            report["shards"][shard][table] = digests
+            if diverged(digests):
+                still.append((shard, table))
+            else:
+                report["transient_cleared"] += 1
+        pending = still
+        still_split = []
+        for shard in pending_lineage:
+            if probe_lineage(shard):
+                still_split.append(shard)
+            else:
+                report["transient_cleared"] += 1
+        pending_lineage = still_split
+
+    for shard, table in pending:
+        digests = report["shards"][shard][table]
+        report["mismatches"].append(
+            {"shard": shard, "table": table,
+             "digests": {r: v["value"] for r, v in digests.items()
+                         if v["status"] == "ok"}})
+    for shard in pending_lineage:
+        eps = report["epochs"][shard]
+        report["lineage_violations"].append(
+            {"shard": shard,
+             "serving_ranks": report["serving_ranks"][shard],
+             "epochs": {r: v["epoch"] for r, v in eps.items()
+                        if v["status"] == "ok"}})
+    for shard, eps in report["epochs"].items():
+        for rank, v in eps.items():
+            if v["status"] != "ok":
+                report["errors"].append(
+                    {"shard": shard, "table": None, "rank": rank,
+                     "error": f"epoch probe: {v['error']}"})
+    for shard, per_shard in report["shards"].items():
+        for table, digests in per_shard.items():
+            for rank, v in digests.items():
+                if v["status"] != "ok":
+                    report["errors"].append(
+                        {"shard": shard, "table": table, "rank": rank,
+                         "error": v["value"]})
+    report["ok"] = not report["mismatches"] and not report["errors"] \
+        and not report["lineage_violations"]
     return report
 
 
@@ -113,7 +235,8 @@ def _parse_endpoints(spec):
 
 def main(argv=None):
     p = argparse.ArgumentParser(
-        prog="ps_fsck", description="PS replica-divergence checker")
+        prog="ps_fsck",
+        description="PS replica-divergence + lineage checker")
     p.add_argument("--endpoints", required=True,
                    help="host:port per rank, comma-separated, rank order")
     p.add_argument("--tables", type=int, default=1,
@@ -121,25 +244,40 @@ def main(argv=None):
     p.add_argument("--replication", type=int, default=2,
                    help="cluster replication factor (default 2)")
     p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--retries", type=int, default=0,
+                   help="re-digest only diverging shards up to N extra "
+                        "passes: an in-flight op-log frame clears, only "
+                        "a STABLE divergence fails --verify")
+    p.add_argument("--retry-wait", type=float, default=0.5,
+                   help="pause between stabilisation passes (seconds)")
     p.add_argument("--verify", action="store_true",
-                   help="exit nonzero on any divergence/missing replica")
+                   help="exit nonzero on any stable divergence, missing "
+                        "replica, or shard without exactly one serving "
+                        "lineage")
     p.add_argument("--json", action="store_true",
-                   help="emit the full report as JSON")
+                   help="emit the full report (incl. per-shard fencing "
+                        "epochs + serving ranks) as JSON")
     args = p.parse_args(argv)
 
     report = fsck(_parse_endpoints(args.endpoints), args.tables,
-                  replication=args.replication, timeout=args.timeout)
+                  replication=args.replication, timeout=args.timeout,
+                  retries=args.retries, retry_wait=args.retry_wait)
     if args.json:
         print(json.dumps(report, indent=2))
     else:
         for m in report["mismatches"]:
             print(f"MISMATCH shard {m['shard']} table {m['table']}: "
                   f"{m['digests']}")
+        for v in report["lineage_violations"]:
+            print(f"LINEAGE shard {v['shard']}: serving ranks "
+                  f"{v['serving_ranks']} (want exactly 1), epochs "
+                  f"{v['epochs']}")
         for e in report["errors"]:
             print(f"ERROR shard {e['shard']} table {e['table']} rank "
                   f"{e['rank']}: {e['error']}")
         print("ok" if report["ok"] else
               f"DIVERGED: {len(report['mismatches'])} mismatch(es), "
+              f"{len(report['lineage_violations'])} lineage violation(s), "
               f"{len(report['errors'])} error(s)")
     if args.verify and not report["ok"]:
         return 1
