@@ -11,6 +11,27 @@ the artifact) and ``workload_hash`` (sha256[:12] of the canonical workload
 JSON).  Artifacts whose own schema already exposes the knobs top-level for
 programmatic consumers (flash_ab's resume check) embed only the hash.
 
+``artifacts/host_overhead.json`` (``bench.py --config overhead`` /
+``tools/host_overhead_bench.py``) records the executor dispatch-path
+evidence: ``raw_jit_us`` (bare trivial-jit dispatch — the floor),
+``step_jit_us`` (the executor's OWN jitted step dispatched bare: the
+program's compute/thunk floor a zero-overhead executor would still
+pay), ``device_feed_us``/``numpy_feed_us``/``pipelined_feed_us``
+(``ex.run`` / ``ex.run_steps(sync=False)`` wall per step),
+``dispatch_overhead_us`` (the executor's per-step host Python, measured
+directly as loop wall minus in-jit time under synchronous dispatch),
+``plan_cache`` (run-plan hit/miss counters over the steady-schema loop)
+and ``async_bitwise_equal`` (sync=False vs sync loss/state parity).
+``overhead_multiple_vs_raw_jit`` = (overhead_pair_raw_us +
+dispatch_overhead_us) / overhead_pair_raw_us, each quantity the MINIMUM
+over many short interleaved rounds (shared-host contention only ever
+inflates a round, so min is the least-noise estimate of each; the raw
+per-round pairs ride in ``overhead_pairs``; a minimum-RATIO pick would
+be floor-seeking) — the ISSUE 9 ≤ 2.0 gate; pre-ISSUE-9 artifacts
+computed
+``device_feed_us / raw_jit_us`` (kept as ``wall_multiple_vs_raw_jit``),
+which folded ``step_jit_us`` into "overhead".
+
 Chaos/robustness artifacts (``chaos``, ``failover``, ``serve``,
 ``partition``) additionally follow a shared convention in ``extra``:
 ``restarts``/``resumes`` (must be 0 for the transparent-recovery

@@ -75,14 +75,10 @@ def _free_ports(n):
 def _sync(outs):
     """Force completion: remote platforms (axon tunnel) do not honor
     block_until_ready/wait, so read one element back to host — training
-    steps chain through the params, so this syncs every dispatched step."""
-    for o in outs:
-        if o is None:
-            continue
-        arr = o.jax() if hasattr(o, "jax") else o
-        if getattr(arr, "ndim", 0):
-            arr = arr.ravel()[0]
-        np.asarray(arr)
+    steps chain through the params, so this syncs every dispatched step.
+    Delegates to the ONE shared discipline in graph.executor."""
+    from hetu_tpu.graph.executor import _sync_outs
+    _sync_outs(outs)
 
 
 def _timed(run_step, steps, warmup):
@@ -325,14 +321,47 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
 
     seq 512 (the flash-gated regime) with a real attention_mask input —
     the kernel's key-mask strip path is the measured path, per the round-3
-    verdict (seq 128 dense never reached the kernel)."""
+    verdict (seq 128 dense never reached the kernel).
+
+    The headline ``step_time_ms`` is the PIPELINED run (ISSUE 9):
+    numpy-ingested feeds double-buffered to the device by
+    ``Executor.run_steps`` + non-blocking (``sync=False``) stepping, at
+    the backend's default compute dtype (bf16 on TPU).  The same-dtype
+    unpipelined loop and (on TPU) the fp32 unpipelined reference ride in
+    ``extra`` so the pipelining and bf16 wins are separable."""
     import jax
+    from hetu_tpu.metrics import reset_run_plan_counts, run_plan_counts
 
     if batch_size is None:
         batch_size = 64 if seq_len >= 512 else 192
     cfg, ex, fd = build_bert_graph(batch_size=batch_size, seq_len=seq_len)
 
-    dt = _timed(lambda i: ex.run("train", feed_dict=fd), steps, warmup)
+    # numpy ingest: the realistic feed path (a dataloader hands the
+    # executor host arrays) — exactly what the feed pipeline overlaps
+    fd_np = {node: np.asarray(v) for node, v in fd.items()}
+
+    dt_unpip = _timed(lambda i: ex.run("train", feed_dict=fd_np),
+                      steps, warmup)
+    reset_run_plan_counts()
+    t0 = time.perf_counter()
+    rs = ex.run_steps(lambda i: fd_np, steps, name="train", sync=False)
+    _sync(rs[-1])
+    dt = (time.perf_counter() - t0) / steps
+    plan_counters = run_plan_counts()
+    if _compute_dtype():
+        # TPU: the fp32 unpipelined reference the ISSUE 9 acceptance
+        # compares against (same batch/seq/environment)
+        _, ex32, fd32 = build_bert_graph(batch_size=batch_size,
+                                         seq_len=seq_len,
+                                         compute_dtype=None)
+        fd32_np = {node: np.asarray(v) for node, v in fd32.items()}
+        dt_fp32 = _timed(lambda i: ex32.run("train", feed_dict=fd32_np),
+                         max(steps // 2, 1), warmup)
+        del ex32, fd32
+    else:
+        # CPU fallback runs f32 either way (XLA-CPU emulates bf16; the
+        # committed torch baselines are f32) — the reference IS dt_unpip
+        dt_fp32 = dt_unpip
     out = ex.run("train", feed_dict=fd)
 
     n_params = _params_count(ex)
@@ -371,6 +400,12 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
             **_provenance({"batch_size": batch_size, "seq_len": seq_len}),
             "mfu": round(mfu, 4),
             "step_time_ms": round(dt * 1e3, 2),
+            "pipelined": True,
+            "step_time_ms_unpipelined": round(dt_unpip * 1e3, 2),
+            "step_time_ms_fp32_unpipelined": round(dt_fp32 * 1e3, 2),
+            "vs_fp32_unpipelined": round(dt_fp32 / max(dt, 1e-9), 3),
+            "run_plan_counters": {k: int(v)
+                                  for k, v in plan_counters.items()},
             "params": n_params, "matmul_params": n_matmul,
             "flops_per_step": flops_per_step,
             "peak_flops": peak, "device_kind": device_kind,
@@ -502,6 +537,292 @@ def bench_zero(dp=4, steps=12, warmup=2, batch_size=8, seq_len=128,
         os.replace(path + ".tmp", path)
     except Exception:
         pass    # the printed result is the bench contract; file is extra
+    return res
+
+
+def bench_overhead(smoke=False, steps=None, write_artifact=None):
+    """ISSUE 9 acceptance: the executor's dispatch-gap evidence.
+
+    One tiny graph (8x8 matmul + SGD — the XLA program is ~free, so
+    per-step wall is dispatch + host Python) measured five ways:
+
+    * ``raw_jit_us`` — dispatching a bare ``jax.jit`` fn (the floor)
+    * ``step_jit_us`` — dispatching the executor's own jitted step
+      directly (the program's floor: forward+backward+update is ~4x the
+      raw program's thunks, so this is what a ZERO-overhead executor
+      would cost)
+    * ``device_feed_us`` / ``numpy_feed_us`` — ``ex.run`` wall per step
+    * ``pipelined_feed_us`` — ``ex.run_steps(..., sync=False)`` wall per
+      step with numpy feeds placed on the background feed pipeline
+    * ``dispatch_overhead_us`` — the executor's per-step host Python
+      measured DIRECTLY: total loop wall minus time inside the jit call
+      (on CPU the loop runs under synchronous dispatch so XLA's compute
+      threads cannot steal the timing core mid-Python-section), minus
+      the instrumentation's own calibrated cost.
+
+    ``overhead_multiple_vs_raw_jit`` = (overhead_pair_raw_us +
+    dispatch_overhead_us) / overhead_pair_raw_us — the executor's host
+    tax expressed against a raw dispatch, each quantity the minimum
+    over short interleaved rounds (the ≤ 2.0 acceptance gate;
+    ``raw_jit_us`` additionally folds in the standalone raw rounds, so
+    recompute the gate from the pair fields).  Earlier artifacts computed
+    ``device_feed_us / raw_jit_us``, which conflated the step program's
+    own compute/thunk floor (now recorded as ``step_jit_us``) with host
+    overhead — once the Python residue is ~1x a raw dispatch, wall time
+    is compute-dominated and the tax must be measured directly.
+
+    CI gates (``--smoke``, tier-1): plan-cache hits >= steps-1 on a
+    steady feed schema, and async (``sync=False``) vs sync stepping
+    bitwise-equal losses + final weights — parity, not wall clock, so
+    CI stays deterministic."""
+    import gc
+    import jax
+    if write_artifact is None:
+        write_artifact = not smoke
+    # synchronous CPU dispatch for the overhead attribution: under async
+    # dispatch XLA-CPU's compute threads contend with the timing thread,
+    # inflating the measured Python sections 2-3x.  MUST land before ANY
+    # backend query — even jax.default_backend() initializes the client,
+    # after which the flag is a silent no-op (a live non-CPU backend
+    # ignores it; the flag is CPU-client-specific).
+    try:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:
+        pass
+    import hetu_tpu as ht
+    from hetu_tpu.metrics import (reset_run_plan_counts, run_plan_counts)
+
+    n = steps or (200 if smoke else 2000)
+    rounds = 2 if smoke else 5
+    pair_rounds = 3 if smoke else 12
+    # the gate pairs use SHORT windows (~50ms): shared-host contention
+    # arrives in bursts, and a short window has far better odds of
+    # landing entirely inside a quiet slice
+    pair_n = min(n, 600)
+
+    def build():
+        x = ht.placeholder_op("x", shape=(8, 8))
+        w = ht.init.zeros(shape=(8, 8), name="w")
+        loss = ht.reduce_mean_op(ht.ops.matmul_op(x, w), [0, 1])
+        opt = ht.optim.SGDOptimizer(0.1)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+        return ex, x
+
+    xv = np.ones((8, 8), np.float32)
+    xd = jax.device_put(xv)
+
+    def loop_us(fn, count=n):
+        t0 = time.perf_counter()
+        for i in range(count):
+            fn(i)
+        return (time.perf_counter() - t0) / count * 1e6
+
+    def best(fn, count=n):
+        return min(loop_us(fn, count) for _ in range(rounds))
+
+    # raw jit floor (re-measured interleaved with the overhead rounds
+    # below — this standalone min feeds the wall ratios)
+    f = jax.jit(lambda a, b: (a @ b).mean())
+    f(xd, xd).block_until_ready()
+    raw = best(lambda i: f(xd, xd))
+
+    # dispatch overhead, measured directly and FIRST (the wall
+    # measurements below leave dead executors / lingering pool threads
+    # behind — the gate pairs deserve the cleanest process state): a
+    # fresh executor whose jit is wrapped BEFORE any plan binds it, so
+    # total - in_jit is exactly the executor's per-step Python
+    # (instrumentation cost calibrated out)
+    ex2, x2 = build()
+    sub2 = ex2.subexecutors["train"]
+    ex2.run("train", feed_dict={x2: xd})
+    real_jit = sub2._jit
+    sync_cpu = jax.default_backend() == "cpu"
+    in_jit = [0.0]
+
+    def timing_jit(*a):
+        t0 = time.perf_counter()
+        out = real_jit(*a)
+        if not sync_cpu:    # async backends: compute must not leak into
+            jax.block_until_ready(out)   # the Python sections
+        in_jit[0] += time.perf_counter() - t0
+        return out
+    sub2._jit = timing_jit
+    sub2._plan_cache = None     # plans must capture the wrapped jit
+    fd2 = {x2: xd}
+
+    def overhead_round(count):
+        in_jit[0] = 0.0
+        t0 = time.perf_counter()
+        for i in range(count):
+            ex2.run("train", feed_dict=fd2)
+        return (time.perf_counter() - t0 - in_jit[0]) / count * 1e6
+    # calibrate the instrumentation's own cost: the timing wrapper adds
+    # a Python frame, *args packing of the 7 step inputs and two
+    # perf_counter reads per call — measured around a no-op with the
+    # SAME call shape, so subtracting it cannot eat real overhead
+    def fake(*a):
+        return None
+    cal_in = [0.0]
+
+    def cal_wrap(*a):
+        t0 = time.perf_counter()
+        fake(*a)
+        cal_in[0] += time.perf_counter() - t0
+        return None
+    cal_args = (0, 1, 2, 3, 4, 5, 6)
+
+    def cal(i):
+        cal_wrap(*cal_args)
+    wrap_cost = min(loop_us(cal, 20000) for _ in range(3))
+    # the gate multiple takes the MINIMUM of each quantity over many
+    # short interleaved rounds: shared-host contention only ever
+    # INFLATES a round, so the min is the least-noise estimate of each
+    # true value (standard microbenchmark practice).  Selecting a
+    # minimum-RATIO pair instead would be floor-seeking (a noise-
+    # inflated raw round makes any overhead look small); the raw pairs
+    # are recorded in the artifact for transparency.
+    overhead_round(pair_n)      # warm: plan + fast lane rebuilt
+    pairs = []
+    for _ in range(pair_rounds):
+        r = loop_us(lambda i: f(xd, xd), pair_n)
+        o = max(0.0, overhead_round(pair_n) - wrap_cost)
+        pairs.append((r, o))
+    raw_best = min(p[0] for p in pairs)
+    overhead = min(p[1] for p in pairs)
+    raw = min(raw, raw_best)
+    multiple = (raw_best + overhead) / max(raw_best, 1e-9)
+    # really free the instrumented executor: sub2/real_jit still point
+    # into it, and the compiled-step cache pins its builder — clear all
+    # three so the wall measurements below run without the extra state
+    from hetu_tpu.graph import step_cache
+    del ex2, fd2, sub2, real_jit
+    step_cache.clear()
+    gc.collect()
+
+    # the executor's own step program, dispatched bare (donated state
+    # threaded back through the loop — the zero-overhead executor)
+    ex, x = build()
+    ex.run("train", feed_dict={x: xd})
+    sub = ex.subexecutors["train"]
+    feeds = {ex._k(x): xd}
+    key, lrs = ex.master_key, sub._host_lrs(0)
+
+    def bare_round(count):
+        tp, sp = sub._pack_state()
+        os_ = {k: ex.opt_states[op] for k, op in sub._opt_items}
+        t0 = time.perf_counter()
+        for i in range(count):
+            outs, tp, upd, os_, _sd = sub._jit(tp, sp, os_, feeds, key,
+                                               np.int32(i), lrs)
+        dt = (time.perf_counter() - t0) / count * 1e6
+        for n_, k_ in sub._writeback_pairs:
+            ex.var_values[n_] = tp[k_]
+        for k_, op in sub._opt_items:
+            ex.opt_states[op] = os_[k_]
+        return dt
+    step_jit = min(bare_round(n) for _ in range(rounds))
+
+    # executor wall: device-committed and numpy feeds
+    fd_dev, fd_np = {x: xd}, {x: xv}
+    reset_run_plan_counts()
+    dev = best(lambda i: ex.run("train", feed_dict=fd_dev))
+    counters_steady = run_plan_counts()
+    npf = best(lambda i: ex.run("train", feed_dict=fd_np))
+
+    # pipelined: numpy feeds placed ahead by the run_steps driver
+    def pipelined_round(count):
+        t0 = time.perf_counter()
+        ex.run_steps(lambda i: {x: xv}, count, name="train", sync=False)
+        return (time.perf_counter() - t0) / count * 1e6
+    pipelined = min(pipelined_round(n) for _ in range(rounds))
+
+    # -- CI gates: plan-cache reuse + async/sync bitwise parity ----------
+    hits = counters_steady.get("plan_cache_hit", 0)
+    plan_reuse_ok = hits >= n - 1
+
+    def losses(sync, nsteps=12):
+        exp, xp = build()
+        out = []
+        if sync:
+            for i in range(nsteps):
+                r = exp.run("train", feed_dict={xp: xv})
+                out.append(np.asarray(r[0].jax(), np.float32))
+        else:
+            rs = exp.run_steps(lambda i: {xp: xv}, nsteps, name="train",
+                               sync=False)
+            out = [np.asarray(r[0].jax(), np.float32) for r in rs]
+        final_w = {k: np.asarray(v) for k, v in
+                   exp.return_tensor_values().items()}
+        del exp
+        gc.collect()
+        return out, final_w
+    s_loss, s_w = losses(sync=True)
+    a_loss, a_w = losses(sync=False)
+    async_bitwise = (
+        [v.tobytes() for v in s_loss] == [v.tobytes() for v in a_loss]
+        and set(s_w) == set(a_w)
+        and all(s_w[k].tobytes() == a_w[k].tobytes() for k in s_w))
+
+    workload = {"graph": "8x8 matmul + SGD", "steps_timed": n}
+    artifact = {
+        "metric": "executor_host_overhead",
+        "unit": "us/step",
+        "backend": jax.default_backend(),
+        "raw_jit_us": round(raw, 1),
+        "step_jit_us": round(step_jit, 1),
+        "device_feed_us": round(dev, 1),
+        "numpy_feed_us": round(npf, 1),
+        "pipelined_feed_us": round(pipelined, 1),
+        "dispatch_overhead_us": round(overhead, 1),
+        "overhead_pair_raw_us": round(raw_best, 1),
+        "overhead_pairs": [[round(r, 1), round(o, 1)] for r, o in pairs],
+        "overhead_multiple_vs_raw_jit": round(multiple, 2),
+        "wall_multiple_vs_raw_jit": round(dev / max(raw, 1e-9), 1),
+        "plan_cache": {k: int(v) for k, v in counters_steady.items()},
+        "async_bitwise_equal": bool(async_bitwise),
+        "schema_note": (
+            "overhead_multiple_vs_raw_jit = (overhead_pair_raw_us + "
+            "dispatch_overhead_us) / overhead_pair_raw_us: the "
+            "executor's per-step host Python (loop wall minus in-jit "
+            "time under synchronous dispatch) over a raw jit dispatch, "
+            "each the MINIMUM over many short interleaved rounds "
+            "(contention only inflates a round, so min is the least-"
+            "noise estimate; the per-round pairs are recorded in "
+            "overhead_pairs — a minimum-RATIO pick would be floor-"
+            "seeking).  Pre-ISSUE-9 artifacts used "
+            "device_feed_us / raw_jit_us, which folded the step "
+            "program's own compute floor (step_jit_us) into "
+            "'overhead'."),
+        **_provenance(workload),
+    }
+    if write_artifact:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts", "host_overhead.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "w") as fh:
+            json.dump(artifact, fh, indent=1, sort_keys=True)
+        os.replace(path + ".tmp", path)
+    res = {
+        "metric": "executor_host_overhead_multiple",
+        "value": round(multiple, 2),
+        "unit": "x",
+        # >1.0 = beats the <=2.0 host-tax acceptance gate
+        "vs_baseline": round(2.0 / max(multiple, 1e-9), 3),
+        "extra": {
+            "baseline_def": "2.0 / overhead_multiple_vs_raw_jit — the "
+                            "ISSUE 9 host-tax gate (>=1.0 passes)",
+            **artifact,
+        },
+    }
+    errors = []
+    if not plan_reuse_ok:
+        errors.append(f"plan cache missed on a steady schema: "
+                      f"{counters_steady}")
+    if not async_bitwise:
+        errors.append("async (sync=False) stepping NOT bitwise-equal "
+                      "to sync stepping")
+    if errors:
+        res["error"] = " | ".join(errors)
     return res
 
 
@@ -812,6 +1133,12 @@ def _child_main(args):
         print(json.dumps(bench_partition(steps=args.steps or 10,
                                          smoke=args.smoke)))
         return
+    if args.config == "overhead":
+        # host-side dispatch-gap microbench: the XLA program is ~free by
+        # construction, so any backend measures the same host tax
+        print(json.dumps(bench_overhead(smoke=args.smoke,
+                                        steps=args.steps)))
+        return
 
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
@@ -894,7 +1221,8 @@ def _error_result(args, msg):
              "partition": ("partition_recovery_ms", "ms"),
              "emb": ("emb_cache_rows_per_sec", "rows/s"),
              "serve": ("serve_qps", "requests/s"),
-             "zero": ("zero_opt_state_shrink_vs_replicated", "x")}
+             "zero": ("zero_opt_state_shrink_vs_replicated", "x"),
+             "overhead": ("executor_host_overhead_multiple", "x")}
     metric, unit = names[args.config]
     return {"metric": metric, "value": 0.0, "unit": unit,
             "vs_baseline": 0.0, "error": msg[-2000:]}
@@ -2324,7 +2652,7 @@ if __name__ == "__main__":
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
                             "chaos", "failover", "emb", "zero", "serve",
-                            "partition"])
+                            "partition", "overhead"])
     p.add_argument("--dp", type=int, default=4,
                    help="zero only: data-parallel mesh size (the child "
                         "forces a CPU host-device mesh of >= this)")
@@ -2351,7 +2679,8 @@ if __name__ == "__main__":
                         "300-request CI config (artifacts/"
                         "serve_smoke.json); partition: the CI-sized "
                         "partition+heal run (artifacts/"
-                        "partition_smoke.json)")
+                        "partition_smoke.json); overhead: the CI parity/"
+                        "plan-cache gate (no artifact write)")
     p.add_argument("--steps", type=int, default=None,
                    help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
                         "the CPU fallback unless given explicitly)")
@@ -2359,7 +2688,7 @@ if __name__ == "__main__":
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
     elif args.config in ("chaos", "failover", "emb", "zero", "serve",
-                         "partition"):
+                         "partition", "overhead"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness

@@ -36,6 +36,14 @@ Rule catalog (see README "Static analysis & graph validation"):
   optimizer subgraphs); a dropout node is a warning (it lowers to
   identity under ``training=False``, but its presence usually means the
   fetch set was lifted from a training head)
+* ``feed-schema-churn`` (warn, RUNTIME) — emitted by the executor's
+  run-plan cache (``graph/run_plan.py``), not a static pass: successive
+  ``run()`` calls keep missing the plan cache because a fed
+  placeholder's shape ping-pongs (an unbucketed ragged batch) — every
+  new schema re-plans the dispatch path AND retraces/compiles a fresh
+  XLA program.  Same diagnostic shape as the static rules (rule name,
+  offending node, creation site, concrete fix: bucket ragged batches,
+  e.g. to the mod-128 buckets the flash kernel entry uses)
 """
 from __future__ import annotations
 

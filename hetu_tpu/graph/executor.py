@@ -33,7 +33,7 @@ import numpy as np
 
 from .node import Op, PlaceholderOp, LowerCtx, topo_sort
 from .gradients import GradientOp
-from ..ndarray import NDArray
+from ..ndarray import NDArray, wrap_device
 
 
 class _ZeroView:
@@ -100,6 +100,52 @@ def _filter_spec(mesh, spec):
     """Drop axes the mesh doesn't have (e.g. 'ep' under pure DP)."""
     from jax.sharding import PartitionSpec
     return PartitionSpec(*[a if a in mesh.axis_names else None for a in spec])
+
+
+#: resolved once on first step (a per-step `from .. import chaos` plus
+#: attribute walk is measurable at microsecond step rates); the injector
+#: itself can still be (un)installed mid-run — only the module ref is
+#: cached, active() is consulted every training step
+_chaos_active_fn = None
+
+
+def _chaos_active():
+    global _chaos_active_fn
+    if _chaos_active_fn is None:
+        from .. import chaos
+        _chaos_active_fn = chaos.active
+    return _chaos_active_fn()
+
+
+def _sync_outs(outs):
+    """Force completion of step outputs via a host read — THE sync
+    discipline (``HetuProfiler._sync`` and bench.py delegate here):
+    remote-tunnel platforms do not honor ``block_until_ready``, and
+    training steps chain through the params, so reading one element
+    back syncs every dispatched step."""
+    for o in outs or ():
+        if o is None:
+            continue
+        arr = o.jax() if hasattr(o, "jax") else o
+        if getattr(arr, "ndim", 0):
+            if not getattr(arr, "size", 1):
+                continue    # size-0 fetch: no element to read back
+            arr = arr.ravel()[0]
+        np.asarray(arr)
+
+
+def _block_one(arr):
+    """Bound the async in-flight window on one array.  Unlike
+    ``_sync_outs`` this must be FREE on an already-complete array (it
+    runs once per step at the window bound — a ``ravel()`` host-read
+    would dispatch a fresh device op every step), so it uses
+    ``block_until_ready`` and falls back to a host read only where
+    that's unavailable.  On remote-tunnel platforms that do not honor
+    block_until_ready the window is advisory, not a hard bound."""
+    try:
+        arr.block_until_ready()
+    except Exception:
+        _sync_outs([arr])
 
 
 def lower_forward(topo, ctx, resolve_leaf, mesh=None, skip=()):
@@ -204,6 +250,51 @@ class SubExecutor:
         self.fetch_depends_feed = [f is not None and deps.get(f, False)
                                    for f in self.fetches]
         self._jit = None
+        # -- dispatch-path precomputation (graph/run_plan.py): everything
+        # below depends only on graph structure + the executor's static
+        # config, so it is resolved once here instead of per step --------
+        self._plan_cache = None     # schema -> RunPlan (built lazily)
+        self._feed_pool = None      # feed-pipeline device_put worker
+        self._empty_lrs_dev = None  # committed (0,) lrs for all-traced
+        ex = executor
+        # traced lr: schedules that are pure functions of the step index
+        # evaluate INSIDE the jitted step; only data-dependent ones stay
+        # per-step host inputs (the `lrs` argument shrinks accordingly)
+        self._opt_items = [(ex._k(op), op) for op in self.opt_ops]
+        self._derive_lr_state()
+        # state packing / writeback pairs: stage-3 ZeRO membership and
+        # _zero_covered are fixed at Executor construction (before any
+        # SubExecutor exists), so the per-step slab/view/plain split is
+        # static
+        self._zero3 = [
+            (op, ex._zero_plans[op]) for op in self.opt_ops
+            if ex._zero_plans.get(op) is not None
+            and ex._zero_plans[op].stage >= 3]
+        slab_nodes = set()
+        self._slab_keys = []
+        for op, plan in self._zero3:
+            self._slab_keys += [b.key for b in plan.buckets]
+            slab_nodes.update(op.params)
+        covered = ex._zero_covered
+        self._t_plain = [(ex._k(n), n) for n in self.trainable_vars
+                         if n not in slab_nodes and n not in covered]
+        self._t_view = [(ex._k(n), n) for n in self.trainable_vars
+                        if n not in slab_nodes and n in covered]
+        self._s_plain = [(ex._k(n), n) for n in self.state_vars
+                         if n not in covered]
+        self._s_view = [(ex._k(n), n) for n in self.state_vars
+                        if n in covered]
+        self._writeback_pairs = [(n, ex._k(n)) for n in self.trainable_vars
+                                 if n not in covered]
+        self._state_pairs = [(n, ex._k(n)) for n in self.state_vars]
+        self._ps_items = [(n, ex._k(n), n.ids_node, ex._k(n.ids_node))
+                          for n in self.ps_nodes]
+        # PS rows are pulled full-batch; executor-level microbatching
+        # splits feeds — statically incompatible (raised per run)
+        self._ps_microbatch_clash = bool(
+            self.ps_nodes and self.grad_ops and ex.pipeline
+            and (ex.num_microbatches or 1) > 1
+            and not self.has_pipeline_block)
 
     # -- lowering ---------------------------------------------------------
 
@@ -228,13 +319,9 @@ class SubExecutor:
 
     def _zero3_plans(self):
         """[(opt_op, plan)] for this subgraph's stage-3 ZeRO optimizers —
-        the ones whose params enter/leave the step as bucket slabs."""
-        out = []
-        for op in self.opt_ops:
-            plan = self.ex._zero_plans.get(op)
-            if plan is not None and plan.stage >= 3:
-                out.append((op, plan))
-        return out
+        the ones whose params enter/leave the step as bucket slabs.
+        Static after construction (precomputed in ``__init__``)."""
+        return self._zero3
 
     def _pack_state(self, materialize=False):
         """Assemble the step's ``(tparams, sparams)`` inputs.
@@ -244,22 +331,27 @@ class SubExecutor:
         used here *without* its optimizer (an eval subgraph sharing the
         weights) is materialized to a full replicated value instead.
         ``materialize=True`` forces full values everywhere (the
-        profiler's forward-only shape evaluation)."""
+        profiler's forward-only shape evaluation).
+
+        The slab/view/plain split is precomputed (``__init__``) — the
+        per-step work is two dict builds over prebound (key, node)
+        pairs, not a per-variable isinstance walk (the dispatch-gap
+        discipline, graph/run_plan.py)."""
         ex = self.ex
-        slabs, slab_nodes = {}, set()
-        if not materialize:
-            for op, plan in self._zero3_plans():
-                for b in plan.buckets:
-                    slabs[b.key] = ex._zero_slabs[b.key]
-                slab_nodes.update(op.params)
-        tparams, sparams = {}, {}
-        for n in self.trainable_vars:
-            if n in slab_nodes:
-                continue
-            tparams[ex._k(n)] = ex._var_value(n)
-        for n in self.state_vars:
-            sparams[ex._k(n)] = ex._var_value(n)
-        tparams.update(slabs)
+        if materialize:
+            tparams = {ex._k(n): ex._var_value(n)
+                       for n in self.trainable_vars}
+            sparams = {ex._k(n): ex._var_value(n) for n in self.state_vars}
+            return tparams, sparams
+        vv = ex.var_values
+        tparams = {k: vv[n] for k, n in self._t_plain}
+        for k, n in self._t_view:
+            tparams[k] = ex._var_value(n)
+        sparams = {k: vv[n] for k, n in self._s_plain}
+        for k, n in self._s_view:
+            sparams[k] = ex._var_value(n)
+        for bk in self._slab_keys:
+            tparams[bk] = ex._zero_slabs[bk]
         return tparams, sparams
 
     def _build_step(self):
@@ -286,10 +378,31 @@ class SubExecutor:
                 return x
             return jax.tree.map(cast, tree)
 
+        # lr resolution: traced schedules evaluate inside the step (a pure
+        # function of step_idx — zero per-step host work, no retrace since
+        # step_idx is a runtime input); data-dependent ones arrive through
+        # the (shrunken) host `lrs` input.  _host_lrs builds that array.
+        lr_traced = self._lr_traced
+        host_slot = {}
+        for i, t in enumerate(lr_traced):
+            if t is None:
+                host_slot[i] = len(host_slot)
+
+        def _resolve_lrs(step_idx, lrs):
+            return [lr_traced[i](step_idx) if lr_traced[i] is not None
+                    else lrs[host_slot[i]]
+                    for i in range(len(lr_traced))]
+
         def step(tparams, sparams, opt_states, feeds, key, step_idx, lrs):
             with _precision_scope():
-                return _step_inner(tparams, sparams, opt_states, feeds,
-                                   key, step_idx, lrs)
+                outs, ntp, upd, nos = _step_inner(
+                    tparams, sparams, opt_states, feeds, key, step_idx,
+                    lrs)
+            # the step counter advances ON DEVICE (step_idx + 1 fed back
+            # by the executor): converting a fresh np.int32 scalar at
+            # every dispatch cost ~2-3us of host time; int32 wraps at
+            # 2^31 steps (the x64-canonicalization note below)
+            return outs, ntp, upd, nos, step_idx + 1
 
         def _step_inner(tparams, sparams, opt_states, feeds, key, step_idx,
                         lrs):
@@ -354,6 +467,7 @@ class SubExecutor:
                         updates["psgrad:" + k] = grads[k]
                 new_tparams = dict(tparams)
                 new_opt_states = dict(opt_states)
+                lr_vals = _resolve_lrs(step_idx, lrs)
                 for i, opt_op in enumerate(self.opt_ops):
                     pk = [self.ex._k(v) for v in opt_op.params]
                     sub_g = {k: grads[k] for k in pk}
@@ -362,7 +476,7 @@ class SubExecutor:
                     if plan is None:
                         sub_p = {k: new_tparams[k] for k in pk}
                         upd, new_opt_states[ok] = opt_op.optimizer.apply(
-                            sub_p, sub_g, opt_states[ok], lrs[i])
+                            sub_p, sub_g, opt_states[ok], lr_vals[i])
                     else:
                         # ZeRO: reduce-scatter the grads, update only this
                         # replica's 1/dp slice of params+moments, gather
@@ -375,7 +489,7 @@ class SubExecutor:
                             src = {k: new_tparams[k] for k in pk}
                         upd, new_opt_states[ok] = _zero.apply_sharded(
                             opt_op.optimizer, plan, src, sub_g,
-                            opt_states[ok], lrs[i], self.ex.mesh)
+                            opt_states[ok], lr_vals[i], self.ex.mesh)
                     new_tparams.update(upd)
                 outs = []
                 for f, a in zip(fetch_nodes, aux_vals):
@@ -495,49 +609,220 @@ class SubExecutor:
 
     # -- run --------------------------------------------------------------
 
-    def run(self, feed_dict, convert_to_numpy_ret_vals=False):
+    def run(self, feed_dict, convert_to_numpy_ret_vals=False, sync=True):
         # the in-step guard defers a SIGTERM/SIGINT emergency save to the
         # step boundary: mid-step, var_values/opt_states are being swapped
         # and a signal-time save could capture a half-updated state
         ex = self.ex
+        if self._lr_objs:
+            self._check_lr_objs()
         ex._in_step = True
         try:
-            out = self._run_impl(feed_dict, convert_to_numpy_ret_vals)
+            out = self._run_impl(feed_dict, convert_to_numpy_ret_vals, sync)
         finally:
             ex._in_step = False
         ex._post_step(self.training)
         return out
 
-    def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False):
-        import jax
+    def _derive_lr_state(self):
+        """Everything derived from each optimizer's CURRENT lr object:
+        the traced-lr closures (constant floats and pure step-indexed
+        schedulers evaluate inside the jitted step), the host ``lrs``
+        input membership (data-dependent schedules), the baked-constant
+        snapshot the per-run mutation check compares against, and the
+        ops whose optimizer/scheduler actually OVERRIDES on_step (the
+        built-ins are no-ops, not worth a per-step method call each).
+        Called from ``__init__`` and again by ``_check_lr_objs`` when a
+        reassignment is detected — ONE derivation, so a rebuilt lr
+        cannot leave part of this state stale."""
+        from ..optim.optimizer import Optimizer, traced_lr_fn
+        from ..optim.lr_scheduler import LRScheduler
+        self._lr_traced = [traced_lr_fn(op.optimizer)
+                           for op in self.opt_ops]
+        self._host_lr_ops = [op for op, t in
+                             zip(self.opt_ops, self._lr_traced)
+                             if t is None]
+        # snapshot of every optimizer's lr OBJECT: a mid-training
+        # `opt.lr = x` reassignment — new float, new scheduler,
+        # scheduler↔float — is detected per run (identity compares on
+        # the dispatch hot path) and honored by rebuilding whatever it
+        # invalidates: a TRACED lr is baked into the compiled step (full
+        # rebuild), and even on the host path a structural change can
+        # move the op between the traced/host sets or bring a live
+        # ``on_step`` (stale ``_sched_ops``).  Same-type host-path
+        # reassignment (float→float under HETU_TRACED_LR=0 — the
+        # mutate-every-step workflow) stays free: the host ``lrs`` input
+        # re-reads the value anyway.  Mutating a live scheduler's ATTRS
+        # in place stays undetected (the lr_scheduler docstring's
+        # contract).
+        self._lr_objs = [(op.optimizer, op.optimizer.lr)
+                         for op in self.opt_ops]
+        self._sched_ops = []
+        for op in self.opt_ops:
+            o = op.optimizer
+            # class-level overrides AND instance-assigned hooks
+            # (`opt.on_step = fn`) both count — the pre-plan executor
+            # dispatched on_step unconditionally every step
+            if type(o).on_step is not Optimizer.on_step \
+                    or "on_step" in o.__dict__ \
+                    or (isinstance(o.lr, LRScheduler)
+                        and (type(o.lr).on_step is not LRScheduler.on_step
+                             or "on_step" in o.lr.__dict__)):
+                self._sched_ops.append(op)
+
+    def _check_lr_objs(self):
+        """Honor a mid-training ``optimizer.lr = x`` reassignment (see
+        the ``_lr_objs`` note above): a traced lr lives inside
+        the compiled step, so the step (and the plans bound to it) is
+        rebuilt against the new value — the compiled-step cache hashes
+        traced lrs, so a revisited value is a cache hit, a fresh one
+        retraces once.  ALL lr state re-derives (``_sched_ops``
+        included: the new lr may be a scheduler with a live
+        ``on_step``).  Identity-first, then: traced + equal value (a
+        re-assigned identical float) changes nothing; host-path + same
+        TYPE (float→float, or same scheduler class — ``host_lr`` reads
+        the live object every step) just refreshes the snapshot."""
+        for i, (opt, old) in enumerate(self._lr_objs):
+            lr = opt.lr
+            if lr is old:
+                continue
+            if self._lr_traced[i] is not None:
+                if lr != old:       # baked value/schedule changed
+                    self._rebuild_lr_state()
+                    return
+            elif type(lr) is not type(old):     # host path: structural
+                self._rebuild_lr_state()
+                return
+            self._lr_objs[i] = (opt, lr)    # benign: refresh snapshot
+
+    def _rebuild_lr_state(self):
+        self._derive_lr_state()
+        self._jit = None            # rebuilt on the next _run_impl
+        self._plan_cache = None     # plans captured the old jit
+
+    def _host_lrs(self, step):
+        """The step's host-side lr input: one float32 per optimizer whose
+        schedule is DATA-dependent (everything else is traced inside the
+        jitted step from ``step_idx`` — graph/run_plan.py).  The all-
+        traced case returns one committed device constant: a fresh numpy
+        array would pay an H2D conversion at every dispatch for an input
+        the program never reads."""
+        if not self._host_lr_ops:
+            lrs = self._empty_lrs_dev
+            if lrs is None:
+                import jax
+                lrs = self._empty_lrs_dev = jax.device_put(
+                    np.zeros((0,), np.float32))
+            return lrs
+        return np.asarray([op.optimizer.host_lr(step)
+                           for op in self._host_lr_ops], np.float32)
+
+    def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False,
+                  sync=True):
         ex = self.ex
-        if getattr(ex, "validate", "off") != "off" and feed_dict:
-            ex._check_feeds(self, feed_dict)
         if self._jit is None:
             self._build_step()
+        # the cached run plan resolves feed keys, placement closures and
+        # the validation verdict ONCE per feed schema (run_plan.py); the
+        # per-step residue is this flat replay
+        cache = self._plan_cache
+        if cache is None:
+            from .run_plan import PlanCache
+            cache = self._plan_cache = PlanCache(self)
+        plan = cache.lookup(feed_dict)
+        if not convert_to_numpy_ret_vals and plan._fast_eligible:
+            fast = plan._fast
+            if fast is None:
+                fast = plan._fast = plan._make_fast()
+            return fast(feed_dict, sync)
+        feeds = plan.place_feeds(feed_dict)
 
+        if self._ps_items:
+            ps_vals = self._resolve_ps_rows(feed_dict, feeds)
+            if self._ps_microbatch_clash:
+                # only the executor-level microbatch path splits feeds;
+                # PS rows are pulled full-batch — mutually exclusive
+                raise NotImplementedError(
+                    "PS embeddings + executor-level pipeline microbatching "
+                    "are mutually exclusive (rows are pulled full-batch)")
+        tparams, sparams = self._pack_state()
+        if self._ps_items:
+            (tparams if self.grad_ops else sparams).update(ps_vals)
+        opt_states = {k: ex.opt_states[op] for k, op in self._opt_items}
+        lrs = self._host_lrs(ex._step_counter)
+
+        # step_idx rides as int32: without jax_enable_x64 an int64 input
+        # is silently canonicalized to int32 anyway, and WITH x64 enabled
+        # an int64 would change the traced dtype (and the jit cache key)
+        # between configurations — fold_in only needs 32 bits.  It is
+        # device-CHAINED: the step returns step_idx+1, fed back next run
+        # (a fresh np scalar per dispatch cost ~2-3us; _step_input falls
+        # back to host after construction/restore).
+        outs, new_tparams, updates, new_opt_states, new_step = self._jit(
+            tparams, sparams, opt_states, feeds, ex.master_key,
+            ex._step_input(), lrs)
+
+        # step N+1's host→device feed copies start NOW, overlapping the
+        # in-flight device work (the double-buffered feed pipeline)
+        plan.start_feed_prefetch()
+
+        if self._ps_items:
+            self._ps_post_step(updates, sync)
+        # stage-3 ZeRO: updated params come back as dp-sharded slabs —
+        # they replace the slab store, never a full per-param array
+        for opt_op, zplan in self._zero3:
+            for b in zplan.buckets:
+                ex._zero_slabs[b.key] = new_tparams[b.key]
+                ex._slab_fetch_cache.pop(b.key, None)
+        # covered params whose optimizer did NOT run here (eval /
+        # grad-only subgraphs sharing stage-3 weights) entered as
+        # transient materializations; writing those back would DETACH
+        # the param from its slab — _writeback_pairs excludes them
+        vv = ex.var_values
+        for n, k in self._writeback_pairs:
+            vv[n] = new_tparams[k]
+        if updates:
+            for n, k in self._state_pairs:
+                if k in updates:
+                    vv[n] = updates[k]
+        for k, op in self._opt_items:
+            ex.opt_states[op] = new_opt_states[k]
+        if self.training:
+            # host and device counters advance together; eval subgraphs
+            # leave both untouched (their new_step is discarded)
+            ex._step_counter += 1
+            ex._step_dev = new_step
+            for op in self._sched_ops:
+                op.optimizer.on_step(ex._step_counter)
+
+        if convert_to_numpy_ret_vals:
+            if not sync:
+                # the numpy conversion IS a sync point: materializing a
+                # fetch waits for its step (per-run, not per-fetch)
+                from ..metrics import record_run_plan
+                record_run_plan("async_sync_points")
+            results = [None if v is None else np.asarray(v) for v in outs]
+        else:
+            results = [None if v is None else wrap_device(v)
+                       for v in outs]
+            if not sync:
+                ex._note_async(outs, new_opt_states)
+        return results
+
+    def _resolve_ps_rows(self, feed_dict, feeds):
+        """PS pulls: resolve the ids batch host-side, pull rows (through
+        the HET cache if configured), feed them as leaf params so jax
+        computes their gradient alongside the model's.  A lookahead
+        prefetch issued at the end of the PREVIOUS run (reference
+        dataloader-lookahead overlap, ParameterServerCommunicate.py:69-77)
+        is consumed here when its ids match — the pull then overlapped
+        the prior step."""
         from ..data.dataloader import DataloaderOp
-        feeds = {}
-        for node in self.feed_nodes:
-            if isinstance(node, DataloaderOp) and node not in feed_dict:
-                val = node.get_arr(self.name)
-            elif node in feed_dict:
-                val = feed_dict[node]
-            else:
-                raise ValueError(f"missing feed for {node}")
-            feeds[ex._k(node)] = ex._place_feed(node, val)
-
-        # PS pulls: resolve the ids batch host-side, pull rows (through the
-        # HET cache if configured), feed them as leaf params so jax computes
-        # their gradient alongside the model's.  A lookahead prefetch issued
-        # at the end of the PREVIOUS run (reference dataloader-lookahead
-        # overlap, ParameterServerCommunicate.py:69-77) is consumed here
-        # when its ids match — the pull then overlapped the prior step.
+        ex = self.ex
         ps_vals = {}
-        for node in self.ps_nodes:
-            idn = node.ids_node
-            if ex._k(idn) in feeds:
-                ids = np.asarray(feeds[ex._k(idn)])
+        for node, key, idn, idk in self._ps_items:
+            if idk in feeds:
+                ids = np.asarray(feeds[idk])
             elif idn in feed_dict:
                 ids = np.asarray(feed_dict[idn])
             elif isinstance(idn, DataloaderOp):
@@ -555,39 +840,25 @@ class SubExecutor:
                     node._last_ids = pre_ids
             if rows is None:
                 rows = node.pull(ids)
-            ps_vals[ex._k(node)] = ex._place_feed(node, rows)
+            ps_vals[key] = ex._place_feed(node, rows)
+        return ps_vals
 
-        tparams, sparams = self._pack_state()
-        if self.ps_nodes:
-            # only the executor-level microbatch path splits feeds; PS rows
-            # are pulled full-batch, so the two are mutually exclusive
-            if self.grad_ops and self.ex.pipeline \
-                    and (self.ex.num_microbatches or 1) > 1 \
-                    and not self.has_pipeline_block:
-                raise NotImplementedError(
-                    "PS embeddings + executor-level pipeline microbatching "
-                    "are mutually exclusive (rows are pulled full-batch)")
-            (tparams if self.grad_ops else sparams).update(ps_vals)
-        opt_states = {ex._k(op): ex.opt_states[op] for op in self.opt_ops}
-        lrs = np.asarray(
-            [op.optimizer.host_lr(ex.step_counter) for op in self.opt_ops],
-            np.float32) if self.opt_ops else np.zeros((0,), np.float32)
-
-        # step_idx rides as int32: without jax_enable_x64 an int64 input
-        # is silently canonicalized to int32 anyway, and WITH x64 enabled
-        # an int64 would change the traced dtype (and the jit cache key)
-        # between configurations — fold_in only needs 32 bits
-        outs, new_tparams, updates, new_opt_states = self._jit(
-            tparams, sparams, opt_states, feeds, ex.master_key,
-            np.int32(ex.step_counter), lrs)
-
+    def _ps_post_step(self, updates, sync=True):
+        """Post-dispatch PS plane: grad push (sync/async by ``bsp``),
+        cross-rank barriers, SSP clock, next-batch row prefetch — the
+        push boundary is where non-blocking stepping is FORCED to sync
+        (the row gradient must be materialized to host to be pushed)."""
+        import jax
+        ex = self.ex
         if ex.bsp == -1 and ex.prefetch:
             # ASP: next-batch pull may overlap the in-flight step AND the
             # async push (bounded-staleness semantics already allow it)
             self._start_ps_prefetch()
+        pushed = False
         for node in self.ps_nodes:
             g = updates.pop("psgrad:" + ex._k(node), None)
             if g is not None:
+                pushed = True
                 # multiprocess: the host fetch may be a cross-process
                 # COLLECTIVE, so every rank runs it BEFORE the one-pusher
                 # gate below.  Single-process keeps the device array —
@@ -609,6 +880,12 @@ class SubExecutor:
                     ex._ps_async_push(node, gv)
                 else:
                     node.push(np.asarray(gv))
+        if pushed and not sync:
+            # the push boundary forces the sync point: the row gradient
+            # is materialized host-side exactly here (BSP inline; ASP on
+            # the worker), which is where async-vs-sync parity is pinned
+            from ..metrics import record_run_plan
+            record_run_plan("async_sync_points")
         if ex._multiprocess and self.ps_nodes and self.training:
             # every rank's NEXT pull must observe this step's push (the
             # reference's _compute_bsp_prefetch barrier) — ranks must
@@ -688,44 +965,6 @@ class SubExecutor:
             # still in flight: np.asarray above only synced the grad) and
             # host-side inter-step time
             self._start_ps_prefetch()
-        # stage-3 ZeRO: updated params come back as dp-sharded slabs —
-        # they replace the slab store, never a full per-param array
-        slab_nodes = set()
-        for opt_op, plan in self._zero3_plans():
-            for b in plan.buckets:
-                ex._zero_slabs[b.key] = new_tparams[b.key]
-                ex._slab_fetch_cache.pop(b.key, None)
-            slab_nodes.update(opt_op.params)
-        for n in self.trainable_vars:
-            if n in slab_nodes or n in ex._zero_covered:
-                # covered params whose optimizer did NOT run here (eval /
-                # grad-only subgraphs sharing stage-3 weights) entered as
-                # transient materializations; writing those back would
-                # DETACH the param from its slab — later steps would keep
-                # updating the slab while var_values served a frozen full
-                # copy to save()/return_tensor_values()
-                continue
-            ex.var_values[n] = new_tparams[ex._k(n)]
-        for n in self.state_vars:
-            k = ex._k(n)
-            if k in updates:
-                ex.var_values[n] = updates[k]
-        for op in self.opt_ops:
-            ex.opt_states[op] = new_opt_states[ex._k(op)]
-        if self.training:
-            ex.step_counter += 1
-            for op in self.opt_ops:
-                op.optimizer.on_step(ex.step_counter)
-
-        results = []
-        for f, v in zip(self.fetches, outs):
-            if v is None:
-                results.append(None)
-            elif convert_to_numpy_ret_vals:
-                results.append(np.asarray(v))
-            else:
-                results.append(NDArray(v))
-        return results
 
     def _host_fetch(self, g):
         """Bring a step output to host memory across process boundaries.
@@ -827,7 +1066,8 @@ class Executor:
         self.timer_logs = {}
         self.seed = 0 if seed is None else int(seed)
         self.master_key = jax.random.key(self.seed)
-        self.step_counter = 0
+        self._step_counter = 0
+        self._step_dev = None   # device-chained int32 step (see run loop)
         self.comm_mode = comm_mode
         # bsp: 0 = synchronous push (BSP, default); -1 = ASP async push;
         # >0 = SSP staleness bound (enforced via ps store ssp_sync by the
@@ -963,10 +1203,46 @@ class Executor:
             else:
                 self.subexecutors[name] = SubExecutor(name, fetches, self)
 
+        # dispatch-path statics: PS presence gates the per-step PS hooks
+        # (re-replication env polling etc.) off the dense hot path, and
+        # the async in-flight window bounds run(sync=False) stepping
+        self._has_ps = any(getattr(se, "ps_nodes", None)
+                           for se in self.subexecutors.values())
+        from collections import deque
+        self._async_pending = deque()
+        try:
+            self._async_window = max(
+                1, int(_os.environ.get("HETU_ASYNC_WINDOW", "4")))
+        except ValueError:
+            self._async_window = 4
+
         self._validate_graphs()
 
         if self._auto_resume and self.auto_save_dir:
             self.resume(self.auto_save_dir)
+
+    # -- step counter ------------------------------------------------------
+
+    @property
+    def step_counter(self):
+        return self._step_counter
+
+    @step_counter.setter
+    def step_counter(self, v):
+        """External assignment (load/resume/user code): the device-
+        chained step scalar is stale now — the next run re-places it
+        from the host value.  The run loops bump ``_step_counter``
+        directly (their device copy advances inside the jitted step)."""
+        self._step_counter = int(v)
+        self._step_dev = None
+
+    def _step_input(self):
+        """The jitted step's ``step_idx`` input: the device scalar the
+        previous step returned (zero host work), or a fresh host int32
+        right after construction / checkpoint restore / external
+        assignment."""
+        sd = self._step_dev
+        return np.int32(self._step_counter) if sd is None else sd
 
     # -- canonical step-input keys ----------------------------------------
 
@@ -1255,7 +1531,20 @@ class Executor:
     # -- public API (reference parity) ------------------------------------
 
     def run(self, name="default", eval_node_list=None, feed_dict=None,
-            convert_to_numpy_ret_vals=False, **kwargs):
+            convert_to_numpy_ret_vals=False, sync=True, **kwargs):
+        """Run one step of subgraph ``name``.
+
+        ``sync=False`` is NON-BLOCKING stepping: the returned fetches are
+        handles backed by jax's async dispatch (``NDArray`` wrappers whose
+        ``.asnumpy()`` materializes on demand) and the executor keeps a
+        bounded window of dispatched steps in flight
+        (``HETU_ASYNC_WINDOW``, default 4) instead of letting the host
+        run arbitrarily far ahead.  Sync points are forced exactly where
+        correctness needs one — ``convert_to_numpy_ret_vals``, the PS
+        push boundary, checkpoint saves, the window filling — and counted
+        (``async_sync_points``).  Async and sync stepping run the SAME
+        jitted program in the same order, so losses and final state are
+        bitwise identical."""
         if isinstance(name, dict):  # run(feed_dict) shorthand
             feed_dict = name
             name = "default"
@@ -1269,16 +1558,131 @@ class Executor:
                           "fixed per subgraph at construction")
         if self.timing:
             # in-training timers (reference timer_subexecutor.py:109 /
-            # Executor(timing=...)); dispatch wall time per subgraph —
-            # per-op timing under fusion comes from HetuProfiler instead
+            # Executor(timing=...)); per-op timing under fusion comes from
+            # HetuProfiler instead.  The timer BLOCKS on the fetches:
+            # dispatch returns before the device finishes, so an
+            # unblocked bracket under-reports real step time — which also
+            # means timing=True measures away the pipelining/async wins
+            # it is asked to time.
             import time
             t0 = time.perf_counter()
             out = self.subexecutors[name].run(feed_dict,
-                                              convert_to_numpy_ret_vals)
+                                              convert_to_numpy_ret_vals,
+                                              sync=sync)
+            _sync_outs(out)
             self.timer_logs.setdefault(name, []).append(
                 (time.perf_counter() - t0) * 1e3)
             return out
-        return self.subexecutors[name].run(feed_dict, convert_to_numpy_ret_vals)
+        return self.subexecutors[name].run(feed_dict,
+                                           convert_to_numpy_ret_vals,
+                                           sync=sync)
+
+    def run_steps(self, feeder, n, name="default", sync=False,
+                  convert_to_numpy_ret_vals=False):
+        """Drive ``n`` steps with pipelined host→device feeds and (by
+        default) non-blocking stepping — the convenience loop around
+        ``run(..., sync=False)``.
+
+        ``feeder``: ``callable(i) -> feed_dict`` (host arrays are fine),
+        a list of feed_dicts, or ``None`` for dataloader-fed graphs
+        (whose feeds the run plan double-buffers on its own).  Step
+        ``i+1``'s feeds are placed on a background thread while step
+        ``i``'s jitted program executes, so the H2D copy overlaps compute
+        (``feeds_pipelined`` counts the overlapped arrays); step 0 is
+        placed inline so the feed schema stays steady from the first
+        step.  Returns the list of per-step fetch lists — handles under
+        ``sync=False`` (materialize with ``.asnumpy()``), bitwise equal
+        to a sync loop."""
+        if not isinstance(n, int) or n < 0:
+            raise ValueError(f"run_steps needs a step count, got {n!r}")
+        if feeder is None:
+            get_fd = None
+        elif callable(feeder):
+            get_fd = feeder
+        else:
+            fds = list(feeder)
+            if len(fds) < n:
+                raise ValueError(
+                    f"run_steps: {n} steps but only {len(fds)} feed dicts")
+            get_fd = fds.__getitem__
+
+        def place_all(fd):
+            return {node: self._place_feed(node, v)
+                    for node, v in fd.items()}
+
+        import time as _time
+        from .run_plan import feed_pipeline_enabled, pipeline_min_us
+        pool = fut = None
+        placed, overlap = {}, False
+        if get_fd and n:
+            import jax
+            # warm the device_put dispatch infra with one scalar so the
+            # timed placement below measures steady-state cost, without
+            # paying a full redundant copy of step 0's batch
+            jax.device_put(np.zeros((), np.float32))
+            # adaptive: only feeds whose placement outweighs a thread
+            # handoff (~60-100us) are double-buffered — pipelining a
+            # 256-byte copy behind a submit/result wakeup would SLOW
+            # the loop.  HETU_FEED_PIPELINE=0 kills the thread entirely
+            # (this driver AND the plan's dataloader double-buffer).
+            t0 = _time.perf_counter()
+            placed = place_all(get_fd(0))
+            overlap = feed_pipeline_enabled() \
+                and (_time.perf_counter() - t0) * 1e6 >= pipeline_min_us()
+        results = []
+        try:
+            for i in range(n):
+                if overlap and i + 1 < n:
+                    if pool is None:
+                        import concurrent.futures
+                        pool = concurrent.futures.ThreadPoolExecutor(
+                            max_workers=1,
+                            thread_name_prefix="run-steps-feed")
+                    fut = pool.submit(place_all, get_fd(i + 1))
+                else:
+                    fut = None
+                results.append(self.run(
+                    name, feed_dict=placed, sync=sync,
+                    convert_to_numpy_ret_vals=convert_to_numpy_ret_vals))
+                if fut is not None:
+                    placed = fut.result()
+                    from ..metrics import record_run_plan
+                    record_run_plan("feeds_pipelined", len(placed))
+                elif get_fd and i + 1 < n:
+                    placed = place_all(get_fd(i + 1))
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False)
+        return results
+
+    def _note_async(self, outs, new_opt_states):
+        """Track one non-blocking step; block on the OLDEST in-flight
+        step once the window fills (bounded pipelining, not unbounded
+        host run-ahead)."""
+        rep = next((o for o in outs if o is not None), None)
+        if rep is None:     # fetch-less step: track a state leaf instead
+            import jax
+            leaves = jax.tree_util.tree_leaves(new_opt_states)
+            rep = leaves[0] if leaves else None
+        if rep is None:
+            return
+        self._async_pending.append(rep)
+        if len(self._async_pending) > self._async_window:
+            from ..metrics import record_run_plan
+            record_run_plan("async_sync_points")
+            _block_one(self._async_pending.popleft())
+
+    def _drain_async(self):
+        """Force every in-flight async step to completion (counted as one
+        sync point when anything was actually in flight) — called by the
+        boundaries whose correctness needs a quiesced device: checkpoint
+        saves and explicit flushes."""
+        if not self._async_pending:
+            return
+        from ..metrics import record_run_plan
+        record_run_plan("async_sync_points")
+        while self._async_pending:
+            _block_one(self._async_pending.popleft())
 
     def logOut(self, path, clear=True):
         """Write recorded step timings (reference Executor.logOut:548)."""
@@ -1305,9 +1709,10 @@ class Executor:
 
         Returns ``(fn, example_args)`` where ``fn(tparams, sparams,
         opt_states, feeds, key, step_idx, lrs)`` is the exact step the
-        executor jits
-        (params update + state side-channel included).  Feeds in the example
-        args are zeros of the dataloader/placeholder shapes.
+        executor jits (params update + state side-channel included; the
+        5th output is ``step_idx + 1`` — the device-chained step
+        counter).  Feeds in the example args are zeros of the
+        dataloader/placeholder shapes.
         """
         import jax
         sub = self.subexecutors[name]
@@ -1329,8 +1734,9 @@ class Executor:
             feeds[self._k(node)] = arr
         tparams, sparams = sub._pack_state()
         opt_states = {self._k(op): self.opt_states[op] for op in sub.opt_ops}
-        lrs = np.asarray([op.optimizer.host_lr(0) for op in sub.opt_ops],
-                         np.float32)
+        # host lrs cover only the data-dependent schedules; traced ones
+        # live inside the step (graph/run_plan.py)
+        lrs = sub._host_lrs(0)
         key = jax.random.key(self.seed)
         if sub._jit is None:
             sub._build_step()
@@ -1414,14 +1820,14 @@ class Executor:
             if self.auto_save_dir and self.auto_save_every > 0 \
                     and self.step_counter % self.auto_save_every == 0:
                 self._auto_save()
-            from .. import chaos as _chaos
-            inj = _chaos.active()
+            inj = _chaos_active()
             if inj is not None:
                 # the injected kill lands AFTER this step's auto-save: a
                 # schedule's `kill:ps@rank<r>:step<s>` is reproducibly
                 # "step s completed, then the server died"
                 inj.on_step(self.step_counter)
-            self._tick_re_replication()
+            if self._has_ps:    # dense graphs skip the PS repair hooks
+                self._tick_re_replication()
         if self._preempt_signum is not None:
             self._handle_preemption()
 
@@ -1672,6 +2078,9 @@ class Executor:
             pp = getattr(se, "_prefetch_pool", None)
             if pp is not None:
                 pp.shutdown(wait=False)
+            fp = getattr(se, "_feed_pool", None)
+            if fp is not None:
+                fp.shutdown(wait=False)
             # embedding caches owned by this graph: flush pending grads
             # and release their resources (CacheSparseTable leaked its
             # per-table ThreadPoolExecutor without this)
@@ -1788,6 +2197,7 @@ class Executor:
         preemption at ANY point leaves either the previous checkpoint at
         ``path`` untouched or a work dir ``resume`` never considers;
         never a half-written checkpoint that validates."""
+        self._drain_async()  # async stepping: quiesce before fetching
         self.ps_flush()  # ASP pushes must land before persisting
         self._flush_ps_caches()  # cache-pending grads too: tables persist
         import json                 # server-side
@@ -1919,6 +2329,7 @@ class Executor:
             raise NotImplementedError(
                 "save_orbax is single-process; multiprocess meshes use "
                 "save() (collective fetch + rank-0 writes)")
+        self._drain_async()
         self.ps_flush()
         self._flush_ps_caches()
         tree = {

@@ -258,7 +258,10 @@ class InterOpSubExecutor:
         self._seg_fns = seg_fns
 
     # ---- execution -------------------------------------------------------
-    def run(self, feed_dict, convert_to_numpy_ret_vals=False):
+    def run(self, feed_dict, convert_to_numpy_ret_vals=False, sync=True):
+        # `sync` accepted for signature parity with SubExecutor.run; the
+        # inter-op segment chain materializes per segment boundary, so
+        # non-blocking stepping has nothing to overlap here
         import jax
         from .executor import NDArray
         ex = self.ex

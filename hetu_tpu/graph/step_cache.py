@@ -138,10 +138,27 @@ def _hash_code(h, code, depth=0):
 
 def _hash_optimizer(h, opt):
     from ..optim.lr_scheduler import LRScheduler
+    from ..optim.optimizer import traced_lr_fn
     _feed(h, "opt", type(opt).__module__, type(opt).__qualname__)
+    # lr: a TRACED schedule (constant float or pure step-indexed
+    # scheduler, graph/run_plan.py) is baked into the compiled program —
+    # hash its full definition, or two executors differing only in lr
+    # would alias one compiled step.  A host-path lr (data-dependent
+    # scheduler, HETU_TRACED_LR=0) rides as a runtime input, never baked.
+    if traced_lr_fn(opt) is not None:
+        sched = opt.lr
+        if isinstance(sched, LRScheduler):
+            _feed(h, "lr-sched", type(sched).__module__,
+                  type(sched).__qualname__)
+            for k in sorted(sched.__dict__):
+                _feed(h, k)
+                _hash_value(h, sched.__dict__[k])
+        else:
+            _feed(h, "lr-const")
+            _hash_value(h, float(sched))
     for k in sorted(opt.__dict__):
         if k == "lr":
-            continue    # lr rides as a runtime input, never baked
+            continue    # handled above (traced) or a runtime input (host)
         v = opt.__dict__[k]
         if isinstance(v, LRScheduler):
             continue    # schedulers only shape host_lr, never the trace
@@ -217,7 +234,11 @@ def signature(sub):
             # contract is "del executor closes them"
             raise _Uncachable("PS-backed subgraph pins host resources")
         import jax
-        _feed(h, "v1", jax.__version__, jax.default_backend(),
+        # v2: traced-lr schedules are part of the program (hashed in
+        # _hash_optimizer); the env gate flips every optimizer between
+        # the traced and host-input paths, so it keys the signature too
+        _feed(h, "v2", os.environ.get("HETU_TRACED_LR", "1"),
+              jax.__version__, jax.default_backend(),
               _mesh_fingerprint(ex.mesh),
               ex.compute_dtype, ex.matmul_precision, ex.remat,
               ex.pipeline, ex.num_microbatches, sub.name, sub.training,

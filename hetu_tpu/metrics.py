@@ -225,6 +225,57 @@ def reset_step_cache_counts():
         _step_cache_counts.clear()
 
 
+# ------------------------------------------------------ run-plan counters
+# The executor's cached run plans (``graph/run_plan.py``) record the
+# dispatch-path behaviour here: ``plan_cache_hit`` / ``plan_cache_miss``
+# count per-step plan lookups (a steady feed schema hits every step after
+# the first — the per-step Python work of resolving feeds, placement
+# closures and validation is amortized to zero; misses climbing across
+# steps mean the feed schema is churning, see the ``feed-schema-churn``
+# warning), ``feeds_pipelined`` counts feed arrays whose host→device
+# transfer was issued ahead of the step that consumed them (the
+# double-buffered feed pipeline: dataloader prefetch + the
+# ``Executor.run_steps`` driver), ``feed_pipeline_depth_hw`` is the
+# high-water count of dataloader feed NODES with an outstanding
+# prefetched transfer — the double-buffer is one step deep per node, so
+# a 3-loader graph tops out at 3 (gauge semantics: the stored value is
+# the MAX ever seen), and ``async_sync_points``
+# counts the places where non-blocking stepping (``run(..., sync=False)``)
+# was FORCED to materialize — a numpy conversion, a PS push boundary, a
+# checkpoint save, or the bounded in-flight window filling up.  Surfaced
+# by ``HetuProfiler.run_plan_counters()`` and ``bench.py --config
+# overhead``.
+
+_run_plan_counts = collections.Counter()
+_run_plan_lock = threading.Lock()
+
+
+def record_run_plan(kind, n=1):
+    """Count ``n`` run-plan/dispatch events of ``kind``; kinds ending in
+    ``_hw`` are high-water gauges (the stored value is the max seen).
+    This recorder runs once per training step on the dispatch hot path
+    — the plain-counter branch is kept deliberately lean."""
+    if kind.__class__ is not str:
+        kind = str(kind)
+    with _run_plan_lock:
+        if not kind.endswith("_hw"):
+            if n:
+                _run_plan_counts[kind] += int(n)
+        elif n > _run_plan_counts[kind]:
+            _run_plan_counts[kind] = int(n)
+
+
+def run_plan_counts():
+    """{kind: count} snapshot of run-plan / async-dispatch counters."""
+    with _run_plan_lock:
+        return dict(_run_plan_counts)
+
+
+def reset_run_plan_counts():
+    with _run_plan_lock:
+        _run_plan_counts.clear()
+
+
 # ------------------------------------------------------- serving counters
 # The online-serving layer (``hetu_tpu.serving``) records its request /
 # batching behaviour here: requests admitted (``serve_requests``) and

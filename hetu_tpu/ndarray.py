@@ -14,6 +14,22 @@ import numpy as np
 
 from .context import DLContext, gpu
 
+#: shared default context: NDArray is constructed per fetch per step on
+#: the executor's dispatch path — a fresh DLContext each wrap is pure
+#: allocation churn (the ctx is descriptive metadata, never mutated)
+_DEFAULT_CTX = gpu(0)
+
+
+def wrap_device(arr):
+    """Fetch-handle constructor for the executor's dispatch path:
+    ``arr`` is ALREADY a device array (a jitted step output), so the
+    ``NDArray.__init__`` isinstance/conversion ladder is pure per-step
+    overhead — this skips straight to the wrapped form."""
+    nd = NDArray.__new__(NDArray)
+    nd._arr = arr
+    nd.ctx = _DEFAULT_CTX
+    return nd
+
 
 class NDArray:
     __slots__ = ("_arr", "ctx")
@@ -25,7 +41,7 @@ class NDArray:
         if not hasattr(arr, "devices"):  # numpy / list → device array
             arr = jnp.asarray(np.asarray(arr))
         self._arr = arr
-        self.ctx = ctx or gpu(0)
+        self.ctx = ctx or _DEFAULT_CTX
 
     @property
     def shape(self):

@@ -1,8 +1,25 @@
 """LR schedulers (reference ``python/hetu/lr_scheduler.py``: FixedScheduler:2,
 StepScheduler:13, MultiStepScheduler:39, ExponentialScheduler:59,
-ReduceOnPlateauScheduler:83).  Schedulers are host-side — the executor feeds
-the scalar lr into the jitted step each call, so schedule changes never
-retrace.
+ReduceOnPlateauScheduler:83).
+
+Two evaluation paths, one schedule definition:
+
+* ``get(step)`` — the host-side value (checkpoint metadata, logging, and
+  the executor's fallback path).
+* ``traced(step)`` — the SAME schedule as a jax expression of the traced
+  ``step_idx`` scalar, evaluated INSIDE the jitted training step.  The
+  executor prefers this path (``graph/run_plan.py``): a pure
+  step-indexed schedule then costs zero per-step Python (no ``get``
+  call, no per-step ``np.asarray(lrs)`` on the hot path) and never
+  retraces — ``step_idx`` is a runtime input.  Schedules whose next
+  value depends on DATA rather than the step index
+  (``ReduceOnPlateauScheduler``'s monitored metric) return ``None`` and
+  stay host-computed per step.  A traced schedule's parameters are baked
+  into the compiled program (and hashed into the compiled-step cache
+  signature); mutate a live schedule only through the data-dependent
+  kind, or disable tracing with ``HETU_TRACED_LR=0``.  Traced math runs
+  in float32 (the step input dtype) — equal to the float64 host value
+  within one f32 ulp.
 """
 from __future__ import annotations
 
@@ -12,6 +29,11 @@ import numpy as np
 class LRScheduler:
     def get(self, step: int) -> float:
         raise NotImplementedError
+
+    def traced(self, step):
+        """jax lr expression of the traced ``step`` scalar, or ``None``
+        when the schedule is data-dependent (host-computed per step)."""
+        return None
 
     def on_step(self, step: int):
         pass
@@ -24,6 +46,10 @@ class FixedScheduler(LRScheduler):
     def get(self, step):
         return self.lr
 
+    def traced(self, step):
+        import jax.numpy as jnp
+        return jnp.float32(self.lr)
+
 
 class StepScheduler(LRScheduler):
     def __init__(self, learning_rate, step_size, gamma=0.1):
@@ -32,6 +58,11 @@ class StepScheduler(LRScheduler):
 
     def get(self, step):
         return self.lr * self.gamma ** (step // self.step_size)
+
+    def traced(self, step):
+        import jax.numpy as jnp
+        k = (step // self.step_size).astype(jnp.float32)
+        return jnp.float32(self.lr) * jnp.float32(self.gamma) ** k
 
 
 class MultiStepScheduler(LRScheduler):
@@ -44,6 +75,12 @@ class MultiStepScheduler(LRScheduler):
         k = int(np.searchsorted(self.milestones, step, side="right"))
         return self.lr * self.gamma ** k
 
+    def traced(self, step):
+        import jax.numpy as jnp
+        ms = jnp.asarray(self.milestones, jnp.int32)
+        k = jnp.searchsorted(ms, step, side="right").astype(jnp.float32)
+        return jnp.float32(self.lr) * jnp.float32(self.gamma) ** k
+
 
 class ExponentialScheduler(LRScheduler):
     def __init__(self, learning_rate, gamma=0.99):
@@ -51,6 +88,11 @@ class ExponentialScheduler(LRScheduler):
 
     def get(self, step):
         return self.lr * self.gamma ** step
+
+    def traced(self, step):
+        import jax.numpy as jnp
+        return jnp.float32(self.lr) \
+            * jnp.float32(self.gamma) ** step.astype(jnp.float32)
 
 
 class ReduceOnPlateauScheduler(LRScheduler):
@@ -109,3 +151,14 @@ class CosineScheduler(LRScheduler):
         p = min(1.0, (step - self.warmup) / max(1, self.total - self.warmup))
         cos = 0.5 * (1 + np.cos(np.pi * p))
         return self.lr * (self.min_ratio + (1 - self.min_ratio) * cos)
+
+    def traced(self, step):
+        import jax.numpy as jnp
+        s = step.astype(jnp.float32)
+        warm = jnp.float32(self.lr) * (s + 1) / self.warmup
+        p = jnp.minimum(1.0, (s - self.warmup)
+                        / max(1, self.total - self.warmup))
+        cos = 0.5 * (1 + jnp.cos(jnp.float32(np.pi) * p))
+        decay = jnp.float32(self.lr) \
+            * (self.min_ratio + (1 - self.min_ratio) * cos)
+        return jnp.where(step < self.warmup, warm, decay)

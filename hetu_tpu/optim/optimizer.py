@@ -71,6 +71,23 @@ class Optimizer:
             return float(self.lr.get(step))
         return float(self.lr)
 
+    # -- traced lr (inside the jitted step) -------------------------------
+    def traced_lr(self, step):
+        """lr as a jax expression of the traced ``step_idx`` scalar, or
+        ``None`` when the schedule is data-dependent (the executor then
+        computes ``host_lr`` per step and feeds it as a runtime input).
+        A constant float lr and every pure step-indexed scheduler trace
+        (the per-step Python call and the ``np.asarray(lrs)`` disappear
+        from the dispatch path — ``graph/run_plan.py``); the traced
+        schedule is baked into the compiled program and hashed into the
+        compiled-step cache signature.  ``HETU_TRACED_LR=0`` forces the
+        host path everywhere (see :func:`traced_lr_enabled`)."""
+        from .lr_scheduler import LRScheduler
+        if isinstance(self.lr, LRScheduler):
+            return self.lr.traced(step)
+        import jax.numpy as jnp
+        return jnp.float32(float(self.lr))
+
     def on_step(self, step):
         from .lr_scheduler import LRScheduler
         if isinstance(self.lr, LRScheduler):
@@ -85,6 +102,34 @@ class Optimizer:
 
     def apply(self, params, grads, state, lr):
         raise NotImplementedError
+
+
+def traced_lr_enabled():
+    """Traced-lr gate: ``HETU_TRACED_LR=0`` forces every optimizer onto
+    the host ``lrs``-input path (parity debugging; the escape hatch for
+    code that mutates a live ``optimizer.lr`` mid-training)."""
+    import os
+    return os.environ.get("HETU_TRACED_LR", "1") != "0"
+
+
+def traced_lr_fn(opt):
+    """``step -> lr`` callable evaluated inside the jitted step, or
+    ``None`` when this optimizer's lr must stay a per-step host input
+    (data-dependent schedule, tracing disabled, or a custom ``traced_lr``
+    that errors).  Probed EAGERLY with a concrete step so the decision —
+    which drives the host ``lrs`` input's shape and the compiled-step
+    cache signature (``graph/step_cache.py`` hashes traced schedules) —
+    is made before any tracing happens."""
+    if not traced_lr_enabled():
+        return None
+    import jax.numpy as jnp
+    try:
+        probe = opt.traced_lr(jnp.int32(0))
+    except Exception:
+        return None
+    if probe is None:
+        return None
+    return opt.traced_lr
 
 
 class SGDOptimizer(Optimizer):

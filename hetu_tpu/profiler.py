@@ -165,18 +165,16 @@ class HetuProfiler:
         ``block_until_ready`` is not honored by remote-tunnel platforms
         (axon), so read one element back to host — consecutive training
         steps form a data-dependent chain through the params, so syncing
-        the last outputs syncs every dispatched step.
+        the last outputs syncs every dispatched step.  The per-leaf read
+        is the ONE shared discipline (``graph.executor._sync_outs``).
         """
         import jax
+        from .graph.executor import _sync_outs
         for o in outs:
             if o is None:
                 continue
             arr = o.jax() if hasattr(o, "jax") else o
-            for leaf in jax.tree.leaves(arr):
-                if getattr(leaf, "ndim", 0):
-                    # device-side gather → 4-byte host read
-                    leaf = leaf.ravel()[0]
-                np.asarray(leaf)
+            _sync_outs(jax.tree.leaves(arr))
 
     def profile_step(self, feed_dict):
         """Fused whole-step wall time (ms) — the number that matters on TPU."""
@@ -199,7 +197,10 @@ class HetuProfiler:
             sub._build_step()
         tparams, sparams, feeds, key, step_idx = self._pack(feed_dict)
         opt_states = {ex._k(op): ex.opt_states[op] for op in sub.opt_ops}
-        lrs = np.zeros((len(sub.opt_ops),), np.float32)
+        # only data-dependent schedules ride the host lrs input (traced
+        # ones live inside the step) — mirror the live calling convention
+        lrs = sub._host_lrs(ex.step_counter) if hasattr(sub, "_host_lrs") \
+            else np.zeros((len(sub.opt_ops),), np.float32)
         # reuse the executor's jitted step — .lower on the same jit object
         # hits jax's compilation cache instead of recompiling
         return sub._jit.lower(tparams, sparams, opt_states, feeds, key,
@@ -276,6 +277,24 @@ class HetuProfiler:
         computed so caching was skipped."""
         from .metrics import step_cache_counts
         return step_cache_counts()
+
+    @staticmethod
+    def run_plan_counters():
+        """{kind: count} of cached-run-plan / async-dispatch events
+        (``hetu_tpu.metrics`` registry): ``plan_cache_hit`` /
+        ``plan_cache_miss`` — per-step plan lookups (a steady feed schema
+        misses once and hits every step after; climbing misses mean the
+        schema churns — see the ``feed-schema-churn`` warning),
+        ``feeds_pipelined`` — feed arrays whose host→device transfer was
+        issued ahead of the consuming step (dataloader double-buffering
+        and the ``Executor.run_steps`` driver), ``feed_pipeline_depth_hw``
+        — high-water count of dataloader feed nodes with an outstanding
+        prefetched transfer (one step deep per node; a max gauge, not a
+        sum), and ``async_sync_points`` — forced materializations on the
+        ``run(..., sync=False)`` path (numpy conversion, PS push
+        boundary, checkpoint save, bounded-window overflow)."""
+        from .metrics import run_plan_counts
+        return run_plan_counts()
 
     @staticmethod
     def serve_counters():

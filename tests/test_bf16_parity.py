@@ -1,0 +1,136 @@
+"""bf16 loss-parity sweep over every example family (ISSUE 9 satellite,
+ROADMAP item 2): a few real training steps at ``compute_dtype='bfloat16'``
+must track the fp32 run within bf16 tolerance, for bert, swin, moe, rnn,
+ctr/wdl-PS and gnn.
+
+Tolerance: bf16 keeps ~8 mantissa bits (~2-3 significant decimal digits
+per op); over a handful of accumulating steps the documented budget is
+**5% relative, 0.05 absolute** on the loss — tight enough to catch a
+dtype-handling bug (casts applied twice, integer feeds rounded, masters
+updated in bf16), loose enough to absorb legitimate rounding.  fp32
+master weights and optimizer state are the executor's contract
+(``compute_dtype`` docstring), so divergence beyond this budget means
+the mixed-precision path is wrong, not "bf16 being bf16".
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import models
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RTOL, ATOL = 5e-2, 5e-2
+STEPS = 3
+
+
+def _bert():
+    from hetu_tpu.models.bert import synthetic_mlm_batch
+    cfg = models.BertConfig.tiny(batch_size=2, seq_len=32)
+    feeds, loss, _ = models.bert_pretrain_graph(cfg)
+    ids, tt, labels, attn = synthetic_mlm_batch(cfg)
+    fd = {feeds["input_ids"]: np.asarray(ids, np.int32),
+          feeds["token_type_ids"]: np.asarray(tt, np.int32),
+          feeds["masked_lm_labels"]: np.asarray(labels, np.int32),
+          feeds["attention_mask"]: np.asarray(attn, np.int32)}
+    opt = ht.optim.AdamOptimizer(1e-3)
+    return loss, opt.minimize(loss), fd
+
+
+def _swin():
+    cfg = models.SwinConfig.tiny(batch_size=2)
+    feeds, loss, _ = models.swin_classify_graph(cfg)
+    imgs, y = models.synthetic_image_batch(cfg)
+    fd = {feeds["images"]: imgs, feeds["labels"]: y}
+    opt = ht.optim.AdamOptimizer(1e-3)
+    return loss, opt.minimize(loss), fd
+
+
+def _moe():
+    from hetu_tpu.layers import Expert, MoELayer, TopKGate
+    x = ht.placeholder_op("x")
+    moe = MoELayer(TopKGate(16, 64, num_experts=4, k=2,
+                            capacity_factor=2.0),
+                   Expert(4, 16, 32))
+    y, aux = moe(x)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(y * y, [1]), [0]) + aux
+    xv = np.random.RandomState(0).randn(64, 16).astype(np.float32)
+    return loss, ht.optim.SGDOptimizer(0.1).minimize(loss), {x: xv}
+
+
+def _rnn():
+    from hetu_tpu.layers import LSTM, Embedding, Linear
+    B, T, V, H = 8, 16, 32, 64
+    ids = ht.placeholder_op("ids")
+    y = ht.placeholder_op("y")
+    seq = LSTM(H, H)(Embedding(V, H, name="emb")(ids))
+    last = ht.slice_op(seq, begin=[0, T - 1, 0], size=[-1, 1, -1])
+    last = ht.array_reshape_op(last, output_shape=(B, H))
+    logits = Linear(H, 4, name="head")(last)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    rng = np.random.RandomState(1)
+    fd = {ids: rng.randint(0, V, (B, T)).astype(np.int32),
+          y: rng.randint(0, 4, (B,)).astype(np.int32)}
+    return loss, ht.optim.AdamOptimizer(1e-3).minimize(loss), fd
+
+
+def _wdl_ps():
+    spec = importlib.util.spec_from_file_location(
+        "ctr_models_bf16", os.path.join(ROOT, "examples", "ctr",
+                                        "models.py"))
+    ctr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ctr)
+    B = 32
+    dense = ht.placeholder_op("dense")
+    sparse = ht.placeholder_op("sparse", dtype=np.int64)
+    y_ = ht.placeholder_op("y")
+    loss, _ = ctr.wdl_criteo(dense, sparse, y_, B, vocab=1000, dim=8,
+                             embed_mode="ps", lr=0.01)[:2]
+    dv, sv, yv = ctr.synthetic_criteo(B, vocab=1000)
+    fd = {dense: dv, sparse: sv, y_: yv}
+    return loss, ht.optim.SGDOptimizer(0.01).minimize(loss), fd
+
+
+def _gnn():
+    from hetu_tpu.gnn import DistGCN15D, normalized_adjacency
+    rng = np.random.RandomState(2)
+    n, f, hidden, classes = 32, 6, 16, 4
+    edges = rng.randint(0, n, (120, 2))
+    vals, rows, cols = normalized_adjacency(edges, n)
+    v, r, c = (ht.placeholder_op(s) for s in "vrc")
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("yg")
+    logits = DistGCN15D(f, hidden, classes, n, axis=None)(v, r, c, x)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(logits, y), [0])
+    fd = {v: vals, r: rows, c: cols,
+          x: rng.randn(n, f).astype(np.float32),
+          y: rng.randint(0, classes, (n,)).astype(np.int32)}
+    return loss, ht.optim.AdamOptimizer(1e-2).minimize(loss), fd
+
+
+FAMILIES = {"bert": _bert, "swin": _swin, "moe": _moe, "rnn": _rnn,
+            "wdl_ps": _wdl_ps, "gnn": _gnn}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+@pytest.mark.timeout(600)
+def test_bf16_loss_parity(family):
+    losses = {}
+    for dtype in (None, "bfloat16"):
+        loss, train, fd = FAMILIES[family]()
+        ex = ht.Executor({"train": [loss, train]}, seed=0,
+                         compute_dtype=dtype)
+        losses[dtype] = [float(ex.run("train", feed_dict=fd)[0].asnumpy())
+                        for _ in range(STEPS)]
+        del ex
+    f32, bf16 = losses[None], losses["bfloat16"]
+    assert all(np.isfinite(f32)) and all(np.isfinite(bf16)), (f32, bf16)
+    np.testing.assert_allclose(
+        bf16, f32, rtol=RTOL, atol=ATOL,
+        err_msg=f"{family}: bf16 loss diverged from fp32 beyond the "
+                f"documented {RTOL:.0%}/{ATOL} budget")

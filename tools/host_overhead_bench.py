@@ -1,27 +1,28 @@
 """Executor host-overhead microbench (reproducible evidence for the
-round-5 dispatch-path work).
+dispatch-gap work: round 5 and the ISSUE 9 cached-run-plan path).
 
-Measures the per-step Python/dispatch cost of ``Executor.run`` on a
-trivially small graph — at this size the XLA program is ~free, so the
-wall time IS the host overhead a real TPU step pays on top of device
-compute.  Three paths:
-
-  raw_jit      dispatching a bare ``jax.jit`` fn (the floor)
-  device_feed  ``ex.run`` with a pre-placed ``jax.Array`` feed (the
-               bench drivers' fast path)
-  numpy_feed   ``ex.run`` with a host numpy feed (pays one H2D copy)
+Delegates to ``bench.bench_overhead`` — ONE definition of the
+measurement (``bench.py --config overhead`` and the tier-1 smoke test
+run the same code).  See that docstring for the measured rows; in
+short: ``raw_jit_us`` (bare jit floor), ``step_jit_us`` (the executor's
+own program dispatched bare — its compute/thunk floor),
+``device_feed_us``/``numpy_feed_us``/``pipelined_feed_us`` (executor
+wall per step), ``dispatch_overhead_us`` (the executor's per-step host
+Python, measured directly as wall minus in-jit time), and
+``overhead_multiple_vs_raw_jit`` = (raw + overhead) / raw — the host
+tax the ISSUE 9 gate holds at <= 2.0.
 
 History (committed artifacts): round-5 start was 634 us/step on the
 device-feed path; moving the per-step RNG fold inside the jitted
 program and short-circuiting device_put on committed feeds brought it
-to ~77 us/step.
+to ~77 us/step; the cached run plans + traced-lr + fast-lane dispatch
+of ISSUE 9 cut the per-step host Python itself to ~1x a raw dispatch.
 
 Writes ``artifacts/host_overhead.json``.
 """
 import json
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -32,54 +33,12 @@ if os.environ.get("_HETU_AUDIT_FORCE_CPU") or "--cpu" in sys.argv:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _timed(fn, n=2000, warmup=30):
-    for _ in range(warmup):
-        fn()
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fn()
-    return (time.perf_counter() - t0) / n
-
-
 def main():
-    import numpy as np
-    import hetu_tpu as ht
-    from artifact_schema import provenance
+    from bench import bench_overhead
 
-    x = ht.placeholder_op("x", shape=(8, 8))
-    w = ht.init.zeros(shape=(8, 8), name="w")
-    loss = ht.reduce_mean_op(ht.ops.matmul_op(x, w), [0, 1])
-    opt = ht.optim.SGDOptimizer(0.1)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
-
-    xv = np.ones((8, 8), np.float32)
-    xd = jax.device_put(xv)
-    dev = _timed(lambda: ex.run("train", feed_dict={x: xd}))
-    npf = _timed(lambda: ex.run("train", feed_dict={x: xv}))
-
-    f = jax.jit(lambda a, b: (a @ b).mean())
-    a = jax.device_put(xv)
-    f(a, a).block_until_ready()
-    raw = _timed(lambda: f(a, a))
-
-    out = {
-        "metric": "executor_host_overhead",
-        "unit": "us/step",
-        "raw_jit_us": round(raw * 1e6, 1),
-        "device_feed_us": round(dev * 1e6, 1),
-        "numpy_feed_us": round(npf * 1e6, 1),
-        "overhead_multiple_vs_raw_jit": round(dev / raw, 1),
-        "backend": jax.default_backend(),
-        **provenance({"graph": "8x8 matmul + SGD", "steps_timed": 2000}),
-    }
-    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
-    path = os.path.join(ROOT, "artifacts", "host_overhead.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(out, fh, indent=1, sort_keys=True)
-    os.replace(tmp, path)
-    print(json.dumps(out))
-    return 0
+    res = bench_overhead(smoke=False, write_artifact=True)
+    print(json.dumps(res["extra"] if "extra" in res else res))
+    return 0 if "error" not in res else 1
 
 
 if __name__ == "__main__":
