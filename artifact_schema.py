@@ -32,6 +32,21 @@ computed
 ``device_feed_us / raw_jit_us`` (kept as ``wall_multiple_vs_raw_jit``),
 which folded ``step_jit_us`` into "overhead".
 
+ISSUE 10 added the telemetry fields: ``host_overhead.json`` records the
+span-tracing tax (``traced_dispatch_overhead_us``, ``trace_overhead_us``
+= traced minus untraced per-step host Python over interleaved toggled
+rounds, ``trace_overhead_pct`` against the untraced dispatch path,
+gated <= ``trace_gate_pct`` 25%); step-timed configs carry
+``step_time_hist_ms`` ({sub: count/mean/p50/p99}) from the obs
+registry's log-bucketed ``step_time_us`` histogram — percentiles, not
+just means; ``--config serve`` adds ``latency_hist_ms`` /
+``chaos_latency_hist_ms`` ({queue_wait, batch} per run) from
+``serve_latency_us``; ``--config trace`` commits
+``artifacts/trace_step.json``, a Chrome/Perfetto trace (the
+``traceEvents`` schema, NOT the provenance schema) of a 5-step wdl-PS
+run with a mid-run primary kill — step spans, per-opcode RPC spans,
+fault point events, serving + feed-pipeline tracks.
+
 Chaos/robustness artifacts (``chaos``, ``failover``, ``serve``,
 ``partition``) additionally follow a shared convention in ``extra``:
 ``restarts``/``resumes`` (must be 0 for the transparent-recovery

@@ -83,16 +83,51 @@ def _sync(outs):
 
 def _timed(run_step, steps, warmup):
     """Shared timing harness: warmup, sync, timed loop, sync → s/step.
-    ONE copy of the remote-platform sync discipline (see _sync)."""
+    ONE copy of the remote-platform sync discipline (see _sync).  The
+    timed loop runs with the per-step wall-time histogram recording
+    (``metrics.step_time_us`` on the obs registry), so every config
+    that uses this harness gets p50/p99 step-time percentiles
+    (``_step_percentiles``) alongside the mean — not just means."""
+    from hetu_tpu import metrics as ht_metrics
     out = None
     for i in range(warmup):
         out = run_step(i)
     _sync(out)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        out = run_step(i)
-    _sync(out)
-    return (time.perf_counter() - t0) / steps
+    prev = ht_metrics.step_timing
+    ht_metrics.reset_step_times()
+    ht_metrics.enable_step_timing(True)
+    try:
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = run_step(i)
+        _sync(out)
+        return (time.perf_counter() - t0) / steps
+    finally:
+        ht_metrics.enable_step_timing(prev)
+
+
+def _step_percentiles():
+    """{sub: {p50_ms, p99_ms, count}} from the step-time histogram the
+    last ``_timed`` loop recorded (obs registry; per-step dispatch wall
+    — under sync=False stepping this measures dispatch, not device
+    completion, same caveat as ``timing=True``)."""
+    from hetu_tpu.metrics import step_time_stats
+    return _hist_ms(step_time_stats())
+
+
+def _hist_ms(snap):
+    """Compress a microsecond histogram snapshot (obs registry) to
+    artifact-friendly ms percentiles: {label: {count, mean_ms, p50_ms,
+    p99_ms}} — empty labels dropped."""
+    out = {}
+    for label, h in (snap or {}).items():
+        if not h.get("count"):
+            continue
+        out[label] = {"count": int(h["count"]),
+                      "mean_ms": round(h["mean"] / 1e3, 3),
+                      "p50_ms": round(h["p50"] / 1e3, 3),
+                      "p99_ms": round(h["p99"] / 1e3, 3)}
+    return out
 
 
 def _params_count(ex):
@@ -343,10 +378,23 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
     dt_unpip = _timed(lambda i: ex.run("train", feed_dict=fd_np),
                       steps, warmup)
     reset_run_plan_counts()
-    t0 = time.perf_counter()
-    rs = ex.run_steps(lambda i: fd_np, steps, name="train", sync=False)
-    _sync(rs[-1])
-    dt = (time.perf_counter() - t0) / steps
+    from hetu_tpu import metrics as ht_metrics
+    ht_metrics.reset_step_times()
+    prev_timing = ht_metrics.step_timing
+    ht_metrics.enable_step_timing(True)
+    try:
+        t0 = time.perf_counter()
+        rs = ex.run_steps(lambda i: fd_np, steps, name="train",
+                          sync=False)
+        _sync(rs[-1])
+        dt = (time.perf_counter() - t0) / steps
+    finally:
+        # restore, don't clobber: HETU_STEP_TIMING=1 processes keep
+        # recording after the bench (the _timed harness's discipline)
+        ht_metrics.enable_step_timing(prev_timing)
+    # per-step dispatch-wall percentiles of the headline (pipelined,
+    # sync=False) loop — the p99 tail the mean hides
+    step_hist = _step_percentiles()
     plan_counters = run_plan_counts()
     if _compute_dtype():
         # TPU: the fp32 unpipelined reference the ISSUE 9 acceptance
@@ -382,6 +430,9 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
     n_dev = len(jax.devices())
     peak, device_kind = _device_peak_flops()
     mfu = flops_per_step / dt / (peak * n_dev)
+    # publish the per-run gauges on the obs registry: metrics_dump()
+    # and tools/metricsd.py expose the same numbers this artifact embeds
+    ht_metrics.record_run_gauges("bert", dt * 1e3, mfu)
     samples_per_sec_chip = batch_size / dt / n_dev
     final_loss = float(np.asarray(out[0].jax() if hasattr(out[0], "jax")
                                   else out[0]))
@@ -400,6 +451,7 @@ def bench_bert(batch_size=None, seq_len=512, steps=20, warmup=3):
             **_provenance({"batch_size": batch_size, "seq_len": seq_len}),
             "mfu": round(mfu, 4),
             "step_time_ms": round(dt * 1e3, 2),
+            "step_time_hist_ms": step_hist,
             "pipelined": True,
             "step_time_ms_unpipelined": round(dt_unpip * 1e3, 2),
             "step_time_ms_fp32_unpipelined": round(dt_fp32 * 1e3, 2),
@@ -540,7 +592,25 @@ def bench_zero(dp=4, steps=12, warmup=2, batch_size=8, seq_len=128,
     return res
 
 
-def bench_overhead(smoke=False, steps=None, write_artifact=None):
+def bench_overhead(smoke=False, steps=None, write_artifact=None,
+                   gate_only=False):
+    """See :func:`_bench_overhead_impl` — this wrapper only guarantees
+    the process-global telemetry toggles (span tracing, step timing)
+    are restored even when a measurement raises: the bench runs
+    in-process under pytest, and leaking an inverted HETU_TRACE state
+    into later tests would silently distort them."""
+    from hetu_tpu import metrics as ht_metrics, obs
+    prev_trace = obs.enabled()
+    prev_step_timing = ht_metrics.step_timing
+    try:
+        return _bench_overhead_impl(smoke, steps, write_artifact,
+                                    gate_only)
+    finally:
+        obs.enable(prev_trace)
+        ht_metrics.enable_step_timing(prev_step_timing)
+
+
+def _bench_overhead_impl(smoke, steps, write_artifact, gate_only):
     """ISSUE 9 acceptance: the executor's dispatch-gap evidence.
 
     One tiny graph (8x8 matmul + SGD — the XLA program is ~free, so
@@ -574,7 +644,11 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
     CI gates (``--smoke``, tier-1): plan-cache hits >= steps-1 on a
     steady feed schema, and async (``sync=False``) vs sync stepping
     bitwise-equal losses + final weights — parity, not wall clock, so
-    CI stays deterministic."""
+    CI stays deterministic.  ``gate_only`` measures ONLY the gate
+    quantities (raw-jit floor, interleaved overhead pairs, the ISSUE 10
+    tracing-tax pairs) and skips the wall/step-jit/parity measurements
+    — the tier-1 subprocess guard's budget-friendly mode (parity is
+    covered in-process by ``test_overhead_bench_smoke``)."""
     import gc
     import jax
     if write_artifact is None:
@@ -590,11 +664,22 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
     except Exception:
         pass
     import hetu_tpu as ht
+    from hetu_tpu import metrics as ht_metrics, obs
     from hetu_tpu.metrics import (reset_run_plan_counts, run_plan_counts)
+
+    # the untraced gate must measure the HETU_TRACE=0 path even when the
+    # surrounding process (a test, an inherited env) enabled telemetry —
+    # the bench_overhead wrapper restores both toggles on every exit;
+    # the traced rounds below flip tracing explicitly
+    obs.enable(False)
+    ht_metrics.enable_step_timing(False)
 
     n = steps or (200 if smoke else 2000)
     rounds = 2 if smoke else 5
-    pair_rounds = 3 if smoke else 12
+    # smoke pays 6 short pair rounds (not 3): the min-of-rounds gate
+    # quantities (incl. the ISSUE 10 tracing-tax pairs) want more draws
+    # on a noisy CI box, and a round is ~5ms
+    pair_rounds = 6 if smoke else 12
     # the gate pairs use SHORT windows (~50ms): shared-host contention
     # arrives in bursts, and a short window has far better odds of
     # landing entirely inside a quiet slice
@@ -632,6 +717,7 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
     # fresh executor whose jit is wrapped BEFORE any plan binds it, so
     # total - in_jit is exactly the executor's per-step Python
     # (instrumentation cost calibrated out)
+    reset_run_plan_counts()
     ex2, x2 = build()
     sub2 = ex2.subexecutors["train"]
     ex2.run("train", feed_dict={x2: xd})
@@ -691,13 +777,69 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
     overhead = min(p[1] for p in pairs)
     raw = min(raw, raw_best)
     multiple = (raw_best + overhead) / max(raw_best, 1e-9)
+
+    # the tracing tax (ISSUE 10 acceptance): the SAME instrumented
+    # executor and interleaved-min discipline, with the obs span tracer
+    # toggled per round — a traced step pays the ring-buffer spans (step
+    # span + plan-lookup + feeds/dispatch stamps) on every dispatch.
+    # Gate: the added host Python must stay <= 25% of the UNTRACED
+    # dispatch path (raw dispatch + untraced overhead).
+    trace_pairs = []
+    for _ in range(pair_rounds):
+        u = max(0.0, overhead_round(pair_n) - wrap_cost)
+        obs.enable(True)
+        t = max(0.0, overhead_round(pair_n) - wrap_cost)
+        obs.enable(False)
+        trace_pairs.append((u, t))
+    obs.clear_trace()
+    untraced_best = min(p[0] for p in trace_pairs)
+    traced_best = min(p[1] for p in trace_pairs)
+    trace_overhead_us = max(0.0, traced_best - untraced_best)
+    trace_overhead_pct = trace_overhead_us \
+        / max(raw_best + untraced_best, 1e-9) * 100.0
     # really free the instrumented executor: sub2/real_jit still point
     # into it, and the compiled-step cache pins its builder — clear all
     # three so the wall measurements below run without the extra state
     from hetu_tpu.graph import step_cache
+    gate_counters = run_plan_counts()
     del ex2, fd2, sub2, real_jit
     step_cache.clear()
     gc.collect()
+
+    if gate_only:
+        # tier-1 guard mode: the gate quantities only — no wall /
+        # step-jit / parity measurements (those cost two more executor
+        # builds and are covered in-process by the run-plan smoke test)
+        res = {
+            "metric": "executor_host_overhead_multiple",
+            "value": round(multiple, 2),
+            "unit": "x",
+            "vs_baseline": round(2.0 / max(multiple, 1e-9), 3),
+            "extra": {
+                "gate_only": True,
+                "backend": jax.default_backend(),
+                "raw_jit_us": round(raw, 1),
+                "dispatch_overhead_us": round(overhead, 1),
+                "overhead_pair_raw_us": round(raw_best, 1),
+                "overhead_pairs": [[round(r, 1), round(o, 1)]
+                                   for r, o in pairs],
+                "overhead_multiple_vs_raw_jit": round(multiple, 2),
+                "traced_dispatch_overhead_us": round(traced_best, 1),
+                "trace_overhead_us": round(trace_overhead_us, 1),
+                "trace_overhead_pct": round(trace_overhead_pct, 1),
+                "trace_gate_pct": 25.0,
+                "trace_pairs": [[round(u, 1), round(t, 1)]
+                                for u, t in trace_pairs],
+                "plan_cache": {k: int(v)
+                               for k, v in gate_counters.items()},
+            },
+        }
+        if trace_overhead_pct > 25.0:
+            res["error"] = (
+                f"HETU_TRACE=1 span tracing costs "
+                f"{trace_overhead_pct:.1f}% of the untraced dispatch "
+                f"path (gate: 25%)")
+        return res
 
     # the executor's own step program, dispatched bare (donated state
     # threaded back through the loop — the zero-overhead executor)
@@ -777,6 +919,14 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
         "overhead_pair_raw_us": round(raw_best, 1),
         "overhead_pairs": [[round(r, 1), round(o, 1)] for r, o in pairs],
         "overhead_multiple_vs_raw_jit": round(multiple, 2),
+        # ISSUE 10: per-step span-tracing tax (HETU_TRACE=1) against the
+        # untraced dispatch path, min over interleaved toggled rounds
+        "traced_dispatch_overhead_us": round(traced_best, 1),
+        "trace_overhead_us": round(trace_overhead_us, 1),
+        "trace_overhead_pct": round(trace_overhead_pct, 1),
+        "trace_gate_pct": 25.0,
+        "trace_pairs": [[round(u, 1), round(t, 1)]
+                        for u, t in trace_pairs],
         "wall_multiple_vs_raw_jit": round(dev / max(raw, 1e-9), 1),
         "plan_cache": {k: int(v) for k, v in counters_steady.items()},
         "async_bitwise_equal": bool(async_bitwise),
@@ -821,6 +971,10 @@ def bench_overhead(smoke=False, steps=None, write_artifact=None):
     if not async_bitwise:
         errors.append("async (sync=False) stepping NOT bitwise-equal "
                       "to sync stepping")
+    if trace_overhead_pct > 25.0:
+        errors.append(
+            f"HETU_TRACE=1 span tracing costs {trace_overhead_pct:.1f}% "
+            f"of the untraced dispatch path (gate: 25%)")
     if errors:
         res["error"] = " | ".join(errors)
     return res
@@ -844,6 +998,7 @@ def bench_resnet18(batch_size=128, steps=20, warmup=3):
                                   "unavailable: no committed same-workload "
                                   "torch baseline",
                   **_provenance({"batch_size": batch_size}),
+                  "step_time_hist_ms": _step_percentiles(),
                   "compute_dtype": _compute_dtype() or "float32",
                   "backend": jax.default_backend()},
     }
@@ -1139,6 +1294,13 @@ def _child_main(args):
         print(json.dumps(bench_overhead(smoke=args.smoke,
                                         steps=args.steps)))
         return
+    if args.config == "trace":
+        # host-side telemetry demo: chaos failover + serving + feed
+        # pipeline captured in one Chrome trace (ISSUE 10)
+        print(json.dumps(bench_trace(steps=args.steps or 5,
+                                     smoke=args.smoke,
+                                     write_artifact=True)))
+        return
 
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
@@ -1222,7 +1384,8 @@ def _error_result(args, msg):
              "emb": ("emb_cache_rows_per_sec", "rows/s"),
              "serve": ("serve_qps", "requests/s"),
              "zero": ("zero_opt_state_shrink_vs_replicated", "x"),
-             "overhead": ("executor_host_overhead_multiple", "x")}
+             "overhead": ("executor_host_overhead_multiple", "x"),
+             "trace": ("trace_step_events", "events")}
     metric, unit = names[args.config]
     return {"metric": metric, "value": 0.0, "unit": unit,
             "vs_baseline": 0.0, "error": msg[-2000:]}
@@ -1563,6 +1726,7 @@ def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
                   "cache_hit_rate": round(cache_perf["hit_rate"], 4)
                   if "hit_rate" in cache_perf else None,
                   "step_time_ms": round(dt * 1e3, 2),
+                  "step_time_hist_ms": _step_percentiles(),
                   "backend": jax.default_backend()},
     }
 
@@ -1693,6 +1857,7 @@ def bench_moe(batch_tokens=8192, steps=20, warmup=3):
                   **_provenance({"tokens": batch_tokens}),
                   "experts": experts,
                   "step_time_ms": round(dt * 1e3, 2),
+                  "step_time_hist_ms": _step_percentiles(),
                   "compute_dtype": _compute_dtype() or "float32",
                   "backend": jax.default_backend()},
     }
@@ -2058,7 +2223,8 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     import hetu_tpu as ht
     from hetu_tpu import chaos as chaos_mod
     from hetu_tpu.metrics import (fault_counts, reset_faults,
-                                  reset_serve_counts, serve_counts)
+                                  reset_serve_counts, serve_counts,
+                                  serve_latency_stats)
     from hetu_tpu.ps.dist_store import DistCacheTable, DistributedStore
     from hetu_tpu.serving import InferenceExecutor, ServingRouter
 
@@ -2177,7 +2343,7 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
             finally:
                 router.close()
             return (responses, lat_ms, wave_ms, wave_failover,
-                    serve_counts())
+                    serve_counts(), serve_latency_stats())
         finally:
             for s in stores:
                 try:
@@ -2188,7 +2354,7 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     try:
         # --- clean run: zero fault counters, the parity oracle -----------
         reset_faults()
-        base_resp, base_lat, base_wave_ms, _, base_serve = \
+        base_resp, base_lat, base_wave_ms, _, base_serve, base_hist = \
             run_stream("clean")
         clean_counters = fault_counts()
 
@@ -2199,7 +2365,7 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
             chaos_mod.ChaosInjector.from_spec(schedule))
         t0 = time.monotonic()
         try:
-            resp, lat, wave_ms, wave_failover, serve_ctrs = \
+            resp, lat, wave_ms, wave_failover, serve_ctrs, chaos_hist = \
                 run_stream("chaos")
         finally:
             chaos_mod.install(prev)
@@ -2254,6 +2420,13 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
             "chaos_p50_ms": round(pct(lat, 50), 2),
             "chaos_p99_ms": round(pct(lat, 99), 2),
             "chaos_qps": round(qps, 1),
+            # queue-wait / batch-latency distributions from the obs
+            # registry's log-bucketed histograms (ISSUE 10): the
+            # router's contribution to tail latency vs the device
+            # call's, separable per run — means alone could not tell a
+            # p99 spike from a shifted mean
+            "latency_hist_ms": _hist_ms(base_hist),
+            "chaos_latency_hist_ms": _hist_ms(chaos_hist),
             "rejections": int(serve_ctrs.get("serve_rejections", 0)),
             "failover_recovery_ms": round(recovery_ms, 1),
             "recovery_bound_ms": bound_ms,
@@ -2265,6 +2438,241 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
             "fault_counters": counters,
             "clean_run_counters": clean_counters,
             "total_wall_ms": round(total_ms, 1),
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+def bench_trace(steps=5, kill_step=2, smoke=True, write_artifact=None):
+    """ISSUE 10 demo: one unified telemetry trace of the framework's
+    signature behaviours — ``artifacts/trace_step.json``.
+
+    A 5-step wdl-style PS training run (3-rank ``replication=2``
+    cluster, Adam through a PS embedding) executes under a
+    ``kill:primary@shard1:step<k>`` chaos schedule with ``HETU_TRACE=1``
+    live: the kill lands in step k's post-step hook, so the NEXT step's
+    pull absorbs the failover — its ``fault:ps_rpc_retry`` /
+    ``fault:ps_failover*`` point events appear INSIDE that step's span,
+    between its per-opcode ``rpc:OP_*`` spans.  The run is driven by
+    ``Executor.run_steps(sync=False)`` with the feed pipeline forced on
+    (``HETU_FEED_PIPELINE_MIN_US=0``) so the background H2D copies show
+    up as a named ``run-steps-feed`` track and the non-blocking window
+    as flow arrows; a small serving burst through
+    ``InferenceExecutor``/``ServingRouter`` adds the serve-router track
+    (enqueue -> assemble -> device call -> scatter).  Losses stay
+    BITWISE equal to an untraced clean run — telemetry and failover are
+    both transparent.  The exported Chrome JSON loads directly in
+    Perfetto; the step-time histogram and the MFU gauge (inferred-shape
+    FLOPs over measured step time) land on the metrics registry and
+    ride in ``extra``."""
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod, obs
+    from hetu_tpu import metrics as ht_metrics
+    from hetu_tpu.metrics import fault_counts, reset_faults
+    from hetu_tpu.ps.dist_store import DistributedStore
+    from hetu_tpu.serving import InferenceExecutor, ServingRouter
+
+    if write_artifact is None:
+        write_artifact = not smoke
+    world, rows, width = 3, 48, 8
+    rpc_timeout = 5.0
+    assert 0 < kill_step < steps - 1, "the failover needs a later step"
+
+    def make_cluster(ports):
+        stores = [DistributedStore(
+            r, world, [("127.0.0.1", p) for p in ports], port=ports[r],
+            rpc_timeout=rpc_timeout, rpc_retries=2, connect_timeout=2.0,
+            replication=2) for r in range(world)]
+        tid = None
+        for s in stores:
+            tid = s.init_table(rows, width, opt="sgd", lr=0.1,
+                               init_scale=0.0)
+        table = np.random.RandomState(42).normal(
+            0, 0.01, (rows, width)).astype(np.float32)
+        stores[0].set_data(tid, table)
+        return stores, tid
+
+    def build(store, tid):
+        rng = np.random.RandomState(1)
+        ids = ht.placeholder_op("ids")
+        y_ = ht.placeholder_op("y")
+        h = ht.ps_embedding_lookup_op((store, tid), ids, width=width)
+        w = ht.Variable("w", value=rng.randn(width, 2).astype(np.float32)
+                        * .3)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, w), y_), [0])
+        ex = ht.Executor(
+            {"train": [loss, ht.optim.AdamOptimizer(0.01).minimize(loss)]},
+            seed=0, install_signal_handlers=False)
+        return ex, loss, ids, y_
+
+    rng = np.random.RandomState(0)
+    feeds = [(rng.randint(0, rows, 32),
+              np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)])
+             for _ in range(steps)]
+
+    def run_train(store, tid):
+        ex, loss, ids, y_ = build(store, tid)
+        rs = ex.run_steps(
+            lambda i: {ids: feeds[i][0], y_: feeds[i][1]}, steps,
+            name="train", sync=False)
+        fd0 = {ids: feeds[0][0], y_: feeds[0][1]}
+        return ex, loss, fd0, [
+            np.asarray(r[0].jax(), np.float32).tobytes() for r in rs]
+
+    env_chaos = os.environ.pop("HETU_CHAOS", None)
+    env_min = os.environ.get("HETU_FEED_PIPELINE_MIN_US")
+    # tiny batches: force the H2D double-buffer on so the feed-pipeline
+    # track exists (the adaptive threshold would keep them inline)
+    os.environ["HETU_FEED_PIPELINE_MIN_US"] = "0"
+    chaos_mod.uninstall()
+    prev_trace = obs.enabled()
+    prev_timing = ht_metrics.step_timing
+
+    try:
+        # --- clean, untraced run: the parity oracle ----------------------
+        obs.enable(False)
+        reset_faults()
+        stores, tid = make_cluster(_free_ports(world))
+        try:
+            _, _, _, base_losses = run_train(stores[0], tid)
+        finally:
+            for s in stores:
+                s.close()
+        clean_counters = fault_counts()
+
+        # --- traced chaos run -------------------------------------------
+        schedule = f"11:kill:primary@shard1:step{kill_step}"
+        reset_faults()
+        ht_metrics.reset_step_times()
+        ht_metrics.enable_step_timing(True)
+        obs.clear_trace()
+        obs.enable(True)
+        prev = chaos_mod.install(
+            chaos_mod.ChaosInjector.from_spec(schedule))
+        try:
+            stores, tid = make_cluster(_free_ports(world))
+            try:
+                ex, loss, fd0, chaos_losses = run_train(stores[0], tid)
+                # MFU gauge: PR 5 inferred-shape FLOPs over the MEASURED
+                # per-step wall from the step_time_us histogram (the
+                # run just recorded it) — a wall clock around the whole
+                # run would fold cluster setup + compile into "step
+                # time" and understate MFU ~100x on a 5-step run
+                flops = obs.graph_flops([loss], feeds=fd0)
+                # p50, not mean: step 0's recorded wall contains the
+                # jit compile, which would dominate a 5-step mean
+                step_s = ht_metrics.step_time_stats()["train"]["p50"] \
+                    / 1e6
+                peak, device_kind = _device_peak_flops()
+                mfu = obs.record_mfu("trace_wdl", flops, step_s, peak)
+                # serving burst: the router/assemble/device-call/scatter
+                # lifecycle on its own named track
+                sx = ht.placeholder_op("sx", shape=(width,))
+                sw = ht.Variable("trace_serve_w", value=np.random.RandomState(
+                    3).randn(width, 1).astype(np.float32))
+                prob = ht.sigmoid_op(ht.matmul_op(sx, sw))
+                iex = InferenceExecutor([prob], seed=0, buckets=(4, 8))
+                with ServingRouter(iex, max_batch=4,
+                                   max_wait_ms=20.0) as router:
+                    futs = [router.submit(
+                        {sx: np.ones((width,), np.float32) * i})
+                        for i in range(8)]
+                    for f in futs:
+                        f.result(timeout=30)
+            finally:
+                for s in stores:
+                    try:
+                        s.close()
+                    except Exception:
+                        pass
+        finally:
+            chaos_mod.install(prev)
+            obs.enable(False)
+            ht_metrics.enable_step_timing(False)
+        counters = fault_counts()
+        evs = obs.trace_events()
+        step_stats = ht_metrics.step_time_stats().get("train", {})
+    finally:
+        if env_chaos is not None:
+            os.environ["HETU_CHAOS"] = env_chaos
+        if env_min is None:
+            os.environ.pop("HETU_FEED_PIPELINE_MIN_US", None)
+        else:
+            os.environ["HETU_FEED_PIPELINE_MIN_US"] = env_min
+        obs.enable(prev_trace)
+        ht_metrics.enable_step_timing(prev_timing)
+
+    # --- trace self-checks (the acceptance claims, machine-checked) ------
+    names = [e["name"] for e in evs]
+    tracks = [e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e["name"] == "thread_name"]
+    step_spans = [e for e in evs if e.get("ph") == "X"
+                  and e["name"] == "step"]
+    promo = [e for e in evs if e["name"] == "fault:ps_failover_promoted"]
+    # the promotion instant must land INSIDE one step span's window
+    promo_in_step = any(
+        s["ts"] <= p["ts"] <= s["ts"] + s["dur"]
+        for p in promo for s in step_spans)
+    checks = {
+        "step_spans": len(step_spans),
+        "rpc_spans": sum(1 for n in names if n.startswith("rpc:")),
+        "retry_events": sum(1 for n in names
+                            if n == "fault:ps_rpc_retry"),
+        "failover_promotions": len(promo),
+        "promotion_inside_step_span": bool(promo_in_step),
+        "feed_pipeline_track": any("run-steps-feed" in t
+                                   or "feed-pipeline" in t
+                                   for t in tracks),
+        "serve_router_track": any("hetu-serve-router" in t
+                                  for t in tracks),
+        "serve_device_calls": names.count("serve.device_call"),
+        "flow_arrows": sum(1 for e in evs if e.get("ph") == "s"),
+        "loss_parity": chaos_losses == base_losses,
+        "clean_run_counters_empty": not clean_counters,
+    }
+    ok = (checks["step_spans"] >= steps
+          and checks["rpc_spans"] > 0
+          and checks["failover_promotions"] >= 1
+          and checks["promotion_inside_step_span"]
+          and checks["feed_pipeline_track"]
+          and checks["serve_router_track"]
+          and checks["serve_device_calls"] >= 1
+          and checks["loss_parity"]
+          and checks["clean_run_counters_empty"])
+
+    if write_artifact:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts", "trace_step.json")
+        obs.export_chrome_trace(path)
+
+    workload = {"steps": steps, "kill_step": kill_step, "world": world,
+                "replication": 2, "schedule": schedule,
+                "smoke": bool(smoke)}
+    return {
+        "metric": "trace_step_events",
+        "value": len(evs),
+        "unit": "events",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "extra": {
+            "baseline_def": "1.0 iff the exported trace carries >= "
+                            "steps step spans, per-opcode rpc spans, "
+                            "the failover promotion as a point event "
+                            "INSIDE a step span, the feed-pipeline and "
+                            "serve-router thread tracks, >= 1 serving "
+                            "device call, bitwise loss parity vs the "
+                            "untraced clean run, and the clean run "
+                            "recorded zero fault counters",
+            **_provenance(workload),
+            **checks,
+            "tracks": sorted(set(tracks)),
+            "step_time_us_p50": step_stats.get("p50"),
+            "step_time_us_p99": step_stats.get("p99"),
+            "mfu": mfu,
+            "flops_per_step": flops,
+            "device_kind": device_kind,
+            "fault_counters": counters,
             "backend": jax.default_backend(),
         },
     }
@@ -2652,7 +3060,7 @@ if __name__ == "__main__":
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
                             "chaos", "failover", "emb", "zero", "serve",
-                            "partition", "overhead"])
+                            "partition", "overhead", "trace"])
     p.add_argument("--dp", type=int, default=4,
                    help="zero only: data-parallel mesh size (the child "
                         "forces a CPU host-device mesh of >= this)")
@@ -2688,7 +3096,7 @@ if __name__ == "__main__":
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
     elif args.config in ("chaos", "failover", "emb", "zero", "serve",
-                         "partition", "overhead"):
+                         "partition", "overhead", "trace"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness

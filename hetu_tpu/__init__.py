@@ -58,6 +58,7 @@ from .parallel.pipeline import pipeline_block, PipelineParallel
 from .parallel.ring_attention import ContextParallel
 from . import layers
 from . import metrics
+from . import obs
 from . import chaos
 from . import tokenizers
 from .profiler import HetuProfiler, CollectiveProfiler

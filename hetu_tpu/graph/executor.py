@@ -27,6 +27,7 @@ equivalent of the reference's NCCL allreduce insertion
 from __future__ import annotations
 
 import pickle
+import time as _time
 import warnings
 
 import numpy as np
@@ -34,6 +35,8 @@ import numpy as np
 from .node import Op, PlaceholderOp, LowerCtx, topo_sort
 from .gradients import GradientOp
 from ..ndarray import NDArray, wrap_device
+from .. import metrics as _metrics
+from ..obs.trace import TRACER as _TRACE
 
 
 class _ZeroView:
@@ -616,12 +619,43 @@ class SubExecutor:
         ex = self.ex
         if self._lr_objs:
             self._check_lr_objs()
+        # telemetry: the step span (HETU_TRACE=1) and the opt-in wall-
+        # time histogram share one timed wrapper; both disabled costs
+        # two module/attribute reads — the dispatch-gap gate
+        # (tools/host_overhead_bench.py) holds that claim
+        timed = _TRACE.on or _metrics.step_timing
+        t0 = _time.perf_counter_ns() if timed else 0
+        # captured BEFORE the step increments it: the span's step arg
+        # must equal the StepTraceAnnotation step_num of the same run
+        # (HetuProfiler.trace correlation), and eval subgraphs — which
+        # never increment — use the same convention
+        step0 = ex._step_counter if timed else 0
         ex._in_step = True
         try:
-            out = self._run_impl(feed_dict, convert_to_numpy_ret_vals, sync)
+            out = self._run_impl(feed_dict, convert_to_numpy_ret_vals,
+                                 sync, t0)
         finally:
             ex._in_step = False
         ex._post_step(self.training)
+        if timed:
+            t1 = _time.perf_counter_ns()
+            if _metrics.step_timing:
+                _metrics.record_step_time((t1 - t0) / 1e3, self.name)
+            tr = _TRACE
+            if tr.on:
+                # the span covers _post_step too: chaos kills and the
+                # re-replication tick fire inside the step that
+                # scheduled them.  Inline ring store with the buffer
+                # getattr open-coded (hot path: the <=25% tracing-tax
+                # gate counts every frame here).
+                b = getattr(tr._tl, "buf", None)
+                if b is None or b.gen != tr._gen:
+                    b = tr._buf()
+                i = b.i
+                # packed "S" record (see obs/trace.py): no args dict on
+                # the hot path — the exporter rebuilds it
+                b.items[i % b.cap] = ("S", self.name, t0, t1, step0)
+                b.i = i + 1
         return out
 
     def _derive_lr_state(self):
@@ -718,7 +752,7 @@ class SubExecutor:
                            for op in self._host_lr_ops], np.float32)
 
     def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False,
-                  sync=True):
+                  sync=True, t_run0=0):
         ex = self.ex
         if self._jit is None:
             self._build_step()
@@ -729,16 +763,40 @@ class SubExecutor:
         if cache is None:
             from .run_plan import PlanCache
             cache = self._plan_cache = PlanCache(self)
+        tr = _TRACE if _TRACE.on else None
+        if tr is not None:
+            # the lookup window starts at run()'s own stamp when it has
+            # one (sub-us skew, one clock read saved on the hot path)
+            t_pl = t_run0 or _time.perf_counter_ns()
         plan = cache.lookup(feed_dict)
         if not convert_to_numpy_ret_vals and plan._fast_eligible:
             fast = plan._fast
             if fast is None:
                 fast = plan._fast = plan._make_fast()
-            return fast(feed_dict, sync)
+            if tr is None:
+                return fast(feed_dict, sync)
+            # hand the lookup window to the fast lane: it batches ALL
+            # three phase spans into one ring write (a separate emit
+            # here would double the hot path's buffer walks)
+            return fast(feed_dict, sync, t_pl, _time.perf_counter_ns())
+        if tr is not None:
+            # general path (PS / ZeRO-3 / convert): not the dispatch-gap
+            # hot path — the method-call emit is fine here
+            tr.complete("run_plan.lookup", t_pl, _time.perf_counter_ns(),
+                        cat="executor")
+            t_fd = _time.perf_counter_ns()
         feeds = plan.place_feeds(feed_dict)
+        if tr is not None:
+            tr.complete("feeds.place", t_fd, _time.perf_counter_ns(),
+                        cat="executor")
 
         if self._ps_items:
+            if tr is not None:
+                t_ps = _time.perf_counter_ns()
             ps_vals = self._resolve_ps_rows(feed_dict, feeds)
+            if tr is not None:
+                tr.complete("ps.pull_rows", t_ps,
+                            _time.perf_counter_ns(), cat="ps")
             if self._ps_microbatch_clash:
                 # only the executor-level microbatch path splits feeds;
                 # PS rows are pulled full-batch — mutually exclusive
@@ -758,16 +816,26 @@ class SubExecutor:
         # device-CHAINED: the step returns step_idx+1, fed back next run
         # (a fresh np scalar per dispatch cost ~2-3us; _step_input falls
         # back to host after construction/restore).
+        if tr is not None:
+            t_jit = _time.perf_counter_ns()
         outs, new_tparams, updates, new_opt_states, new_step = self._jit(
             tparams, sparams, opt_states, feeds, ex.master_key,
             ex._step_input(), lrs)
+        if tr is not None:
+            tr.complete("jit.dispatch", t_jit, _time.perf_counter_ns(),
+                        cat="executor")
 
         # step N+1's host→device feed copies start NOW, overlapping the
         # in-flight device work (the double-buffered feed pipeline)
         plan.start_feed_prefetch()
 
         if self._ps_items:
+            if tr is not None:
+                t_push = _time.perf_counter_ns()
             self._ps_post_step(updates, sync)
+            if tr is not None:
+                tr.complete("ps.push_boundary", t_push,
+                            _time.perf_counter_ns(), cat="ps")
         # stage-3 ZeRO: updated params come back as dp-sharded slabs —
         # they replace the slab store, never a full per-param array
         for opt_op, zplan in self._zero3:
@@ -1210,6 +1278,10 @@ class Executor:
                            for se in self.subexecutors.values())
         from collections import deque
         self._async_pending = deque()
+        # flow-arrow ids paired with _async_pending entries (traced runs
+        # only; empty otherwise) — ties each non-blocking dispatch to
+        # the sync point that materialized it in the exported trace
+        self._async_fids = deque()
         try:
             self._async_window = max(
                 1, int(_os.environ.get("HETU_ASYNC_WINDOW", "4")))
@@ -1607,10 +1679,17 @@ class Executor:
             get_fd = fds.__getitem__
 
         def place_all(fd):
-            return {node: self._place_feed(node, v)
-                    for node, v in fd.items()}
+            if not _TRACE.on:
+                return {node: self._place_feed(node, v)
+                        for node, v in fd.items()}
+            # traced: the H2D copy shows up on the run-steps-feed track
+            t0 = _time.perf_counter_ns()
+            out = {node: self._place_feed(node, v)
+                   for node, v in fd.items()}
+            _TRACE.complete("feed.h2d", t0, _time.perf_counter_ns(),
+                            cat="feed", args={"n": len(out)})
+            return out
 
-        import time as _time
         from .run_plan import feed_pipeline_enabled, pipeline_min_us
         pool = fut = None
         placed, overlap = {}, False
@@ -1667,9 +1746,19 @@ class Executor:
         if rep is None:
             return
         self._async_pending.append(rep)
+        # LOCKSTEP with _async_pending (None when tracing was off at
+        # dispatch): the fids pop positionally against the handles, so
+        # a mid-run enable must not shift every later arrow onto the
+        # wrong dispatch
+        self._async_fids.append(
+            _TRACE.flow_begin("async_step", cat="async")
+            if _TRACE.on else None)
         if len(self._async_pending) > self._async_window:
             from ..metrics import record_run_plan
             record_run_plan("async_sync_points")
+            fid = self._async_fids.popleft() if self._async_fids else None
+            if fid is not None and _TRACE.on:
+                _TRACE.flow_end("async_step", fid)
             _block_one(self._async_pending.popleft())
 
     def _drain_async(self):
@@ -1682,6 +1771,9 @@ class Executor:
         from ..metrics import record_run_plan
         record_run_plan("async_sync_points")
         while self._async_pending:
+            fid = self._async_fids.popleft() if self._async_fids else None
+            if fid is not None and _TRACE.on:
+                _TRACE.flow_end("async_step", fid)
             _block_one(self._async_pending.popleft())
 
     def logOut(self, path, clear=True):

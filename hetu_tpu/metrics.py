@@ -1,13 +1,32 @@
 """Numpy-side metrics (reference ``python/hetu/metrics.py``: AUC:120,
 accuracy:154, precision/recall/F1:220-315) + host-side performance
-counters (flash-attention fallback accounting, fault-tolerance events)."""
+counters on the unified observability registry (ISSUE 10).
+
+Every counter family, latency histogram and gauge here registers
+against :data:`hetu_tpu.obs.registry` — the thin ``record_*`` wrappers
+below are the ONE recording API the rest of the package calls, and
+``obs.metrics_dump()`` / ``tools/metricsd.py`` read the same registry
+back out (one source of truth; the per-family accessors are kept as
+thin views over it).  The wrappers keep the exact hot-path cost of the
+pre-registry module-level families: ``record_run_plan`` runs once per
+training step on the dispatch path, so its counter branch is one lock +
+one dict add, nothing more.  ``reset_all()`` zeroes everything;
+the per-family ``reset_*`` functions remain as thin delegates.
+
+When span tracing is on (``HETU_TRACE=1``), every fault-counter
+recording also lands as an instant event on the active thread's trace
+track — retries, failovers, promotions, epoch refusals and chaos
+injections appear INSIDE the step/RPC span that absorbed them.
+"""
 from __future__ import annotations
 
-import collections
 import contextlib
 import threading
 
 import numpy as np
+
+from .obs.registry import REGISTRY
+from .obs.trace import TRACER as _TR
 
 # ------------------------------------------------------- counter suppression
 # The static analyzer (``hetu_tpu.analysis``) abstractly evaluates op
@@ -45,27 +64,26 @@ def counters_suppressed():
 # bench.py attention microbench; ``HETU_REQUIRE_FLASH=1`` turns any
 # recording into a hard failure (ops/attention.py).
 
-_flash_fallbacks = collections.Counter()
-_flash_lock = threading.Lock()
+_flash = REGISTRY.counter_family(
+    "flash_fallbacks",
+    "attention dispatches that left the Pallas flash fast path, by "
+    "reason (per jax trace, not per step)")
 
 
 def record_flash_fallback(reason):
     """Count one attention dispatch that fell back off the flash path."""
     if counters_suppressed():
         return  # abstract (eval_shape) trace, not a real dispatch
-    with _flash_lock:
-        _flash_fallbacks[str(reason)] += 1
+    _flash.inc(str(reason))
 
 
 def flash_fallback_counts():
     """{reason: count} snapshot of recorded fallbacks."""
-    with _flash_lock:
-        return dict(_flash_fallbacks)
+    return _flash.counts()
 
 
 def reset_flash_fallbacks():
-    with _flash_lock:
-        _flash_fallbacks.clear()
+    _flash.reset()
 
 
 # ------------------------------------------------------ fault-event counters
@@ -98,25 +116,30 @@ def reset_flash_fallbacks():
 # all.  Surfaced by ``HetuProfiler.fault_counters()`` and ``bench.py
 # --config chaos`` / ``--config failover``.
 
-_fault_counts = collections.Counter()
-_fault_lock = threading.Lock()
+_faults = REGISTRY.counter_family(
+    "faults",
+    "fault-tolerance events: detections, injections, recoveries "
+    "(a clean run records none but auto_save bookkeeping)")
 
 
 def record_fault(kind, n=1):
-    """Count one fault-tolerance event (detection, injection, recovery)."""
-    with _fault_lock:
-        _fault_counts[str(kind)] += n
+    """Count one fault-tolerance event (detection, injection, recovery).
+    With tracing on, the event also lands as an instant on the calling
+    thread's trace track — a failover/retry/epoch-refusal is visible
+    INSIDE the step or RPC span that absorbed it."""
+    kind = str(kind)
+    _faults.inc(kind, n)
+    if _TR.on:
+        _TR.instant("fault:" + kind, cat="fault")
 
 
 def fault_counts():
     """{kind: count} snapshot of recorded fault events."""
-    with _fault_lock:
-        return dict(_fault_counts)
+    return _faults.counts()
 
 
 def reset_faults():
-    with _fault_lock:
-        _fault_counts.clear()
+    _faults.reset()
 
 
 # ------------------------------------------------- cache / sparse-RPC counters
@@ -137,26 +160,25 @@ def reset_faults():
 # empty dict.  Surfaced by ``HetuProfiler.cache_counters()`` and
 # ``bench.py --config emb``.
 
-_cache_counts = collections.Counter()
-_cache_lock = threading.Lock()
+_cache = REGISTRY.counter_family(
+    "cache",
+    "HET embedding-cache / sparse-transport batching events (a clean "
+    "dense run records nothing)")
 
 
 def record_cache(kind, n=1):
     """Count ``n`` cache/sparse-transport events of ``kind``."""
     if n:
-        with _cache_lock:
-            _cache_counts[str(kind)] += int(n)
+        _cache.inc(str(kind), int(n))
 
 
 def cache_counts():
     """{kind: count} snapshot of cache/dedup/batching counters."""
-    with _cache_lock:
-        return dict(_cache_counts)
+    return _cache.counts()
 
 
 def reset_cache_counts():
-    with _cache_lock:
-        _cache_counts.clear()
+    _cache.reset()
 
 
 # ------------------------------------------------- ZeRO weight-update counters
@@ -171,8 +193,10 @@ def reset_cache_counts():
 # is thrashing.  Surfaced by ``HetuProfiler.zero_counters()`` and
 # ``bench.py --config zero``; a run without ``zero=`` records nothing.
 
-_zero_counts = collections.Counter()
-_zero_lock = threading.Lock()
+_zero = REGISTRY.counter_family(
+    "zero",
+    "ZeRO sharded-update collective/padding bytes (per jax trace; "
+    "empty without Executor(zero=...))")
 
 
 def record_zero(kind, n=1):
@@ -180,19 +204,16 @@ def record_zero(kind, n=1):
     if counters_suppressed():
         return  # abstract (eval_shape) trace, not a real build
     if n:
-        with _zero_lock:
-            _zero_counts[str(kind)] += int(n)
+        _zero.inc(str(kind), int(n))
 
 
 def zero_counts():
     """{kind: bytes} snapshot of ZeRO collective/padding counters."""
-    with _zero_lock:
-        return dict(_zero_counts)
+    return _zero.counts()
 
 
 def reset_zero_counts():
-    with _zero_lock:
-        _zero_counts.clear()
+    _zero.reset()
 
 
 # -------------------------------------------------- compiled-step cache counters
@@ -204,25 +225,23 @@ def reset_zero_counts():
 # computed (caching skipped, never wrong-cached).  Surfaced by
 # ``HetuProfiler.step_cache_counters()``.
 
-_step_cache_counts = collections.Counter()
-_step_cache_lock = threading.Lock()
+_step_cache = REGISTRY.counter_family(
+    "step_cache",
+    "compiled-step cache lookups: hit / miss / uncachable")
 
 
 def record_step_cache(kind, n=1):
     """Count one compiled-step cache event (hit/miss/uncachable)."""
-    with _step_cache_lock:
-        _step_cache_counts[str(kind)] += n
+    _step_cache.inc(str(kind), n)
 
 
 def step_cache_counts():
     """{kind: count} snapshot of compiled-step cache events."""
-    with _step_cache_lock:
-        return dict(_step_cache_counts)
+    return _step_cache.counts()
 
 
 def reset_step_cache_counts():
-    with _step_cache_lock:
-        _step_cache_counts.clear()
+    _step_cache.reset()
 
 
 # ------------------------------------------------------ run-plan counters
@@ -246,8 +265,10 @@ def reset_step_cache_counts():
 # by ``HetuProfiler.run_plan_counters()`` and ``bench.py --config
 # overhead``.
 
-_run_plan_counts = collections.Counter()
-_run_plan_lock = threading.Lock()
+_run_plan = REGISTRY.counter_family(
+    "run_plan",
+    "cached-run-plan / async-dispatch events: plan cache hits/misses, "
+    "pipelined feeds, forced async sync points")
 
 
 def record_run_plan(kind, n=1):
@@ -257,23 +278,24 @@ def record_run_plan(kind, n=1):
     — the plain-counter branch is kept deliberately lean."""
     if kind.__class__ is not str:
         kind = str(kind)
-    with _run_plan_lock:
-        if not kind.endswith("_hw"):
-            if n:
-                _run_plan_counts[kind] += int(n)
-        elif n > _run_plan_counts[kind]:
-            _run_plan_counts[kind] = int(n)
+    if not kind.endswith("_hw"):
+        if n:
+            _run_plan.inc(kind, int(n))
+            if kind == "async_sync_points" and _TR.on:
+                # trace view of the forced materialization (numpy
+                # convert, PS push boundary, save drain, window full)
+                _TR.instant("async_sync_point", cat="async")
+    else:
+        _run_plan.max_gauge(kind, int(n))
 
 
 def run_plan_counts():
     """{kind: count} snapshot of run-plan / async-dispatch counters."""
-    with _run_plan_lock:
-        return dict(_run_plan_counts)
+    return _run_plan.counts()
 
 
 def reset_run_plan_counts():
-    with _run_plan_lock:
-        _run_plan_counts.clear()
+    _run_plan.reset()
 
 
 # ------------------------------------------------------- serving counters
@@ -283,9 +305,9 @@ def reset_run_plan_counts():
 # with the TOTAL bucket rows they ran at (``serve_batch_rows`` — real
 # plus padding), of which ``serve_pad_rows`` were padding added to reach
 # a legal bucket (the micro-batcher's waste: real rows =
-# ``serve_batch_rows - serve_pad_rows``), queue-full rejections (``serve_rejections`` — the
-# backpressure path), PS failovers absorbed MID-SERVE
-# (``serve_failovers``), per-bucket executable builds
+# ``serve_batch_rows - serve_pad_rows``), queue-full rejections
+# (``serve_rejections`` — the backpressure path), PS failovers absorbed
+# MID-SERVE (``serve_failovers``), per-bucket executable builds
 # (``serve_bucket_compiles`` — the compile-once claim is exactly "this
 # equals the number of distinct buckets used"), read-only embedding
 # refreshes (``serve_emb_refresh_rows``), and the queue-depth high-water
@@ -294,31 +316,193 @@ def reset_run_plan_counts():
 # ``HetuProfiler.serve_counters()`` and ``bench.py --config serve``; a
 # process that never serves reports an empty dict.
 
-_serve_counts = collections.Counter()
-_serve_lock = threading.Lock()
+_serve = REGISTRY.counter_family(
+    "serve",
+    "online-serving request/batching events (empty in a process that "
+    "never serves)")
 
 
 def record_serve(kind, n=1):
     """Count ``n`` serving events of ``kind``; kinds ending in ``_hw``
     are high-water gauges (the stored value is the max seen)."""
     kind = str(kind)
-    with _serve_lock:
-        if kind.endswith("_hw"):
-            if n > _serve_counts[kind]:
-                _serve_counts[kind] = int(n)
-        elif n:
-            _serve_counts[kind] += int(n)
+    if kind.endswith("_hw"):
+        _serve.max_gauge(kind, int(n))
+    elif n:
+        _serve.inc(kind, int(n))
 
 
 def serve_counts():
     """{kind: count} snapshot of serving counters."""
-    with _serve_lock:
-        return dict(_serve_counts)
+    return _serve.counts()
 
 
 def reset_serve_counts():
-    with _serve_lock:
-        _serve_counts.clear()
+    """Reset the serving counters AND the serving latency histograms —
+    one serving run's telemetry, one reset."""
+    _serve.reset()
+    _serve_latency.reset()
+
+
+# --------------------------------------------------- latency histograms
+# Log-bucketed distributions (``obs.registry.Histogram``: 8 buckets per
+# octave, p50/p90/p99 accessors) — the mean-only counters above cannot
+# distinguish a p99 spike from a shifted mean; these can.
+
+# Per-opcode PS RPC latency (one observation per CLIENT round trip,
+# labeled ``OP_PULL``/``OP_PUSH``/... — ``opcodes.op_name``) plus the
+# request payload bytes it carried (keys + payload, header excluded), as
+# a counter family keyed the same way.  Recording rides ``_rpc``'s
+# success path; counter-silent probes (``record=False``) stay silent
+# here too.
+_rpc_lat = REGISTRY.histogram(
+    "ps_rpc_us",
+    "PS client RPC round-trip latency per opcode, microseconds")
+_rpc_bytes = REGISTRY.counter_family(
+    "ps_rpc_bytes",
+    "PS client RPC request payload bytes per opcode (keys + payload)")
+
+
+def record_rpc(op, us, nbytes):
+    """One successful PS client RPC: latency (us) into the per-opcode
+    histogram, request bytes into the per-opcode byte counter."""
+    _rpc_lat.observe(us, label=op)
+    if nbytes:
+        _rpc_bytes.inc(op, int(nbytes))
+
+
+def rpc_stats():
+    """{"latency_us": {op: histogram snapshot}, "bytes": {op: total}}."""
+    return {"latency_us": _rpc_lat.snapshot(),
+            "bytes": _rpc_bytes.counts()}
+
+
+def reset_rpc_stats():
+    _rpc_lat.reset()
+    _rpc_bytes.reset()
+
+
+# Serving latency: per-request queue wait (submit -> batch claim) and
+# per-batch device-call time, labeled ``queue_wait`` / ``batch``.
+_serve_latency = REGISTRY.histogram(
+    "serve_latency_us",
+    "serving latency: per-request queue wait and per-batch device "
+    "call, microseconds")
+
+
+def record_serve_latency(kind, us):
+    """Observe one serving latency sample (``kind``: ``queue_wait`` per
+    request, ``batch`` per dispatched micro-batch)."""
+    _serve_latency.observe(us, label=kind)
+
+
+def serve_latency_stats():
+    """{kind: histogram snapshot} for the serving latency families."""
+    return _serve_latency.snapshot()
+
+
+# Executor step wall time, labeled by subexecutor name.  OFF by default:
+# the observation costs ~0.5us (two clock reads + one bucketed insert),
+# which the dispatch-gap work (PR 9) fought to excise — benches and
+# traced runs enable it (``enable_step_timing`` / ``HETU_STEP_TIMING=1``
+# / any ``HETU_TRACE=1`` session records spans anyway).
+_step_time = REGISTRY.histogram(
+    "step_time_us",
+    "executor step wall time per subexecutor, microseconds (enable "
+    "with metrics.enable_step_timing or HETU_STEP_TIMING=1)")
+
+#: read directly by ``SubExecutor.run`` — a module attribute load, not
+#: a function call, keeps the disabled path at ~one global read
+step_timing = False
+
+
+def _init_step_timing():
+    global step_timing
+    import os
+    step_timing = os.environ.get("HETU_STEP_TIMING", "0").lower() \
+        not in ("", "0", "false", "off")
+
+
+_init_step_timing()
+
+
+def enable_step_timing(on=True):
+    """Turn the per-step wall-time histogram on/off (see
+    ``step_time_us``'s registration note for why it is opt-in)."""
+    global step_timing
+    step_timing = bool(on)
+
+
+def record_step_time(us, label="default"):
+    """Observe one executor step's wall time (called by
+    ``SubExecutor.run`` when step timing is enabled)."""
+    _step_time.observe(us, label=label)
+
+
+def step_time_stats():
+    """{subexecutor: histogram snapshot} of recorded step wall times."""
+    return _step_time.snapshot()
+
+
+def reset_step_times():
+    _step_time.reset()
+
+
+# ------------------------------------------------------------- run gauges
+# Per-run step-time/MFU gauges: ``obs.record_mfu`` computes MFU from the
+# PR 5 inferred-shape FLOP model (``obs.graph_flops``) over measured
+# step time and publishes both here, labeled by run/config name — the
+# measured half of the BENCH trajectory (ROADMAP item 2).
+
+_mfu_gauge = REGISTRY.gauge(
+    "mfu",
+    "model FLOP/s utilization per run: inferred-shape FLOPs / step "
+    "time / hardware peak")
+_step_gauge = REGISTRY.gauge(
+    "step_time_ms",
+    "measured step wall time per run, milliseconds")
+
+
+def record_run_gauges(label, step_time_ms, mfu):
+    """Publish one run's measured step time + MFU gauges."""
+    _step_gauge.set(step_time_ms, label=label)
+    _mfu_gauge.set(mfu, label=label)
+
+
+def run_gauges():
+    """{"mfu": {label: v}, "step_time_ms": {label: v}}."""
+    return {"mfu": _mfu_gauge.values(),
+            "step_time_ms": _step_gauge.values()}
+
+
+# ------------------------------------------------------------ one-registry view
+
+#: the counter families in registration order — ``all_counts`` and the
+#: profiler's ``all_counters`` read this instead of seven accessors
+_FAMILIES = {
+    "flash_fallbacks": _flash,
+    "faults": _faults,
+    "cache": _cache,
+    "zero": _zero,
+    "step_cache": _step_cache,
+    "run_plan": _run_plan,
+    "serve": _serve,
+    "ps_rpc_bytes": _rpc_bytes,
+}
+
+
+def all_counts():
+    """{family: {kind: count}} over EVERY counter family — the one-call
+    view behind ``HetuProfiler.all_counters()`` (the per-family
+    accessors are thin slices of this)."""
+    return {name: fam.counts() for name, fam in _FAMILIES.items()}
+
+
+def reset_all():
+    """Zero every registered instrument — counters, histograms and
+    gauges — in one call (replaces the per-family ``reset_*`` bodies,
+    which remain as thin delegates)."""
+    REGISTRY.reset_all()
 
 
 def _np(x):

@@ -1,0 +1,98 @@
+"""Chrome/Perfetto trace export (ISSUE 10 tentpole, part 2).
+
+Serializes the :data:`~hetu_tpu.obs.trace.TRACER` rings into the Chrome
+trace-event JSON format (the ``{"traceEvents": [...]}`` object both
+``chrome://tracing`` and https://ui.perfetto.dev load directly):
+
+* complete spans   -> ``ph="X"`` with microsecond ``ts``/``dur``
+* instant events   -> ``ph="i"`` (thread scope)
+* flow begin/end   -> ``ph="s"``/``ph="f"`` (``bp="e"``) — the arrows
+  tying a ``run(sync=False)`` dispatch to the sync point that
+  materialized it
+* track names      -> ``ph="M"`` ``thread_name`` metadata per thread
+  (the feed-pipeline / serve-router / PS-serve threads appear as named
+  tracks), plus a ``process_name`` record.
+
+Timestamps are ``perf_counter_ns / 1000`` — one shared monotonic base,
+so cross-track ordering in the viewer is real.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .trace import TRACER
+
+
+def trace_events(tracer=None):
+    """The recorded rings as a list of Chrome trace-event dicts
+    (metadata first, then events sorted by timestamp)."""
+    tr = tracer or TRACER
+    pid = os.getpid()
+    events = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+               "args": {"name": "hetu_tpu"}}]
+    for tid, name in tr.tracks():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": name}})
+    rows = []
+    for tid, rec in tr.records():
+        ph = rec[0]
+        if ph == "P":
+            # packed executor phase set -> three spans (trace.py)
+            _, t_pl, t0, t1, t2 = rec
+            for name, a, b in (("run_plan.lookup", t_pl, t0),
+                               ("feeds.place", t0, t1),
+                               ("jit.dispatch", t1, t2)):
+                if a:       # t_pl may be 0 (no lookup window captured)
+                    rows.append({"ph": "X", "name": name,
+                                 "cat": "executor", "pid": pid,
+                                 "tid": tid, "ts": a / 1e3,
+                                 "dur": (b - a) / 1e3})
+            continue
+        if ph == "S":
+            _, sub, t0, t1, step = rec
+            rows.append({"ph": "X", "name": "step", "cat": "executor",
+                         "pid": pid, "tid": tid, "ts": t0 / 1e3,
+                         "dur": (t1 - t0) / 1e3,
+                         "args": {"sub": sub, "step": step}})
+            continue
+        if ph == "X":
+            _, name, cat, t0, dur, args = rec
+            ev = {"ph": "X", "name": name, "cat": cat, "pid": pid,
+                  "tid": tid, "ts": t0 / 1e3, "dur": dur / 1e3}
+            if args:
+                ev["args"] = dict(args)
+        elif ph == "i":
+            _, name, cat, t, args = rec
+            ev = {"ph": "i", "name": name, "cat": cat, "pid": pid,
+                  "tid": tid, "ts": t / 1e3, "s": "t"}
+            if args:
+                ev["args"] = dict(args)
+        else:       # "s" / "f" flow pair
+            _, name, cat, t, fid = rec
+            ev = {"ph": ph, "name": name, "cat": cat, "pid": pid,
+                  "tid": tid, "ts": t / 1e3, "id": int(fid)}
+            if ph == "f":
+                ev["bp"] = "e"
+        rows.append(ev)
+    rows.sort(key=lambda e: e["ts"])
+    return events + rows
+
+
+def export_chrome_trace(path, tracer=None):
+    """Write the recorded trace as Chrome/Perfetto JSON to ``path``
+    (atomic rename).  Returns the event count.  Load it at
+    https://ui.perfetto.dev or ``chrome://tracing``."""
+    evs = trace_events(tracer)
+    blob = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(blob, fh)
+    os.replace(tmp, path)
+    return len(evs)
+
+
+__all__ = ["trace_events", "export_chrome_trace"]

@@ -1,0 +1,254 @@
+"""Lock-cheap thread-aware span/event tracer (ISSUE 10 tentpole, part 1).
+
+One process-wide :class:`Tracer` collects timing records into
+PER-THREAD ring buffers: the hot path touches only thread-local state
+(no lock, no allocation beyond the record tuple), so a span costs two
+``perf_counter_ns`` reads plus one ring store (~0.3us) — cheap enough
+to leave compiled into the executor's dispatch path behind a single
+``TRACER.on`` flag read (the ``HETU_TRACE=0`` default pays one
+attribute load per guarded site, nothing else; the host-overhead gate
+in ``tools/host_overhead_bench.py`` holds that claim to <= 2.0x a raw
+jit dispatch, and the traced path to <= 25% over the untraced one).
+
+Record shapes (plain tuples — a ring slot assignment, never a dict):
+
+* complete span  ``("X", name, cat, t0_ns, dur_ns, args)``
+* instant event  ``("i", name, cat, t_ns, args)``
+* flow begin/end ``("s"/"f", name, cat, t_ns, flow_id)`` — ties a
+  ``run(sync=False)`` dispatch to the sync point that materialized it
+  across arbitrary span nesting (rendered as arrows in Perfetto).
+* packed hot-path records, expanded by the exporter: ``("P", t_pl, t0,
+  t1, t2)`` is the executor fast lane's whole phase set (run-plan
+  lookup / feed placement / jit dispatch) in ONE tuple, and ``("S",
+  sub, t0, t1, step)`` one step span — per-step telemetry allocates
+  two GC-tracked objects instead of five (generation-0 collections
+  were a measurable slice of the tracing tax at microsecond step
+  rates).
+
+Thread buffers register themselves on first emit, named after their
+thread (``threading.current_thread().name`` — the feed-pipeline /
+serve-router / PS-serve pools pass ``thread_name_prefix``, so the
+background planes show up as named tracks for free);
+:meth:`Tracer.set_track_name` overrides.  Each buffer is a ring of
+``HETU_TRACE_BUF`` slots (default 65536): a long run keeps the newest
+events per thread instead of growing without bound, and
+:func:`hetu_tpu.obs.export_chrome_trace` merges whatever survived.
+
+Timestamps are ``time.perf_counter_ns()`` everywhere — one monotonic
+base shared by every thread, so cross-track ordering is meaningful.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+
+def _env_on():
+    return os.environ.get("HETU_TRACE", "0").lower() not in (
+        "", "0", "false", "off")
+
+
+def _env_cap():
+    try:
+        return max(16, int(os.environ.get("HETU_TRACE_BUF", "65536")))
+    except ValueError:
+        return 65536
+
+
+class _Buf:
+    """One thread's ring: ``items[i % cap]`` with a monotonically growing
+    write index ``i`` (``i > cap`` means the ring wrapped and the oldest
+    ``i - cap`` records were overwritten)."""
+
+    __slots__ = ("items", "i", "cap", "tid", "name", "gen")
+
+    def __init__(self, cap, tid, name, gen):
+        self.items = [None] * cap
+        self.i = 0
+        self.cap = cap
+        self.tid = tid
+        self.name = name
+        self.gen = gen
+
+
+class Tracer:
+    """Process-wide trace collector (module singleton :data:`TRACER`).
+
+    ``on`` is the ONE hot flag: instrumentation sites read it directly
+    (``if TRACER.on: ...``) so a disabled tracer costs an attribute
+    load per site.  Everything else — buffers, capacity, the flow-id
+    counter — hides behind it.
+    """
+
+    def __init__(self):
+        self.on = _env_on()
+        self.cap = _env_cap()
+        self._lock = threading.Lock()
+        self._bufs = []
+        self._tl = threading.local()
+        self._gen = 0           # bumped by clear()/set_capacity()
+        self._flow_ids = itertools.count(1)     # thread-safe in CPython
+
+    # -- buffer management -------------------------------------------------
+
+    def _buf(self):
+        b = getattr(self._tl, "buf", None)
+        if b is None or b.gen != self._gen:
+            t = threading.current_thread()
+            with self._lock:
+                b = _Buf(self.cap, threading.get_ident(), t.name,
+                         self._gen)
+                self._bufs.append(b)
+            self._tl.buf = b
+        return b
+
+    def set_track_name(self, name):
+        """Name this thread's track in the exported trace (defaults to
+        the thread's own name)."""
+        self._buf().name = str(name)
+
+    # -- hot emitters ------------------------------------------------------
+
+    def complete(self, name, t0_ns, t1_ns, cat="hetu", args=None):
+        """One finished span: explicit timestamps, for hot paths that
+        stamp ``perf_counter_ns`` inline instead of entering a context
+        manager."""
+        b = self._buf()
+        i = b.i
+        b.items[i % b.cap] = ("X", name, cat, t0_ns, t1_ns - t0_ns, args)
+        b.i = i + 1
+
+    def instant(self, name, cat="hetu", args=None):
+        """One point event (a fault, a sync point, an injection)."""
+        b = self._buf()
+        i = b.i
+        b.items[i % b.cap] = ("i", name, cat,
+                              time.perf_counter_ns(), args)
+        b.i = i + 1
+
+    def flow_begin(self, name, cat="async"):
+        """Open a flow arrow (returns the flow id to close it with)."""
+        fid = next(self._flow_ids)
+        b = self._buf()
+        i = b.i
+        b.items[i % b.cap] = ("s", name, cat, time.perf_counter_ns(), fid)
+        b.i = i + 1
+        return fid
+
+    def flow_end(self, name, fid, cat="async"):
+        """Close a flow arrow opened by :meth:`flow_begin` (any thread)."""
+        b = self._buf()
+        i = b.i
+        b.items[i % b.cap] = ("f", name, cat, time.perf_counter_ns(), fid)
+        b.i = i + 1
+
+    # -- control -----------------------------------------------------------
+
+    def enable(self, on=True):
+        """Turn tracing on/off at runtime (the env knob sets the initial
+        state; tests and ``bench.py --config trace`` flip it live)."""
+        self.on = bool(on)
+
+    def set_capacity(self, cap):
+        """Resize the per-thread rings.  Drops everything recorded so
+        far (each thread re-registers a fresh ring on its next emit)."""
+        with self._lock:
+            self.cap = max(16, int(cap))
+            self._gen += 1
+            self._bufs = []
+
+    def clear(self):
+        """Drop all recorded events (capacity unchanged)."""
+        with self._lock:
+            self._gen += 1
+            self._bufs = []
+
+    # -- readout -----------------------------------------------------------
+
+    def tracks(self):
+        """[(tid, track name)] for every thread that recorded events."""
+        with self._lock:
+            bufs = list(self._bufs)
+        return [(b.tid, b.name) for b in bufs if b.i]
+
+    def records(self):
+        """Merged [(tid, record)] over all live rings, oldest-first per
+        ring (the export sorts globally by timestamp)."""
+        with self._lock:
+            bufs = list(self._bufs)
+        out = []
+        for b in bufs:
+            i, cap = b.i, b.cap
+            if i <= cap:
+                ring = b.items[:i]
+            else:       # wrapped: oldest surviving record first
+                k = i % cap
+                ring = b.items[k:] + b.items[:k]
+            for rec in ring:
+                if rec is not None:
+                    out.append((b.tid, rec))
+        return out
+
+    def dropped(self):
+        """{tid: overwritten-record count} for rings that wrapped."""
+        with self._lock:
+            bufs = list(self._bufs)
+        return {b.tid: b.i - b.cap for b in bufs if b.i > b.cap}
+
+
+#: the process-wide tracer — instrumentation sites read ``TRACER.on``
+TRACER = Tracer()
+
+
+class _SpanCtx:
+    """Context-manager span for non-hot call sites (``obs.span(...)``)."""
+
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args or None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        TRACER.complete(self.name, self.t0, time.perf_counter_ns(),
+                        self.cat, self.args)
+        return False
+
+
+class _NullSpan:
+    """Tracing-off singleton: enter/exit are no-ops."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name, cat="hetu", **args):
+    """``with obs.span("step", step=3): ...`` — a no-op singleton when
+    tracing is off, a recorded complete event when on."""
+    if not TRACER.on:
+        return _NULL_SPAN
+    return _SpanCtx(name, cat, args)
+
+
+def event(name, cat="hetu", **args):
+    """Record one instant event (no-op when tracing is off)."""
+    if TRACER.on:
+        TRACER.instant(name, cat, args or None)
+
+
+__all__ = ["Tracer", "TRACER", "span", "event"]

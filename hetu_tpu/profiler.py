@@ -234,6 +234,34 @@ class HetuProfiler:
         return self._lowered(feed_dict).as_text()
 
     @staticmethod
+    def all_counters():
+        """{family: {kind: count}} over EVERY counter family on the
+        observability registry in one call (``hetu_tpu.metrics``
+        ``all_counts``): flash_fallbacks, faults, cache, zero,
+        step_cache, run_plan, serve, ps_rpc_bytes.  The per-family
+        accessors below are thin slices of this — same registry, same
+        numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
+        from .metrics import all_counts
+        return all_counts()
+
+    @staticmethod
+    def latency_stats():
+        """Latency-distribution snapshots from the observability
+        registry's log-bucketed histograms (count/sum/min/max/mean/
+        p50/p90/p99 per label): ``ps_rpc_us`` per opcode (+ payload
+        bytes), ``serve_latency_us`` (per-request queue wait /
+        per-batch device call), ``step_time_us`` per subexecutor
+        (opt-in — ``metrics.enable_step_timing`` or
+        ``HETU_STEP_TIMING=1``), and the per-run ``mfu`` /
+        ``step_time_ms`` gauges."""
+        from .metrics import (rpc_stats, run_gauges, serve_latency_stats,
+                              step_time_stats)
+        return {"ps_rpc": rpc_stats(),
+                "serve_latency_us": serve_latency_stats(),
+                "step_time_us": step_time_stats(),
+                "gauges": run_gauges()}
+
+    @staticmethod
     def flash_fallbacks():
         """{reason: count} of attention dispatches that LEFT the Pallas
         flash fast path (``hetu_tpu.metrics`` registry).  Counts are per
@@ -346,14 +374,23 @@ class HetuProfiler:
         """Capture a hardware trace of real steps into ``log_dir``
         (TensorBoard/XProf format via ``jax.profiler`` — the TPU-native
         replacement for the reference's per-op CUDA-event timeline;
-        SURVEY.md §5.1).  Returns the directory for convenience."""
+        SURVEY.md §5.1).  Each step is wrapped in
+        ``jax.profiler.StepTraceAnnotation`` so XProf groups its device
+        slices under the host step index — with ``HETU_TRACE=1`` the
+        host-side ``obs`` spans carry the same step numbers, giving
+        host-span <-> device-trace correlation (match ``step_num``
+        against the ``step`` span's ``step`` arg).  Returns the
+        directory for convenience."""
         import jax
         if steps < 1:
             raise ValueError("trace needs steps >= 1")
         self._sync(self.sub.run(feed_dict))  # compile+warm OUTSIDE the trace
+        first = int(self.ex.step_counter)
         with jax.profiler.trace(str(log_dir)):
-            for _ in range(steps):
-                out = self.sub.run(feed_dict)
+            for i in range(steps):
+                with jax.profiler.StepTraceAnnotation(
+                        "hetu_step", step_num=first + i):
+                    out = self.sub.run(feed_dict)
             self._sync(out)
         return str(log_dir)
 

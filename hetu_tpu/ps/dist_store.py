@@ -85,7 +85,8 @@ import numpy as np
 
 from .store import EmbeddingStore, _OPT_IDS, _OPT_NAMES, _V3_CHUNK
 from .. import chaos as _chaos
-from ..metrics import record_cache, record_fault
+from ..metrics import record_cache, record_fault, record_rpc
+from ..obs.trace import TRACER as _TR
 
 # Opcodes register through hetu_tpu.ps.opcodes: the registry asserts wire-
 # value uniqueness at import time (runtime twin of the tools/hetu_lint.py
@@ -456,8 +457,12 @@ class StoreServer:
                     pass
                 return
             self._live_conns.add(conn)
+            # named: handler threads carry the replication forward (the
+            # op-log mirror to the backup), so they appear as a
+            # "ps-serve-r<rank>" track in exported traces
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"ps-serve-r{self.rank}").start()
 
     def _serve(self, conn):
         try:
@@ -471,7 +476,17 @@ class StoreServer:
                     # tests pass vacuously
                     break
                 try:
-                    stop = self._handle(conn, body)
+                    if _TR.on:
+                        # server apply path: one span per handled frame
+                        # on this rank's ps-serve track (the replication
+                        # forward nests inside it)
+                        t_h = time.perf_counter_ns()
+                        stop = self._handle(conn, body)
+                        _TR.complete("ps.apply", t_h,
+                                     time.perf_counter_ns(), cat="ps",
+                                     args={"bytes": len(body)})
+                    else:
+                        stop = self._handle(conn, body)
                 except (ConnectionError, OSError):
                     raise
                 except Exception as e:  # surface handler errors to the client
@@ -531,12 +546,19 @@ class StoreServer:
             return
         if not self._fwd_ok.get(shard):
             return
+        t_fwd = time.perf_counter_ns() if _TR.on else 0
         try:
             if self.rpc_fn is None:
                 raise RuntimeError("replication transport not attached")
             self.rpc_fn(self._fwd_target(shard), OP_REPLICATE, 0,
                         np.asarray([shard], np.int64), payload=bytes(body),
                         epoch=self._epochs.get(shard, 0))
+            if _TR.on:
+                # the replication-forwarder leg of the apply critical
+                # section, on the serve thread's track
+                _TR.complete("repl.forward", t_fwd,
+                             time.perf_counter_ns(), cat="ps",
+                             args={"shard": shard})
         except Exception as e:
             fence = _fence_info(e)
             if fence is not None:
@@ -1228,6 +1250,12 @@ class DistributedStore:
         hdr = _HDR.pack(op, table, keys.size, lr, width, self.rank,
                         next(self._seq) if seq is None else seq, shard,
                         epoch)
+        # per-opcode latency histogram + payload-bytes counter (the
+        # telemetry registry) — a socket round trip dwarfs two clock
+        # reads, so the measurement is unconditional; counter-silent
+        # probes (record=False) stay invisible here too
+        t_rpc = time.perf_counter_ns()
+        nbytes = keys.nbytes + len(payload)
         last_err = None
         delay = 0.0
         for attempt in range(self.rpc_retries if retries is None
@@ -1286,6 +1314,15 @@ class DistributedStore:
             raise RuntimeError(
                 f"PS rank {peer} error on {op_name(op)}: "
                 f"{resp[1:].decode(errors='replace')}")
+        if record:
+            name = op_name(op)
+            record_rpc(name, (time.perf_counter_ns() - t_rpc) / 1e3,
+                       nbytes)
+            if _TR.on:
+                _TR.complete("rpc:" + name, t_rpc,
+                             time.perf_counter_ns(), cat="ps",
+                             args={"peer": peer, "bytes": nbytes,
+                                   "shard": shard})
         return resp[1:]
 
     # -- shard routing + client-side failover ------------------------------
@@ -2413,8 +2450,14 @@ class DistCacheTable:
             self._refresh_thread = t
 
     def _refresh_quiet(self):
+        t0 = time.perf_counter_ns() if _TR.on else 0
         try:
-            self.refresh_stale()
+            n = self.refresh_stale()
+            if _TR.on:
+                # the read-only staleness sweep, on its own
+                # "hetu-emb-refresh" track
+                _TR.complete("emb.refresh", t0, time.perf_counter_ns(),
+                             cat="serve", args={"rows": n})
         except Exception:
             pass    # best-effort: the next counter trip retries
 

@@ -41,7 +41,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from ..metrics import record_serve
+from ..metrics import record_serve, record_serve_latency
+from ..obs.trace import TRACER as _TR
 
 
 class ServeRejected(RuntimeError):
@@ -156,6 +157,9 @@ class ServingRouter:
             self._admitted += 1
             record_serve("serve_requests")
             record_serve("serve_queue_depth_hw", len(self._q))
+            if _TR.on:
+                _TR.instant("serve.enqueue", cat="serve",
+                            args={"depth": len(self._q)})
             self._cv.notify()
         return req.future
 
@@ -229,17 +233,36 @@ class ServingRouter:
             inj.on_request(admitted)
         n = len(reqs)
         nodes = list(reqs[0].feeds)
+        # per-request queue wait: submit -> claimed into a batch (the
+        # router's contribution to tail latency — a p99 spike here is a
+        # batching/backpressure problem, not a model problem)
+        now = time.monotonic()
+        for r in reqs:
+            record_serve_latency("queue_wait", (now - r.t_arrival) * 1e6)
+        tr = _TR if _TR.on else None
+        if tr is not None:
+            t_asm = time.perf_counter_ns()
         try:
             stacked = {node: np.stack(
                 [np.asarray(r.feeds[node]) for r in reqs], 0)
                 for node in nodes}
             before = fault_counts().get("ps_failover_promoted", 0)
+            if tr is not None:
+                t_dev = time.perf_counter_ns()
+                tr.complete("serve.assemble", t_asm, t_dev, cat="serve",
+                            args={"n": n})
+            t_call = time.perf_counter_ns()
             # the executor's scatter plan is STATIC (abstract shapes at
             # two batch sizes — see _fetch_row_scaling): each request
             # gets its k per-sample rows of a row-scaled fetch, the
             # whole value of a batch-invariant (or exact-fit aggregate)
             # one; no runtime shape guessing to mis-scatter
             outs, rows_per_req = self.iex.infer_rows(stacked)
+            t_done = time.perf_counter_ns()
+            record_serve_latency("batch", (t_done - t_call) / 1e3)
+            if tr is not None:
+                tr.complete("serve.device_call", t_call, t_done,
+                            cat="serve", args={"n": n})
             delta = fault_counts().get("ps_failover_promoted", 0) - before
             if delta:
                 record_serve("serve_failovers", delta)
@@ -249,6 +272,8 @@ class ServingRouter:
                     r.future.set_exception(e)
             return
         record_serve("serve_responses", n)
+        if tr is not None:
+            t_sc = time.perf_counter_ns()
         for i, r in enumerate(reqs):
             row = []
             for o, k in zip(outs, rows_per_req):
@@ -259,6 +284,9 @@ class ServingRouter:
                 else:
                     row.append(o[i * k:(i + 1) * k])
             r.future.set_result(row)
+        if tr is not None:
+            tr.complete("serve.scatter", t_sc, time.perf_counter_ns(),
+                        cat="serve", args={"n": n})
         self._batches += 1
         if self.refresh_every_batches > 0 \
                 and self._batches % self.refresh_every_batches == 0:

@@ -232,6 +232,70 @@ def test_metrics_detect_recorder_without_accessor():
     assert any("no accessor" in f for f in findings), findings
 
 
+def test_metrics_detect_unrecorded_registry_instrument():
+    """ISSUE 10: a registered counter/histogram/gauge with no record_*
+    recording site is dead telemetry — the registry extension must say
+    so (one case per instrument kind)."""
+    for ctor in ("counter_family", "histogram", "gauge"):
+        src = f'_x = REGISTRY.{ctor}("lonely", "doc")\n'
+        findings = hetu_lint.check_metrics(src, "", {})
+        assert any("no record_* recording site" in f for f in findings), \
+            (ctor, findings)
+    # a recorded + accessed + surfaced registry instrument is clean
+    src = textwrap.dedent("""
+        _h = REGISTRY.histogram("fine_us", "doc")
+
+        def record_fine(us):
+            _h.observe(us)
+
+        def fine_stats():
+            return _h.snapshot()
+    """)
+    prof = "from .metrics import fine_stats\n"
+    findings = hetu_lint.check_metrics(src, prof,
+                                       {"a.py": "record_fine(1.0)"})
+    assert findings == [], findings
+
+
+def test_metrics_detect_raw_counter_off_registry():
+    """A module-level collections.Counter family bypasses metrics_dump
+    — flagged even when recorder/accessor/profiler wiring is right."""
+    src = textwrap.dedent("""
+        import collections
+        _c = collections.Counter()
+
+        def record_c(kind):
+            _c[kind] += 1
+
+        def c_counts():
+            return dict(_c)
+    """)
+    prof = "from .metrics import c_counts\n"
+    findings = hetu_lint.check_metrics(src, prof, {"a.py": "record_c('x')"})
+    assert any("raw Counter family off the obs registry" in f
+               for f in findings), findings
+
+
+def test_metrics_detect_adhoc_recorder_and_unregistered_call():
+    """A record_* defined outside metrics.py/obs, or a call to a
+    record_* name defined in neither, is an unregistered ad-hoc
+    recorder; the same def under hetu_tpu/obs/ is allowed."""
+    findings = hetu_lint.check_metrics(
+        "", "", {"hetu_tpu/rogue.py":
+                 "def record_rogue(k):\n    pass\nrecord_rogue('x')\n"})
+    assert any("ad-hoc recorder 'record_rogue'" in f
+               for f in findings), findings
+    findings = hetu_lint.check_metrics(
+        "", "", {"hetu_tpu/other.py": "record_ghost('x')\n"})
+    assert any("unregistered recorder 'record_ghost'" in f
+               for f in findings), findings
+    findings = hetu_lint.check_metrics(
+        "", "", {"hetu_tpu/obs/__init__.py":
+                 "def record_wrapped(k):\n    pass\n",
+                 "hetu_tpu/user.py": "record_wrapped('x')\n"})
+    assert not any("record_wrapped" in f for f in findings), findings
+
+
 def test_style_detects_unused_import_and_bare_fstring():
     src = "import os\nimport sys\nprint(sys.argv)\nx = f'no placeholders'\n"
     findings = hetu_lint.check_style(src, "synthetic.py")

@@ -665,3 +665,11 @@ def test_serve_bench_smoke():
     # run reuses them through the serve cache and builds none
     assert 0 < extra["clean_serve_counters"]["serve_bucket_compiles"] <= 4
     assert extra["serve_counters"].get("serve_bucket_compiles", 0) == 0
+    # ISSUE 10: queue-wait and batch-latency PERCENTILES from the obs
+    # registry's log-bucketed histograms, per run — not just means
+    for hist in (extra["latency_hist_ms"], extra["chaos_latency_hist_ms"]):
+        for kind in ("queue_wait", "batch"):
+            h = hist[kind]
+            assert h["count"] > 0
+            assert 0 <= h["p50_ms"] <= h["p99_ms"], (kind, h)
+    assert extra["latency_hist_ms"]["queue_wait"]["count"] == 180

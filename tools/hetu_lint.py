@@ -308,16 +308,32 @@ def check_opcodes(sources):
 
 # ------------------------------------------------------------------ metrics
 
+#: registry factory methods whose module-level assignments register an
+#: instrument (``_x = REGISTRY.counter_family("name", ...)``)
+_REGISTRY_CTORS = ("counter_family", "histogram", "gauge")
+
+
 def check_metrics(metrics_src, profiler_src, usage_srcs=None):
-    """Every ``record_*`` family in metrics.py must be recorded somewhere,
-    have a snapshot accessor, and that accessor must be read by
-    profiler.py."""
+    """Telemetry-registry coverage (ISSUE 10 extension of the counter
+    self-lint).  Over metrics.py: every REGISTERED instrument (an
+    ``obs.registry`` ``counter_family``/``histogram``/``gauge``
+    assignment) must have a ``record_*`` recording site, every recorder
+    must have a snapshot accessor that profiler.py surfaces, and every
+    recorder must be CALLED somewhere in the package.  A raw
+    ``collections.Counter`` family is itself a finding — it is
+    invisible to ``metrics_dump()``/Prometheus (pre-registry families
+    get the same recorder/accessor checks so the synthetic tests keep
+    meaning).  Over the rest of the package: a ``def record_*`` outside
+    metrics.py / the obs package, or a call to a ``record_*`` name
+    defined in neither, is an unregistered ad-hoc recorder — counters
+    nobody can dump are dead telemetry."""
     findings = []
     try:
         mtree = ast.parse(metrics_src)
     except SyntaxError as e:
         return [f"metrics.py: syntax error: {e}"]
-    counters = set()
+    counters = set()        # raw Counter() families (off-registry)
+    registered = {}         # var name -> (ctor kind, instrument name)
     for node in mtree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
@@ -325,14 +341,27 @@ def check_metrics(metrics_src, profiler_src, usage_srcs=None):
             fn = node.value.func
             ctor = fn.attr if isinstance(fn, ast.Attribute) else \
                 getattr(fn, "id", None)
+            var = node.targets[0].id
             if ctor == "Counter":
-                counters.add(node.targets[0].id)
+                counters.add(var)
+            elif ctor in _REGISTRY_CTORS:
+                args = node.value.args
+                iname = args[0].value if args and isinstance(
+                    args[0], ast.Constant) else var
+                registered[var] = (ctor, iname)
+    instrument_vars = counters | set(registered)
+
+    for var in sorted(counters):
+        findings.append(
+            f"metrics.py: {var} is a raw Counter family off the obs "
+            f"registry — invisible to metrics_dump()/Prometheus; "
+            f"register it via obs.registry (REGISTRY.counter_family)")
 
     def refs(func):
         return {n.id for n in ast.walk(func)
-                if isinstance(n, ast.Name)} & counters
+                if isinstance(n, ast.Name)} & instrument_vars
 
-    recorders, accessors = {}, {}   # func name -> counter vars
+    recorders, accessors = {}, {}   # func name -> instrument vars
     for node in mtree.body:
         if not isinstance(node, ast.FunctionDef):
             continue
@@ -344,6 +373,13 @@ def check_metrics(metrics_src, profiler_src, usage_srcs=None):
         elif not node.name.startswith("reset_") \
                 and not node.name.startswith("_"):
             accessors[node.name] = r
+    recorded_vars = set().union(*recorders.values()) if recorders \
+        else set()
+    for var in sorted(set(registered) - recorded_vars):
+        findings.append(
+            f"metrics.py: registered {registered[var][0]} "
+            f"'{registered[var][1]}' ({var}) has no record_* recording "
+            f"site — dead instrument")
 
     prof_names = set()
     try:
@@ -357,16 +393,51 @@ def check_metrics(metrics_src, profiler_src, usage_srcs=None):
     except SyntaxError as e:
         return [f"profiler.py: syntax error: {e}"]
 
+    # names defined/called across the package (outside metrics.py), plus
+    # the ad-hoc recorder sweep: record_* defs in obs/ are part of the
+    # telemetry surface (obs.record_mfu wraps registry gauges); anywhere
+    # else they bypass the registry
     usage_names = set()
-    for src in (usage_srcs or {}).values():
+    allowed_recorders = set(recorders) | {
+        n.name for n in mtree.body if isinstance(n, ast.FunctionDef)
+        and n.name.startswith("record_")}
+    adhoc_defs, called = [], {}     # called: name -> first file
+    for fname, src in (usage_srcs or {}).items():
+        in_obs = "obs" in fname.replace(os.sep, "/").split("/")
         try:
-            for node in ast.walk(ast.parse(src)):
-                if isinstance(node, ast.Name):
-                    usage_names.add(node.id)
-                elif isinstance(node, ast.Attribute):
-                    usage_names.add(node.attr)
+            tree = ast.parse(src)
         except SyntaxError:
             continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                usage_names.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                usage_names.add(node.attr)
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name.startswith("record_"):
+                if in_obs:
+                    allowed_recorders.add(node.name)
+                else:
+                    adhoc_defs.append((fname, node.lineno, node.name))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                cname = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if cname and cname.startswith("record_"):
+                    called.setdefault(cname, fname)
+    for fname, lineno, name in adhoc_defs:
+        findings.append(
+            f"{fname}:{lineno}: ad-hoc recorder '{name}' defined outside "
+            f"metrics.py/obs — its counts never reach the obs registry "
+            f"(metrics_dump/Prometheus); move the instrument into "
+            f"metrics.py")
+    if usage_srcs is not None:
+        for cname, fname in sorted(called.items()):
+            if cname not in allowed_recorders:
+                findings.append(
+                    f"{fname}: call to unregistered recorder '{cname}' — "
+                    f"no such record_* in metrics.py/obs; counts recorded "
+                    f"there are invisible to metrics_dump()")
 
     for rec, vars_ in sorted(recorders.items()):
         acc = [a for a, av in accessors.items() if av & vars_]
