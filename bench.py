@@ -1350,7 +1350,8 @@ def _child_main(args):
                       "lfu": "vlfu"}[args.emb_policy]
         res = bench_wdl(batch_size=bs, steps=_steps(3),
                         warmup=1 if cpu_fallback else 3,
-                        policy=policy)
+                        policy=policy,
+                        emb_device=args.emb_device or "host")
     elif args.config == "moe":
         bs = args.batch_size or (1024 if cpu_fallback else 8192)
         res = bench_moe(batch_tokens=bs, steps=_steps(3),
@@ -1618,7 +1619,8 @@ def _parent_main(args):
         if args.batch_size is None and args.seq_len is None \
         and args.steps in (None, DEFAULT_STEPS) \
         and getattr(args, "wdl_embed", "lru") == "lru" \
-        and getattr(args, "emb_policy", None) is None else None
+        and getattr(args, "emb_policy", None) is None \
+        and getattr(args, "emb_device", None) in (None, "host") else None
     if cached is not None:
         # top-level marker: a real on-TPU number, but NOT measured by this
         # invocation — consumers must not read it as a live success
@@ -1666,14 +1668,41 @@ def _parent_main(args):
     print(json.dumps(_error_result(args, last_err)))
 
 
-def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
+def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru",
+              emb_device="host"):
     """BASELINE config 4: Wide&Deep CTR with the HET embedding cache —
     rows pulled through the bounded-staleness cache around each jitted
-    step (reference run_hetu.py:121-126 cache flags)."""
-    import jax
+    step (reference run_hetu.py:121-126 cache flags).
 
-    _, ex, _fd0, (dense, sparse, y_) = build_wdl_graph(
-        batch_size=batch_size, policy=policy)
+    ``emb_device="device"`` (ISSUE 11) routes the embedding through the
+    DEVICE-RESIDENT cache slab (``--emb-device device`` requires a
+    vectorized-cache policy: vlru/vlfu): hit rows are gathered on-device
+    by slot index, only miss rows cross the host boundary (overlapped
+    with the forward on the feed-pipeline thread), and the grad
+    segment-sum runs on device.  The artifact then ALSO measures the
+    host-mode cache on the SAME warm zipf trace and records
+    ``vs_host_cache`` — the acceptance comparison — plus the
+    ``emb_pallas_fallback_reason`` counters (empty = the Pallas kernels
+    were the measured path; ``{gather,scatter_add}:backend_cpu`` = an
+    off-TPU run measured the counted ``jnp.take``/``segment_sum``
+    fallbacks)."""
+    import jax
+    from hetu_tpu import metrics as hmetrics
+
+    if emb_device not in ("host", "device"):
+        raise ValueError(f"emb_device must be host|device, got "
+                         f"{emb_device!r}")
+    if emb_device == "device":
+        if policy not in ("vlru", "vlfu"):
+            # the device slab belongs to DistCacheTable; map the native
+            # cache names onto their vectorized twins
+            policy = {"lru": "vlru", "lfu": "vlfu"}.get(policy)
+            if policy is None:
+                raise ValueError(
+                    "--emb-device device needs a DistCacheTable policy "
+                    "(--emb-policy lru|lfu)")
+        policy = policy + "_dev"
+
     ctr = _load_example_models("ctr")
     # Zipf-skewed ids: the HET cache's hit pattern (and therefore the
     # measured step time) is only meaningful under Criteo-like skew
@@ -1684,18 +1713,48 @@ def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
                 y_all[i * batch_size:(i + 1) * batch_size])
                for i in range(8)]
 
-    def run_step(i):
-        dv, sv, yv = batches[i % len(batches)]
-        return ex.run("train", feed_dict={dense: dv, sparse: sv, y_: yv})
+    def _measure(pol):
+        _, ex, _fd0, (dense, sparse, y_) = build_wdl_graph(
+            batch_size=batch_size, policy=pol)
 
-    dt = _timed(run_step, steps, warmup)
-    # cache evidence for the artifact: hit rate from whichever cache
-    # flavour the policy selected (native C++ or vectorized numpy)
-    cache_perf = {}
-    for node in ex.subexecutors["train"].ps_nodes:
-        c = getattr(node, "cache", None)
-        if c is not None and hasattr(c, "perf"):
-            cache_perf = c.perf() or {}
+        def run_step(i):
+            dv, sv, yv = batches[i % len(batches)]
+            return ex.run("train",
+                          feed_dict={dense: dv, sparse: sv, y_: yv})
+
+        dt = _timed(run_step, steps, warmup)   # resets step times itself
+        hist = _step_percentiles()
+        perf = {}
+        for node in ex.subexecutors["train"].ps_nodes:
+            c = getattr(node, "cache", None)
+            if c is not None and hasattr(c, "perf"):
+                perf = c.perf() or {}
+            if c is not None and hasattr(c, "flush"):
+                # flush BEFORE the executor is dropped: a pending-grad
+                # flush deferred to GC-time __del__ runs with the store
+                # graph half-collected (pre-existing teardown hazard)
+                c.flush()
+        return dt, perf, hist
+
+    hmetrics.reset_emb_pallas_fallbacks()
+    dt, cache_perf, step_hist = _measure(policy)
+    fallbacks = dict(hmetrics.emb_pallas_fallback_counts())
+    host_dt = host_hist = None
+    h2d_rows = None
+    if emb_device == "device" and cache_perf.get("lookups"):
+        # the backend-independent evidence: rows crossing the host
+        # boundary per step.  Device mode H2D-transfers only the PULLED
+        # (miss/refresh) rows; host mode materializes + transfers every
+        # looked-up occurrence, every step
+        n_steps = steps + warmup
+        h2d_rows = {
+            "device_miss_rows_per_step":
+                round(cache_perf["fetches"] / n_steps, 1),
+            "host_all_rows_per_step":
+                round(cache_perf["lookups"] / n_steps, 1)}
+    if emb_device == "device":
+        # the acceptance twin: the HOST-mode cache on the same trace
+        host_dt, _, host_hist = _measure(policy[:-4])
     base, label = _torch_bench_baseline("wdl", {"batch_size": batch_size})
     # NB: the torch baseline is a PLAIN device embedding — it implements
     # no bounded-staleness cache.  vs_baseline is only a same-semantics
@@ -1723,12 +1782,50 @@ def bench_wdl(batch_size=2048, steps=20, warmup=3, policy="lru"):
                   **_provenance({"batch_size": batch_size,
                                  "embed": policy}),
                   "cache": policy,
+                  "cache_mode": emb_device,
                   "cache_hit_rate": round(cache_perf["hit_rate"], 4)
                   if "hit_rate" in cache_perf else None,
+                  "emb_pallas_fallback_reason": fallbacks,
+                  **({"device_note":
+                      "off-TPU measurement: the gather/scatter-add ran "
+                      "the COUNTED jnp fallbacks on the host CPU (see "
+                      "emb_pallas_fallback_reason), so the 'device' ops "
+                      "compete with host Python for the same cores — "
+                      "the h2d_rows_per_step ratio is the backend-"
+                      "independent win (only miss rows cross the "
+                      "boundary); the wall-clock win requires a real "
+                      "TPU, where an empty fallback dict certifies the "
+                      "Pallas kernels as the measured path"}
+                     if emb_device == "device"
+                     and jax.default_backend() != "tpu" else {}),
+                  **({"host_step_time_ms": round(host_dt * 1e3, 2),
+                      # wall ratio (includes the device path's one-time
+                      # per-bucket fill compiles inside the timed
+                      # window) ...
+                      "vs_host_cache": round(host_dt / dt, 3),
+                      # ... and the steady-state ratio: p50-vs-p50 from
+                      # the step-time histograms, which is the number a
+                      # long-running job converges to
+                      "vs_host_cache_p50": _p50_ratio(host_hist,
+                                                      step_hist),
+                      "host_step_time_hist_ms": host_hist}
+                     if host_dt is not None else {}),
+                  **({"h2d_rows_per_step": h2d_rows}
+                     if h2d_rows is not None else {}),
                   "step_time_ms": round(dt * 1e3, 2),
-                  "step_time_hist_ms": _step_percentiles(),
+                  "step_time_hist_ms": step_hist,
                   "backend": jax.default_backend()},
     }
+
+
+def _p50_ratio(host_hist, dev_hist):
+    """host p50 / measured p50 from two ``_step_percentiles`` snapshots
+    (>1 = the measured mode is faster at steady state)."""
+    try:
+        return round(host_hist["train"]["p50_ms"]
+                     / dev_hist["train"]["p50_ms"], 3)
+    except (KeyError, TypeError, ZeroDivisionError):
+        return None
 
 
 def bench_attention(steps=10, warmup=2, cpu_fallback=False):
@@ -3080,6 +3177,15 @@ if __name__ == "__main__":
                         "vectorized HET cache path (direct = PS store "
                         "without a cache; lru/lfu = vectorized "
                         "DistCacheTable) — overrides --wdl-embed")
+    p.add_argument("--emb-device", default=None,
+                   choices=["host", "device"],
+                   help="wdl: where the HET cache's row slab lives "
+                        "(default host).  device = ISSUE 11 device-"
+                        "resident slab: on-device slot gather, "
+                        "overlapped miss pulls, Pallas grad scatter-add; "
+                        "the artifact extra records cache_mode, hit "
+                        "rate, emb_pallas_fallback_reason and the same-"
+                        "trace host-cache comparison (vs_host_cache)")
     p.add_argument("--smoke", action="store_true",
                    help="emb: 10^5-row smoke config (seconds, CPU) "
                         "instead of the 10^7x64 scale run; failover: "

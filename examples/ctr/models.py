@@ -15,14 +15,19 @@ NUM_DENSE = 13
 NUM_SPARSE = 26
 
 
-def _embed(ids_node, vocab, dim, mode, lr, name):
+def _embed(ids_node, vocab, dim, mode, lr, name, batch_ids=None):
     """Shared embedding: dense variable or PS/cache host table.
 
     Modes: ``dense`` (in-graph variable), ``ps`` (direct host store, no
-    cache), ``lru``/``lfu``/``lfuopt`` (native C++ HET cache), and
+    cache), ``lru``/``lfu``/``lfuopt`` (native C++ HET cache),
     ``vlru``/``vlfu`` (the vectorized numpy HET cache —
     :class:`hetu_tpu.ps.DistCacheTable` — the batched sparse-RPC path
-    ``bench.py --config wdl --emb-policy`` exercises)."""
+    ``bench.py --config wdl --emb-policy`` exercises), and
+    ``vlru_dev``/``vlfu_dev`` (the same cache with the DEVICE-RESIDENT
+    slab: hit rows gathered on-device by slot index, only miss rows
+    crossing the host boundary, grads segment-summed by the Pallas
+    scatter-add kernel — ``bench.py --config wdl --emb-device
+    device``)."""
     if mode == "dense":
         table = ht.Variable(
             name, initializer=ht.init.GenNormal(0.0, 0.01), shape=(vocab, dim),
@@ -33,14 +38,23 @@ def _embed(ids_node, vocab, dim, mode, lr, name):
         t = store.init_table(vocab, dim, opt="sgd", lr=lr, seed=0,
                              init_scale=0.01)
         return ht.ps_embedding_lookup_op((store, t), ids_node, width=dim)
-    if mode in ("vlru", "vlfu"):
+    if mode in ("vlru", "vlfu", "vlru_dev", "vlfu_dev"):
         from hetu_tpu.ps import DistCacheTable, EmbeddingStore
         store = EmbeddingStore()
         t = store.init_table(vocab, dim, opt="sgd", lr=lr, seed=0,
                              init_scale=0.01)
+        device = mode.endswith("_dev")
+        # scratch bound: a batch can never hold more uncacheable unique
+        # keys than its own flattened id count, so batch_ids scratch
+        # rows make overflow impossible at batch-sized memory cost (the
+        # vocab would also bound it — but a vocab-sized scratch would
+        # dwarf the cache and defeat its memory rationale)
+        scratch = min(vocab, batch_ids) if device and batch_ids \
+            else (vocab if device else None)
         cache = DistCacheTable(store, t, limit=max(vocab // 10, 256),
                                pull_bound=10, push_bound=10,
-                               policy=mode[1:])
+                               policy=mode[1:4], device=device,
+                               device_scratch=scratch)
         return ht.ps_embedding_lookup_op(cache, ids_node, width=dim)
     # native cache policies: lru / lfu / lfuopt
     cs = ht.CacheSparseTable(limit=max(vocab // 10, 256), length=vocab,
@@ -67,7 +81,8 @@ def _mlp(x, dims, name):
 def wdl_criteo(dense, sparse, y_, batch_size, vocab=100000, dim=16,
                embed_mode="dense", lr=0.01):
     """Wide & Deep (reference models/wdl_criteo.py)."""
-    emb = _embed(sparse, vocab, dim, embed_mode, lr, "wdl_embed")
+    emb = _embed(sparse, vocab, dim, embed_mode, lr, "wdl_embed",
+                 batch_ids=batch_size * NUM_SPARSE)
     flat = ht.array_reshape_op(emb, (batch_size, NUM_SPARSE * dim))
     deep_in = ht.concat_op(flat, dense, axis=1)
     deep = _mlp(deep_in, [NUM_SPARSE * dim + NUM_DENSE, 256, 256, 1], "deep")
@@ -83,7 +98,8 @@ def deepfm_criteo(dense, sparse, y_, batch_size, vocab=100000, dim=16,
                   embed_mode="dense", lr=0.01):
     """DeepFM (reference models/deepfm_criteo.py): FM 2nd-order term via
     0.5*((Σv)² − Σv²) + linear term + deep MLP."""
-    emb = _embed(sparse, vocab, dim, embed_mode, lr, "fm_embed")  # B,26,D
+    emb = _embed(sparse, vocab, dim, embed_mode, lr, "fm_embed",
+                 batch_ids=batch_size * NUM_SPARSE)  # B,26,D
     sum_vec = ht.reduce_sum_op(emb, [1])                  # B,D
     sum_sq = ht.mul_op(sum_vec, sum_vec)
     sq = ht.mul_op(emb, emb)
@@ -101,7 +117,8 @@ def dcn_criteo(dense, sparse, y_, batch_size, vocab=100000, dim=16,
                embed_mode="dense", lr=0.01, n_cross=3):
     """Deep & Cross (reference models/dcn_criteo.py): x_{l+1} = x0·(x_l·w) +
     b + x_l cross layers alongside a deep tower."""
-    emb = _embed(sparse, vocab, dim, embed_mode, lr, "dcn_embed")
+    emb = _embed(sparse, vocab, dim, embed_mode, lr, "dcn_embed",
+                 batch_ids=batch_size * NUM_SPARSE)
     flat = ht.array_reshape_op(emb, (batch_size, NUM_SPARSE * dim))
     x0 = ht.concat_op(flat, dense, axis=1)
     width = NUM_SPARSE * dim + NUM_DENSE

@@ -35,7 +35,7 @@ def main():
                    choices=["wdl", "deepfm", "dcn"])
     p.add_argument("--embed", default="dense",
                    choices=["dense", "ps", "lru", "lfu", "lfuopt",
-                            "vlru", "vlfu"])
+                            "vlru", "vlfu", "vlru_dev", "vlfu_dev"])
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--vocab", type=int, default=100000)
     p.add_argument("--dim", type=int, default=16)

@@ -30,7 +30,7 @@ def main():
     p.add_argument("--vocab", type=int, default=100000)
     p.add_argument("--embed-mode", default="lru",
                    choices=["dense", "ps", "lru", "lfu", "lfuopt",
-                            "vlru", "vlfu"])
+                            "vlru", "vlfu", "vlru_dev", "vlfu_dev"])
     p.add_argument("--bsp", type=int, default=0,
                    help="0 BSP, -1 ASP, k>0 SSP staleness bound")
     args = p.parse_args()

@@ -39,6 +39,23 @@ from .. import metrics as _metrics
 from ..obs.trace import TRACER as _TRACE
 
 
+def _dev_roundtrip(h):
+    """Feed-pipeline-thread unit of work for a device-cache step: the
+    batched pending-push + miss-pull round trip (``_DevLookup.roundtrip``
+    — store calls only, no cache state).  Traced as a ``ps.miss_pull``
+    span on the feed-pipeline track, with a flow arrow opened here and
+    closed inside the step span that consumes the rows."""
+    if not _TRACE.on:
+        return h.roundtrip()
+    t0 = _time.perf_counter_ns()
+    rows = h.roundtrip()
+    _TRACE.complete("ps.miss_pull", t0, _time.perf_counter_ns(), cat="ps",
+                    args={"miss_rows": 0 if rows is None
+                          else int(rows.shape[0])})
+    h.flow_id = _TRACE.flow_begin("emb.miss_fill", cat="ps")
+    return rows
+
+
 class _ZeroView:
     """``Executor.var_values`` stand-in for a stage-3 ZeRO parameter: the
     master bytes live dp-SHARDED inside a bucket slab
@@ -292,6 +309,21 @@ class SubExecutor:
         self._state_pairs = [(n, ex._k(n)) for n in self.state_vars]
         self._ps_items = [(n, ex._k(n), n.ids_node, ex._k(n.ids_node))
                           for n in self.ps_nodes]
+        # device-resident HET tables (DistCacheTable(device=True)) take
+        # the ISSUE 11 path: slot-plan host-side, batched miss pull on
+        # the feed-pipeline thread (overlapping the dense forward),
+        # slot-indexed on-device gather in the step, grads back through
+        # the device scatter-add kernel.  Host-mode tables keep the
+        # pull-rows-as-leaf path below unchanged.
+        self._ps_dev_items = [t for t in self._ps_items
+                              if getattr(t[0], "device_mode", False)]
+        self._ps_host_items = [t for t in self._ps_items
+                               if not getattr(t[0], "device_mode", False)]
+        #: node -> in-flight _DevLookup handle (consumed by _ps_post_step
+        #: for the summed-grad commit)
+        self._dev_live = {}
+        self._feed_node_set = frozenset(self.feed_nodes)
+        self._dev_node_set = frozenset(t[0] for t in self._ps_dev_items)
         # PS rows are pulled full-batch; executor-level microbatching
         # splits feeds — statically incompatible (raised per run)
         self._ps_microbatch_clash = bool(
@@ -363,6 +395,11 @@ class SubExecutor:
         fetch_nodes = self.fetches
 
         ps_keys = [self.ex._k(n) for n in self.ps_nodes]
+        # device-resident tables: key -> Pallas dispatch knob (the grad
+        # scatter-add runs inside the step with the table's own
+        # interpret policy)
+        dev_keys = {k: n.cache.device_interpret
+                    for n, k, _i, _ik in self._ps_dev_items}
 
         from contextlib import nullcontext
 
@@ -464,10 +501,22 @@ class SubExecutor:
                             model_params, feeds, sparams, key)
                     del loss_val
                 # PS-embedding row-gradients ride the updates side-channel;
-                # the executor pushes them into the host store post-step
+                # the executor pushes them into the host store post-step.
+                # Device-resident tables segment-sum the per-occurrence
+                # grads ON DEVICE first (sort + the Pallas segment-sum
+                # kernel keyed by the batch's unique-inverse map) — the
+                # host then commits U pre-summed rows instead of running
+                # the scipy-CSR pass over the whole batch
                 for k in ps_keys:
                     if k in grads:
-                        updates["psgrad:" + k] = grads[k]
+                        g = grads[k]
+                        if k in dev_keys:
+                            from ..ops.pallas import emb_cache as _emb
+                            g = _emb.emb_scatter_add(
+                                g.reshape(-1, g.shape[-1]),
+                                feeds["psdev:" + k + ":inv"],
+                                interpret=dev_keys[k])
+                        updates["psgrad:" + k] = g
                 new_tparams = dict(tparams)
                 new_opt_states = dict(opt_states)
                 lr_vals = _resolve_lrs(step_idx, lrs)
@@ -753,9 +802,29 @@ class SubExecutor:
 
     def _run_impl(self, feed_dict, convert_to_numpy_ret_vals=False,
                   sync=True, t_run0=0):
-        ex = self.ex
         if self._jit is None:
             self._build_step()
+        if not self._ps_dev_items:
+            return self._run_general(feed_dict, convert_to_numpy_ret_vals,
+                                     sync, t_run0, None)
+        # device-resident PS tables: the batched miss pull is issued on
+        # the feed-pipeline thread FIRST, so it overlaps everything the
+        # host does before the dispatch (dense feed placement, state
+        # packing) and — under async dispatch — the previous step's
+        # in-flight device work (the GC3 overlap discipline).  Any
+        # failure before the commit settles the in-flight handles so
+        # the cache locks release and exactly-once holds.
+        dev_pending = self._begin_dev_lookups(feed_dict)
+        try:
+            return self._run_general(feed_dict, convert_to_numpy_ret_vals,
+                                     sync, t_run0, dev_pending)
+        except BaseException:
+            self._settle_dev_pending(dev_pending)
+            raise
+
+    def _run_general(self, feed_dict, convert_to_numpy_ret_vals, sync,
+                     t_run0, dev_pending):
+        ex = self.ex
         # the cached run plan resolves feed keys, placement closures and
         # the validation verdict ONCE per feed schema (run_plan.py); the
         # per-step residue is this flat replay
@@ -794,9 +863,11 @@ class SubExecutor:
             if tr is not None:
                 t_ps = _time.perf_counter_ns()
             ps_vals = self._resolve_ps_rows(feed_dict, feeds)
-            if tr is not None:
+            if tr is not None and self._ps_host_items:
                 tr.complete("ps.pull_rows", t_ps,
                             _time.perf_counter_ns(), cat="ps")
+            if dev_pending is not None:
+                self._finish_dev_lookups(dev_pending, feeds, ps_vals)
             if self._ps_microbatch_clash:
                 # only the executor-level microbatch path splits feeds;
                 # PS rows are pulled full-batch — mutually exclusive
@@ -888,7 +959,7 @@ class SubExecutor:
         from ..data.dataloader import DataloaderOp
         ex = self.ex
         ps_vals = {}
-        for node, key, idn, idk in self._ps_items:
+        for node, key, idn, idk in self._ps_host_items:
             if idk in feeds:
                 ids = np.asarray(feeds[idk])
             elif idn in feed_dict:
@@ -911,6 +982,145 @@ class SubExecutor:
             ps_vals[key] = ex._place_feed(node, rows)
         return ps_vals
 
+    def _ensure_feed_pool(self):
+        """The single feed-pipeline worker, shared by the dataloader
+        H2D double-buffer (run_plan.start_feed_prefetch) and the
+        device-cache miss pull — ONE bootstrap so the two paths can
+        never build differently-configured pools."""
+        pool = self._feed_pool
+        if pool is None:
+            import concurrent.futures
+            pool = self._feed_pool = \
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"feed-pipeline-{self.name}")
+        return pool
+
+    # -- device-resident PS tables (ISSUE 11) -----------------------------
+    def _begin_dev_lookups(self, feed_dict):
+        """Phase 1 of the device-cache step: resolve each table's ids
+        batch, take the cache plan (``begin_lookup`` — slot plan +
+        push-payload copies under the cache lock), and issue the one
+        fallible store round trip on the feed-pipeline thread.  The
+        pull overlaps the dense feed placement / state packing on this
+        thread and, under async dispatch, the previous step's device
+        work; ``_finish_dev_lookups`` lands the rows in the slab before
+        the gather consumes them."""
+        from ..data.dataloader import DataloaderOp
+        ex = self.ex
+        if ex._multiprocess or ex.bsp != 0:
+            raise NotImplementedError(
+                "device-resident embedding caches support single-process "
+                "BSP training (bsp=0) — ASP/SSP and multi-process meshes "
+                "need the host-mode cache (DistCacheTable(device=False))")
+        pool = self._ensure_feed_pool()
+        pending = []
+        try:
+            for node, key, idn, idk in self._ps_dev_items:
+                if idn in feed_dict:
+                    ids = np.asarray(feed_dict[idn], np.int64)
+                elif isinstance(idn, DataloaderOp):
+                    if idn in self._feed_node_set:
+                        # the run plan will CONSUME this loader when it
+                        # places the graph's own ids feed later in the
+                        # step — PEEK here (get_arr pops the same peeked
+                        # batch), or the loader would advance twice per
+                        # step and desync ids from rows
+                        ids = np.asarray(idn.get_next_arr(self.name),
+                                         np.int64)
+                    else:
+                        # ids feed nothing but this lookup: nobody else
+                        # consumes, so consume here (host-path parity)
+                        ids = np.asarray(idn.get_arr(self.name), np.int64)
+                else:
+                    raise ValueError(
+                        f"cannot resolve ids for PS embedding {node}")
+                h = node.cache.begin_lookup(ids)
+                pending.append((node, key, ids, h,
+                                pool.submit(_dev_roundtrip, h)))
+        except BaseException:
+            self._settle_dev_pending(pending)
+            raise
+        return pending
+
+    def _finish_dev_lookups(self, pending, feeds, ps_vals):
+        """Phase 3: join the miss pull, COMMIT the cache plan — host
+        bookkeeping plus the EAGER in-place slab fill (a tiny donated
+        per-bucket fill program) — then gather the batch's rows from the
+        resident slab ON DEVICE and feed them as the node's ordinary
+        leaf value: the jitted step is byte-identical to host mode
+        except for the grad scatter-add, and hit rows never cross the
+        host boundary (host mode materialized + H2D-copied every row,
+        every step).  The unique-inverse map rides along for the in-step
+        grad segment-sum."""
+        import jax
+        from ..ops.pallas import emb_cache as _emb
+        tr = _TRACE if _TRACE.on else None
+        for node, key, ids, h, fut in pending:
+            try:
+                rows = fut.result()
+            except BaseException:
+                node.cache.abort_lookup(h)
+                raise
+            # span stamped AFTER the join: any blocked wait for the
+            # overlapped pull belongs to the ps.miss_pull span on the
+            # feed-pipeline track, not to the gather
+            t0 = _time.perf_counter_ns() if tr is not None else 0
+            cache = node.cache
+            # RLock depth 2 across commit+gather (finish_lookup's
+            # release drops to 1): a concurrent lookup/update on the
+            # same table must not evict a just-committed slot and fill
+            # another key's row into it before the gather DISPATCH has
+            # captured this slab/positions pairing (the same atomicity
+            # _lookup_device keeps for standalone callers)
+            cache._lock.acquire()
+            try:
+                cache.finish_lookup(h, rows)
+                m = 0 if rows is None else int(rows.shape[0])
+                if tr is not None and h.flow_id is not None:
+                    # the overlapped pull, as an arrow from the feed-
+                    # pipeline track into the step span that consumes it
+                    tr.flow_end("emb.miss_fill", h.flow_id, cat="ps")
+                w = cache.width
+                if h.flat.size:
+                    slots_occ = h.positions[h.inv].astype(np.int32)
+                    inv = h.inv.astype(np.int32)
+                else:
+                    slots_occ = np.zeros(0, np.int32)
+                    inv = np.zeros(0, np.int32)
+                g = _emb.gather_for_step(cache._ensure_dev_slab(),
+                                         jax.device_put(slots_occ),
+                                         interpret=cache.device_interpret)
+            finally:
+                cache._lock.release()
+            ps_vals[key] = g.reshape(tuple(ids.shape) + (w,))
+            feeds["psdev:" + key + ":inv"] = jax.device_put(inv)
+            self._dev_live[node] = h
+            if tr is not None:
+                tr.complete("emb.gather", t0, _time.perf_counter_ns(),
+                            cat="ps",
+                            args={"unique": 0 if h.uk is None
+                                  else int(h.uk.size), "miss_rows": m})
+
+    def _settle_dev_pending(self, pending):
+        """Failure path: every not-yet-committed handle must release its
+        cache lock.  A round trip that already SUCCEEDED is committed
+        (its pushes reached the server — dropping the plan would leave
+        the pending grads marked unsent and a retry would double-apply);
+        a failed or unread one is aborted with the cache untouched."""
+        for node, key, ids, h, fut in pending:
+            if h.done:
+                continue
+            try:
+                rows = fut.result()
+            except BaseException:
+                node.cache.abort_lookup(h)
+                continue
+            try:
+                node.cache.finish_lookup(h, rows)   # eager slab fill
+            except BaseException:
+                node.cache.abort_lookup(h)
+
     def _ps_post_step(self, updates, sync=True):
         """Post-dispatch PS plane: grad push (sync/async by ``bsp``),
         cross-rank barriers, SSP clock, next-batch row prefetch — the
@@ -923,7 +1133,35 @@ class SubExecutor:
             # async push (bounded-staleness semantics already allow it)
             self._start_ps_prefetch()
         pushed = False
+        dev_nodes = self._dev_node_set
+        tr = _TRACE if _TRACE.on else None
+        if dev_nodes:
+            from ..ops.pallas.emb_cache import fill_bucket
         for node in self.ps_nodes:
+            if node in dev_nodes:
+                # device-resident table: commit the device-summed grads
+                # — the host applies U pre-summed rows (bounded-
+                # staleness bookkeeping + batched push) instead of
+                # segment-summing the whole batch
+                k = ex._k(node)
+                h = self._dev_live.pop(node, None)
+                g = updates.pop("psgrad:" + k, None)
+                if g is not None and h is not None and h.uk is not None:
+                    pushed = True
+                    t0 = _time.perf_counter_ns() if tr is not None else 0
+                    # only rows [0, U) of the padded scatter-add output
+                    # are real — slice to a pow2 bucket on device first
+                    # so the D2H copy (the sync point) moves ~U rows,
+                    # not the whole padded batch
+                    U = int(h.uk.size)
+                    ub = min(g.shape[0], fill_bucket(U))
+                    gv = np.asarray(g[:ub])[:U]
+                    node.cache.apply_update_summed(h.uk, gv, h.cnt)
+                    if tr is not None:
+                        tr.complete("emb.scatter_add", t0,
+                                    _time.perf_counter_ns(), cat="ps",
+                                    args={"unique": int(h.uk.size)})
+                continue
             g = updates.pop("psgrad:" + ex._k(node), None)
             if g is not None:
                 pushed = True
@@ -978,7 +1216,6 @@ class SubExecutor:
             # and keeps the poll loop.  Either way a finite watchdog
             # raises rather than wedging every healthy worker behind one
             # dead straggler with no diagnostic.
-            import time as _time
             seen = set()
             for node in self.ps_nodes:
                 store = node.store
@@ -1059,6 +1296,10 @@ class SubExecutor:
         from ..ps.dist_store import DistributedStore
         for node in self.ps_nodes:
             if node in self._prefetched:
+                continue
+            if getattr(node, "device_mode", False):
+                # device-resident tables overlap their miss pull on the
+                # feed-pipeline thread instead (_begin_dev_lookups)
                 continue
             if isinstance(node.store, DistributedStore) \
                     and (self.ex.bsp != -1 or self.ex._multiprocess):

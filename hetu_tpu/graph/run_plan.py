@@ -408,14 +408,7 @@ class RunPlan:
             if cost is None or cost < min_us:
                 continue
             if pool is None:
-                pool = self.sub._feed_pool
-                if pool is None:
-                    import concurrent.futures
-                    pool = self.sub._feed_pool = \
-                        concurrent.futures.ThreadPoolExecutor(
-                            max_workers=1,
-                            thread_name_prefix=f"feed-pipeline-"
-                                               f"{self.sub.name}")
+                pool = self.sub._ensure_feed_pool()
             try:
                 host = node.get_next_arr(self.sub.name)
             except KeyError:    # no dataloader registered for this split

@@ -86,6 +86,40 @@ def reset_flash_fallbacks():
     _flash.reset()
 
 
+# ---------------------------------------------- embedding Pallas fallbacks
+# The device-resident embedding-cache dispatchers
+# (``ops/pallas/emb_cache.py``) record WHY a gather / grad scatter-add
+# left the Pallas kernel path (backend, forced interpret policy).  Flash
+# semantics: counts are per jax TRACE, not per step — one nonzero entry
+# means that workload compiled onto the fallback (``jnp.take`` /
+# ``jax.ops.segment_sum``) path, and a count climbing across steps means
+# the jit cache is thrashing.  Surfaced by
+# ``HetuProfiler.emb_pallas_fallbacks()`` and ``bench.py --config wdl
+# --emb-device device``; ``HETU_REQUIRE_PALLAS_EMB=1`` turns any
+# recording into a hard failure (emb_cache._note_fallback).
+
+_emb_pallas = REGISTRY.counter_family(
+    "emb_pallas_fallbacks",
+    "embedding-cache dispatches that left the Pallas device-kernel "
+    "path, by reason (per jax trace, not per step)")
+
+
+def record_emb_pallas_fallback(reason):
+    """Count one embedding-cache dispatch that fell back off Pallas."""
+    if counters_suppressed():
+        return  # abstract (eval_shape) trace, not a real dispatch
+    _emb_pallas.inc(str(reason))
+
+
+def emb_pallas_fallback_counts():
+    """{reason: count} snapshot of recorded embedding-kernel fallbacks."""
+    return _emb_pallas.counts()
+
+
+def reset_emb_pallas_fallbacks():
+    _emb_pallas.reset()
+
+
 # ------------------------------------------------------ fault-event counters
 # The fault-tolerance layer records every detection/recovery event here so
 # a run can PROVE what happened: transport retries (``ps_rpc_retry``),
@@ -155,7 +189,11 @@ def reset_faults():
 # the saving covers the local shard's share too, so on a w-rank store
 # (w-1)/w of it is wire traffic), and round trips where a fused
 # ``OP_PUSH_PULL`` frame carried both a push and a pull shard
-# (``ps_push_pull_fused_rpcs``).  Invariant (asserted by the tests):
+# (``ps_push_pull_fused_rpcs``), and grad segment-sums that ran on the
+# scipy-absent ``np.add.at`` host fallback (``emb_grad_host_fallback``
+# — scipy ships with jax, so any count here means an exotic build lost
+# the CSR fast path; device-resident tables skip the host pass
+# entirely).  Invariant (asserted by the tests):
 # only sparse-PS traffic records here, so a clean dense run reports an
 # empty dict.  Surfaced by ``HetuProfiler.cache_counters()`` and
 # ``bench.py --config emb``.
@@ -481,6 +519,7 @@ def run_gauges():
 #: profiler's ``all_counters`` read this instead of seven accessors
 _FAMILIES = {
     "flash_fallbacks": _flash,
+    "emb_pallas_fallbacks": _emb_pallas,
     "faults": _faults,
     "cache": _cache,
     "zero": _zero,

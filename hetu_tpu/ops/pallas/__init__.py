@@ -4,6 +4,8 @@
 - :mod:`flash_attention` — blockwise online-softmax attention, fwd+bwd.
 - :mod:`moe_dispatch` — row-gather sparse dispatch/combine (O(s·m) memory).
 - :mod:`segment_sum` — sorted-run segment sum / IndexedSlices dedup.
+- :mod:`emb_cache` — device-resident HET-cache slab: slot-indexed row
+  gather + unique-inverse grad scatter-add (ISSUE 11).
 
 Every kernel runs under ``interpret=True`` in CPU CI (tests/test_pallas.py)
 so the exact TPU kernel code is exercised without hardware.
@@ -11,3 +13,4 @@ so the exact TPU kernel code is exercised without hardware.
 from .flash_attention import flash_attention
 from .moe_dispatch import row_gather, sparse_dispatch, sparse_combine
 from .segment_sum import sorted_segment_sum, dedup_rows
+from .emb_cache import emb_gather, emb_scatter_add, fill_rows

@@ -237,8 +237,8 @@ class HetuProfiler:
     def all_counters():
         """{family: {kind: count}} over EVERY counter family on the
         observability registry in one call (``hetu_tpu.metrics``
-        ``all_counts``): flash_fallbacks, faults, cache, zero,
-        step_cache, run_plan, serve, ps_rpc_bytes.  The per-family
+        ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
+        cache, zero, step_cache, run_plan, serve, ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -271,6 +271,18 @@ class HetuProfiler:
         hard failures instead of counters."""
         from .metrics import flash_fallback_counts
         return flash_fallback_counts()
+
+    @staticmethod
+    def emb_pallas_fallbacks():
+        """{reason: count} of embedding-cache dispatches that LEFT the
+        Pallas device-kernel path (``hetu_tpu.metrics`` registry) — the
+        slot-indexed gather or the grad scatter-add compiled onto the
+        ``jnp.take`` / ``jax.ops.segment_sum`` fallback instead
+        (``ops/pallas/emb_cache.py``).  Flash semantics: per trace, not
+        per step; ``HETU_REQUIRE_PALLAS_EMB=1`` makes these hard
+        failures instead of counters."""
+        from .metrics import emb_pallas_fallback_counts
+        return emb_pallas_fallback_counts()
 
     @staticmethod
     def cache_counters():

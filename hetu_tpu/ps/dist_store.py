@@ -149,9 +149,14 @@ def _next_backoff(base, prev, cap, rng):
 def _segment_sum(grads, inv, counts):
     """Per-unique-key float32 grad sums (the client-side half of wire
     dedup).  A one-hot CSR matmul when scipy is present — numpy's own
-    scatter-reductions (``ufunc.at``, ``reduceat``) are scalar-dispatched
-    and ~5x slower on the (batch, width) slabs this path moves; scipy
-    ships with jax, so the fallback exists only for exotic builds.
+    scatter-reductions (``ufunc.at``) are scalar-dispatched and ~5x
+    slower on the (batch, width) slabs this path moves; scipy ships
+    with jax, so the fallback exists only for exotic builds and is
+    COUNTED (``emb_grad_host_fallback`` in the cache family) so a run
+    that silently lost the fast path is visible in its counters.
+    Device-resident tables skip this host pass entirely: their grads
+    arrive pre-summed by the Pallas scatter-add kernel
+    (``ops/pallas/emb_cache.py``) through ``apply_update_summed``.
     Summation association may differ from a per-occurrence loop by
     float32 rounding; every cache/transport DECISION is value-independent
     (keys and counters only), so semantics are unaffected."""
@@ -165,10 +170,14 @@ def _segment_sum(grads, inv, counts):
             shape=(inv.size, counts.size))
         return np.asarray(onehot.T @ grads, np.float32)
     except ImportError:
-        order = np.argsort(inv, kind="stable")
-        starts = np.zeros(counts.size, np.int64)
-        np.cumsum(counts[:-1], out=starts[1:])
-        return np.add.reduceat(grads[order], starts, axis=0)
+        # DELIBERATELY np.add.at (ISSUE 11 satellite): simplest correct
+        # scatter-reduce, slow per the note above — which is exactly why
+        # it is counted; a build that trips this counter should install
+        # scipy, not live on the fallback
+        record_cache("emb_grad_host_fallback", 1)
+        out = np.zeros((counts.size, grads.shape[1]), np.float32)
+        np.add.at(out, inv, grads)
+        return out
 
 
 def _recv_exact(sock, n):
@@ -2027,6 +2036,49 @@ class DistributedStore:
         self.server.stop()
 
 
+class _DevLookup:
+    """Pending device-mode lookup (``DistCacheTable.begin_lookup``): the
+    host-side plan frozen before the one fallible store round trip.
+    :meth:`roundtrip` touches ONLY the store (no cache state, no lock),
+    so it is safe on any thread — the executor runs it on the
+    feed-pipeline thread to overlap the miss pull with the dense
+    forward; stats/counters land at commit on the owning thread."""
+
+    __slots__ = ("cache", "shape", "flat", "uk", "inv", "cnt", "slots",
+                 "hit", "refresh", "rkeys", "rslots", "dirty", "plan",
+                 "absent", "pk", "pg", "positions", "fill_targets",
+                 "done", "flow_id")
+
+    def __init__(self, cache, shape, flat):
+        self.cache, self.shape, self.flat = cache, shape, flat
+        self.uk = self.inv = self.cnt = self.slots = None
+        self.hit = self.refresh = None
+        self.rkeys = self.rslots = None
+        self.dirty = self.plan = self.absent = None
+        self.pk = self.pg = None
+        self.positions = self.fill_targets = None
+        self.done = False
+        self.flow_id = None     # trace arrow: miss pull -> consuming step
+
+    def roundtrip(self):
+        """The one fallible step: pending pushes + the batched MISS pull,
+        fused into one ``push_pull`` per peer when the store supports it
+        (``_flush_to_store`` wire behaviour, counters deferred to
+        commit).  Returns the pulled rows aligned to ``rkeys`` (None
+        when the batch had no misses)."""
+        c = self.cache
+        rows = None
+        if self.pk is not None:
+            if self.rkeys is not None and hasattr(c.store, "push_pull"):
+                rows = c.store.push_pull(c.table, self.pk, self.pg,
+                                         self.rkeys, c.lr)
+            else:
+                c.store.push(c.table, self.pk, self.pg, c.lr)
+        if rows is None and self.rkeys is not None:
+            rows = c.store.pull(c.table, self.rkeys)
+        return rows
+
+
 class DistCacheTable:
     """HET bounded-staleness embedding cache — fully vectorized, batch-
     granular (reference ``src/hetu_cache/cache.h:21`` pull_bound_/
@@ -2065,6 +2117,38 @@ class DistCacheTable:
       get slots and the remainder are served (and their grads pushed)
       uncached.
 
+    **Device-resident mode** (``device=True`` — ISSUE 11): the slot
+    table, hash table, eviction clocks and the transactional commit
+    protocol stay host-side and UNCHANGED (every decision above is
+    byte-identical to host mode), but the row slab gains a
+    device-resident mirror of shape ``(limit + device_scratch + 1,
+    width)`` and the hot path stops moving hit rows across the host
+    boundary: a lookup is split into :meth:`begin_lookup` (plan, under
+    the lock) → a store round trip for the pushes + MISS pull only
+    (:meth:`_DevLookup.roundtrip`, lock-free — the executor runs it on
+    the feed-pipeline thread so it overlaps the dense forward) →
+    :meth:`finish_lookup` (commit).  Hit rows are gathered ON DEVICE by
+    slot index (``ops/pallas/emb_cache.py`` Pallas kernel, with counted
+    ``jnp.take`` fallback off-TPU); only miss rows are H2D-transferred,
+    landing in their committed slots via :func:`fill_rows`.  Batch
+    unique keys that exceed capacity are served through ``device_scratch``
+    scratch rows past the slab (positions ``[limit, limit+scratch)``;
+    never registered, overwritten freely — the "served uncached"
+    contract above), and one dump row at ``limit + scratch`` absorbs
+    fill padding.  The training grad path arrives pre-summed per unique
+    key from the device scatter-add kernel through
+    :meth:`apply_update_summed`, replacing the host scipy-CSR segment
+    sum.  The lock is HELD from ``begin_lookup`` to
+    ``finish_lookup``/:meth:`abort_lookup` (the host-mode ``lookup``
+    holds it for the same window), so a transport failure still leaves
+    the cache untouched.  In device mode the host ``_data`` slab is NOT
+    mirrored (the device slab is the one serving copy — a host mirror
+    would double the per-step row traffic for a buffer nothing reads);
+    served values stay bitwise equal to host mode because both modes
+    fill from the same pull bytes and copy them verbatim.  Restrictions:
+    mutually exclusive with ``read_only``; the executor wiring supports
+    BSP single-process training (ASP/SSP/multi-process raise).
+
     **Read-only serving mode** (``read_only=True`` — what
     :class:`hetu_tpu.serving.InferenceExecutor` mounts): a pure lookup
     serves any cached row WITHOUT burning ``pull_bound`` budget, touching
@@ -2087,13 +2171,31 @@ class DistCacheTable:
 
     def __init__(self, store, table, limit=1 << 16,
                  pull_bound=100, push_bound=10, lr=-1.0, policy="lru",
-                 read_only=False, refresh_every=0):
+                 read_only=False, refresh_every=0, device=False,
+                 device_scratch=None, device_interpret=None):
         self.store, self.table = store, table
         self.width = int(store.width(table))
         self.limit = int(limit)
         self.pull_bound, self.push_bound = int(pull_bound), int(push_bound)
         self.lr = lr
         self.read_only = bool(read_only)
+        #: device-resident slab mode (see class docstring)
+        self.device = bool(device)
+        if self.device and self.read_only:
+            raise NotImplementedError(
+                "DistCacheTable(device=True, read_only=True): the "
+                "serving path keeps its host slab (version-refresh "
+                "rides it) — device-resident serving is future work")
+        #: scratch rows past the slab for capacity-overflow batches
+        #: (keys served uncached still need a device row to gather)
+        self._dev_scratch = int(device_scratch) if device_scratch \
+            is not None else max(256, self.limit // 4)
+        #: fill-padding target: one garbage row that is never gathered
+        self._dev_dump = self.limit + self._dev_scratch
+        #: Pallas dispatch knob forwarded to ops/pallas/emb_cache.py
+        #: (None = auto: kernel on TPU, counted fallback elsewhere)
+        self.device_interpret = device_interpret
+        self._dev_slab = None   # lazily-built (limit+scratch+1, width)
         #: read-only mode: run a version-based refresh sweep every N
         #: lookup calls (0 = only when refresh_stale() is called)
         self.refresh_every = int(refresh_every)
@@ -2104,7 +2206,10 @@ class DistCacheTable:
             raise ValueError(f"unknown cache policy {policy!r}")
         self.policy = policy
         L, w = self.limit, self.width
-        self._data = np.zeros((L, w), np.float32)   # cached rows
+        # device mode never reads the host row mirror (the device slab
+        # is the one serving copy) — don't commit limit*width host bytes
+        # to a buffer nothing reads
+        self._data = np.zeros((0 if self.device else L, w), np.float32)
         self._grad = np.zeros((L, w), np.float32)   # pending grad slab
         self._slotkey = np.full(L, self._EMPTY, np.int64)  # slot -> key
         self._uses = np.zeros(L, np.int64)     # lookups since refresh
@@ -2314,6 +2419,8 @@ class DistCacheTable:
     # -- core ops ----------------------------------------------------------
     def lookup(self, keys):
         keys = np.ascontiguousarray(keys, np.int64)
+        if self.device:
+            return self._lookup_device(keys)
         sweep = False
         with self._lock:
             if self.read_only:
@@ -2472,6 +2579,236 @@ class DistCacheTable:
         t.join(timeout)
         return not t.is_alive()
 
+    # -- device-resident mode (ISSUE 11; see class docstring) --------------
+    def _ensure_dev_slab(self):
+        """The device row slab: ``limit`` cache slots + ``device_scratch``
+        overflow rows + one dump row for fill padding.  Built lazily so
+        a host-mode table never touches jax."""
+        if self._dev_slab is None:
+            import jax.numpy as jnp
+            self._dev_slab = jnp.zeros(
+                (self.limit + self._dev_scratch + 1, self.width),
+                jnp.float32)
+        return self._dev_slab
+
+    def begin_lookup(self, keys):
+        """Device-mode lookup, phase 1 of 3: take the cache lock and PLAN
+        — hit/refresh partition, victim/slot plan, push payload copies,
+        device positions — exactly the pre-RPC half of the host-mode
+        ``_lookup_locked``.  Returns a :class:`_DevLookup` handle whose
+        :meth:`_DevLookup.roundtrip` runs the one fallible store round
+        trip LOCK-FREE (any thread — the executor uses the feed-pipeline
+        thread so the miss pull overlaps the dense forward), after which
+        :meth:`finish_lookup` commits, or :meth:`abort_lookup` releases
+        with the cache untouched (transactional contract: a transport
+        failure registers no never-filled slot and loses no pending
+        grad).  The lock is HELD until finish/abort — the same window
+        the host-mode ``lookup`` holds it for."""
+        if not self.device:
+            raise RuntimeError("begin_lookup requires device=True")
+        keys = np.ascontiguousarray(keys, np.int64)
+        flat = keys.reshape(-1)
+        self._lock.acquire()
+        try:
+            h = _DevLookup(self, keys.shape, flat)
+            self._tick += 1
+            self._batch_memo = None
+            self.stats["lookups"] += int(flat.size)
+            if not flat.size:
+                return h
+            uk, inv, cnt = np.unique(flat, return_inverse=True,
+                                     return_counts=True)
+            slots = self._find(uk)
+            present = slots >= 0
+            hit = np.zeros(uk.size, bool)
+            hit[present] = self._uses[slots[present]] < self.pull_bound
+            refresh = ~hit
+            h.uk, h.inv, h.cnt = uk, inv, cnt
+            h.slots, h.hit, h.refresh = slots, hit, refresh
+            push_keys, push_grads = [], []
+            if refresh.any():
+                rkeys = uk[refresh]
+                rslots = slots[refresh].copy()
+                stale = rslots >= 0
+                dirty, dkeys, dgrads = self._plan_dirty(rslots[stale])
+                if dirty.size:
+                    push_keys.append(dkeys)
+                    push_grads.append(dgrads)
+                absent = ~stale
+                plan = None
+                if absent.any():
+                    plan = self._plan_slots(rkeys[absent], slots[present])
+                    ev_dirty, evk, evg = self._plan_dirty(plan[2])
+                    if ev_dirty.size:
+                        push_keys.append(evk)
+                        push_grads.append(evg)
+                    rslots[absent] = plan[0]
+                h.rkeys, h.rslots = rkeys, rslots
+                h.dirty, h.plan, h.absent = dirty, plan, absent
+            if push_keys:
+                pk = np.concatenate(push_keys)
+                pg = np.concatenate(push_grads)
+                order = np.argsort(pk, kind="stable")  # deterministic wire
+                h.pk, h.pg = pk[order], pg[order]
+            # device positions per unique key: committed/planned slot,
+            # or a scratch row for capacity-overflow keys (served — and
+            # grad-pushed — uncached, never registered)
+            pos = slots.copy()
+            if h.rslots is not None:
+                pos[refresh] = h.rslots
+            over = pos < 0
+            n_over = int(over.sum())
+            if n_over > self._dev_scratch:
+                raise RuntimeError(
+                    f"device-mode batch overflow: {n_over} uncacheable "
+                    f"unique keys exceed device_scratch="
+                    f"{self._dev_scratch} — raise device_scratch (or "
+                    f"limit), or use the host cache for this workload")
+            pos[over] = self.limit + np.arange(n_over)
+            h.positions = pos
+            if h.rkeys is not None:
+                h.fill_targets = pos[refresh].astype(np.int32)
+            return h
+        except BaseException:
+            self._lock.release()
+            raise
+
+    def finish_lookup(self, h, rows):
+        """Device-mode lookup, phase 3: COMMIT the plan with the pulled
+        miss ``rows`` (aligned to ``h.rkeys``) — the post-RPC half of
+        the host-mode ``_lookup_locked`` (slot registration, hit/
+        eviction bookkeeping, counters) plus the eager in-place device
+        fill (:meth:`_apply_dev_fill`) — and release the lock.
+        Standalone callers and the executor share this one commit
+        path; the consuming gather (in the step, or eagerly in
+        ``lookup``) happens after it."""
+        try:
+            if h.flat.size == 0:
+                return
+            uk, cnt, hit, refresh = h.uk, h.cnt, h.hit, h.refresh
+            if h.pk is not None:
+                self.stats["pushes"] += int(h.pk.size)
+                self.stats["push_rpcs"] += 1
+                record_cache("emb_cache_push_rows", int(h.pk.size))
+                record_cache("emb_cache_push_rpcs", 1)
+            slots = h.slots
+            if h.rkeys is not None:
+                rslots = h.rslots
+                self.stats["fetches"] += int(h.rkeys.size)
+                if h.dirty.size:
+                    self._grad[h.dirty] = 0.0
+                    self._gcnt[h.dirty] = 0
+                if h.plan is not None:
+                    self._commit_slots(h.rkeys[h.absent], h.plan)
+                cached = rslots >= 0
+                if cached.all():
+                    cs, cnt_r = rslots, cnt[refresh]
+                else:
+                    cs = rslots[cached]
+                    cnt_r = cnt[refresh][cached]
+                # NB: no ``_data[cs] = rows`` here — in device mode the
+                # filled slab IS the serving copy; mirroring every miss
+                # row into the host slab would double the per-step row
+                # traffic for a buffer nothing reads
+                self._uses[cs] = cnt_r
+                self._ticks[cs] = self._tick
+                self._freq[cs] += cnt_r
+                self._maybe_rehash()
+                slots = slots.copy()
+                slots[refresh] = rslots
+            n_hit_rows = int(cnt[hit].sum())
+            self.stats["hits"] += n_hit_rows
+            record_cache("emb_cache_hit_rows", n_hit_rows)
+            record_cache("emb_cache_miss_rows",
+                         int(h.flat.size) - n_hit_rows)
+            if hit.any():
+                hs = slots[hit]
+                self._uses[hs] += cnt[hit]
+                self._ticks[hs] = self._tick
+                self._freq[hs] += cnt[hit]
+            self._batch_memo = (h.flat, uk, h.inv, cnt, slots)
+            if h.rkeys is not None:
+                try:
+                    self._apply_dev_fill(rows, h.fill_targets)
+                except BaseException:
+                    # the host commit above is already irreversible (and
+                    # correct — the pushes landed); a failed FILL must
+                    # not leave registered slots whose slab rows were
+                    # never written, so poison them stale: they re-pull
+                    # on the next lookup instead of serving garbage
+                    if cs.size:
+                        self._uses[cs] = self.pull_bound
+                    raise
+        finally:
+            h.done = True
+            self._lock.release()
+
+    def _apply_dev_fill(self, rows, targets):
+        """Land pulled rows in the device slab IN PLACE: the fill
+        arrays are padded to a pow2 bucket (padding targets the dump
+        row) so miss-count jitter cycles a bounded set of tiny compiled
+        fill programs, and the slab rides through a jit donated on TPU
+        so no per-step ``(limit + scratch, width)`` copy exists there
+        (CPU cannot honor donation and copies either way).  The
+        training step's own program never sees the fill — its input
+        shapes stay fixed."""
+        import jax
+        from ..ops.pallas import emb_cache as _emb
+        m = int(rows.shape[0])
+        bucket = _emb.fill_bucket(m)
+        # np.empty: padding rows are garbage by design — their targets
+        # all point at the dump row, which is never gathered
+        fr = np.empty((bucket, self.width), np.float32)
+        ft = np.full((bucket,), self._dev_dump, np.int32)
+        fr[:m] = rows
+        ft[:m] = targets
+        self._dev_slab = _emb.fill_rows_inplace(
+            self._ensure_dev_slab(), jax.device_put(fr),
+            jax.device_put(ft))
+
+    def abort_lookup(self, h):
+        """Release a :meth:`begin_lookup` handle after a failed round
+        trip: the plan is discarded, nothing host- or device-side was
+        mutated by it (the tick/lookup stats advanced, as they do on a
+        failed host-mode lookup)."""
+        if not h.done:
+            h.done = True
+            self._lock.release()
+
+    def _lookup_device(self, keys):
+        """Standalone device-mode lookup (parity tests, the profiler,
+        non-executor callers — e.g. ``PSEmbeddingLookupOp.pull_rows``
+        on a prefetch thread): the same begin → round trip → commit
+        protocol the executor drives, with the gather run eagerly
+        through the dispatcher.  The RLock is re-entered around
+        commit+gather so the whole serve is ATOMIC like the host-mode
+        ``lookup`` — without it, a concurrent lookup could evict one of
+        this batch's slots and fill another key's row into it between
+        the commit and the gather.  Returns host rows like host mode."""
+        h = self.begin_lookup(keys)
+        try:
+            rows = h.roundtrip()
+        except BaseException:
+            self.abort_lookup(h)
+            raise
+        # RLock depth 2 (begin holds depth 1): finish_lookup's release
+        # drops to depth 1, keeping other threads out until the gather
+        # below has served this batch's rows
+        self._lock.acquire()
+        try:
+            self.finish_lookup(h, rows)
+            if not h.flat.size:
+                return np.empty(keys.shape + (self.width,), np.float32)
+            import jax.numpy as jnp
+            from ..ops.pallas import emb_cache as _emb
+            out = _emb.emb_gather(self._ensure_dev_slab(),
+                                  jnp.asarray(h.positions[h.inv]
+                                              .astype(np.int32)),
+                                  interpret=self.device_interpret)
+            return np.asarray(out).reshape(keys.shape + (self.width,))
+        finally:
+            self._lock.release()
+
     def _lookup_locked(self, flat):
         self._tick += 1
         self._batch_memo = None
@@ -2558,8 +2895,41 @@ class DistCacheTable:
             return
         grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size,
                                                                 -1)
+        if self.device:
+            # standalone device-mode update: the per-unique-key segment
+            # sum runs through the device scatter-add dispatcher (the
+            # executor hands in pre-summed grads via apply_update_summed
+            # instead — same kernel, summed inside the jitted step)
+            import jax.numpy as jnp
+            from ..ops.pallas import emb_cache as _emb
+            uk, inv, cnt = np.unique(keys, return_inverse=True,
+                                     return_counts=True)
+            acc = np.asarray(_emb.emb_scatter_add(
+                jnp.asarray(grads), jnp.asarray(inv.astype(np.int32)),
+                interpret=self.device_interpret))[:uk.size]
+            self.apply_update_summed(uk, acc, cnt)
+            return
         with self._lock:
             self._update_locked(keys, grads)
+
+    def apply_update_summed(self, uk, acc, cnt):
+        """Device-path update entry: ``acc`` already holds the
+        per-unique-key grad sums (the device scatter-add kernel replaced
+        the host scipy-CSR pass), ``uk`` the batch's sorted unique keys
+        and ``cnt`` their occurrence counts — everything the bounded-
+        staleness bookkeeping (``gcnt``/``push_bound``/eviction clocks)
+        needs, with identical integer decisions to the host-mode
+        ``update`` on the same batch."""
+        uk = np.ascontiguousarray(uk, np.int64).reshape(-1)
+        acc = np.ascontiguousarray(acc, np.float32).reshape(uk.size, -1)
+        cnt = np.ascontiguousarray(cnt, np.int64).reshape(-1)
+        with self._lock:
+            self._tick += 1
+            self._batch_memo = None
+            self.stats["updates"] += int(cnt.sum())
+            if not uk.size:
+                return
+            self._apply_update(uk, cnt, self._find(uk), acc)
 
     def _update_locked(self, flat, grads):
         self._tick += 1
@@ -2578,6 +2948,13 @@ class DistCacheTable:
                                      return_counts=True)
             slots = self._find(uk)
         acc = _segment_sum(grads, inv, cnt)
+        self._apply_update(uk, cnt, slots, acc)
+
+    def _apply_update(self, uk, cnt, slots, acc):
+        """Post-segment-sum half of ``update`` (shared by the host path
+        and the device path's pre-summed entry): slot planning for
+        absent keys, push-bound accounting, the one batched push round
+        trip, and the transactional commit."""
         present = slots >= 0
         push_keys, push_grads = [], []
         absent = ~present
@@ -2628,8 +3005,10 @@ class DistCacheTable:
         if plan is not None:
             regk, regs = self._commit_slots(uk[absent], plan)
             # grad-only slots: the row was never pulled, so it must never
-            # serve — born stale
-            self._data[regs] = 0.0
+            # serve — born stale (device mode has no host row mirror to
+            # zero; uses=pull_bound alone keeps the slot unservable)
+            if not self.device:
+                self._data[regs] = 0.0
             self._uses[regs] = self.pull_bound
         self._grad[cs] += acc_c
         self._gcnt[cs] = new_gcnt

@@ -55,6 +55,20 @@ class PSEmbeddingLookupOp(PlaceholderOp):
             self.store, self.table = default_store(), int(table)
             self.width = width
 
+    @property
+    def device_mode(self):
+        """True when the backing cache keeps a device-resident slab
+        (``DistCacheTable(device=True)``): the executor then lowers this
+        lookup to a slot-indexed on-device gather, overlaps the PS miss
+        pull with the dense forward on the feed-pipeline thread, and
+        feeds the grad back through the device scatter-add kernel —
+        ``pull``/``push`` below are the HOST-mode protocol and are not
+        used on the device path (``pull_rows`` still works: standalone
+        callers and the profiler get rows through the same device
+        commit protocol)."""
+        return isinstance(self.cache, DistCacheTable) \
+            and getattr(self.cache, "device", False)
+
     # host-side pull/push used by the executor around the jitted step
     def pull_rows(self, ids):
         """Stateless row pull — safe on a background prefetch thread (does

@@ -196,6 +196,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
     dump = obs.metrics_dump()
     legacy = {
         "flash_fallbacks": metrics.flash_fallback_counts(),
+        "emb_pallas_fallbacks": metrics.emb_pallas_fallback_counts(),
         "faults": metrics.fault_counts(),
         "cache": metrics.cache_counts(),
         "zero": metrics.zero_counts(),
