@@ -24,11 +24,17 @@ Hardened against a flaky TPU backend (the round-1 artifact died with
 "Unable to initialize backend 'axon'" and a >9-min hang): the parent process
 runs the measurement in a child with a hard wall-clock budget and bounded
 retries, and ALWAYS prints exactly one JSON line — with an ``error`` field
-instead of a traceback/hang on failure.
+instead of a traceback/hang on failure.  Probe attempts retry with
+decorrelated-jitter backoff under a bounded attempt budget
+(``HETU_BENCH_PROBE_ATTEMPTS``), and every attempt's outcome is appended
+to ``artifacts/tpu_probe_log.jsonl`` (the same log tools/tpu_watch.py
+writes) so a wedged round leaves a per-attempt audit trail instead of a
+silent near-timeout.
 """
 import argparse
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -1301,6 +1307,13 @@ def _child_main(args):
                                      smoke=args.smoke,
                                      write_artifact=True)))
         return
+    if args.config == "elastic":
+        # CPU host-device mesh (the parent's child env forces >=8
+        # devices): the elastic resize acceptance run of ISSUE 12 —
+        # chaos step-clock kill, shrink to dp-1, rejoin, grow back
+        print(json.dumps(bench_elastic(steps=args.steps or 10,
+                                       dp=args.dp, smoke=args.smoke)))
+        return
 
     def _steps(cpu_cap):
         # explicit --steps is honored verbatim (comparison harnesses need
@@ -1468,6 +1481,66 @@ def _foreign_bench_running():
     return False
 
 
+PROBE_LOG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "artifacts", "tpu_probe_log.jsonl")
+# the wedged tunnel recovers on a minutes scale: a bounded number of
+# probe attempts with decorrelated-jitter backoff replaces both the old
+# fixed 15s cadence (fleet-synchronized hammering) and the unbounded
+# "probe until the budget drains" loop (a clean diagnostic beats a
+# near-timeout wedge)
+MAX_PROBE_ATTEMPTS = int(os.environ.get("HETU_BENCH_PROBE_ATTEMPTS", "8"))
+PROBE_BACKOFF_BASE_S = 5.0
+PROBE_BACKOFF_CAP_S = 60.0
+
+
+def _next_probe_backoff(prev, rng, base=PROBE_BACKOFF_BASE_S,
+                        cap=PROBE_BACKOFF_CAP_S):
+    """Decorrelated-jitter probe retry delay (the ``dist_store.
+    _next_backoff`` formula: ``min(cap, uniform(base, 3*prev))``) — no
+    two bench invocations hammer a recovering tunnel on the same
+    schedule.  Split out so the schedule is unit-testable."""
+    return min(cap, rng.uniform(base, 3.0 * max(base, prev)))
+
+
+#: rotation bound for the committed probe log; tools/tpu_watch.py
+#: delegates its writes here, so this is the ONE append-and-rotate
+#: discipline for artifacts/tpu_probe_log.jsonl
+PROBE_LOG_CAP = 2000
+
+
+def _append_probe_log(entry, path=PROBE_LOG_PATH):
+    """One JSONL line per probe attempt — the same log
+    ``tools/tpu_watch.py`` writes, so the committed
+    ``artifacts/tpu_probe_log.jsonl`` is the single wedge history
+    BENCH rounds are judged on.  Rotated at PROBE_LOG_CAP lines
+    (oldest dropped, header note kept — the watcher's discipline: a
+    wedged quarter cannot bloat the repo).  Best-effort: a read-only
+    checkout must not fail the measurement."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        entry = {"at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 **entry}
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        return
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+        if len(lines) > PROBE_LOG_CAP + 200:
+            head = lines[:1] if lines and "note" in lines[0] else []
+            kept = head + [json.dumps(
+                {"note": f"rotated: {len(lines) - len(head) - PROBE_LOG_CAP}"
+                         f" older probes dropped"}) + "\n"] \
+                + lines[-PROBE_LOG_CAP:]
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.writelines(kept)
+            os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _probe_backend(timeout_s):
     """(ok, err) — ok iff jax backend init answers within timeout_s AND the
     default backend is an accelerator AND a tiny computation actually
@@ -1561,12 +1634,24 @@ def _parent_main(args):
     last_err = "no attempts made"
     attempt = 0
     rc_failures = 0
+    probe_failures = 0
+    backoff = PROBE_BACKOFF_BASE_S
+    rng = random.Random()       # jitter wants entropy, not repeatability
     while True:
         remaining = deadline - time.monotonic()
         if remaining - CPU_RESERVE_S <= MIN_MEASURE_S:
             # too little runway for compile+warmup+steps: probing further
             # only delays the fallback artifact
             last_err += " | stopped (insufficient runway for a measurement)"
+            break
+        if probe_failures >= MAX_PROBE_ATTEMPTS:
+            # bounded attempt budget: a tunnel that failed this many
+            # probes is wedged for longer than this invocation can wait —
+            # hand a clean diagnostic to the fallback path instead of
+            # burning the rest of the window on more probes
+            last_err = (f"tunnel wedged: {probe_failures} probe attempts "
+                        f"failed with decorrelated-jitter backoff (last: "
+                        f"{last_err}); see artifacts/tpu_probe_log.jsonl")
             break
         if _foreign_bench_running() or _pytest_live():
             # another measurement (the watcher's) or a test run owns the
@@ -1579,11 +1664,21 @@ def _parent_main(args):
             continue
         ok, probe_err = _probe_backend(min(PROBE_TIMEOUT_S,
                                            remaining - CPU_RESERVE_S))
+        _append_probe_log({"ok": bool(ok), "err": probe_err,
+                           "source": "bench", "attempt": attempt,
+                           "config": args.config})
         if not ok:
             last_err = f"attempt {attempt}: {probe_err}"
             attempt += 1
-            time.sleep(15)  # give the tunnel a chance to recover
+            probe_failures += 1
+            # decorrelated jitter: spread recovering-tunnel retries out
+            # instead of the old lockstep 15s cadence
+            backoff = _next_probe_backoff(backoff, rng)
+            time.sleep(min(backoff,
+                           max(0.0, deadline - time.monotonic())))
             continue
+        probe_failures = 0
+        backoff = PROBE_BACKOFF_BASE_S
         remaining = deadline - time.monotonic()
         if remaining - CPU_RESERVE_S <= MIN_MEASURE_S:
             continue    # probe ate the runway; top-of-loop break explains
@@ -3152,15 +3247,241 @@ def _two_cell_scenario(cut_step, heal_step):
                 pass
 
 
+def bench_elastic(steps=10, kill_step=3, rejoin_step=5, dp=4, zero=1,
+                  smoke=True):
+    """ISSUE 12 acceptance: elastic data-parallel training — kill one of
+    dp=4 mid-run, keep training at dp=3 without a restart, grow back on
+    rejoin.
+
+    One chaos-driven run (``kill:proc@rank2:step<kill_step>`` on the
+    deterministic step clock; the rank rejoins before step
+    ``rejoin_step``) against the uninterrupted dp-MATCHED reference (same
+    graph, same feeds, same world trajectory via explicit resizes, no
+    chaos, no controller).  The artifact records the resize timeline
+    (step, dp transition, recovery_ms per resize), restarts=0/resumes=0,
+    BITWISE loss parity vs the reference, the compiled-step-cache
+    evidence (2 misses for the two world sizes, >= 1 HIT on the
+    grow-back — no recompile), the elastic counters, and both resizes as
+    spans/instants counted out of the exported Perfetto trace.  Writes
+    ``artifacts/elastic_smoke.json``."""
+    import gc
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import chaos as chaos_mod, metrics as ht_metrics, obs
+    from hetu_tpu.graph import step_cache
+    from hetu_tpu.parallel.elastic import (ElasticController, LogicalRank,
+                                           handles_alive_fn)
+
+    if len(jax.devices()) < dp:
+        raise RuntimeError(
+            f"bench_elastic needs >= {dp} devices — run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={dp} (bench.py "
+            f"--config elastic sets this for its child automatically)")
+    if not (0 < kill_step < rejoin_step <= steps - 2):
+        raise ValueError(
+            f"need 0 < kill_step < rejoin_step <= steps-2, got "
+            f"kill={kill_step} rejoin={rejoin_step} steps={steps}")
+    if dp < 3:
+        # the scenario kills one rank and keeps training: the controller
+        # floors the shrink at min_dp=2, so dp=2 would refuse the resize
+        # and the run would fail the acceptance instead of explaining
+        raise ValueError(
+            f"bench_elastic needs dp >= 3 (kill one of dp, survive at "
+            f"dp-1 >= the min_dp=2 floor), got dp={dp}")
+
+    dead_rank = dp - 2
+    per_rank = 4        # per-replica batch rows: global batch = dp * 4
+
+    def build():
+        rng = np.random.RandomState(0)
+        x = ht.placeholder_op("x")
+        y_ = ht.placeholder_op("y_")
+        w1 = ht.Variable("w1",
+                         value=rng.randn(16, 32).astype(np.float32) * 0.2)
+        b1 = ht.Variable("b1", value=np.zeros(32, np.float32))
+        w2 = ht.Variable("w2",
+                         value=rng.randn(32, 8).astype(np.float32) * 0.2)
+        h = ht.relu_op(ht.linear_op(x, w1, b1))
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+        opt = ht.optim.AdamOptimizer(0.01)
+        ex = ht.Executor(
+            {"train": [loss, opt.minimize(loss)]}, seed=0,
+            dist_strategy=ht.dist.DataParallel(num_devices=dp), zero=zero)
+        return x, y_, ex
+
+    def batch(step, world):
+        rng = np.random.RandomState(4242 + step)
+        n = per_rank * world
+        xv = rng.randn(n, 16).astype(np.float32)
+        yv = np.eye(8, dtype=np.float32)[rng.randint(0, 8, n)]
+        return xv, yv
+
+    # the world trajectory both runs follow: shrink fires at the poll
+    # after the kill (chaos on_step reports post-step counters, so
+    # kill_step means "kill after the step that leaves the counter
+    # there"), grow at the poll after the rejoin
+    worlds = [dp if (i < kill_step or i >= rejoin_step) else dp - 1
+              for i in range(steps)]
+
+    step_cache.clear()
+    gc.collect()
+    ht_metrics.reset_all()
+
+    # ---- elastic run: chaos kill + controller-driven resize ----------
+    handles = [LogicalRank(r) for r in range(dp)]
+    inj = chaos_mod.ChaosInjector.from_spec(
+        f"7:kill:proc@rank{dead_rank}:step{kill_step}")
+    for h in handles:
+        inj.register_proc(h.rank, h)
+    prev = chaos_mod.install(inj)
+    obs.clear_trace()
+    obs.enable(True)
+    t_wall0 = time.perf_counter()
+    try:
+        x, y_, ex = build()
+        ctl = ElasticController(ex, world=dp,
+                                alive_fn=handles_alive_fn(handles),
+                                min_dp=2)
+        losses, seen_worlds = [], []
+        for i in range(steps):
+            xv, yv = batch(i, ctl.dp)
+            out = ex.run("train", feed_dict={x: xv, y_: yv})
+            losses.append(np.float32(out[0].asnumpy()))
+            seen_worlds.append(ctl.dp)
+            if i == rejoin_step - 1:
+                handles[dead_rank].rejoin()
+            ctl.poll()
+        trace_evs = obs.trace_events()
+    finally:
+        obs.enable(False)
+        obs.clear_trace()
+        chaos_mod.install(prev)
+    wall_s = time.perf_counter() - t_wall0
+    elastic_counters = dict(ht_metrics.elastic_counts())
+    fault_counters = dict(ht_metrics.fault_counts())
+    sc = dict(ht_metrics.step_cache_counts())
+    timeline = list(ctl.events)
+    # drop BOTH references to the elastic executor (ctl.ex pins it) so
+    # the reference run below doesn't coexist with its device buffers
+    del ex, ctl
+    gc.collect()
+
+    resize_spans = [e for e in trace_evs if e.get("ph") == "X"
+                    and e["name"] == "elastic.resize"]
+    shrink_events = [e for e in trace_evs if e.get("ph") == "i"
+                     and e["name"] == "elastic:shrink"]
+    grow_events = [e for e in trace_evs if e.get("ph") == "i"
+                   and e["name"] == "elastic:grow"]
+
+    # ---- dp-matched reference: same trajectory, zero chaos -----------
+    ht_metrics.reset_elastic_counts()
+    x, y_, ex2 = build()
+    ref_losses, active = [], list(range(dp))
+    for i, w in enumerate(worlds):
+        if w != len(active):
+            active = [r for r in range(dp) if r != dead_rank] \
+                if w == dp - 1 else list(range(dp))
+            ex2.resize_world(active)
+        xv, yv = batch(i, w)
+        out = ex2.run("train", feed_dict={x: xv, y_: yv})
+        ref_losses.append(np.float32(out[0].asnumpy()))
+    clean_elastic = dict(ht_metrics.elastic_counts())
+    del ex2
+    step_cache.clear()
+    gc.collect()
+
+    loss_bits = [v.tobytes().hex() for v in losses]
+    ref_bits = [v.tobytes().hex() for v in ref_losses]
+    parity = loss_bits == ref_bits
+    recovery_ms = max((e["recovery_ms"] for e in timeline), default=None)
+    kinds = [e["kind"] for e in timeline]
+    ok = (parity and seen_worlds == worlds
+          and kinds == ["shrink", "grow"]
+          and fault_counters.get("chaos_kill_proc") == 1
+          and fault_counters.get("supervisor_restart", 0) == 0
+          and fault_counters.get("resume", 0) == 0
+          and sc.get("step_cache_miss") == 2
+          and sc.get("step_cache_hit", 0) >= 1
+          and len(resize_spans) == 2
+          and len(shrink_events) >= 1 and len(grow_events) >= 1)
+
+    res = {
+        "metric": "elastic_resize_recovery_ms",
+        "value": recovery_ms,
+        "unit": "ms",
+        # 1.0 = the elastic trajectory is bitwise the dp-matched
+        # uninterrupted reference (the continuous-loss-trajectory gate)
+        "vs_baseline": 1.0 if parity else 0.0,
+        "extra": {
+            "baseline_def": "value = slowest resize (detection poll -> "
+                            "resized executor); vs_baseline 1.0 = losses "
+                            "bitwise equal to an uninterrupted dp-matched "
+                            "reference run (no restart, no checkpoint "
+                            "resume anywhere)",
+            **_provenance({"dp": dp, "steps": steps, "zero": zero,
+                           "kill_step": kill_step,
+                           "rejoin_step": rejoin_step,
+                           "per_rank_batch": per_rank}),
+            "world_trajectory": seen_worlds,
+            "resize_timeline": timeline,
+            "loss_bits": loss_bits,
+            "final_loss": float(losses[-1]),
+            "loss_bitwise_equal_vs_reference": parity,
+            "restarts": int(fault_counters.get("supervisor_restart", 0)),
+            "resumes": int(fault_counters.get("resume", 0)),
+            "elastic_counters": elastic_counters,
+            "fault_counters": fault_counters,
+            "clean_run_elastic_counters": clean_elastic,
+            "step_cache": sc,
+            "trace": {"resize_spans": len(resize_spans),
+                      "shrink_events": len(shrink_events),
+                      "grow_events": len(grow_events)},
+            "wall_s": round(wall_s, 2),
+            "backend": jax.default_backend(),
+            "smoke": bool(smoke),
+        },
+    }
+    if not ok:
+        res["error"] = (
+            "elastic acceptance failed: "
+            + "; ".join(filter(None, [
+                None if parity else "loss NOT bitwise vs reference",
+                None if seen_worlds == worlds
+                else f"world trajectory {seen_worlds} != {worlds}",
+                None if kinds == ["shrink", "grow"]
+                else f"resize kinds {kinds}",
+                None if sc.get("step_cache_hit", 0) >= 1
+                else f"no step-cache hit on grow-back ({sc})",
+                None if len(resize_spans) == 2
+                else f"{len(resize_spans)} resize spans in trace",
+            ])))
+    try:
+        from artifact_schema import provenance as _prov
+        out = {**res, **_prov({"dp": dp, "steps": steps, "zero": zero,
+                               "kill_step": kill_step,
+                               "rejoin_step": rejoin_step})}
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts", "elastic_smoke.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        os.replace(path + ".tmp", path)
+    except Exception:
+        pass    # the printed result is the bench contract; file is extra
+    return res
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
                             "chaos", "failover", "emb", "zero", "serve",
-                            "partition", "overhead", "trace"])
+                            "partition", "overhead", "trace", "elastic"])
     p.add_argument("--dp", type=int, default=4,
-                   help="zero only: data-parallel mesh size (the child "
-                        "forces a CPU host-device mesh of >= this)")
+                   help="zero/elastic: data-parallel mesh size (the child "
+                        "forces a CPU host-device mesh of >= this; "
+                        "elastic needs >= 3 — kill one, survive at dp-1)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None,
                    help="bert only: sequence length (default 512 — the "
@@ -3194,7 +3515,9 @@ if __name__ == "__main__":
                         "serve_smoke.json); partition: the CI-sized "
                         "partition+heal run (artifacts/"
                         "partition_smoke.json); overhead: the CI parity/"
-                        "plan-cache gate (no artifact write)")
+                        "plan-cache gate (no artifact write); elastic: "
+                        "the chaos-driven dp=4 kill+rejoin run "
+                        "(artifacts/elastic_smoke.json)")
     p.add_argument("--steps", type=int, default=None,
                    help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
                         "the CPU fallback unless given explicitly)")
@@ -3202,14 +3525,14 @@ if __name__ == "__main__":
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
     elif args.config in ("chaos", "failover", "emb", "zero", "serve",
-                         "partition", "overhead", "trace"):
+                         "partition", "overhead", "trace", "elastic"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness
         env = dict(os.environ, **{CHILD_ENV_FLAG: "1",
                                   "_HETU_BENCH_FORCE_CPU": "1"})
-        if args.config == "zero":
-            # the acceptance run measures a dp>=4 CPU mesh: the device
+        if args.config in ("zero", "elastic"):
+            # these acceptance runs measure a dp>=4 CPU mesh: the device
             # count flag must land before the child's backend init
             flags = env.get("XLA_FLAGS", "")
             if "host_platform_device_count" not in flags:

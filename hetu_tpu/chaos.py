@@ -15,7 +15,12 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   registered :class:`~hetu_tpu.ps.dist_store.StoreServer` when the
   executor reports training step ``s``; ``kill:proc@rank<r>:after<ms>``
   tells the supervising launcher to kill a child rank after a wall-clock
-  delay (fired at most once per injector);
+  delay (fired at most once per injector); ``kill:proc@rank<r>:step<n>``
+  is the DETERMINISTIC form on the step clock — it stops a worker-rank
+  handle registered via :meth:`ChaosInjector.register_proc` when the
+  executor reports step ``n``, so the elastic tests
+  (:mod:`hetu_tpu.parallel.elastic`) kill a rank at an exact step
+  boundary instead of a wall-clock race;
 * **replica-role kills** — with PS shard replication
   (``replication=2``), ``kill:primary@shard<s>:step<n>`` stops whichever
   registered server currently SERVES shard ``s`` at step ``n`` (resolved
@@ -48,6 +53,7 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="1234:drop=0.1,delay=0.2:50,dup=0.05,wedge=0.01:2000"
     HETU_CHAOS="7:kill:ps@rank1:step3"
     HETU_CHAOS="7:kill:proc@rank0:after250"
+    HETU_CHAOS="7:kill:proc@rank2:step5"
     HETU_CHAOS="7:kill:primary@shard1:step3"
     HETU_CHAOS="7:kill:backup@shard1:step3"
     HETU_CHAOS="7:kill:primary@shard1:req200"
@@ -190,11 +196,18 @@ def _parse_fault(part):
             if what == "proc" and when.startswith("after"):
                 return {"kind": "kill_proc", "rank": rank,
                         "after_ms": float(when[len("after"):])}
+            if what == "proc" and when.startswith("step"):
+                # deterministic form: fires on the executor's step clock
+                # against a register_proc'd handle — elastic tests kill a
+                # rank at an EXACT step boundary instead of a wall-clock
+                # delay (the after<ms> form stays the launcher's)
+                return {"kind": "kill_proc", "rank": rank,
+                        "step": int(when[len("step"):])}
             raise ValueError(part)
         except (ValueError, IndexError):
             raise ChaosSpecError(
                 f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>,"
-                f" kill:proc@rank<r>:after<ms>, or "
+                f" kill:proc@rank<r>:{{after<ms>|step<n>}}, or "
                 f"kill:{{primary,backup}}@shard<s>:{{step<n>|req<n>}}"
                 ) from None
     if "=" not in part:
@@ -253,6 +266,7 @@ class ChaosInjector:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._servers = {}          # rank -> StoreServer
+        self._procs = {}            # rank -> proc handle (step-clock kills)
         self._fired = set()         # one-shot kill faults already fired
         #: the step clock partitions level-trigger on (fed by on_step);
         #: -1 = the executor never reported a step, so no partition is
@@ -338,6 +352,17 @@ class ChaosInjector:
         with self._lock:
             self._servers[rank] = server
 
+    def register_proc(self, rank, handle):
+        """A worker-rank handle volunteers as the kill target for the
+        step-clock form ``kill:proc@rank<r>:step<n>`` — anything with a
+        ``stop()`` (the elastic harness's
+        :class:`~hetu_tpu.parallel.elastic.LogicalRank`; a real
+        launcher-side wrapper could hold a Popen).  The wall-clock
+        ``after<ms>`` form stays on :meth:`due_proc_kills` (the
+        launcher's monitor loop has no step clock)."""
+        with self._lock:
+            self._procs[rank] = handle
+
     def _resolve_role_kill(self, fault):
         """The registered server currently filling the fault's replica
         role: ``kill_primary`` → the one SERVING the shard, ``kill_backup``
@@ -382,10 +407,23 @@ class ChaosInjector:
             for i, f in enumerate(self.faults):
                 if i in self._fired or f.get("step") != step \
                         or f["kind"] not in ("kill_ps", "kill_primary",
-                                             "kill_backup"):
+                                             "kill_backup", "kill_proc"):
                     continue
                 self._fired.add(i)
-                if f["kind"] == "kill_ps":
+                if f["kind"] == "kill_proc":
+                    # step-clock worker-rank kill (elastic harness): the
+                    # registered handle's stop() is the fail-stop death
+                    handle = self._procs.get(f["rank"])
+                    if handle is not None:
+                        killed.append((f["rank"], handle,
+                                       "chaos_kill_proc"))
+                    elif not self._procs:
+                        # same quiet/loud split as kill:ps — with OTHER
+                        # handles registered the target presumably lives
+                        # in a different process and fires there
+                        missing.append(f"kill:proc@rank{f['rank']}"
+                                       f":step{step}")
+                elif f["kind"] == "kill_ps":
                     server = self._servers.get(f["rank"])
                     if server is not None:
                         killed.append((f["rank"], server, "chaos_kill_ps"))
@@ -424,9 +462,10 @@ class ChaosInjector:
         for what in missing:
             import warnings
             record_fault("chaos_kill_target_missing")
-            warnings.warn(f"chaos {what} fired but no registered server "
-                          f"fills that role — the kill did NOT happen",
-                          RuntimeWarning)
+            warnings.warn(f"chaos {what} fired but no registered kill "
+                          f"target fills that role (register_server for "
+                          f"ps/primary/backup, register_proc for proc) — "
+                          f"the kill did NOT happen", RuntimeWarning)
         for rank, server, counter in killed:
             record_fault(counter)
             server.stop()
@@ -457,11 +496,15 @@ class ChaosInjector:
 
     # -- launcher-level child kills ----------------------------------------
     def due_proc_kills(self, elapsed_ms):
-        """Ranks whose ``kill:proc`` delay has elapsed; each fires once."""
+        """Ranks whose wall-clock ``kill:proc@rank<r>:after<ms>`` delay
+        has elapsed; each fires once.  Step-clock ``:step<n>`` proc
+        kills never fire here — they ride :meth:`on_step` against
+        ``register_proc``'d handles."""
         due = []
         with self._lock:
             for i, f in enumerate(self.faults):
-                if f["kind"] == "kill_proc" and i not in self._fired \
+                if f["kind"] == "kill_proc" and "after_ms" in f \
+                        and i not in self._fired \
                         and elapsed_ms >= f["after_ms"]:
                     self._fired.add(i)
                     due.append(f["rank"])

@@ -1687,6 +1687,222 @@ class Executor:
         self._slab_fetch_cache[bucket.key] = (cur, host)
         return host
 
+    # -- elastic world resize (parallel/elastic.py, ISSUE 12) --------------
+
+    @staticmethod
+    def _transcode_opt_state(tree, old_plan, new_plan):
+        """Re-layout one optimizer's HOST state between ZeRO bucket
+        plans: slab-keyed moment dicts of ``old_plan`` unpack to
+        per-param arrays, which ``new_plan`` re-packs into its own
+        ``(dp, width)`` slabs — pure data movement (flatten/concat/pad),
+        so the moments survive a resize bitwise.  Either plan may be
+        None (replicated layout on that side).  Scalars (Adam's ``t``)
+        and non-matching subtrees pass through untouched."""
+        from ..parallel import zero as _zero
+        old_keys = frozenset(b.key for b in old_plan.buckets) \
+            if old_plan is not None else frozenset()
+        new_keys = frozenset(new_plan.param_keys) \
+            if new_plan is not None else frozenset()
+
+        def walk(t):
+            if not isinstance(t, dict):
+                return t
+            keys = frozenset(t)
+            if old_keys and keys == old_keys:
+                flat = {}
+                for b in old_plan.buckets:
+                    flat.update(_zero.host_unpack_slab(
+                        np.asarray(t[b.key]), b))
+                t = flat
+                keys = frozenset(t)
+            if new_keys and keys == new_keys:
+                return {b.key: _zero.host_pack_slab(t, b)
+                        for b in new_plan.buckets}
+            return {k: walk(v) for k, v in t.items()}
+
+        return walk(tree)
+
+    def _maybe_transcode_loaded_opt(self, op, host_tree):
+        """Cross-dp checkpoint portability: a directory checkpoint
+        written under a different world size carries ``op``'s ZeRO
+        moment slabs in the WRITER's ``(dp, width)`` layout.  Bucket
+        boundaries are dp-independent (packing is by bytes and dtype),
+        so the writer's plan is reconstructible from the slab's leading
+        dim — reconstruct it and transcode the moments into this
+        world's layout (bitwise, pure data movement).  Anything that
+        does not look like a clean cross-dp slab set (different bucket
+        partition, stage mismatch) passes through untouched and the
+        existing shape handling decides.  This is what lets a
+        supervisor restart — or a fresh executor — resume a checkpoint
+        that an elastic resize (``resize_world``) wrote at a different
+        dp."""
+        plan = self._zero_plans.get(op)
+        if plan is None:
+            return host_tree
+        from ..parallel import zero as _zero
+        new_shapes = {(b.dp, b.width) for b in plan.buckets}
+        bucket_keys = frozenset(b.key for b in plan.buckets)
+        slab_shape = []
+
+        def scan(t):
+            if not isinstance(t, dict) or slab_shape:
+                return
+            if frozenset(t) == bucket_keys:
+                for bi, b in enumerate(plan.buckets):
+                    v = t.get(b.key)
+                    if getattr(v, "ndim", 0) == 2:
+                        slab_shape.append((bi, tuple(v.shape)))
+                        return
+            for v in t.values():
+                scan(v)
+
+        scan(host_tree)
+        if not slab_shape or slab_shape[0][1] in new_shapes:
+            return host_tree        # same world (or nothing slab-like)
+        bi, shape = slab_shape[0]
+        dp_old = int(shape[0])
+        items = [(k, s, b.dtype) for b in plan.buckets
+                 for k, s in zip(b.param_keys, b.shapes)]
+        old_plan = _zero.build_plan(
+            items, dp_old, plan.stage,
+            per_param=bool(getattr(op.optimizer, "lamb", False)),
+            prefix=self._k(op) + ".")
+        if frozenset(b.key for b in old_plan.buckets) != bucket_keys \
+                or shape != (old_plan.buckets[bi].dp,
+                             old_plan.buckets[bi].width):
+            return host_tree        # not a clean cross-dp layout
+        warnings.warn(
+            f"checkpoint optimizer state for '{op.name}' was written at "
+            f"dp={dp_old}; transcoding its moment slabs to this world's "
+            f"dp={plan.dp} layout (elastic-resize checkpoint "
+            f"portability)")
+        return self._transcode_opt_state(host_tree, old_plan, plan)
+
+    def resize_world(self, ranks):
+        """Resize the data-parallel world IN PLACE — the elastic
+        shrink/grow primitive (:mod:`hetu_tpu.parallel.elastic`).
+
+        ``ranks``: the active rank indices into the BASE world (the
+        device order of the mesh this executor was constructed with —
+        rank r is base device r).  Everything that makes training
+        continuous is preserved bitwise: params and optimizer moments
+        (ZeRO slab layouts transcoded through
+        :meth:`_transcode_opt_state`), the RNG key, the step counter,
+        and dataloader positions (never touched).  In-flight async
+        steps are drained first; the jitted step rebuilds THROUGH the
+        compiled-step cache, so revisiting a world size (the grow-back)
+        is a ``step_cache_hit`` — no recompile.  The transient cost is
+        one full host materialization of params + moments (the same
+        bytes a checkpoint restore moves) plus one compile per
+        first-visited world size.
+
+        Single-controller only: a multiprocess mesh is refused (every
+        process would have to agree on the new world — that is the
+        jax.distributed coordination problem, out of scope per the
+        fail-stop model note in ``parallel/elastic.py``), as is any
+        mesh with model-parallel axes (re-planning 'tp'/'pp' layouts is
+        a different problem than re-packing dp slabs).  Returns True if
+        the world actually changed, False for a no-op."""
+        import jax
+        from ..parallel import zero as _zero
+        from ..context import make_mesh
+        if self.mesh is None:
+            raise ValueError(
+                "resize_world needs a mesh (dist_strategy=DataParallel)")
+        if self._multiprocess:
+            raise NotImplementedError(
+                "elastic resize is single-controller: a multiprocess "
+                "mesh needs coordinated re-initialization (future work; "
+                "use the supervisor's restart path)")
+        if tuple(self.mesh.axis_names) != (_zero.ZERO_AXIS,):
+            raise NotImplementedError(
+                f"elastic resize supports pure data-parallel meshes "
+                f"(axes ('dp',)), got {tuple(self.mesh.axis_names)}")
+        base = getattr(self, "_elastic_base_devices", None)
+        if base is None:
+            base = self._elastic_base_devices = list(self.mesh.devices.flat)
+        ranks = sorted({int(r) for r in ranks})
+        if not ranks:
+            raise ValueError("resize_world: empty rank set")
+        if ranks[-1] >= len(base) or ranks[0] < 0:
+            raise ValueError(
+                f"resize_world: rank {ranks[-1] if ranks[0] >= 0 else ranks[0]}"
+                f" outside the base world of {len(base)} "
+                f"(ranks index the construction-time mesh)")
+        new_devices = [base[r] for r in ranks]
+        if new_devices == list(self.mesh.devices.flat):
+            return False
+
+        # 1. quiesce: no dispatched step may still reference the old
+        # world's buffers, and no async PS push may land mid-swap
+        self._drain_async()
+        self.ps_flush()
+
+        # 2. snapshot training state host-side (ZeRO views materialize
+        # one gather per bucket via the _slab_host memo; optimizer slab
+        # state transcodes to per-param layout below)
+        var_host = {}
+        for node in self.global_topo:
+            if isinstance(node, PlaceholderOp) and node.is_variable:
+                var_host[node] = self._fetch_host(self.var_values[node])
+        old_plans = dict(self._zero_plans)
+        opt_host = {
+            op: jax.tree.map(self._fetch_host, st)
+            for op, st in self.opt_states.items()}
+
+        # 3. the new world: same axis name, the surviving base devices
+        # in rank order — revisiting a rank set reproduces the exact
+        # mesh fingerprint, which is what turns the grow-back rebuild
+        # into a compiled-step cache HIT
+        self.mesh = make_mesh({_zero.ZERO_AXIS: len(new_devices)},
+                              new_devices)
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._replicated_sharding = NamedSharding(self.mesh,
+                                                  PartitionSpec())
+        # the caller-owned strategy object is NOT touched: it may be
+        # shared by other executors (its make_mesh only runs at
+        # construction; this executor's live world is self.mesh)
+
+        # 4. redistribute: re-place every variable, re-plan the ZeRO
+        # buckets for the new dp, re-pack slabs and moments
+        self._zero_plans = {}
+        self._zero_slabs = {}
+        self._zero_covered = {}
+        self._slab_fetch_cache = {}
+        for node, val in var_host.items():
+            self.var_values[node] = self._place_param(val, node)
+        self._build_zero_plans()
+        for op in list(self.opt_states):
+            plan = self._zero_plans.get(op)
+            if plan is not None and plan.stage >= 3:
+                # re-establish the slab-resident master params (and the
+                # _ZeroView stand-ins) under the new bucket widths
+                self._init_zero_slabs(op, plan)
+            st = self._transcode_opt_state(opt_host[op],
+                                           old_plans.get(op), plan)
+            self.opt_states[op] = jax.tree.map(
+                lambda leaf, _op=op: self._place_opt_leaf(_op, leaf), st)
+
+        # 5. rebuild the subexecutors against the new mesh.  The old
+        # ones' background pools are shut down here (their caches stay
+        # open — they belong to the graph nodes, which the new
+        # subexecutors share); the new jitted steps resolve through the
+        # compiled-step cache.
+        for se in self.subexecutors.values():
+            for attr in ("_prefetch_pool", "_feed_pool"):
+                pool = getattr(se, attr, None)
+                if pool is not None:
+                    pool.shutdown(wait=False)
+        self.subexecutors = {
+            name: SubExecutor(name, [f for f in fetches], self)
+            for name, fetches in self.eval_node_dict.items()}
+        self._has_ps = any(getattr(se, "ps_nodes", None)
+                           for se in self.subexecutors.values())
+        # the device-chained step scalar lives on the old mesh — force
+        # the next run to re-place it from the host counter
+        self.step_counter = self._step_counter
+        return True
+
     # -- static validation (hetu_tpu.analysis) -----------------------------
 
     def _validate_graphs(self):
@@ -2781,14 +2997,26 @@ class Executor:
                 named_live = self._named_opt_state(op, live)
                 paths, treedef = jax.tree_util.tree_flatten_with_path(
                     named_live)
-                leaves, missed = [], []
+                host_leaves, missed = [], []
                 for kpath, old_leaf in paths:
                     fn = entry["leaves"].get(jax.tree_util.keystr(kpath))
                     if fn is None:
                         missed.append(jax.tree_util.keystr(kpath))
-                    leaves.append(
-                        old_leaf if fn is None else self._place_opt_leaf(
-                            op, np.load(os.path.join(path, "opt", fn))))
+                        host_leaves.append(old_leaf)
+                    else:
+                        host_leaves.append(
+                            np.load(os.path.join(path, "opt", fn)))
+                if not missed:
+                    # dp portability (elastic resizes change the world
+                    # between save and restore): slab moments written
+                    # under a different dp transcode to this world's
+                    # bucket layout instead of failing shape placement
+                    tree = self._maybe_transcode_loaded_opt(
+                        op, jax.tree.unflatten(treedef, host_leaves))
+                    host_leaves = jax.tree_util.tree_leaves(tree)
+                leaves = [self._place_opt_leaf(op, leaf)
+                          if isinstance(leaf, np.ndarray) else leaf
+                          for leaf in host_leaves]
                 if missed and entry["leaves"]:
                     # ZeRO slab state is keyed by bucket layout: loading
                     # across a zero-stage / graph-structure change finds

@@ -20,7 +20,19 @@ exponential backoff and a bounded restart budget, resuming from the
 latest auto-checkpoint (``--ckpt-dir`` exports ``HETU_AUTO_SAVE_DIR`` so
 workers auto-save and ``Executor.resume`` on restart).  A ``HETU_CHAOS``
 schedule with ``kill:proc@rank<r>:after<ms>`` faults is honored inside
-the monitor loop, making launcher-level failures reproducible tests.
+the monitor loop, making launcher-level failures reproducible tests
+(the deterministic ``kill:proc@rank<r>:step<n>`` form fires on the
+executor's step clock against ``register_proc``'d in-process handles
+instead — the elastic harness's clock, see ``parallel/elastic.py``;
+this wall-clock monitor loop has no step counter to schedule against).
+
+Elastic note (ISSUE 12): the supervisor restart budget is the FLOOR
+under elastic training — when an :class:`ElasticController` refuses a
+shrink below ``min_dp``, recovery falls back to this module's
+relaunch-from-checkpoint path; post-resize checkpoints restore at any
+dp (the executor's load transcodes ZeRO moment slabs across world
+sizes), so a supervised relaunch after a resize resumes with real
+moments.
 
 PS replication (``--ps-replication 2`` → ``HETU_PS_REPLICATION``)
 changes the failure policy: a dead rank's PS shard keeps serving from

@@ -176,6 +176,49 @@ def reset_faults():
     _faults.reset()
 
 
+# ------------------------------------------------------ elastic-resize counters
+# The elastic data-parallel layer (``parallel/elastic.py``) records every
+# world-resize event here: dead ranks detected (``elastic_dead_rank``),
+# shrinks executed (``elastic_shrink``) and shrinks refused at the
+# ``min_dp`` floor (``elastic_shrink_refused``), rejoins detected
+# (``elastic_rejoin``) and grows executed (``elastic_grow``),
+# heartbeat-silent-but-probe-answering ranks HELD instead of resized
+# over (``elastic_unreachable_held`` — partition evidence, the fencing
+# epochs' problem), and the cumulative resize wall time
+# (``elastic_resize_ms`` — detection poll to resized executor, summed
+# over resizes; per-event recovery_ms lives on the controller's
+# timeline).  Whether a resize recompiled or reused an executable is
+# the step-cache family's story (``step_cache_hit`` on a grow-back).
+# Invariant (asserted by the elastic tests): a fixed-world run records
+# nothing here.  Surfaced by ``HetuProfiler.elastic_counters()`` and
+# ``bench.py --config elastic``.
+
+_elastic = REGISTRY.counter_family(
+    "elastic",
+    "elastic data-parallel resize events: dead-rank detections, "
+    "shrinks/grows, held partitions (a fixed-world run records none)")
+
+
+def record_elastic(kind, n=1):
+    """Count ``n`` elastic-resize events of ``kind``.  With tracing on,
+    the event also lands as an instant on the calling thread's track —
+    a shrink/grow is visible next to the step spans it sits between."""
+    kind = str(kind)
+    if n:
+        _elastic.inc(kind, int(n))
+    if _TR.on:
+        _TR.instant("elastic:" + kind, cat="elastic")
+
+
+def elastic_counts():
+    """{kind: count} snapshot of elastic-resize events."""
+    return _elastic.counts()
+
+
+def reset_elastic_counts():
+    _elastic.reset()
+
+
 # ------------------------------------------------- cache / sparse-RPC counters
 # The HET embedding cache (``ps/dist_store.py:DistCacheTable``) and the
 # sparse transport (``DistributedStore.pull/push/push_pull``) record their
@@ -521,6 +564,7 @@ _FAMILIES = {
     "flash_fallbacks": _flash,
     "emb_pallas_fallbacks": _emb_pallas,
     "faults": _faults,
+    "elastic": _elastic,
     "cache": _cache,
     "zero": _zero,
     "step_cache": _step_cache,

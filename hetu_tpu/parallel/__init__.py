@@ -10,3 +10,5 @@ from .ring_attention import (ContextParallel, ring_attention,
 from .preduce import PartialReduce, preduce_mean, preduce_scatter_mean
 from . import zero
 from .zero import ZeroPlan, ZeroBucket
+from . import elastic
+from .elastic import ElasticController, LogicalRank

@@ -238,7 +238,8 @@ class HetuProfiler:
         """{family: {kind: count}} over EVERY counter family on the
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
-        cache, zero, step_cache, run_plan, serve, ps_rpc_bytes.  The per-family
+        elastic, cache, zero, step_cache, run_plan, serve,
+        ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -283,6 +284,21 @@ class HetuProfiler:
         failures instead of counters."""
         from .metrics import emb_pallas_fallback_counts
         return emb_pallas_fallback_counts()
+
+    @staticmethod
+    def elastic_counters():
+        """{kind: count} of elastic data-parallel resize events
+        (``hetu_tpu.metrics`` registry; ``parallel/elastic.py``):
+        dead-rank detections (``elastic_dead_rank``), shrinks/grows
+        executed (``elastic_shrink``/``elastic_grow``), shrinks refused
+        at the ``min_dp`` floor, rejoins detected, partitioned ranks
+        HELD instead of resized over (``elastic_unreachable_held``),
+        and cumulative resize wall time (``elastic_resize_ms``).
+        Whether a grow-back recompiled is :meth:`step_cache_counters`'s
+        story (``step_cache_hit`` = executable reused).  A fixed-world
+        run reports an empty dict."""
+        from .metrics import elastic_counts
+        return elastic_counts()
 
     @staticmethod
     def cache_counters():

@@ -117,7 +117,13 @@ FAMILIES = {"bert": _bert, "swin": _swin, "moe": _moe, "rnn": _rnn,
             "wdl_ps": _wdl_ps, "gnn": _gnn}
 
 
-@pytest.mark.parametrize("family", sorted(FAMILIES))
+# bert/swin demoted to slow: 21s/30s at HEAD (ISSUE 12 tier-1 budget);
+# the bf16 cast plumbing they exercise is family-independent and stays
+# covered tier-1 by the four cheaper families
+@pytest.mark.parametrize(
+    "family",
+    [pytest.param(f, marks=pytest.mark.slow) if f in ("bert", "swin")
+     else f for f in sorted(FAMILIES)])
 @pytest.mark.timeout(600)
 def test_bf16_loss_parity(family):
     losses = {}

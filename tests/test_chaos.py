@@ -120,6 +120,80 @@ def test_chaos_role_kills_resolve_serving_and_holding_servers():
             s.close()
 
 
+def test_chaos_proc_step_kill_spec_parses():
+    """``kill:proc@rank<r>:step<n>`` — the DETERMINISTIC step-clock
+    worker kill the elastic tests schedule (ISSUE 12 satellite); the
+    wall-clock ``after<ms>`` form keeps parsing unchanged."""
+    _, faults = chaos.parse_spec(
+        "7:kill:proc@rank2:step5,kill:proc@rank0:after250")
+    assert faults[0] == {"kind": "kill_proc", "rank": 2, "step": 5}
+    assert faults[1] == {"kind": "kill_proc", "rank": 0,
+                         "after_ms": 250.0}
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec("7:kill:proc@rank2:when5")
+
+
+class _FakeProc:
+    def __init__(self):
+        self.stopped = 0
+
+    def stop(self):
+        self.stopped += 1
+
+
+def test_chaos_proc_step_kill_fires_once_on_step_clock():
+    """The step form fires a register_proc'd handle exactly once, at
+    exactly its step, via on_step — and NEVER via due_proc_kills (that
+    is the launcher's wall clock); the kill consumes no RNG draw, so a
+    schedule mixing it with probabilistic faults stays deterministic."""
+    reset_faults()
+    spec = "11:drop=0.2,kill:proc@rank1:step3"
+    inj = chaos.ChaosInjector.from_spec(spec)
+    procs = {r: _FakeProc() for r in range(2)}
+    for r, p in procs.items():
+        inj.register_proc(r, p)
+    # the wall clock never fires a step-form kill, at any elapsed time
+    assert inj.due_proc_kills(1e9) == []
+    assert inj.on_step(2) == []
+    assert procs[1].stopped == 0
+    assert inj.on_step(3) == [1]
+    assert procs[1].stopped == 1 and procs[0].stopped == 0
+    assert inj.on_step(3) == []         # one-shot
+    assert procs[1].stopped == 1
+    assert fault_counts().get("chaos_kill_proc") == 1
+    # determinism: same seed + same event order ⇒ same transport stream,
+    # kill present or not (kills draw nothing from the RNG)
+    a = chaos.ChaosInjector.from_spec(spec)
+    b = chaos.ChaosInjector.from_spec("11:drop=0.2")
+    a.register_proc(1, _FakeProc())
+    seq_a = []
+    for i in range(100):
+        if i == 50:
+            a.on_step(3)
+        seq_a.append(a.on_send(i % 3, 1))
+    assert seq_a == [b.on_send(i % 3, 1) for i in range(100)]
+
+
+def test_chaos_proc_step_kill_missing_handle_is_loud():
+    """A step-form proc kill with NO registered handles warns + counts
+    (quiet when OTHER ranks' handles are registered — the target lives
+    in a different process, chaos.py's kill:ps convention)."""
+    reset_faults()
+    inj = chaos.ChaosInjector.from_spec("7:kill:proc@rank1:step2")
+    with pytest.warns(RuntimeWarning, match="kill:proc@rank1:step2"):
+        assert inj.on_step(2) == []
+    assert fault_counts().get("chaos_kill_target_missing") == 1
+    # registered handle for a DIFFERENT rank: quiet no-op
+    reset_faults()
+    inj2 = chaos.ChaosInjector.from_spec("7:kill:proc@rank1:step2")
+    inj2.register_proc(0, _FakeProc())
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert inj2.on_step(2) == []
+    assert fault_counts().get("chaos_kill_target_missing", 0) == 0
+
+
 def test_partition_spec_parses():
     _, faults = chaos.parse_spec("7:partition:rank0|rank1@step3:heal7")
     assert faults == [{"kind": "partition", "a": frozenset({0}),
@@ -190,7 +264,7 @@ def test_partition_heal_clock_isolated_from_kill_clock():
     inj = chaos.ChaosInjector.from_spec(
         "7:partition:rank0|rank1@step2:heal4,kill:ps@rank5:step2")
     assert inj.on_send(1, 1, src=0) is None      # window not open yet
-    with pytest.warns(RuntimeWarning, match="no registered server"):
+    with pytest.warns(RuntimeWarning, match="no registered kill target"):
         inj.on_step(2)          # kill fires (loud: no target) + cut opens
     assert inj.on_send(1, 1, src=0) == ("drop", 0.0)
     assert inj.on_send(0, 1, src=1) == ("drop", 0.0)   # both directions

@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -23,6 +25,8 @@ def test_torch_baseline_schema():
     json.dumps(res)          # schema is JSON-serializable
 
 
+@pytest.mark.slow     # 16s at HEAD (ISSUE 12 tier-1 budget);
+# the baseline schema stays covered by test_torch_baseline_schema above
 def test_torch_baseline_cli():
     proc = subprocess.run(
         [sys.executable,

@@ -2,6 +2,7 @@
 executors + examples/runner/parallel/validate_results.py single-vs-parallel
 numerical parity)."""
 import numpy as np
+import pytest
 
 import hetu_tpu as ht
 
@@ -340,6 +341,8 @@ def test_remat_training_parity():
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
 
 
+@pytest.mark.slow     # 12s at HEAD (ISSUE 12 tier-1 budget);
+# bf16 training stays via the test_bf16_parity sweep
 def test_mixed_precision_bf16_trains_with_f32_masters():
     """The flagship's compute_dtype path (bench.py bert on TPU): bf16
     inside the step, fp32 master weights outside, int feeds exempt from
@@ -374,6 +377,8 @@ def test_mixed_precision_bf16_trains_with_f32_masters():
     assert out.dtype == np.float32
 
 
+@pytest.mark.slow     # 12s at HEAD (ISSUE 12 tier-1 budget);
+# checkpoint resume stays via the native-format chaos/autosave tests
 def test_orbax_checkpoint_bitwise_resume(tmp_path):
     """save_orbax/load_orbax round-trip: a fresh executor restored from
     the orbax tree continues bitwise (params by name, Adam state by

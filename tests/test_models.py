@@ -46,6 +46,8 @@ def test_vit_tiny_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow     # 14s at HEAD (ISSUE 12 tier-1 budget);
+# transformer stack stays covered via bert/t5 tiny-trains
 def test_transformer_tiny_trains():
     cfg = models.TransformerConfig.tiny(batch_size=2, src_len=16, tgt_len=16)
     feeds, loss, _ = models.transformer_graph(cfg)
@@ -98,6 +100,8 @@ def test_gpt2_causality():
     assert np.abs(l1[10:] - l2[10:]).max() > 1e-3
 
 
+@pytest.mark.slow     # 12s at HEAD (ISSUE 12 tier-1 budget);
+# encoder-decoder training stays via test_t5_tiny_trains
 def test_bart_tiny_trains():
     cfg = models.BartConfig.tiny(batch_size=2, src_len=16, tgt_len=16)
     feeds, loss, _ = models.bart_seq2seq_graph(cfg)
@@ -290,6 +294,8 @@ def test_masked_attention_fully_masked_row_is_zero():
     assert np.abs(out[0, 0, 0]).max() > 0
 
 
+@pytest.mark.slow     # 16s at HEAD (ISSUE 12 tier-1 budget);
+# t5 training stays via test_t5_tiny_trains
 def test_t5_padded_mask_trains_and_masks_memory():
     """T5 with use_mask=True: encoder self-attn and decoder CROSS-attn
     ignore padded source keys (reference T5 attention_mask input).  The

@@ -185,6 +185,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.reset_all()
     metrics.record_flash_fallback("test_reason")
     metrics.record_fault("test_fault", 2)
+    metrics.record_elastic("elastic_shrink")
     metrics.record_cache("emb_cache_hit_rows", 5)
     metrics.record_zero("zero_pad_bytes", 64)
     metrics.record_step_cache("step_cache_hit")
@@ -198,6 +199,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "flash_fallbacks": metrics.flash_fallback_counts(),
         "emb_pallas_fallbacks": metrics.emb_pallas_fallback_counts(),
         "faults": metrics.fault_counts(),
+        "elastic": metrics.elastic_counts(),
         "cache": metrics.cache_counts(),
         "zero": metrics.zero_counts(),
         "step_cache": metrics.step_cache_counts(),

@@ -2,6 +2,7 @@
 N-device sharded runs must match single-device runs on the same seed —
 the TPU-native replacement for the reference's mpirun validate_results.py)."""
 import numpy as np
+import pytest
 
 import hetu_tpu as ht
 
@@ -57,6 +58,8 @@ def test_make_mesh_axes():
     assert mesh.devices.shape == (2, 4)
 
 
+@pytest.mark.slow     # 20s at HEAD (ISSUE 12 tier-1 budget);
+# dp parity stays via test_dp8_matches_single_device + test_zero dp=4
 def test_dp8_bert_tiny_loss_curve_parity():
     """The north star's loss-curve parity clause as a repeatable test:
     dp8 BERT-tiny matches the single-device loss trajectory on the same
@@ -89,6 +92,8 @@ def test_dp8_bert_tiny_loss_curve_parity():
     np.testing.assert_allclose(single, dp8, rtol=2e-4)
 
 
+@pytest.mark.slow     # 17s at HEAD (ISSUE 12 tier-1 budget);
+# dp parity stays via test_dp8_adam_matches_single_device
 def test_dp8_bert_tiny_momentum_parity():
     """Same curve-parity check under a stateful non-Adam optimizer."""
     from hetu_tpu.models.bert import (BertConfig, bert_pretrain_graph,

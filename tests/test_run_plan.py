@@ -454,6 +454,12 @@ def test_run_plan_counters_surfaced_by_profiler():
 
 # ------------------------------------------------- CI smoke of the bench
 
+@pytest.mark.slow     # 14s at HEAD (ISSUE 12 tier-1 budget), and its
+# tracing-tax wall gate flakes under in-suite contention on the 2-CPU
+# box (36% vs the 25% gate mid-suite; passes in isolation) — the
+# deterministic halves (plan-cache hits, async bitwise parity) stay
+# covered tier-1 by the dedicated tests above, and the gate still runs
+# in the slow suite + the committed host_overhead.json artifact check
 @pytest.mark.timeout(420)
 def test_overhead_bench_smoke():
     """ISSUE 9 CI gate: plan-cache hits >= steps-1 on a steady schema and
