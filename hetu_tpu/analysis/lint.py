@@ -90,7 +90,7 @@ class GraphInfo:
     """What a lint rule sees: topo + static shapes + executor config."""
 
     def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
-                 feed_values=None, zero=0, serving=False):
+                 feed_values=None, zero=0, serving=False, remat="off"):
         self.shapes = shapes
         self.topo = shapes.topo
         self.feeds = feeds
@@ -104,6 +104,9 @@ class GraphInfo:
         #: True when linting a SERVING fetch set (InferenceExecutor):
         #: enables the train-only-op-in-serving rule
         self.serving = bool(serving)
+        #: requested remat policy (Executor(remat=...)) — raw, NOT
+        #: resolved: the remat-policy rule diagnoses unknown names
+        self.remat = remat
 
     def shape(self, node):
         return self.shapes.shape(node)
@@ -556,6 +559,61 @@ def _r_zero(gi):
                 by_key[ragged[0]])
 
 
+@rule("remat-policy")
+def _r_remat(gi):
+    """Selective-remat policy preconditions (``parallel/remat.py``,
+    ISSUE 13): an unknown policy name is an error (for direct
+    ``ht.lint(remat=...)`` callers — ``Executor(remat=...)`` fails fast
+    at construction like ``pipeline=``), a policy on a graph with no
+    recomputable segment (forward-only, or no matmul-family anchors to
+    segment at) is a silent no-op worth a warning, and ``'auto'`` with
+    no resolvable HBM budget remats EVERY segment — the memory-
+    conservative default, but almost never what the user budgeted for."""
+    from ..parallel import remat as remat_mod
+    pol = gi.remat
+    if pol in (None, False, 0, "off"):
+        return
+    if pol is True:
+        pol = "dots"
+    anchor_node = next((n for n in gi.topo
+                        if remat_mod._is_anchor(n)), None)
+    site_node = anchor_node or next(
+        (n for n in gi.topo
+         if not isinstance(n, (PlaceholderOp, GradientOp))), None)
+    if pol not in remat_mod.POLICIES:
+        yield Diagnostic(
+            "remat-policy", "error",
+            f"unknown remat policy {pol!r} — expected one of "
+            f"{'|'.join(remat_mod.POLICIES)} (True == 'dots')",
+            site_node)
+        return
+    grads = [n for n in gi.topo if isinstance(n, GradientOp)]
+    if not grads:
+        yield Diagnostic(
+            "remat-policy", "warn",
+            f"remat={pol!r} on a forward-only graph — nothing "
+            f"differentiates, so there is no backward pass to "
+            f"rematerialize into (remat is a silent no-op here)",
+            site_node)
+    elif anchor_node is None:
+        yield Diagnostic(
+            "remat-policy", "warn",
+            f"remat={pol!r} on a graph with NO recomputable segment — "
+            f"no matmul-family/attention anchors to segment at, so the "
+            f"policy frees (almost) nothing and 'full'/'auto' build an "
+            f"empty plan", site_node)
+    if pol == "auto":
+        budget, _src = remat_mod.resolve_budget()
+        if budget is None:
+            yield Diagnostic(
+                "remat-policy", "warn",
+                "remat='auto' with no resolvable HBM budget — "
+                "HETU_HBM_BUDGET_MB is unset and this backend reports "
+                "no memory limit, so auto remats EVERY segment (acts "
+                "like 'full'); set HETU_HBM_BUDGET_MB to get the "
+                "budget-fitted plan", site_node)
+
+
 #: op types whose semantics exist only for TRAINING — a serving fetch set
 #: reaching them is either outright wrong (optimizer, gradient: the whole
 #: point of a compile-once inference program is that these subgraphs are
@@ -603,15 +661,17 @@ def _r_train_only_serving(gi):
 # ----------------------------------------------------------------- entry
 
 def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
-         num_microbatches=None, rules=None, zero=0, serving=False):
+         num_microbatches=None, rules=None, zero=0, serving=False,
+         remat="off"):
     """Statically verify a fetch subgraph; returns a :class:`LintReport`.
 
     ``feeds``: example values (or bare shapes) for placeholders declared
     without a static shape, e.g. ``ht.lint([loss], feeds={x: (32, 784)})``.
-    ``mesh`` / ``pipeline`` / ``num_microbatches`` / ``zero``: the
-    executor configuration the graph will compile under (enables the
-    mesh-axis, pipeline-stage and zero-sharding rules, and keeps
-    schedule-sensitive lowering on the same path the executor uses).
+    ``mesh`` / ``pipeline`` / ``num_microbatches`` / ``zero`` /
+    ``remat``: the executor configuration the graph will compile under
+    (enables the mesh-axis, pipeline-stage, zero-sharding and
+    remat-policy rules, and keeps schedule-sensitive lowering on the
+    same path the executor uses).
     ``serving=True``: lint the fetches as a SERVING set (enables the
     train-only-op-in-serving rule — what
     ``InferenceExecutor(validate=...)`` runs; pair with
@@ -635,7 +695,7 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
                 feed_values[node] = v
     gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
                    mesh=mesh, pipeline=pipeline, feed_values=feed_values,
-                   zero=zero, serving=serving)
+                   zero=zero, serving=serving, remat=remat)
     diags = []
     selected = RULES if rules is None else {
         name: RULES[name] for name in rules}

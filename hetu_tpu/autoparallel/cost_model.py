@@ -131,7 +131,13 @@ GRAD_MULT = 1.0
 
 class MemoryCostModel:
     """Per-device memory of running one layer under a strategy
-    (Galvatron MemoryCostModel: model states ×1/dp under fsdp:18-23)."""
+    (Galvatron MemoryCostModel: model states ×1/dp under fsdp:18-23).
+
+    ``remat`` here is the SEARCH-level boolean knob (does the strategy
+    assume activation recompute at all); the executor-side realization
+    is the graded policy ladder in ``parallel/remat.py``, whose planner
+    prices real graphs with this module's :func:`matmul_flops` /
+    :data:`MATMUL_OPS` tables — one FLOP model for both."""
 
     def __init__(self, hw: HardwareSpec, microbatches: int = 1,
                  remat: bool = False):
@@ -359,14 +365,18 @@ def swin_layer_specs(image_size, patch_size, embed_dim, depths, num_heads,
 
 
 #: matmul-family op -> index of the LEFT matrix operand (Addmm/Baddbmm
-#: carry the additive input first)
-_MATMUL_OPS = {"MatrixMult": 0, "Linear": 0, "BatchMatrixMult": 0,
-               "Addmm": 1, "Baddbmm": 1}
+#: carry the additive input first).  Public surface: the selective-remat
+#: planner (``parallel/remat.py``) prices per-SEGMENT recompute FLOPs
+#: with exactly this table + :func:`matmul_flops`, so the remat plan and
+#: the strategy search can never disagree about what a matmul costs.
+MATMUL_OPS = {"MatrixMult": 0, "Linear": 0, "BatchMatrixMult": 0,
+              "Addmm": 1, "Baddbmm": 1}
+_MATMUL_OPS = MATMUL_OPS          # original (private) alias, kept
 _ATTN_OPS = ("ScaledDotProductAttention", "RingAttention",
              "UlyssesAttention")
 
 
-def _matmul_flops(node, gs, out_shape):
+def matmul_flops(node, gs, out_shape):
     """2·(output elements)·(contracted size) for one matmul-family node,
     or None when shapes are unknown."""
     import numpy as np
@@ -395,6 +405,9 @@ def _matmul_flops(node, gs, out_shape):
         return None
     k = a[-2] if node.attrs.get("trans_A", False) else a[-1]
     return 2.0 * float(np.prod(out_shape)) * float(k)
+
+
+_matmul_flops = matmul_flops      # original (private) alias, kept
 
 
 def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
@@ -451,4 +464,5 @@ __all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
            "TimeCostModel", "transformer_layer_spec",
            "attention_layer_spec", "mlp_layer_spec",
            "embedding_layer_spec", "model_layer_specs",
-           "swin_layer_specs", "graph_layer_spec"]
+           "swin_layer_specs", "graph_layer_spec",
+           "MATMUL_OPS", "matmul_flops"]

@@ -168,7 +168,8 @@ def _block_one(arr):
         _sync_outs([arr])
 
 
-def lower_forward(topo, ctx, resolve_leaf, mesh=None, skip=()):
+def lower_forward(topo, ctx, resolve_leaf, mesh=None, skip=(),
+                  remat_segments=None, keep=()):
     """Lower every value-producing node of ``topo`` into one traced
     environment ``{node: value}``.
 
@@ -181,22 +182,93 @@ def lower_forward(topo, ctx, resolve_leaf, mesh=None, skip=()):
     out, and sharding annotations become ``with_sharding_constraint``
     under ``mesh``.  State written during forward (BN running stats)
     lands in ``ctx.state_updates`` — the training executor commits it,
-    serving discards it (read-only replicas)."""
+    serving discards it (read-only replicas).
+
+    ``remat_segments`` (ISSUE 13, the ``remat='full'|'auto'`` policies):
+    node lists — contiguous runs in topo order, planned by
+    ``parallel/remat.py`` — that each lower inside a NESTED
+    ``jax.checkpoint``, so only their boundary values (consumed outside
+    the segment, or in ``keep``) survive as backward residuals; the
+    interiors recompute during the backward pass.  Interior values are
+    NOT in the returned env — callers needing a value must name it in
+    ``keep``."""
     import jax
-    env = {}
-    for node in topo:
-        if isinstance(node, GradientOp) or node in skip:
-            continue
-        if isinstance(node, PlaceholderOp):
-            env[node] = resolve_leaf(node)
-        else:
-            env[node] = node.lower(ctx, *[env[i] for i in node.inputs])
+
+    def constrain(node, v):
         if node.sharding is not None and mesh is not None \
                 and not isinstance(node, PlaceholderOp):
             from jax.sharding import NamedSharding
-            env[node] = jax.lax.with_sharding_constraint(
-                env[node],
-                NamedSharding(mesh, _filter_spec(mesh, node.sharding)))
+            v = jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, _filter_spec(mesh, node.sharding)))
+        return v
+
+    env = {}
+    if not remat_segments:
+        for node in topo:
+            if isinstance(node, GradientOp) or node in skip:
+                continue
+            if isinstance(node, PlaceholderOp):
+                env[node] = resolve_leaf(node)
+            else:
+                env[node] = constrain(
+                    node, node.lower(ctx, *[env[i] for i in node.inputs]))
+        return env
+
+    # segmented path: topo_sort guarantees inputs precede consumers, and
+    # segments are contiguous runs of lowerable nodes, so every external
+    # input of a segment is already in env when its first node arrives
+    from ..parallel.remat import checkpoint_segment
+    lowerable = [n for n in topo
+                 if not (isinstance(n, GradientOp) or n in skip)]
+    consumers = {}
+    for n in lowerable:
+        for i in n.inputs:
+            consumers.setdefault(i, []).append(n)
+    keep = set(keep)
+    seg_of = {}
+    for si, seg in enumerate(remat_segments):
+        for n in seg:
+            seg_of[n] = si
+    done = set()
+    for node in lowerable:
+        if node in done:
+            continue
+        if isinstance(node, PlaceholderOp):
+            env[node] = resolve_leaf(node)
+            done.add(node)
+            continue
+        si = seg_of.get(node)
+        if si is None:
+            env[node] = constrain(
+                node, node.lower(ctx, *[env[i] for i in node.inputs]))
+            done.add(node)
+            continue
+        seg = remat_segments[si]
+        segset = set(seg)
+        ext = []
+        for n in seg:
+            for i in n.inputs:
+                if isinstance(i, PlaceholderOp) and i not in env:
+                    # a placeholder interleaved in topo order INSIDE the
+                    # segment's span: leaf resolution is order-free
+                    env[i] = resolve_leaf(i)
+                    done.add(i)
+                if i not in segset and i not in ext:
+                    ext.append(i)
+        outs = [n for n in seg
+                if n in keep or not consumers.get(n)
+                or any(c not in segset for c in consumers[n])]
+
+        def seg_fn(ins, _seg=seg, _ext=ext, _outs=outs):
+            e = dict(zip(_ext, ins))
+            for n in _seg:
+                e[n] = constrain(n, n.lower(ctx, *[e[i] for i in n.inputs]))
+            return [e[o] for o in _outs]
+
+        vals = checkpoint_segment(seg_fn)([env[i] for i in ext])
+        for o, v in zip(outs, vals):
+            env[o] = v
+        done.update(seg)
     return env
 
 
@@ -330,11 +402,33 @@ class SubExecutor:
             self.ps_nodes and self.grad_ops and ex.pipeline
             and (ex.num_microbatches or 1) > 1
             and not self.has_pipeline_block)
+        # ISSUE 13 selective remat: the segment plan for the
+        # 'full'/'auto' policies, priced by the PR 5 cost model — built
+        # at construction so Executor.remat_plan() answers before the
+        # first run and the step-cache signature hashes the decisions
+        from ..parallel import remat as _remat
+        self._remat_plan = _remat.plan_for(self)
+        self._remat_fingerprint = None if self._remat_plan is None \
+            else self._remat_plan.fingerprint()
+        if _TRACE.on and ex.remat != "off" and self.grad_ops:
+            # build-time provenance in any exported trace: which policy
+            # (and how many segments) this executor's measured steps ran
+            # under — one instant at construction, zero hot-path cost
+            _TRACE.instant("remat:plan", cat="executor", args={
+                "sub": self.name, "policy": ex.remat,
+                "segments_rematted": 0 if self._remat_plan is None
+                else self._remat_plan.n_remat})
 
     # -- lowering ---------------------------------------------------------
 
-    def _forward(self, tparams, sparams, feeds, key):
-        """Evaluate every non-grad node; returns (env, state_updates)."""
+    def _forward(self, tparams, sparams, feeds, key, remat_segments=None):
+        """Evaluate every non-grad node; returns (env, state_updates).
+
+        ``remat_segments`` (the ``remat='full'|'auto'`` training path):
+        planned node lists that lower inside nested ``jax.checkpoint``
+        scopes — see :func:`lower_forward`.  Only the gradient path
+        passes them; eval subgraphs and the profiler's shape trace keep
+        the flat lowering (and a complete env)."""
         ctx = LowerCtx(self.training, key, self.ex.mesh,
                        num_microbatches=self.ex.num_microbatches,
                        pipeline=self.ex.pipeline)
@@ -347,8 +441,16 @@ class SubExecutor:
                 return sparams[k]
             return feeds[k]
 
+        keep = ()
+        if remat_segments:
+            keep = [f for f in self.fetches
+                    if f is not None and not isinstance(f, GradientOp)
+                    and f not in self.opt_ops]
+            if self.loss_node is not None:
+                keep.append(self.loss_node)
         env = lower_forward(self.topo, ctx, resolve, mesh=self.ex.mesh,
-                            skip=self.opt_ops)
+                            skip=self.opt_ops,
+                            remat_segments=remat_segments, keep=keep)
         updates = {self.ex._k(n): v for n, v in ctx.state_updates.items()}
         return env, updates
 
@@ -472,24 +574,35 @@ class SubExecutor:
                             model_params.update(
                                 _zero.gather_full(slab, b, self.ex.mesh))
 
+                # ISSUE 13 policy-graded remat (parallel/remat.py): the
+                # segmented policies ('full'/'auto') act INSIDE the
+                # lowering — each planned segment lowers in a nested
+                # jax.checkpoint so only boundary values survive as
+                # backward residuals; the wrap policies ('dots' dots-
+                # saveable, 'offload' host-offloaded dots with a counted
+                # fallback) wrap the whole loss below
+                seg_lists = None
+                if self._remat_plan is not None:
+                    seg_lists = self._remat_plan.remat_node_lists() or None
+
                 def loss_fn(tp, fd, sp, k):
                     if cd:
                         tp = _cast_tree(tp, cd)
-                    env, updates = self._forward(tp, sp, fd, k)
+                    env, updates = self._forward(
+                        tp, sp, fd, k, remat_segments=seg_lists)
                     aux_vals = [None if f is None or f in self.opt_ops
                                 or isinstance(f, GradientOp)
                                 else env[f] for f in fetch_nodes]
                     return env[self.loss_node], (aux_vals, updates)
 
-                if self.ex.remat:
+                if self.ex.remat in ("dots", "offload"):
                     # rematerialize the forward in the backward pass:
-                    # trades FLOPs for activation memory (the TPU-native
-                    # replacement for the reference's buffer-reuse memory
-                    # plan, memory_pool.py:29; matmul outputs stay saved —
-                    # the standard dots-saveable policy)
-                    loss_fn = jax.checkpoint(
-                        loss_fn, policy=jax.checkpoint_policies
-                        .dots_with_no_batch_dims_saveable)
+                    # trades FLOPs (or, offloaded, host transfers) for
+                    # activation memory — the TPU-native replacement for
+                    # the reference's buffer-reuse memory plan
+                    # (memory_pool.py:29)
+                    from ..parallel import remat as _remat
+                    loss_fn = _remat.wrap_loss(loss_fn, self.ex.remat)
 
                 M = self.ex.num_microbatches or 1
                 if self.ex.pipeline and M > 1 and not self.has_pipeline_block:
@@ -616,8 +729,15 @@ class SubExecutor:
         feeds_mb = {k: v.reshape((M, B // M) + v.shape[1:])
                     for k, v in split.items()}
         fn = loss_fn
-        if self.ex.pipeline in ("pipedream", "hetpipe"):
-            fn = jax.checkpoint(loss_fn, static_argnums=())
+        if self.ex.pipeline in ("pipedream", "hetpipe") \
+                and self.ex.remat == "off":
+            # 1F1B's per-microbatch activation footprint: full remat BY
+            # DEFAULT, routed through the one policy resolver — an
+            # explicit Executor(remat=...) policy already shaped loss_fn
+            # (wrap or segmented lowering), so pipeline= + remat='dots'
+            # COMPOSE instead of double-rematting (ISSUE 13 small fix)
+            from ..parallel import remat as _remat
+            fn = _remat.wrap_loss(loss_fn, "microbatch")
 
         grad_fn = jax.value_and_grad(fn, has_aux=True)
 
@@ -1389,9 +1509,28 @@ class Executor:
         self.prefetch = bool(kwargs.pop("prefetch", True))
         # straggler watchdog for SSP waits (bsp>0)
         self.ssp_timeout_ms = int(kwargs.pop("ssp_timeout_ms", 600000))
-        # remat: recompute activations in backward (jax.checkpoint) —
-        # capability analogue of the reference's memory reuse plan
-        self.remat = bool(kwargs.pop("remat", False))
+        # remat: recompute activations in backward — a POLICY LADDER
+        # (parallel/remat.py, ISSUE 13), not a boolean:
+        #   'off'     save every activation (default)
+        #   'dots'    jax.checkpoint, matmul outputs saved (== the old
+        #             remat=True; True still maps here)
+        #   'full'    segmented remat: the forward lowers in anchored
+        #             segments, each inside a nested jax.checkpoint —
+        #             only segment boundaries survive to backward
+        #   'offload' dot outputs saved to HOST memory on TPU; counted
+        #             fallback to 'dots' elsewhere
+        #             (remat_offload_fallback)
+        #   'auto'    per-segment decisions from the PR 5 shape-inferred
+        #             cost model against an HBM budget
+        #             (HETU_HBM_BUDGET_MB / backend-reported), cheapest
+        #             recompute-per-byte rematted first; plan reported
+        #             by Executor.remat_plan() and hashed into the
+        #             compiled-step-cache signature
+        # Every policy is BITWISE loss-equal to 'off' (remat replays the
+        # same ops).  Capability analogue of the reference's memory
+        # reuse plan (memory_pool.py).
+        from ..parallel import remat as _remat_mod
+        self.remat = _remat_mod.resolve_policy(kwargs.pop("remat", False))
         # validate: static graph verification (hetu_tpu.analysis) at
         # construction + fed-shape checks on every run().  'warn' (default)
         # reports diagnostics as warnings; 'error' fails fast with the
@@ -1917,12 +2056,23 @@ class Executor:
         if self.validate == "off":
             return
         from ..analysis import lint as lint_graph
+        # remat is a training-graph concern: eval subgraphs sharing the
+        # executor must not warn "no recomputable segment" — unless NO
+        # subgraph differentiates, in which case remat= really is a
+        # no-op and the first subgraph's lint says so
+        any_grads = any(getattr(s, "grad_ops", None)
+                        for s in self.subexecutors.values())
+        first = next(iter(self.eval_node_dict), None)
         for name, fetches in self.eval_node_dict.items():
+            sub_grads = getattr(self.subexecutors.get(name), "grad_ops",
+                                None)
+            lint_remat = self.remat if (
+                sub_grads or (not any_grads and name == first)) else "off"
             try:
                 report = lint_graph(fetches, mesh=self.mesh,
                                     pipeline=self.pipeline,
                                     num_microbatches=self.num_microbatches,
-                                    zero=self.zero)
+                                    zero=self.zero, remat=lint_remat)
             except Exception as e:
                 # the analyzer must never be the thing that breaks a
                 # working graph — report and continue
@@ -3085,7 +3235,7 @@ class Executor:
         return {self.var_names[n]: self._fetch_host(v)
                 for n, v in self.var_values.items()}
 
-    def memory_accounting(self):
+    def memory_accounting(self, feed_dict=None, name=None):
         """Per-device byte accounting of the persistent training state —
         the numbers the ZeRO memory claim is judged on (``bench.py``
         artifact schema; works on CPU where ``memory_stats`` reports
@@ -3105,6 +3255,20 @@ class Executor:
           worst-device residency (process-wide).
         * ``peak_hbm_gb`` — backend-reported peak, None where the
           backend (XLA-CPU) keeps no stats.
+
+        With ``feed_dict`` (ISSUE 13 — the remat claims' evidence) two
+        more keys land, from XLA's own buffer assignment of the compiled
+        step (AOT compile; hits jax's jit cache after the first run, so
+        this is cheap on a warm executor):
+
+        * ``step_temp_bytes_per_device`` — the compiled step's TEMP
+          allocation (``memory_analysis().temp_size_in_bytes``): the
+          transient activation/workspace peak INSIDE one step, which
+          between-steps live-array sums cannot see — exactly what
+          ``remat=`` trades.  None where the backend/tunnel does not
+          answer AOT analysis.
+        * ``live_buffer_peak_bytes_per_device`` — live buffers + step
+          temp: the projected worst in-step residency.
         """
         import jax
 
@@ -3149,7 +3313,7 @@ class Executor:
             peak = round(st.get("peak_bytes_in_use", 0) / 2**30, 3) or None
         except Exception:
             pass
-        return {
+        out = {
             "n_devices": len(jax.devices()),
             "zero_stage": self.zero if self._zero_plans else 0,
             "param_bytes_per_device": int(params),
@@ -3159,6 +3323,39 @@ class Executor:
             "live_buffer_bytes_per_device": live,
             "peak_hbm_gb": peak,
         }
+        if feed_dict is not None:
+            temp = None
+            try:
+                from ..profiler import HetuProfiler
+                sub_name = name or ("train" if "train" in
+                                    self.subexecutors
+                                    else next(iter(self.subexecutors)))
+                ma = HetuProfiler(self, name=sub_name) \
+                    ._compiled(feed_dict).memory_analysis()
+                temp = int(ma.temp_size_in_bytes)
+            except Exception:
+                temp = None
+            out["step_temp_bytes_per_device"] = temp
+            out["live_buffer_peak_bytes_per_device"] = \
+                None if (temp is None or live is None) else live + temp
+        return out
+
+    def remat_plan(self, name=None):
+        """The resolved selective-remat plan (``parallel/remat.py``).
+
+        Returns ``{"policy": ..., "plans": {subgraph: plan report}}``;
+        with ``name``, just that subgraph's report (or None).  Plans
+        exist only for the segmented policies (``'full'``/``'auto'``) on
+        differentiating subgraphs — the wrap policies (``'dots'``/
+        ``'offload'``) have no per-segment decisions to report."""
+        plans = {}
+        for sname, sub in self.subexecutors.items():
+            plan = getattr(sub, "_remat_plan", None)
+            if plan is not None:
+                plans[sname] = plan.report()
+        if name is not None:
+            return plans.get(name)
+        return {"policy": self.remat, "plans": plans}
 
 
 # reference-parity no-op shims (MPI/PS boilerplate not needed under XLA SPMD)

@@ -219,6 +219,44 @@ def reset_elastic_counts():
     _elastic.reset()
 
 
+# ----------------------------------------------------- selective-remat counters
+# The remat policy layer (``parallel/remat.py``) records each plan build
+# here: segments found (``remat_layers_total``) and chosen for remat
+# (``remat_layers_rematted``), the activation bytes the chosen plan
+# frees (``remat_bytes_saved``) vs the matmul FLOPs a backward replay
+# re-pays (``remat_recompute_flops``), and activation-offload requests
+# served by the counted on-device fallback because the backend cannot
+# host-offload (``remat_offload_fallback`` — flash-dispatcher style,
+# ``HETU_REQUIRE_OFFLOAD=1`` hard-fails instead).  Counts are per plan
+# BUILD, not per step (flash-counter semantics: a count climbing across
+# steps means executors are being rebuilt).  Surfaced by
+# ``HetuProfiler.remat_counters()`` and ``bench.py --config remat``; a
+# run without ``Executor(remat=...)`` records nothing.
+
+_remat = REGISTRY.counter_family(
+    "remat",
+    "selective-remat plan builds: segments rematted, bytes freed vs "
+    "recompute flops, offload fallbacks (empty without remat=)")
+
+
+def record_remat(kind, n=1):
+    """Count ``n`` selective-remat events of ``kind`` (plan builds,
+    offload fallbacks)."""
+    if counters_suppressed():
+        return  # abstract (eval_shape) trace, not a real build
+    if n:
+        _remat.inc(str(kind), int(n))
+
+
+def remat_counts():
+    """{kind: count} snapshot of selective-remat plan counters."""
+    return _remat.counts()
+
+
+def reset_remat_counts():
+    _remat.reset()
+
+
 # ------------------------------------------------- cache / sparse-RPC counters
 # The HET embedding cache (``ps/dist_store.py:DistCacheTable``) and the
 # sparse transport (``DistributedStore.pull/push/push_pull``) record their
@@ -565,6 +603,7 @@ _FAMILIES = {
     "emb_pallas_fallbacks": _emb_pallas,
     "faults": _faults,
     "elastic": _elastic,
+    "remat": _remat,
     "cache": _cache,
     "zero": _zero,
     "step_cache": _step_cache,

@@ -12,3 +12,5 @@ from . import zero
 from .zero import ZeroPlan, ZeroBucket
 from . import elastic
 from .elastic import ElasticController, LogicalRank
+from . import remat
+from .remat import RematPlan, RematSegment

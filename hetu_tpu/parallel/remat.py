@@ -1,0 +1,497 @@
+"""Memory-aware selective rematerialization + activation offload (ISSUE 13).
+
+``Executor(remat=...)`` grows from the round-1 boolean into a POLICY
+LADDER — each rung trades a different amount of recompute (or host
+traffic) for activation memory:
+
+* ``'off'``    — save every activation (the jax default).
+* ``'dots'``   — ``jax.checkpoint`` with the standard
+  ``dots_with_no_batch_dims_saveable`` policy: matmul outputs stay
+  saved, elementwise chains recompute.  This is exactly what the old
+  ``remat=True`` did (``True`` still maps here).
+* ``'full'``   — SEGMENTED remat: the forward topo is partitioned into
+  contiguous segments anchored at matmul-family ops
+  (``HETU_REMAT_SEGMENT_ANCHORS`` anchors per segment, default 6 — about
+  one transformer block), each segment lowers inside its own nested
+  ``jax.checkpoint``, so the only activations living across the
+  forward/backward boundary are the segment BOUNDARY values.  A single
+  whole-graph ``nothing_saveable`` wrap does NOT deliver this: the one
+  monolithic backward replay keeps every recomputed activation live at
+  once (measured: 5% peak saving vs 40% for the segmented form on
+  bert-tiny).
+* ``'offload'`` — save dot outputs to HOST memory
+  (``offload_dot_with_no_batch_dims`` device→pinned_host) where the
+  backend supports it (TPU); elsewhere a COUNTED fallback to ``'dots'``
+  (``remat_offload_fallback`` — flash-counter style, per build;
+  ``HETU_REQUIRE_OFFLOAD=1`` hard-fails instead).
+* ``'auto'``   — per-segment policy chosen by the PR 5 shape-inferred
+  cost model: each segment is priced (activation bytes it would free vs
+  matmul FLOPs a backward replay would re-pay, from
+  ``analysis.infer_graph`` shapes — the same pricing
+  ``autoparallel.graph_layer_spec`` uses), then segments are greedily
+  rematted CHEAPEST-RECOMPUTE-PER-BYTE-FIRST until the projected
+  persistent + activation bytes fit the HBM budget
+  (``HETU_HBM_BUDGET_MB``, else the backend-reported memory limit).  No
+  resolvable budget (or an unpriceable graph) remats every segment —
+  the memory-conservative direction — and the ``remat-policy`` lint
+  rule says so at construction.
+
+The chosen plan is reported (``Executor.remat_plan()``) and its
+fingerprint is hashed into the compiled-step-cache signature
+(``graph/step_cache.py``) so two policies — or two ``auto`` plans under
+different budgets — can never alias one executable.
+
+Bitwise discipline: remat replays the SAME ops the forward ran (the
+per-step RNG folds happen once at trace time, so dropout masks replay
+identically), hence every policy's losses are exactly equal to
+``'off'`` — the parity tests assert bitwise equality, not tolerance.
+
+Segments never swallow state-writing ops (BatchNorm running stats,
+``StateWrite``): their ``ctx.state_updates`` side-channel values must
+stay outer-trace tracers, so those nodes lower inline and break the
+segment around them.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..metrics import record_remat
+
+POLICIES = ("off", "dots", "full", "offload", "auto")
+
+#: matmul-family + attention op types: segment ANCHORS (their outputs are
+#: the expensive-to-recompute values the pricing charges for) — the same
+#: families ``autoparallel.cost_model`` prices FLOPs for
+ANCHOR_OPS = {"MatrixMult", "Linear", "BatchMatrixMult", "Addmm",
+              "Baddbmm", "Einsum", "Conv2d", "Conv2dAddBias"}
+ANCHOR_PREFIXES = ("ScaledDotProductAttention", "RingAttention",
+                   "UlyssesAttention")
+
+#: ops that write ``ctx.state_updates`` during lowering — inside a
+#: checkpointed segment fn those side-channel values would be leaked
+#: inner tracers, so these lower inline and break the segment
+STATE_WRITING_OPS = {"BatchNorm", "StateWrite"}
+
+
+def _is_anchor(node):
+    t = node.op_type
+    return t in ANCHOR_OPS or t.startswith(ANCHOR_PREFIXES)
+
+
+def anchors_per_segment():
+    """Segment granularity: anchors (matmuls) per segment
+    (``HETU_REMAT_SEGMENT_ANCHORS``, default 6 ≈ one transformer
+    block's q/k/v/o + 2 FFN matmuls)."""
+    try:
+        return max(1, int(os.environ.get("HETU_REMAT_SEGMENT_ANCHORS",
+                                         "6")))
+    except ValueError:
+        return 6
+
+
+def resolve_policy(value):
+    """Normalize a user ``remat=`` setting to a policy name.
+
+    Booleans keep their pre-ISSUE-13 meaning: ``True`` is the old
+    dots-saveable checkpoint wrap, ``False``/``None`` is off.  Unknown
+    strings raise (the ``Executor(pipeline=...)`` convention); the
+    ``remat-policy`` lint rule additionally diagnoses them for direct
+    ``ht.lint(remat=...)`` callers."""
+    if value is None or value is False:
+        return "off"
+    if value is True:
+        return "dots"
+    pol = str(value).lower()
+    if pol not in POLICIES:
+        raise ValueError(
+            f"remat={value!r}: expected one of {'|'.join(POLICIES)} "
+            f"(True == 'dots', False == 'off')")
+    return pol
+
+
+def resolve_budget():
+    """HBM budget in bytes for the ``auto`` policy: ``(bytes, source)``
+    or ``(None, None)`` when nothing is resolvable.
+
+    ``HETU_HBM_BUDGET_MB`` wins; otherwise the backend-reported memory
+    limit (``device.memory_stats()['bytes_limit']`` — TPU reports it,
+    XLA-CPU keeps no stats)."""
+    env = os.environ.get("HETU_HBM_BUDGET_MB")
+    if env:
+        try:
+            return int(float(env) * 2**20), "HETU_HBM_BUDGET_MB"
+        except ValueError:
+            pass
+    try:
+        import jax
+        st = jax.devices()[0].memory_stats() or {}
+        limit = int(st.get("bytes_limit", 0))
+        if limit > 0:
+            return limit, "backend"
+    except Exception:
+        pass
+    return None, None
+
+
+@dataclass
+class RematSegment:
+    """One contiguous run of forward nodes, anchored at matmuls.
+
+    ``act_bytes`` prices every value the segment produces (what saving
+    them costs), ``out_bytes`` the subset that must survive as segment
+    BOUNDARIES either way (consumed outside the segment, or fetched),
+    ``recompute_flops`` the matmul FLOPs a backward replay re-pays.
+    ``saved_bytes`` — what remat actually frees — is the difference."""
+
+    index: int
+    nodes: list
+    anchors: int = 0
+    act_bytes: float = 0.0
+    out_bytes: float = 0.0
+    recompute_flops: float = 0.0
+    remat: bool = False
+
+    @property
+    def saved_bytes(self):
+        return max(0.0, self.act_bytes - self.out_bytes)
+
+    @property
+    def cost_per_byte(self):
+        """Greedy ranking key: recompute FLOPs per byte freed (lower =
+        cheaper to remat)."""
+        return self.recompute_flops / max(1.0, self.saved_bytes)
+
+
+@dataclass
+class RematPlan:
+    """The resolved per-segment remat decisions for one subgraph."""
+
+    policy: str
+    segments: list = field(default_factory=list)
+    budget_bytes: object = None        # int | None
+    budget_source: object = None       # str | None
+    persistent_bytes: int = 0
+    priced: bool = True
+    note: str = ""
+
+    @property
+    def n_remat(self):
+        return sum(1 for s in self.segments if s.remat)
+
+    @property
+    def bytes_saved(self):
+        return int(sum(s.saved_bytes for s in self.segments if s.remat))
+
+    @property
+    def recompute_flops(self):
+        return int(sum(s.recompute_flops for s in self.segments
+                       if s.remat))
+
+    @property
+    def total_act_bytes(self):
+        return int(sum(s.act_bytes for s in self.segments))
+
+    def remat_node_lists(self):
+        """Node lists for ``lower_forward``'s segmented path — only the
+        segments the plan actually remats."""
+        return [s.nodes for s in self.segments if s.remat]
+
+    def fingerprint(self):
+        """Stable decision fingerprint hashed into the compiled-step-
+        cache signature: two plans differing in ANY segment decision (or
+        segmentation) must not alias one executable."""
+        return (self.policy,
+                tuple((len(s.nodes), s.anchors, bool(s.remat))
+                      for s in self.segments))
+
+    def report(self):
+        """JSON-able plan summary (``Executor.remat_plan()``, the bench
+        artifact's per-cell ``remat_plan``)."""
+        return {
+            "policy": self.policy,
+            "segments": len(self.segments),
+            "segments_rematted": self.n_remat,
+            "budget_bytes": self.budget_bytes,
+            "budget_source": self.budget_source,
+            "persistent_bytes": int(self.persistent_bytes),
+            "activation_bytes_total": self.total_act_bytes,
+            "activation_bytes_saved": self.bytes_saved,
+            "recompute_flops": self.recompute_flops,
+            "priced": bool(self.priced),
+            "note": self.note,
+            "per_segment": [
+                {"index": s.index, "ops": len(s.nodes),
+                 "anchors": s.anchors,
+                 "act_bytes": int(s.act_bytes),
+                 "saved_bytes": int(s.saved_bytes),
+                 "recompute_flops": int(s.recompute_flops),
+                 "remat": bool(s.remat)}
+                for s in self.segments],
+        }
+
+
+def build_segments(topo, skip=()):
+    """Partition the lowerable forward nodes of ``topo`` into contiguous
+    anchored segments.
+
+    Placeholders resolve outside segments; gradient markers and ``skip``
+    (optimizer) nodes never lower; state-writing ops lower inline and
+    CLOSE the current segment (their side-channel writes must happen on
+    the outer trace).  A segment closes after ``anchors_per_segment()``
+    anchors.  Returns only segments containing at least one anchor and
+    more than one node — elementwise-only tails free almost nothing."""
+    from ..graph.node import PlaceholderOp
+    from ..graph.gradients import GradientOp
+
+    per = anchors_per_segment()
+    skip = set(skip)
+    segments, cur, nanch = [], [], 0
+
+    def close():
+        nonlocal cur, nanch
+        if cur:
+            segments.append(cur)
+        cur, nanch = [], 0
+
+    for node in topo:
+        if isinstance(node, (PlaceholderOp, GradientOp)) or node in skip:
+            continue
+        if node.op_type in STATE_WRITING_OPS:
+            close()                 # state writer lowers inline
+            continue
+        cur.append(node)
+        if _is_anchor(node):
+            nanch += 1
+            if nanch >= per:
+                close()
+    close()
+    return [s for s in segments
+            if len(s) > 1 and any(_is_anchor(n) for n in s)]
+
+
+def _price_segments(segments, fetches, topo, skip=()):
+    """Per-segment (act_bytes, out_bytes, recompute_flops) from the PR 5
+    shape-inferred cost model.  Returns True when every segment priced;
+    a failed inference leaves prices at 0 (the caller records
+    ``priced=False`` and decides conservatively)."""
+    from ..graph.node import PlaceholderOp
+    from ..graph.gradients import GradientOp
+    try:
+        from ..analysis.shapes import infer_graph
+        from ..autoparallel.cost_model import matmul_flops, MATMUL_OPS
+        gs = infer_graph(fetches)
+    except Exception:
+        return False
+
+    def nbytes(node):
+        st = gs.struct(node)
+        if st is None or isinstance(st, (tuple, list)):
+            return None
+        dt = np.dtype(st.dtype)
+        return float(np.prod(st.shape)) * dt.itemsize if st.shape \
+            else float(dt.itemsize)
+
+    # consumers over the lowerable node set: a segment value consumed
+    # outside its segment survives remat as a boundary
+    skip = set(skip)
+    lowerable = [n for n in topo
+                 if not (isinstance(n, GradientOp) or n in skip)]
+    consumers = {}
+    for n in lowerable:
+        for i in n.inputs:
+            consumers.setdefault(i, []).append(n)
+    fetch_set = {f for f in fetches if f is not None}
+
+    ok = True
+    for seg in segments:
+        segset = set(seg.nodes)
+        act = out = flops = 0.0
+        for node in seg.nodes:
+            b = nbytes(node)
+            if b is None:
+                ok = False
+                continue
+            act += b
+            cons = consumers.get(node, [])
+            if node in fetch_set or not cons \
+                    or any(c not in segset for c in cons):
+                out += b
+            if node.op_type in MATMUL_OPS or node.op_type == "Einsum":
+                f = None
+                st = gs.struct(node)
+                if st is not None and not isinstance(st, (tuple, list)):
+                    try:
+                        f = matmul_flops(node, gs, st.shape)
+                    except Exception:
+                        f = None
+                if f:
+                    flops += f
+                else:
+                    ok = False
+            elif node.op_type.startswith("Conv"):
+                # conv: 2 · output elements · (kernel numel / out
+                # channels) — the contracted Cin·kH·kW per output value
+                # (OIHW kernel layout, ops/nn.py)
+                try:
+                    out_shape = gs.shape(node)
+                    w_shape = gs.shape(node.inputs[1])
+                    if out_shape and w_shape:
+                        flops += 2.0 * float(np.prod(out_shape)) \
+                            * float(np.prod(w_shape)) / w_shape[0]
+                    else:
+                        ok = False
+                except Exception:
+                    ok = False
+            elif node.op_type.startswith(ANCHOR_PREFIXES):
+                # attention: scores+values contractions from q/k shapes
+                # (graph_layer_spec's formula)
+                try:
+                    q = gs.shape(node.inputs[0])
+                    kv = gs.shape(node.inputs[1])
+                    if q and kv:
+                        flops += 2.0 * 2.0 * float(np.prod(q[:-2])) \
+                            * q[-2] * kv[-2] * q[-1]
+                    else:
+                        ok = False
+                except Exception:
+                    ok = False
+        seg.act_bytes, seg.out_bytes, seg.recompute_flops = act, out, flops
+    return ok
+
+
+def build_plan(topo, fetches, policy, skip=(), persistent_bytes=0,
+               budget=None, budget_source=None):
+    """Resolve the per-segment decisions for ``policy`` over one fetch
+    subgraph; returns a :class:`RematPlan` (or None for non-segmented
+    policies).  Records the ``remat_*`` counters per BUILD (flash-
+    counter semantics: per trace, not per step)."""
+    if policy not in ("full", "auto"):
+        return None
+    segs = [RematSegment(index=i, nodes=nodes)
+            for i, nodes in enumerate(build_segments(topo, skip=skip))]
+    for s in segs:
+        s.anchors = sum(1 for n in s.nodes if _is_anchor(n))
+    priced = _price_segments(segs, fetches, topo, skip=skip)
+    note = ""
+    if policy == "full":
+        for s in segs:
+            s.remat = True
+    else:                                   # auto
+        if budget is None:
+            budget, budget_source = resolve_budget()
+        if budget is None or not priced:
+            # memory-conservative default: no resolvable budget (or an
+            # unpriceable graph) remats everything; the remat-policy
+            # lint rule surfaces this at construction
+            for s in segs:
+                s.remat = True
+            note = "no HBM budget resolvable — rematting every segment" \
+                if budget is None else \
+                "graph not fully priceable — rematting every segment"
+        else:
+            live = persistent_bytes + sum(s.act_bytes for s in segs)
+            for s in sorted(segs, key=lambda s: s.cost_per_byte):
+                if live <= budget:
+                    break
+                s.remat = True
+                live -= s.saved_bytes
+            if live > budget:
+                note = (f"budget {budget} B not reachable even with "
+                        f"every segment rematted (projected {int(live)} "
+                        f"B)")
+    plan = RematPlan(policy=policy, segments=segs, budget_bytes=budget,
+                     budget_source=budget_source,
+                     persistent_bytes=int(persistent_bytes),
+                     priced=priced, note=note)
+    record_remat("remat_layers_total", len(segs))
+    record_remat("remat_layers_rematted", plan.n_remat)
+    record_remat("remat_bytes_saved", plan.bytes_saved)
+    record_remat("remat_recompute_flops", plan.recompute_flops)
+    return plan
+
+
+def plan_for(sub):
+    """Build the remat plan for one training SubExecutor (``'full'`` /
+    ``'auto'`` policies only; forward-only subgraphs have nothing to
+    remat).  Called at SubExecutor construction so
+    ``Executor.remat_plan()`` answers before the first run and the
+    step-cache signature can hash the decisions."""
+    ex = sub.ex
+    if ex.remat not in ("full", "auto") or not sub.grad_ops:
+        return None
+    persistent = 0
+    try:
+        mem = ex.memory_accounting()
+        persistent = (mem["param_bytes_per_device"]
+                      + mem["zero_slab_bytes_per_device"]
+                      + mem["opt_state_bytes_per_device"]
+                      + mem["grad_bytes_per_device"])
+    except Exception:
+        pass
+    return build_plan(sub.topo, sub.fetches, ex.remat,
+                      skip=sub.opt_ops, persistent_bytes=persistent)
+
+
+def offload_checkpoint_policy():
+    """The activation-offload checkpoint policy, or ``None`` with a
+    counted fallback where the backend cannot host-offload (flash-
+    dispatcher style: ``remat_offload_fallback`` per build;
+    ``HETU_REQUIRE_OFFLOAD=1`` raises instead)."""
+    import jax
+    reason = None
+    if jax.default_backend() != "tpu":
+        reason = f"backend_{jax.default_backend()}"
+    elif not hasattr(jax.checkpoint_policies,
+                     "offload_dot_with_no_batch_dims"):
+        reason = "jax_version"
+    if reason is None:
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    record_remat("remat_offload_fallback")
+    if os.environ.get("HETU_REQUIRE_OFFLOAD") == "1":
+        raise RuntimeError(
+            f"HETU_REQUIRE_OFFLOAD=1 but activation offload is "
+            f"unavailable here (reason: {reason})")
+    return None
+
+
+def wrap_loss(loss_fn, policy):
+    """Apply a WRAP-STYLE policy to the whole loss function.
+
+    ``'dots'`` and ``'offload'`` (and the pipeline schedulers'
+    per-microbatch default, ``'microbatch'``) are single
+    ``jax.checkpoint`` wraps; the segmented policies (``full``/``auto``)
+    act inside ``lower_forward`` instead and must not be double-wrapped
+    here."""
+    import jax
+    if policy == "dots":
+        return jax.checkpoint(
+            loss_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if policy == "offload":
+        pol = offload_checkpoint_policy()
+        if pol is None:      # counted fallback: save dots on device
+            pol = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return jax.checkpoint(loss_fn, policy=pol)
+    if policy == "microbatch":
+        # 1F1B/hetpipe per-microbatch footprint: recompute everything
+        # (the microbatch forward is small — the pre-13 behavior)
+        return jax.checkpoint(loss_fn)
+    raise ValueError(f"wrap_loss: not a wrap-style policy: {policy!r}")
+
+
+def checkpoint_segment(fn):
+    """The nested per-segment checkpoint ``lower_forward`` applies to a
+    rematted segment (one seam, so tests can observe wrap counts)."""
+    import jax
+    return jax.checkpoint(fn)
+
+
+__all__ = ["POLICIES", "ANCHOR_OPS", "STATE_WRITING_OPS",
+           "resolve_policy", "resolve_budget", "anchors_per_segment",
+           "RematSegment", "RematPlan", "build_segments", "build_plan",
+           "plan_for", "offload_checkpoint_policy", "wrap_loss",
+           "checkpoint_segment"]

@@ -286,6 +286,21 @@ class HetuProfiler:
         return emb_pallas_fallback_counts()
 
     @staticmethod
+    def remat_counters():
+        """{kind: count} of selective-remat plan builds
+        (``hetu_tpu.metrics`` registry; ``parallel/remat.py``): segments
+        found (``remat_layers_total``) and chosen for remat
+        (``remat_layers_rematted``), activation bytes the plan frees
+        (``remat_bytes_saved``) vs the matmul FLOPs a backward replay
+        re-pays (``remat_recompute_flops``), and activation-offload
+        requests served by the counted on-device fallback
+        (``remat_offload_fallback`` — ``HETU_REQUIRE_OFFLOAD=1`` makes
+        these hard failures).  Per plan BUILD, not per step; a run
+        without ``Executor(remat=...)`` reports an empty dict."""
+        from .metrics import remat_counts
+        return remat_counts()
+
+    @staticmethod
     def elastic_counters():
         """{kind: count} of elastic data-parallel resize events
         (``hetu_tpu.metrics`` registry; ``parallel/elastic.py``):
