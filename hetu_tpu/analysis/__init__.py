@@ -10,14 +10,16 @@ that name the offending node and the user line that created it.
 same rules at construction and checks fed values against declared
 placeholder shapes on every ``run()``.
 
-The framework's own static analysis (lock-order, RPC opcode drift, metric
-coverage) lives in ``tools/hetu_lint.py`` — an AST pass gated by
-``tests/test_lint.py``.
+The framework's own static analysis lives in ``tools/hetu_lint.py`` — an
+AST pass gated by ``tests/test_lint.py`` — whose concurrency engine
+(repo-wide lock-order + shared-state + blocking-under-lock detectors,
+ISSUE 14) is this package's :mod:`~hetu_tpu.analysis.concurrency`.
 """
 from .shapes import GraphShapes, abstract_infer_shape, infer_graph
 from .lint import (RULES, Diagnostic, GraphInfo, GraphValidationError,
                    LintReport, lint, rule)
+from . import concurrency  # noqa: F401  (stdlib-only; ISSUE 14 verifier)
 
 __all__ = ["GraphShapes", "abstract_infer_shape", "infer_graph",
            "RULES", "Diagnostic", "GraphInfo", "GraphValidationError",
-           "LintReport", "lint", "rule"]
+           "LintReport", "lint", "rule", "concurrency"]

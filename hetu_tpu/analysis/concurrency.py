@@ -1,0 +1,933 @@
+"""Static concurrency verifier — repo-wide lock-order + shared-state lint
+(ISSUE 14 tentpole, static pass).
+
+The system runs at least eight concurrent host-side planes (feed
+pipeline, serve router, emb-refresh sweeper, ps-serve handler threads,
+heartbeat pinger, elastic controller, tracer rings, metricsd exporter),
+and the PR 3-13 review logs show the same failure class repeatedly:
+races and lock-discipline holes found only by human review (the
+``set_result``/cancel race in the serving router, ``refresh_stale`` RPCs
+under the cache lock, the commit-vs-evict window, the GC-reentrancy
+drain deadlock).  The PR 5 self-lint proved the approach on one package
+(``ps/``); this module grows it into a first-class verifier over the
+WHOLE package, wired as ``tools/hetu_lint.py --concurrency`` and gated
+at zero findings by ``tests/test_lint.py``.
+
+Model
+-----
+One pass over ``{filename: source}`` builds a :class:`Model`:
+
+* per-class **lock inventory** — every ``self.x = threading.Lock()`` /
+  ``RLock`` / ``Condition`` / ``Semaphore`` / ``Event`` assignment, with
+  its creation site (file:line) for provenance; module-level
+  ``NAME = threading.Lock()`` assignments join as ``<module>.NAME``;
+* per-method **acquisition scans** — ``with self._x_lock:`` nesting
+  edges, same-class calls made while holding a lock, attribute-typed
+  calls (``self.store.push(...)``) resolved ACROSS modules through the
+  class's ``self.attr = ClassName(...)`` constructor assignments, writes
+  to ``self.*`` attributes with the lock set held at each write, calls
+  from a blocking-call blocklist, and ``Condition.wait`` sites;
+* **thread entrypoints** — ``threading.Thread(target=...)`` targets,
+  executor ``submit(...)`` callables and local closures handed to
+  either, each closed transitively over same-class calls into a
+  "thread plane" per entrypoint.
+
+Detectors (each proven live by a synthetic-violation test)
+----------------------------------------------------------
+``lock-order``
+    acquisition-order cycles (ABBA deadlocks) over the GLOBAL lock
+    graph — lexical nesting plus held-call propagation, including
+    cross-class edges through resolved attribute calls.
+``lock-reentry``
+    re-entrant acquisition of a non-reentrant ``threading.Lock``
+    (self-deadlock), including re-entry through a call chain.
+``shared-state-without-lock``
+    a mutable ``self.*`` attribute written both from a discovered
+    thread entrypoint's plane and from another plane, where the two
+    writes share no common lock (``__init__`` writes are construction,
+    not sharing, and are exempt).
+``blocking-call-under-lock``
+    an RPC / ``.result()`` / ``.join()`` / ``device_put`` /
+    ``time.sleep`` style blocking call made while a lock is held —
+    directly or through a call chain (the exact ``refresh_stale``-
+    under-the-cache-lock bug class).
+``wait-without-predicate-loop``
+    ``Condition.wait()`` whose surrounding code does not re-check a
+    predicate in a ``while`` loop (missed-wakeup / spurious-wakeup
+    hazard; ``wait_for`` carries its own loop, ``Event.wait`` has no
+    predicate to re-check).
+
+Justified allowlist
+-------------------
+Intentional violations are DOCUMENTED, not silenced: the flagged line
+(or the ``with`` statement that holds the lock) carries a marker
+comment with a MANDATORY reason::
+
+    with self._repl_lock:        # lint: held-rpc-ok apply+mirror is one
+                                 # critical section (backup sees primary order)
+        self.rpc_fn(...)
+
+Tokens: ``held-rpc-ok`` (blocking-call-under-lock), ``unlocked-ok``
+(shared-state-without-lock), ``lock-order-ok`` (cycles), ``reentry-ok``
+(non-reentrant re-entry), ``wait-loop-ok`` (predicate-loop).  A marker
+with no reason text is itself a finding.
+
+The static pass cannot see through ``ctypes``, sockets or callbacks —
+the runtime twin (:mod:`hetu_tpu.obs.lock_witness`) records the REAL
+acquisition graph under ``HETU_LOCK_WITNESS=1`` and catches orders this
+pass can't.  This module is deliberately stdlib-only so
+``tools/hetu_lint.py`` can load it without importing the package.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+#: attribute-name tokens that mark a with-item as a lock even when the
+#: class inventory cannot see its construction (e.g. a lock handed in)
+LOCK_TOKENS = ("lock", "cond", "_cv", "mutex")
+
+#: constructors the per-class inventory recognizes — the raw threading
+#: primitives plus the witness factories (``obs.lock_witness``) the
+#: instrumented call sites use
+LOCK_CTORS = {
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+    "Semaphore": "Semaphore", "BoundedSemaphore": "BoundedSemaphore",
+    "Event": "Event",
+    "make_lock": "Lock", "make_rlock": "RLock",
+    "make_condition": "Condition",
+}
+
+#: lock kinds that may be re-acquired by the holding thread
+REENTRANT = {"RLock", "Condition"}   # Condition defaults to an RLock
+
+#: method names treated as blocking when called while a lock is held.
+#: RPC/transport verbs (the PS client surface + raw sockets), future /
+#: thread joins, sleeps, and host<->device transfers.  ``wait`` /
+#: ``wait_for`` are NOT here: a Condition wait releases its own lock
+#: (the predicate-loop detector owns those sites).
+BLOCKING_CALLS = {
+    "result", "join", "sleep", "recv", "recv_into", "sendall", "send",
+    "accept", "connect", "pull", "push", "push_pull", "versions",
+    "_rpc", "rpc_fn", "ssp_sync", "device_put", "block_until_ready",
+    "urlopen", "getaddrinfo",
+}
+
+#: allowlist marker tokens per detector
+ALLOW_TOKENS = ("held-rpc-ok", "unlocked-ok", "lock-order-ok",
+                "reentry-ok", "wait-loop-ok")
+
+
+# --------------------------------------------------------------- allowlist
+
+class _Allow:
+    """Per-file ``# lint: <token> <reason>`` markers, by line."""
+
+    def __init__(self, src):
+        self.by_line = {}           # lineno -> (token, reason)
+        self.bad = []               # linenos with a token but no reason
+        for i, line in enumerate(src.splitlines(), 1):
+            if "# lint:" not in line:
+                continue
+            body = line.split("# lint:", 1)[1].strip()
+            for tok in ALLOW_TOKENS:
+                if body.startswith(tok):
+                    reason = body[len(tok):].strip()
+                    self.by_line[i] = (tok, reason)
+                    if not reason:
+                        self.bad.append((i, tok))
+                    break
+
+    def ok(self, token, *linenos):
+        """True iff any of ``linenos`` — or the line directly above one
+        (the standard marker-comment-above-the-statement placement) —
+        carries a justified ``token`` marker (reason text present)."""
+        for ln in linenos:
+            for cand in (ln, ln - 1):
+                ent = self.by_line.get(cand)
+                if ent and ent[0] == token and ent[1]:
+                    return True
+        return False
+
+
+# ------------------------------------------------------------------ scans
+
+def _call_name(func):
+    """Constructor/callee name of a Call's func node."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _self_attr(expr):
+    """'x' for ``self.x``, else None."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method (or thread-target closure): lock acquisitions, nesting,
+    held calls, attribute writes, blocking calls, waits, thread spawns."""
+
+    def __init__(self, cls, name, assigns, lock_attrs):
+        self.cls = cls              # _ClassModel
+        self.name = name
+        self.assigns = assigns      # local name -> value expr
+        self.lock_attrs = lock_attrs
+        self.held = []              # stack of (lock id or None=anonymous,
+                                    #           with-stmt lineno)
+        self.acquires = {}          # lock id -> first with lineno
+        self.edges = set()          # (outer, inner, lineno)
+        self.self_calls = set()     # same-class method names called
+        self.site_calls = []        # (callee, frozenset(held)) per site
+        self.attr_calls = set()     # (self-attr, method) calls, any context
+        self.calls_under = []       # (lock, with_ln, kind, target, call_ln)
+        self.writes = []            # (attr, frozenset(held ids), lineno)
+        self.blocking = []          # (desc, lineno)  own direct blocking
+        self.waits = []             # (recv id, lineno, in_while)
+        self.spawns = []            # (target method name, lineno)
+        self._loops = []            # While/For stack
+
+    # -- lock identity ----------------------------------------------------
+    def _lock_of(self, expr):
+        """(lock id or None, known) — id like 'Cls.attr', 'Cls.attr[*]'
+        or '<module>.NAME'; ``known`` True when the expr is lock-like at
+        all (an anonymous lock still counts as held)."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if attr in self.lock_attrs or \
+                    any(t in attr.lower() for t in LOCK_TOKENS):
+                return f"{self.cls.name}.{attr}", True
+            return None, False
+        if isinstance(expr, ast.Name):
+            if expr.id in self.cls.module_locks:
+                return f"<module {self.cls.file}>.{expr.id}", True
+            src = self.assigns.get(expr.id)
+            if src is not None:
+                for sub in ast.walk(src):
+                    a = _self_attr(sub)
+                    if a is not None and (
+                            a in self.lock_attrs or
+                            any(t in a.lower() for t in LOCK_TOKENS)):
+                        return f"{self.cls.name}.{a}[*]", True
+                # a lock reached through another object: anonymous —
+                # held for blocking checks, absent from the order graph
+                for sub in ast.walk(src):
+                    if isinstance(sub, ast.Attribute) and any(
+                            t in sub.attr.lower() for t in LOCK_TOKENS):
+                        return None, True
+            return None, False
+        if isinstance(expr, ast.Attribute) and any(
+                t in expr.attr.lower() for t in LOCK_TOKENS):
+            # obj._lock for a non-self obj: anonymous held lock
+            return None, True
+        return None, False
+
+    # -- visitors ---------------------------------------------------------
+    def visit_With(self, node):
+        # items acquire LEFT TO RIGHT, so `with a, b:` orders a before b
+        # exactly like nested withs — each item sees the earlier ones
+        # already on the held stack (review finding: computing edges
+        # before pushing any item missed multi-item ABBA halves)
+        pushed = 0
+        for item in node.items:
+            lid, known = self._lock_of(item.context_expr)
+            if not known:
+                continue
+            if lid is not None:
+                self.acquires.setdefault(lid, node.lineno)
+                for outer, _ln in self.held:
+                    if outer is not None:
+                        self.edges.add((outer, lid, node.lineno))
+            self.held.append((lid, node.lineno))
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_While(self, node):
+        self._loops.append(node)
+        self.generic_visit(node)
+        self._loops.pop()
+
+    def _note_call(self, node):
+        fn = node.func
+        cname = _call_name(fn)
+        call_ln = node.lineno
+        # thread spawns: Thread(target=...), pool.submit(fn, ...)
+        if cname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self._note_spawn(kw.value, call_ln)
+        elif cname in ("submit", "start_new_thread") and node.args:
+            self._note_spawn(node.args[0], call_ln)
+        # same-class call / resolved attribute call (any context: both
+        # feed the reachability closures even when no lock is held)
+        target = None
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.self_calls.add(fn.attr)
+                self.site_calls.append((fn.attr, frozenset(
+                    l for l, _ in self.held if l is not None)))
+                target = ("self", (fn.attr,))
+            else:
+                a = _self_attr(recv)
+                if a is not None:
+                    self.attr_calls.add((a, fn.attr))
+                    target = ("attr", (a, fn.attr))
+        if cname in BLOCKING_CALLS:
+            desc = ast.unparse(fn) if hasattr(ast, "unparse") \
+                else str(cname)
+            # direct blocking site (held or not: callers holding a lock
+            # reach it through the call-chain closure)
+            self.blocking.append((desc, call_ln))
+            if self.held:
+                innermost = self.held[-1]
+                self.calls_under.append(
+                    (innermost[0], innermost[1], "blocking", desc, call_ln))
+        if target is not None and self.held:
+            for lid, wln in self.held:
+                if lid is not None:
+                    self.calls_under.append(
+                        (lid, wln, target[0], target[1], call_ln))
+        # condition waits
+        if isinstance(fn, ast.Attribute) and fn.attr == "wait":
+            lid, known = self._lock_of(fn.value)
+            if lid is not None or known:
+                self.waits.append((lid, call_ln, bool(self._loops)))
+
+    def _note_spawn(self, target, lineno):
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            self.spawns.append((target.attr, lineno))
+        elif isinstance(target, ast.Name):
+            # a local closure: scan it as its own entrypoint body
+            self.spawns.append((f"{self.name}.<{target.id}>", lineno))
+        elif isinstance(target, ast.Lambda):
+            # an inline lambda target: its body runs on the spawned
+            # thread's plane (registered as a pseudo-method by
+            # _scan_class under the same lineno-keyed name)
+            self.spawns.append(
+                (f"{self.name}.<lambda@{target.lineno}>", lineno))
+
+    def visit_Call(self, node):
+        self._note_call(node)
+        self.generic_visit(node)
+
+    def _note_write(self, tgt, lineno):
+        attr = _self_attr(tgt)
+        if attr is None and isinstance(tgt, ast.Subscript):
+            attr = _self_attr(tgt.value)      # self.x[...] = ...
+        if attr is not None and attr not in self.lock_attrs:
+            locks = frozenset(l for l, _ in self.held if l is not None)
+            self.writes.append((attr, locks, lineno))
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    self._note_write(el, node.lineno)
+            else:
+                self._note_write(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._note_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs are scanned separately when spawned; their bodies
+        # must not leak writes/acquires into the enclosing method scan
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        # a lambda body is DEFERRED — `submit(lambda: self.pull())`
+        # under a lock runs the pull on the pool thread after the lock
+        # is long released, so scanning it inline manufactured a false
+        # blocking-call-under-lock (review finding); like nested defs,
+        # lambdas are scanned as their own pseudo-methods when spawned
+        pass
+
+
+def _name_assigns(func):
+    """local name -> value expr for simple assignments inside ``func``."""
+    out = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out[el.id] = node.value
+    return out
+
+
+class _ClassModel:
+    """One class: lock inventory, method scans, attr->class bindings."""
+
+    def __init__(self, name, file, module_locks):
+        self.name = name
+        self.file = file
+        self.module_locks = module_locks    # module NAME -> (ctor, lineno)
+        self.locks = {}         # attr -> (ctor kind, lineno)
+        self.methods = {}       # method name -> _MethodScan
+        self.attr_classes = {}  # attr -> class name (self.x = Cls(...))
+        self.entrypoints = {}   # method name -> spawn lineno
+
+
+def _scan_class(cls_node, fname, module_locks, registry, reg_name=None):
+    cm = _ClassModel(reg_name or cls_node.name, fname, module_locks)
+    # lock inventory + attr->class bindings (anywhere in the class body)
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            attr = _self_attr(tgt)
+            if attr is None or not isinstance(node.value, ast.Call):
+                continue
+            ctor = _call_name(node.value.func)
+            if ctor in LOCK_CTORS:
+                cm.locks.setdefault(attr, (LOCK_CTORS[ctor], node.lineno))
+            elif ctor is not None and ctor[:1].isupper():
+                cm.attr_classes.setdefault(attr, ctor)
+    lock_attrs = set(cm.locks)
+    # method scans (closures handed to Thread/submit become their own
+    # pseudo-methods so their writes land on the right plane)
+    for meth in cls_node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        assigns = _name_assigns(meth)
+        scan = _MethodScan(cm, meth.name, assigns, lock_attrs)
+        for stmt in meth.body:
+            scan.visit(stmt)
+        cm.methods[meth.name] = scan
+        for target, ln in scan.spawns:
+            cm.entrypoints.setdefault(target, ln)
+        # nested closures: scan each local def as "<meth>.<name>" and
+        # each lambda as "<meth>.<lambda@line>" (a lambda body cannot
+        # contain assignments, but its CALLS feed the thread-plane
+        # closure when the lambda is a Thread/submit target)
+        for node in ast.walk(meth):
+            if isinstance(node, ast.FunctionDef) and node is not meth:
+                sub = _MethodScan(cm, f"{meth.name}.<{node.name}>",
+                                  assigns, lock_attrs)
+                for stmt in node.body:
+                    sub.visit(stmt)
+                cm.methods[f"{meth.name}.<{node.name}>"] = sub
+            elif isinstance(node, ast.Lambda):
+                sub = _MethodScan(cm, f"{meth.name}.<lambda@{node.lineno}>",
+                                  assigns, lock_attrs)
+                sub.visit(node.body)
+                cm.methods[f"{meth.name}.<lambda@{node.lineno}>"] = sub
+    registry[cm.name] = cm
+    return cm
+
+
+class Model:
+    """The parsed repo: classes by name, module locks, sources, allows."""
+
+    def __init__(self):
+        self.classes = {}       # class name -> _ClassModel
+        self.files = {}         # class name -> filename
+        self.allows = {}        # filename -> _Allow
+        self.errors = []
+
+
+def build_model(sources):
+    """Parse ``{filename: source}`` into a :class:`Model`.
+
+    Classes are registered under their BARE name when it is unique
+    across the source set (so ``self.store = DistributedStore(...)``
+    resolves cross-module), and under ``Name@file`` when two files
+    define the same class name — a shadowed duplicate silently dropped
+    from analysis would make the zero-findings gate vacuous for it
+    (review finding); attribute resolution to an ambiguous name is
+    skipped conservatively."""
+    model = Model()
+    parsed = []
+    name_counts = {}
+    for fname, src in sorted(sources.items()):
+        model.allows[fname] = _Allow(src)
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            model.errors.append(f"{fname}: syntax error: {e}")
+            continue
+        parsed.append((fname, tree))
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                name_counts[node.name] = name_counts.get(node.name, 0) + 1
+    for fname, tree in parsed:
+        module_locks = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                ctor = _call_name(node.value.func)
+                if ctor in LOCK_CTORS:
+                    module_locks[node.targets[0].id] = (
+                        LOCK_CTORS[ctor], node.lineno)
+        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+            reg_name = cls.name if name_counts.get(cls.name, 0) == 1 \
+                else f"{cls.name}@{fname}"
+            cm = _scan_class(cls, fname, module_locks, model.classes,
+                             reg_name)
+            model.files[cm.name] = fname
+        # module-level functions form a pseudo-class so ``with _LOCK:``
+        # nesting in module code still reaches the graph
+        pseudo = _ClassModel(f"<module {fname}>", fname, module_locks)
+        for fn in [n for n in tree.body if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            scan = _MethodScan(pseudo, fn.name, _name_assigns(fn), set())
+            for stmt in fn.body:
+                scan.visit(stmt)
+            pseudo.methods[fn.name] = scan
+        model.classes[pseudo.name] = pseudo
+        model.files[pseudo.name] = fname
+    return model
+
+
+# --------------------------------------------------------- the reachability
+
+def _eventual_acquires(model):
+    """method (cls, name) -> set of lock ids it may acquire, closed over
+    same-class calls AND attribute calls resolved to other classes."""
+    ev = {}
+    for cname, cm in model.classes.items():
+        for mname, scan in cm.methods.items():
+            ev[(cname, mname)] = set(scan.acquires)
+    changed = True
+    while changed:
+        changed = False
+        for cname, cm in model.classes.items():
+            for mname, scan in cm.methods.items():
+                cur = ev[(cname, mname)]
+                for callee in scan.self_calls:
+                    extra = ev.get((cname, callee), set()) - cur
+                    if extra:
+                        cur |= extra
+                        changed = True
+                for attr, meth in scan.attr_calls:
+                    tcls = cm.attr_classes.get(attr)
+                    if tcls and (tcls, meth) in ev:
+                        extra = ev[(tcls, meth)] - cur
+                        if extra:
+                            cur |= extra
+                            changed = True
+    return ev
+
+
+def _eventual_blocking(model):
+    """method (cls, name) -> {(desc, lineno)} of blocking calls
+    reachable through same-class calls.  Facts propagate UNCHANGED —
+    the finding names the immediate callee plus the blocking site's
+    file:line, which is the provenance that matters; re-wrapping a
+    chain tag per hop made the fixpoint non-monotone and looped forever
+    on mutually recursive methods (review finding: a 14-line synthetic
+    hung the tier-1 gate)."""
+    ev = {}
+    for cname, cm in model.classes.items():
+        for mname, scan in cm.methods.items():
+            ev[(cname, mname)] = set(scan.blocking)
+    changed = True
+    while changed:
+        changed = False
+        for cname, cm in model.classes.items():
+            for mname, scan in cm.methods.items():
+                cur = ev[(cname, mname)]
+                for callee in scan.self_calls:
+                    extra = ev.get((cname, callee), set()) - cur
+                    if extra:
+                        cur |= extra
+                        changed = True
+    return ev
+
+
+def _caller_context_locks(cm):
+    """{method -> frozenset of locks held at EVERY same-class call site
+    of that method}, transitively (a helper only ever reached under a
+    lock inherits it — ``_advance_unlocked``-style naming conventions
+    become checked facts instead of hoped-for ones).  Methods with no
+    in-class caller (public entry points, thread targets) inherit
+    nothing."""
+    all_locks = frozenset()
+    for scan in cm.methods.values():
+        all_locks |= frozenset(scan.acquires)
+        for _, held in scan.site_calls:
+            all_locks |= held
+    # top = all locks; intersect downwards to a fixpoint
+    eff = {m: all_locks for m in cm.methods}
+    # entry points (no in-class caller) pin to empty
+    called = {c for scan in cm.methods.values()
+              for c, _ in scan.site_calls}
+    for m in cm.methods:
+        if m not in called:
+            eff[m] = frozenset()
+    changed = True
+    while changed:
+        changed = False
+        for mname, scan in cm.methods.items():
+            for callee, held in scan.site_calls:
+                if callee not in eff:
+                    continue
+                ctx = held | eff[mname]
+                new = eff[callee] & ctx
+                if new != eff[callee]:
+                    eff[callee] = new
+                    changed = True
+    return eff
+
+
+def _thread_planes(cm):
+    """{entrypoint -> set of methods reachable from it via self-calls}."""
+    planes = {}
+    for entry in cm.entrypoints:
+        seen, stack = set(), [entry]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in cm.methods:
+                continue
+            seen.add(m)
+            stack.extend(cm.methods[m].self_calls)
+        planes[entry] = seen
+    return planes
+
+
+# ----------------------------------------------------------------- findings
+
+def _split_lock_id(lid):
+    """('ClsOrModule', 'attr') — attr never contains a dot, so split on
+    the LAST one (module pseudo-class names carry '.py')."""
+    cls, _, attr = lid.rpartition(".")
+    if attr.endswith("[*]"):
+        attr = attr[:-3]
+    return cls, attr
+
+
+def _lock_site(model, lid):
+    """'file:line' of a lock id's creation, for provenance."""
+    cls, attr = _split_lock_id(lid)
+    cm = model.classes.get(cls)
+    if cm is None:
+        return "?"
+    if attr in cm.locks:
+        return f"{cm.file}:{cm.locks[attr][1]}"
+    if attr in cm.module_locks:
+        return f"{cm.file}:{cm.module_locks[attr][1]}"
+    return cm.file
+
+
+def _lock_kind(model, lid):
+    cls, attr = _split_lock_id(lid)
+    cm = model.classes.get(cls)
+    if cm is None:
+        return None
+    if attr in cm.locks:
+        return cm.locks[attr][0]
+    if attr in cm.module_locks:
+        return cm.module_locks[attr][0]
+    return None
+
+
+def check_lock_graph(model):
+    """ABBA cycles + non-reentrant re-entry over the global lock graph."""
+    findings = []
+    ev = _eventual_acquires(model)
+    # order edges AND self-edges (re-entry candidates) keep EVERY site:
+    # the allowlist is judged per site, never at a first-seen proxy —
+    # a 'reentry-ok' marker on one re-entry cannot silence a different
+    # unguarded one, and a 'lock-order-ok' marker only excuses an edge
+    # when EVERY site producing it is annotated (an unannotated
+    # duplicate site creates the same cycle on its own; review
+    # findings: the shared-state per-pair rule, applied here too)
+    edges = {}              # (a, b) -> [(file, lineno, allow), ...]
+    reentries = []          # (lock id, file, lineno, allow) per site
+
+    def note(a, b, fname, ln, allow):
+        if a == b:
+            reentries.append((a, fname, ln, allow))
+        else:
+            sites = edges.setdefault((a, b), [])
+            if (fname, ln) not in [(f, l) for f, l, _ in sites]:
+                sites.append((fname, ln, allow))
+
+    for cname, cm in model.classes.items():
+        allow = model.allows.get(cm.file)
+        for mname, scan in cm.methods.items():
+            for outer, inner, ln in scan.edges:
+                note(outer, inner, cm.file, ln, allow)
+            for entry in scan.calls_under:
+                lid, wln, kind = entry[0], entry[1], entry[2]
+                if lid is None or kind == "blocking":
+                    continue
+                if kind == "self":
+                    key = (cname, entry[3][0])
+                elif kind == "attr":
+                    attr, meth = entry[3]
+                    tcls = cm.attr_classes.get(attr)
+                    if not tcls:
+                        continue
+                    key = (tcls, meth)
+                else:
+                    continue
+                for inner in ev.get(key, ()):
+                    note(lid, inner, cm.file, entry[4], allow)
+    # a lock whose construction the inventory cannot see (handed in via
+    # a parameter) has unknown kind: assume NON-reentrant — silently
+    # skipping it would pass a guaranteed self-deadlock through the
+    # zero-findings gate (review finding; the pre-ISSUE-14 ps/-local
+    # pass defaulted unknown locks to Lock for exactly this reason)
+    seen_sites = set()
+    for lid, fname, ln, allow in reentries:
+        kind = _lock_kind(model, lid)
+        if kind in REENTRANT:
+            continue
+        if allow is not None and allow.ok("reentry-ok", ln):
+            continue
+        if (lid, fname, ln) in seen_sites:
+            continue
+        seen_sites.add((lid, fname, ln))
+        desc = f"non-reentrant lock '{lid}' (created " \
+            f"{_lock_site(model, lid)})" if kind is not None else \
+            f"lock '{lid}' of unknown construction (assumed " \
+            f"non-reentrant)"
+        findings.append(
+            f"{fname}:{ln}: lock-reentry: {desc} acquired "
+            f"while already held (self-deadlock); use an RLock "
+            f"or annotate '# lint: reentry-ok <reason>'")
+    graph = {}
+    for (a, b), sites in edges.items():
+        graph.setdefault(a, set()).add(b)
+    # cycle detection (DFS, white/grey/black), findings per distinct cycle
+    color, stack, seen_cycles = {}, [], set()
+
+    def dfs(n):
+        color[n] = 1
+        stack.append(n)
+        for nxt in sorted(graph.get(n, ())):
+            if color.get(nxt, 0) == 1:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                prov = []
+                allowed = False
+                for x, y in zip(cyc, cyc[1:]):
+                    sites = edges[(x, y)]
+                    fname, ln, _ = sites[0]
+                    extra = f" (+{len(sites) - 1} more site(s))" \
+                        if len(sites) > 1 else ""
+                    prov.append(f"{x} -> {y} at {fname}:{ln}{extra}")
+                    if all(allow is not None and
+                           allow.ok("lock-order-ok", ln)
+                           for _, ln, allow in sites):
+                        allowed = True
+                if not allowed:
+                    findings.append(
+                        "lock-order: acquisition-order cycle (ABBA "
+                        "deadlock): " + "; ".join(prov) +
+                        " — pick one order or annotate EVERY site of "
+                        "one edge '# lint: lock-order-ok <reason>'")
+            elif color.get(nxt, 0) == 0:
+                dfs(nxt)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    return findings
+
+
+def check_blocking_under_lock(model):
+    """Blocking calls (RPC, .result(), .join(), sleeps, transfers) made
+    while any lock is held — directly or one call-chain away."""
+    findings = []
+    evb = _eventual_blocking(model)
+    for cname, cm in model.classes.items():
+        allow = model.allows.get(cm.file)
+        for mname, scan in cm.methods.items():
+            for entry in scan.calls_under:
+                lid, wln, kind = entry[0], entry[1], entry[2]
+                if kind == "blocking":
+                    desc, ln = entry[3], entry[4]
+                    if allow and allow.ok("held-rpc-ok", ln, wln):
+                        continue
+                    lname = lid if lid is not None else "a lock"
+                    site = f" (created {_lock_site(model, lid)})" \
+                        if lid is not None else ""
+                    findings.append(
+                        f"{cm.file}:{ln}: blocking-call-under-lock: "
+                        f"'{desc}(...)' while holding {lname}{site} — "
+                        f"an RPC/join under a lock stalls every thread "
+                        f"contending for it (the refresh_stale bug "
+                        f"class); move the call outside the critical "
+                        f"section or annotate '# lint: held-rpc-ok "
+                        f"<reason>'")
+                elif kind == "self":
+                    callee = entry[3][0]
+                    for desc, bln in evb.get((cname, callee), ()):
+                        if allow and allow.ok("held-rpc-ok",
+                                              entry[4], wln, bln):
+                            continue
+                        lname = lid if lid is not None else "a lock"
+                        findings.append(
+                            f"{cm.file}:{entry[4]}: blocking-call-under-"
+                            f"lock: '{callee}()' reaches blocking "
+                            f"'{desc}' ({cm.file}:{bln}) while holding "
+                            f"{lname} — move the round trip outside or "
+                            f"annotate '# lint: held-rpc-ok <reason>'")
+    return findings
+
+
+def check_shared_state(model):
+    """Mutable attributes written from a thread entrypoint's plane and
+    from another plane with no common lock."""
+    findings = []
+    for cname, cm in model.classes.items():
+        if not cm.entrypoints:
+            continue
+        allow = model.allows.get(cm.file)
+        planes = _thread_planes(cm)
+        # method -> set of plane tags ("main" or entrypoint name)
+        plane_of = {}
+        for m in cm.methods:
+            tags = {e for e, ms in planes.items() if m in ms}
+            plane_of[m] = tags or {"main"}
+        eff_ctx = _caller_context_locks(cm)
+        # attr -> [(plane tag, locks, lineno, method)]
+        writes = {}
+        for mname, scan in cm.methods.items():
+            if mname == "__init__":
+                continue    # construction precedes sharing
+            inherited = eff_ctx.get(mname, frozenset())
+            for attr, locks, ln in scan.writes:
+                for tag in plane_of[mname]:
+                    writes.setdefault(attr, []).append(
+                        (tag, locks | inherited, ln, mname))
+        for attr, ws in sorted(writes.items()):
+            tags = {t for t, _, _, _ in ws}
+            if len(tags) < 2 or tags == {"main"}:
+                continue
+            # conflicting pair: two writes on different planes sharing
+            # no lock.  The allowlist applies PER PAIR — a marker on one
+            # write must not silence a different unguarded pair on other
+            # planes (review finding) — and the first non-allowlisted
+            # pair is reported (one finding per attribute).
+            hit = None
+            for i, (t1, l1, ln1, m1) in enumerate(ws):
+                for t2, l2, ln2, m2 in ws[i + 1:]:
+                    if t1 != t2 and not (l1 & l2) and not (
+                            allow and allow.ok("unlocked-ok", ln1, ln2)):
+                        hit = (t1, ln1, m1, t2, ln2, m2, l1, l2)
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            t1, ln1, m1, t2, ln2, m2, l1, l2 = hit
+            ep = t1 if t1 != "main" else t2
+            spawn_ln = cm.entrypoints.get(ep, 0)
+            lockhint = ""
+            owner = (l1 | l2)
+            if owner:
+                own = sorted(owner)[0]
+                lockhint = (f"; its other write holds '{own}' "
+                            f"(created {_lock_site(model, own)})")
+            findings.append(
+                f"{cm.file}:{ln1}: shared-state-without-lock: "
+                f"{cname}.{attr} written in {m1}() [{t1}] and {m2}() "
+                f"({cm.file}:{ln2}) [{t2}] with no common lock — "
+                f"'{ep}' runs as a thread entrypoint (started "
+                f"{cm.file}:{spawn_ln}){lockhint}; guard both writes "
+                f"with one lock or annotate '# lint: unlocked-ok "
+                f"<reason>'")
+    return findings
+
+
+def check_wait_loops(model):
+    """Condition.wait sites outside a predicate-rechecking while loop."""
+    findings = []
+    for cname, cm in model.classes.items():
+        allow = model.allows.get(cm.file)
+        for mname, scan in cm.methods.items():
+            for lid, ln, in_while in scan.waits:
+                if in_while or lid is None:
+                    continue
+                kind = _lock_kind(model, lid)
+                if kind is not None and kind != "Condition":
+                    # Event.wait has no predicate to re-check; a plain
+                    # Lock/RLock has no .wait at all (attr name reuse)
+                    continue
+                if kind is None and "cond" not in lid.lower() \
+                        and "_cv" not in lid.lower():
+                    continue    # inventory-less + not condition-named
+                if allow and allow.ok("wait-loop-ok", ln):
+                    continue
+                name = lid or "a condition"
+                findings.append(
+                    f"{cm.file}:{ln}: wait-without-predicate-loop: "
+                    f"'{name}.wait()' outside a while loop — a spurious "
+                    f"or stolen wakeup proceeds on a false predicate; "
+                    f"wrap in 'while not <predicate>:' (or wait_for) or "
+                    f"annotate '# lint: wait-loop-ok <reason>'")
+    return findings
+
+
+def check_allowlist(model):
+    """A marker with no reason silences nothing and is itself a finding."""
+    findings = []
+    for fname, allow in sorted(model.allows.items()):
+        for ln, tok in allow.bad:
+            findings.append(
+                f"{fname}:{ln}: allowlist marker '# lint: {tok}' has no "
+                f"reason text — intentional holds are documented, not "
+                f"silenced")
+    return findings
+
+
+def check_concurrency(sources):
+    """All detectors over ``{filename: source}`` — the entry point
+    ``tools/hetu_lint.py --concurrency`` and the tier-1 gate call."""
+    model = build_model(sources)
+    findings = list(model.errors)
+    findings += check_lock_graph(model)
+    findings += check_blocking_under_lock(model)
+    findings += check_shared_state(model)
+    findings += check_wait_loops(model)
+    findings += check_allowlist(model)
+    return findings
+
+
+def scan_package(root):
+    """{relpath: source} over ``root``'s ``hetu_tpu`` tree (every plane:
+    ps/, serving/, parallel/, graph/, obs/, data/ and the top-level
+    modules)."""
+    out = {}
+    base = os.path.join(root, "hetu_tpu")
+    for dirpath, _, files in os.walk(base):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if f.endswith(".py"):
+                p = os.path.join(dirpath, f)
+                with open(p, encoding="utf-8") as fh:
+                    out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+__all__ = ["check_concurrency", "build_model", "check_lock_graph",
+           "check_blocking_under_lock", "check_shared_state",
+           "check_wait_loops", "check_allowlist", "scan_package",
+           "Model", "BLOCKING_CALLS", "LOCK_CTORS", "REENTRANT"]

@@ -69,9 +69,9 @@ from __future__ import annotations
 
 import os
 import random
-import threading
 
 from .metrics import record_fault
+from .obs.lock_witness import make_lock
 
 #: transport fault kinds a schedule may inject on an outgoing RPC frame
 _TRANSPORT_KINDS = ("drop", "delay", "dup", "wedge")
@@ -264,7 +264,7 @@ class ChaosInjector:
         self.seed = seed
         self.faults = list(faults)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ChaosInjector._lock")
         self._servers = {}          # rank -> StoreServer
         self._procs = {}            # rank -> proc handle (step-clock kills)
         self._fired = set()         # one-shot kill faults already fired
@@ -515,7 +515,7 @@ class ChaosInjector:
 
 # ------------------------------------------------------------- active chaos
 _active = None
-_active_lock = threading.Lock()
+_active_lock = make_lock("chaos._active_lock")
 
 
 def active():

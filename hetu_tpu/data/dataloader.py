@@ -47,8 +47,8 @@ class Dataloader:
         self._prefetch = max(0, int(prefetch))
         self._consumed = 0        # batches handed to the consumer (resume pt)
         self._gen = 0             # bumped by load_state to retire producers
-        import threading
-        self._plock = threading.Lock()
+        from ..obs.lock_witness import make_lock
+        self._plock = make_lock("Dataloader._plock")
 
     @property
     def batch_num(self):

@@ -1942,6 +1942,9 @@ class Executor:
         mesh with model-parallel axes (re-planning 'tp'/'pp' layouts is
         a different problem than re-packing dp slabs).  Returns True if
         the world actually changed, False for a no-op."""
+        from .. import race as _race
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("exec.resize_world")
         import jax
         from ..parallel import zero as _zero
         from ..context import make_mesh
@@ -2373,6 +2376,9 @@ class Executor:
         sync point when anything was actually in flight) — called by the
         boundaries whose correctness needs a quiesced device: checkpoint
         saves and explicit flushes."""
+        from .. import race as _race
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("exec.drain_async")
         if not self._async_pending:
             return
         from ..metrics import record_run_plan

@@ -33,12 +33,12 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from collections import OrderedDict
 
 import numpy as np
 
 from ..metrics import record_step_cache
+from ..obs.lock_witness import make_lock as _make_lock
 
 _CACHE = OrderedDict()          # signature -> jitted step
 #: serving executables (hetu_tpu.serving.InferenceExecutor): signature
@@ -49,7 +49,7 @@ _CACHE = OrderedDict()          # signature -> jitted step
 #: training graphs uncachable does not apply) and because a serving fleet
 #: legitimately pins one executable per bucket (own size bound).
 _SERVE_CACHE = OrderedDict()
-_LOCK = threading.Lock()
+_LOCK = _make_lock("step_cache._LOCK")
 
 
 class _Uncachable(Exception):

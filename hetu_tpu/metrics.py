@@ -257,6 +257,46 @@ def reset_remat_counts():
     _remat.reset()
 
 
+# ------------------------------------------------- concurrency-verifier counters
+# The concurrency verifier (ISSUE 14) records its runtime evidence here:
+# the lock-witness (``obs/lock_witness.py``, ``HETU_LOCK_WITNESS=1``)
+# publishes distinct lock classes seen (``concurrency_witness_locks``),
+# acquisition-graph edges observed (``concurrency_witness_edges``) and
+# cycles detected (``concurrency_witness_cycles`` — any nonzero count is
+# a deadlock-able order, the tier-1 witness smoke asserts ZERO) at each
+# ``WITNESS.check()`` as deltas since the previous check; the
+# deterministic race harness (``hetu_tpu.race``) counts forced
+# preemptions actually fired (``concurrency_preemptions`` — a loser
+# thread held at its site until the winner's region completed) and
+# rendezvous that timed out because the peer site never arrived
+# (``concurrency_race_timeouts`` — the harness's no-deadlock escape
+# hatch; a deterministic repro should count zero).  Invariant: a run
+# with the witness off and no race schedule installed records nothing.
+# Surfaced by ``HetuProfiler.concurrency_counters()``.
+
+_concurrency = REGISTRY.counter_family(
+    "concurrency",
+    "concurrency-verifier runtime events: witness locks/edges/cycles, "
+    "race-harness preemptions (empty without HETU_LOCK_WITNESS/"
+    "HETU_RACE)")
+
+
+def record_concurrency(kind, n=1):
+    """Count ``n`` concurrency-verifier events of ``kind`` (witness
+    graph deltas, race-harness preemptions/timeouts)."""
+    if n:
+        _concurrency.inc(str(kind), int(n))
+
+
+def concurrency_counts():
+    """{kind: count} snapshot of concurrency-verifier counters."""
+    return _concurrency.counts()
+
+
+def reset_concurrency_counts():
+    _concurrency.reset()
+
+
 # ------------------------------------------------- cache / sparse-RPC counters
 # The HET embedding cache (``ps/dist_store.py:DistCacheTable``) and the
 # sparse transport (``DistributedStore.pull/push/push_pull``) record their
@@ -603,6 +643,7 @@ _FAMILIES = {
     "emb_pallas_fallbacks": _emb_pallas,
     "faults": _faults,
     "elastic": _elastic,
+    "concurrency": _concurrency,
     "remat": _remat,
     "cache": _cache,
     "zero": _zero,

@@ -46,6 +46,7 @@ from __future__ import annotations
 from .trace import TRACER, span, event
 from .export import trace_events, export_chrome_trace
 from .registry import REGISTRY as registry
+from . import lock_witness  # noqa: F401  (ISSUE 14 runtime lock-witness)
 
 
 def enabled():

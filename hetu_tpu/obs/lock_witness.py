@@ -1,0 +1,382 @@
+"""Runtime lock-witness — the dynamic twin of the static concurrency
+verifier (ISSUE 14 tentpole, runtime pass).
+
+The AST pass (:mod:`hetu_tpu.analysis.concurrency`) cannot see through
+``ctypes``, sockets, callbacks or dynamically-wired transports (the
+server's ``rpc_fn`` rides the client's connection locks, a relationship
+no static attr resolution reaches).  This module records what ACTUALLY
+happens: with ``HETU_LOCK_WITNESS=1`` every lock created through the
+factories below is wrapped, each thread keeps its held-stack, and every
+acquisition adds ``held -> acquired`` edges to one process-wide
+acquisition graph — CheckMate/lockdep-style witnessing at Python scale.
+At teardown (or on :func:`check`) the merged graph is cycle-checked: an
+observed cycle means two threads CAN deadlock given the right timing,
+even if this run got lucky.
+
+Cost discipline (the PR 10 flag-read rule): with the witness off — the
+default — the factories return PLAIN ``threading`` primitives, so
+instrumented call sites pay nothing at all, not even a wrapper
+attribute hop.  The flag is read once at import (and by
+:func:`enable` for tests); locks created while the witness is off stay
+plain even if it is enabled later, so tests enable FIRST, then build
+the stack under test.
+
+Lock identity is the CLASS-LEVEL name passed to the factory
+(``"StoreServer._repl_lock"``) — lockdep's "lock class", not the
+instance: a thousand per-connection locks are one node, and the
+hierarchy stays readable.  Per-name acquisition counts, re-entries and
+max held-depth ride along.
+
+``export(path)`` writes the observed hierarchy as JSON
+(``artifacts/lock_hierarchy.json`` is a committed witness run over the
+training, serving and elastic planes): nodes with topological LEVELS
+when the graph is acyclic (level 0 = roots, acquired first; leaves
+last), the edge list with counts, any cycles, and the threads that
+participated.  The README's documented lock hierarchy is generated
+from exactly this artifact (``tools/gen_lock_hierarchy.py``).
+
+Witness counters land in the ``concurrency_*`` metrics family at
+:func:`check` time (``concurrency_witness_locks`` / ``_edges`` /
+``_cycles``), surfaced by ``HetuProfiler.concurrency_counters()`` —
+never from inside the witness's own critical section (the registry's
+lock is deliberately NOT witnessed: instrument-of-the-instrument
+recursion).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+def _env_on():
+    return os.environ.get("HETU_LOCK_WITNESS", "0").lower() not in (
+        "", "0", "false", "off")
+
+
+class _WitnessLock:
+    """One instrumented lock: delegates to the wrapped primitive and
+    reports acquire/release to the process-wide witness.  Exposes the
+    ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` trio so a
+    ``threading.Condition`` built over it keeps exact RLock semantics
+    (a ``cond.wait`` pops the held-stack, the wakeup pushes it back)."""
+
+    __slots__ = ("_inner", "name", "kind")
+
+    def __init__(self, inner, name, kind):
+        self._inner = inner
+        self.name = name
+        self.kind = kind
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            WITNESS._note_acquire(self)
+        return got
+
+    def release(self):
+        WITNESS._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        f = getattr(self._inner, "locked", None)
+        return f() if f else False
+
+    # -- threading.Condition integration ----------------------------------
+    def _release_save(self):
+        # the witness depth rides the saved state: a wait under NESTED
+        # acquisition must restore the held-stack entry at its true
+        # recursion count, or the post-wait releases delete it early and
+        # later orderings go unrecorded (review finding)
+        depth = WITNESS._note_release(self, full=True)
+        f = getattr(self._inner, "_release_save", None)
+        inner_state = f() if f is not None else self._inner.release()
+        return (inner_state, depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        f = getattr(self._inner, "_acquire_restore", None)
+        if f is not None:
+            f(inner_state)
+        else:
+            self._inner.acquire()
+        WITNESS._note_acquire(self, depth=depth)
+
+    def _is_owned(self):
+        f = getattr(self._inner, "_is_owned", None)
+        if f is not None:
+            return f()
+        # plain-Lock fallback (threading.Condition's own trick)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<witnessed {self.kind} {self.name}>"
+
+
+class LockWitness:
+    """Process-wide acquisition-graph recorder (singleton
+    :data:`WITNESS`).  ``on`` is the one hot flag; everything else hides
+    behind the factories."""
+
+    def __init__(self):
+        self.on = _env_on()
+        self._lock = threading.Lock()   # guards the merged graph (plain
+        self._tl = threading.local()    # by design: never witnessed)
+        self._edges = {}        # (held name, acquired name) -> count
+        self._locks = {}        # name -> {"kind", "acquires", "reentries"}
+        self._threads = set()
+        self._max_depth = 0
+        self._reported = {"locks": 0, "edges": 0, "cycles": 0}
+
+    # -- per-thread held stack ---------------------------------------------
+    def _held(self):
+        h = getattr(self._tl, "held", None)
+        if h is None:
+            h = self._tl.held = []
+        return h
+
+    def _note_acquire(self, wl, depth=1):
+        held = self._held()
+        for ent in held:
+            if ent[0] is wl:
+                ent[1] += 1     # re-entry: no new edge, bump the count
+                with self._lock:
+                    self._locks[wl.name]["reentries"] += 1
+                return
+        with self._lock:
+            rec = self._locks.get(wl.name)
+            if rec is None:
+                rec = self._locks[wl.name] = {
+                    "kind": wl.kind, "acquires": 0, "reentries": 0}
+            rec["acquires"] += 1
+            self._threads.add(threading.current_thread().name)
+            for ent in held:
+                if ent[0].name != wl.name:
+                    k = (ent[0].name, wl.name)
+                    self._edges[k] = self._edges.get(k, 0) + 1
+            if len(held) + 1 > self._max_depth:
+                self._max_depth = len(held) + 1
+        held.append([wl, depth])
+
+    def _note_release(self, wl, full=False):
+        """Pop one recursion level (or, ``full``, the whole entry — the
+        Condition.wait path); returns the depth removed so
+        ``_acquire_restore`` can put it back exactly."""
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is wl:
+                prior = held[i][1]
+                if full:
+                    held[i][1] = 0      # Condition.wait: drop ALL depth
+                else:
+                    held[i][1] -= 1
+                if held[i][1] <= 0:
+                    del held[i]
+                return prior if full else 1
+        # release of a lock this thread never witnessed acquiring (e.g.
+        # enabled mid-hold): ignore rather than corrupt the stack
+        return 1
+
+    # -- control -----------------------------------------------------------
+    def enable(self, on=True):
+        """Turn witnessing on/off for locks created FROM NOW ON (the
+        factories consult this flag at creation; already-plain locks
+        stay plain — enable first, then build the stack under test)."""
+        self.on = bool(on)
+
+    def reset(self):
+        """Drop the recorded graph (the on/off flag is unchanged)."""
+        with self._lock:
+            self._edges = {}
+            self._locks = {}
+            self._threads = set()
+            self._max_depth = 0
+            self._reported = {"locks": 0, "edges": 0, "cycles": 0}
+
+    # -- readout -----------------------------------------------------------
+    def cycles(self):
+        """Distinct cycles in the merged acquisition graph, each as the
+        node list ``[a, b, ..., a]`` — a non-empty answer means two
+        threads can deadlock with the observed orders."""
+        with self._lock:
+            graph = {}
+            for (a, b) in self._edges:
+                graph.setdefault(a, set()).add(b)
+        out, seen, color, stack = [], set(), {}, []
+
+        def dfs(n):
+            color[n] = 1
+            stack.append(n)
+            for nxt in sorted(graph.get(n, ())):
+                if color.get(nxt, 0) == 1:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(cyc)
+                elif color.get(nxt, 0) == 0:
+                    dfs(nxt)
+            stack.pop()
+            color[n] = 2
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                dfs(n)
+        return out
+
+    def _levels(self):
+        """{name: topological level} when acyclic (roots = level 0 —
+        acquired first, i.e. outermost), else None."""
+        with self._lock:
+            names = set(self._locks)
+            succ = {}
+            pred_count = {n: 0 for n in names}
+            for (a, b) in self._edges:
+                names.add(a)
+                names.add(b)
+                pred_count.setdefault(a, 0)
+                pred_count.setdefault(b, 0)
+                if b not in succ.setdefault(a, set()):
+                    succ[a].add(b)
+                    pred_count[b] += 1
+        level = {}
+        frontier = sorted(n for n, c in pred_count.items() if c == 0)
+        depth = 0
+        while frontier:
+            nxt = []
+            for n in frontier:
+                level[n] = depth
+                for m in sorted(succ.get(n, ())):
+                    pred_count[m] -= 1
+                    if pred_count[m] == 0:
+                        nxt.append(m)
+            frontier = sorted(set(nxt))
+            depth += 1
+        if len(level) != len(pred_count):
+            return None     # a cycle kept some nodes un-leveled
+        return level
+
+    def report(self):
+        """The merged graph as one JSON-able dict: per-lock stats, edge
+        list with counts, cycles, topological levels (when acyclic),
+        participating threads."""
+        cycles = self.cycles()
+        with self._lock:
+            locks = {n: dict(rec) for n, rec in sorted(self._locks.items())}
+            edges = [{"from": a, "to": b, "count": c}
+                     for (a, b), c in sorted(self._edges.items())]
+            threads = sorted(self._threads)
+            depth = self._max_depth
+        levels = self._levels() if not cycles else None
+        return {"locks": locks, "edges": edges, "cycles": cycles,
+                "levels": levels, "threads": threads,
+                "max_held_depth": depth, "acyclic": not cycles}
+
+    def check(self):
+        """Cycle-check the merged graph, publish the witness counters
+        (``concurrency_witness_locks/edges/cycles`` — deltas since the
+        last check, so repeated checks don't double-count), and return
+        the cycle list.  Called at teardown by the atexit hook and by
+        the tier-1 witness smoke."""
+        cycles = self.cycles()
+        from ..metrics import record_concurrency
+        with self._lock:
+            n_locks, n_edges = len(self._locks), len(self._edges)
+        for kind, now in (("concurrency_witness_locks", n_locks),
+                          ("concurrency_witness_edges", n_edges),
+                          ("concurrency_witness_cycles", len(cycles))):
+            delta = now - self._reported[kind.rsplit("_", 1)[-1]]
+            if delta > 0:
+                record_concurrency(kind, delta)
+            self._reported[kind.rsplit("_", 1)[-1]] = now
+        return cycles
+
+    def export(self, path):
+        """Write :meth:`report` to ``path`` (the committed
+        ``artifacts/lock_hierarchy.json`` shape); returns the report."""
+        rep = self.report()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return rep
+
+
+#: the process-wide witness — the factories below consult ``WITNESS.on``
+WITNESS = LockWitness()
+
+
+def make_lock(name):
+    """A ``threading.Lock`` — plain when the witness is off (zero cost),
+    wrapped and graph-recorded when on.  ``name`` is the lock CLASS
+    identity (``"Cls._attr"``), shared by every instance."""
+    if not WITNESS.on:
+        return threading.Lock()
+    return _WitnessLock(threading.Lock(), name, "Lock")
+
+
+def make_rlock(name):
+    """A ``threading.RLock`` (witnessed when the witness is on;
+    re-entries are counted, never edges)."""
+    if not WITNESS.on:
+        return threading.RLock()
+    return _WitnessLock(threading.RLock(), name, "RLock")
+
+
+def make_condition(name):
+    """A ``threading.Condition`` over a (witnessed) RLock — ``with
+    cond:`` acquisitions and the release/re-acquire inside ``wait``
+    both land on the held-stack correctly."""
+    if not WITNESS.on:
+        return threading.Condition()
+    return threading.Condition(
+        _WitnessLock(threading.RLock(), name, "Condition"))
+
+
+_atexit_armed = False
+
+
+def _arm_atexit():
+    """Warn (and count) at interpreter exit if the witnessed run
+    observed a deadlock-able cycle — the 'detects cycles at teardown'
+    half of the witness contract."""
+    global _atexit_armed
+    if _atexit_armed:
+        return
+    _atexit_armed = True
+    import atexit
+
+    def _teardown_check():
+        if not WITNESS.on:
+            return
+        try:
+            cycles = WITNESS.check()
+        except Exception:
+            return      # metrics may already be torn down
+        if cycles:
+            import warnings
+            warnings.warn(
+                f"lock witness observed {len(cycles)} acquisition-order "
+                f"cycle(s) this run: {cycles} — two threads can deadlock "
+                f"with these orders", RuntimeWarning)
+
+    atexit.register(_teardown_check)
+
+
+if WITNESS.on:
+    _arm_atexit()
+
+
+__all__ = ["WITNESS", "LockWitness", "make_lock", "make_rlock",
+           "make_condition"]

@@ -59,6 +59,7 @@ import numpy as np
 
 from ..metrics import record_elastic
 from .. import obs
+from .. import race as _race
 from .preduce import preduce_mean  # noqa: F401  (re-export: the rescale half)
 
 
@@ -274,6 +275,8 @@ class ElasticController:
 
     def _resize(self, kind, new_active, changed, step, t0):
         from_dp, to_dp = self.dp, len(new_active)
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("elastic.resize")
         obs.event(f"elastic:{kind}", cat="elastic", step=step,
                   ranks=list(changed), from_dp=from_dp, to_dp=to_dp)
         with obs.span("elastic.resize", cat="elastic", kind=kind,

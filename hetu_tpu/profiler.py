@@ -316,6 +316,22 @@ class HetuProfiler:
         return elastic_counts()
 
     @staticmethod
+    def concurrency_counters():
+        """{kind: count} of concurrency-verifier runtime events
+        (``hetu_tpu.metrics`` registry; ISSUE 14): lock-witness graph
+        facts published by ``obs.lock_witness.WITNESS.check()`` —
+        distinct lock classes seen (``concurrency_witness_locks``),
+        acquisition edges observed (``concurrency_witness_edges``),
+        cycles detected (``concurrency_witness_cycles`` — any nonzero
+        value is a deadlock-able order) — and deterministic race-harness
+        activity (``hetu_tpu.race``): forced preemptions fired
+        (``concurrency_preemptions``) and rendezvous timeouts
+        (``concurrency_race_timeouts``).  A run with the witness off
+        and no race schedule installed reports an empty dict."""
+        from .metrics import concurrency_counts
+        return concurrency_counts()
+
+    @staticmethod
     def cache_counters():
         """{kind: count} of HET-cache / sparse-transport batching events
         (``hetu_tpu.metrics`` registry): cache hit/miss/evict rows, rows

@@ -9,13 +9,14 @@ precedent).
 import ctypes
 import os
 import subprocess
-import threading
+
+from ..obs.lock_witness import make_lock as _make_lock
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "native", "ps_store.cc")
 _SO = os.path.join(_HERE, "native", "libhetu_ps.so")
 
-_lock = threading.Lock()
+_lock = _make_lock("ps.build._lock")
 _lib = None
 
 

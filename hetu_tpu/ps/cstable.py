@@ -133,8 +133,13 @@ class CacheSparseTable:
     # -- maintenance -------------------------------------------------------
     def flush(self):
         """Push every dirty cached row to the store (checkpoint barrier)."""
-        if self._pool is not None:
-            self._pool.submit(lambda: None).result()  # drain queue
+        pool = self._pool    # snapshot: close() may null it from a GC
+        if pool is not None:  # thread between the check and the submit
+            try:
+                pool.submit(lambda: None).result()  # drain queue
+            except RuntimeError:
+                pass    # close() shut the snapshot down concurrently —
+                        # a drained-then-destroyed pool has nothing queued
         if self._h:
             self._lib.hetu_cache_flush(self._h)
 

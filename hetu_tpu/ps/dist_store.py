@@ -85,7 +85,9 @@ import numpy as np
 
 from .store import EmbeddingStore, _OPT_IDS, _OPT_NAMES, _V3_CHUNK
 from .. import chaos as _chaos
+from .. import race as _race
 from ..metrics import record_cache, record_fault, record_rpc
+from ..obs.lock_witness import make_condition, make_lock, make_rlock
 from ..obs.trace import TRACER as _TR
 
 # Opcodes register through hetu_tpu.ps.opcodes: the registry asserts wire-
@@ -269,12 +271,12 @@ class StoreServer:
         self.local, self.world, self.rank = local, world, rank
         self.replication = int(replication)
         self.standby = bool(standby)
-        self._ssp_lock = threading.Condition()
+        self._ssp_lock = make_condition("StoreServer._ssp_lock")
         self._clocks = {}          # channel -> per-worker clock vector
         self._hb = {}              # rank -> (monotonic last-seen, step)
-        self._hb_lock = threading.Lock()
+        self._hb_lock = make_lock("StoreServer._hb_lock")
         self._applied = {}         # client -> OrderedDict of recent push seqs
-        self._applied_lock = threading.Lock()
+        self._applied_lock = make_lock("StoreServer._applied_lock")
         self._live_conns = set()
         # -- replication state (all guarded by _repl_lock where it matters)
         #: shard -> store holding that shard's rows on this server
@@ -313,7 +315,7 @@ class StoreServer:
         #: primaries forwarding around the ring deadlock until their
         #: socket timeouts fire.  ``_epoch_lock`` is never held across
         #: any RPC (or across ``_repl_lock``).
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = make_lock("StoreServer._epoch_lock")
         self._fwd_ok = {}          # shard -> live forwarding enabled
         #: shard -> monotonic time of the last broken-forward lineage
         #: probe (see _probe_lineage): rate-limits the reachability
@@ -323,7 +325,7 @@ class StoreServer:
         self._sync_parts = {}      # (shard, table) -> received snapshot chunks
         #: ordered apply+forward: the backup must see ops in primary apply
         #: order, so {apply locally; mirror} is one critical section
-        self._repl_lock = threading.RLock()
+        self._repl_lock = make_rlock("StoreServer._repl_lock")
         #: set by the owning DistributedStore — forwards/syncs ride the
         #: client transport: rpc_fn(peer, op, table, keys, payload=...)
         self.rpc_fn = None
@@ -559,6 +561,12 @@ class StoreServer:
         try:
             if self.rpc_fn is None:
                 raise RuntimeError("replication transport not attached")
+            # the mirror must land inside the apply critical section,
+            # BEFORE the ack: the backup sees ops in primary apply order
+            # and an ack'd write is always replicated (_repl_lock's whole
+            # reason to exist; _epoch_lock is the leaf that keeps the
+            # receive side from blocking on us)
+            # lint: held-rpc-ok ordered apply+mirror-before-ack protocol
             self.rpc_fn(self._fwd_target(shard), OP_REPLICATE, 0,
                         np.asarray([shard], np.int64), payload=bytes(body),
                         epoch=self._epochs.get(shard, 0))
@@ -624,6 +632,7 @@ class StoreServer:
             return
         self._maybe_probe_degraded(shard)
         with self._repl_lock:
+            # lint: held-rpc-ok apply+mirror is ONE critical section
             store.push(table, keys // self.world, grads, lr)
             self._forward(shard, body)
 
@@ -840,6 +849,10 @@ class StoreServer:
                             payload=f.read(chunk), epoch=epoch)
             with self._repl_lock:
                 for frame in self._oplog.pop(shard, []):
+                    # the op-log drain and the fwd_ok flip must be atomic
+                    # against concurrent applies, or a racing write could
+                    # land between catch-up and live forwarding
+                    # lint: held-rpc-ok op-log catch-up precedes live fwd
                     self.rpc_fn(target, OP_REPLICATE, 0,
                                 np.asarray([shard], np.int64),
                                 payload=frame, epoch=epoch)
@@ -1188,7 +1201,7 @@ class DistributedStore:
         self._seq = itertools.count(time.time_ns())  # thread-safe in CPython
         self._conns = {}
         self._conn_locks = {}
-        self._connect_lock = threading.Lock()  # guards the conn dicts
+        self._connect_lock = make_lock("DistributedStore._connect_lock")  # guards the conn dicts
         self._pool = None                      # lazy RPC fan-out pool
         self._tables = {}
         self._table_init_kw = {}   # tid -> init kwargs (replica re-init)
@@ -1201,6 +1214,15 @@ class DistributedStore:
         #: refused write teaches the client the surviving lineage before
         #: the retry (module docstring).
         self._epoch = [0] * world
+        #: leaf lock for the fence-adoption state (_epoch/_route/_flip
+        #: _epoch): _note_fence runs on whichever thread saw the refusal
+        #: — fanout pool workers, the heartbeat pinger, the async push
+        #: worker — and an unlocked check-then-act let two racing
+        #: refusals regress the epoch or double-flip the route BACK onto
+        #: the deposed rank (ISSUE 14 shared-state finding).  Never held
+        #: across an RPC.
+        self._fence_lock = make_lock("DistributedStore._fence_lock")
+        self._flip_epoch = {}      # shard -> epoch at which route flipped
         self._failed_over = set()  # shards running without redundancy
         self._queue = queue.Queue(maxsize=async_queue)
         self._async_thread = None
@@ -1220,7 +1242,8 @@ class DistributedStore:
         # per-peer locks so a slow/unreachable peer cannot stall RPCs to
         # healthy peers; the short global lock only guards the dicts
         with self._connect_lock:
-            lock = self._conn_locks.setdefault(peer, threading.Lock())
+            lock = self._conn_locks.setdefault(
+                peer, make_lock("DistributedStore._conn_locks[*]"))
         with lock:
             if peer not in self._conns:
                 s = socket.create_connection(self.endpoints[peer],
@@ -1231,7 +1254,8 @@ class DistributedStore:
 
     def _drop_conn(self, peer):
         with self._connect_lock:
-            lock = self._conn_locks.setdefault(peer, threading.Lock())
+            lock = self._conn_locks.setdefault(
+                peer, make_lock("DistributedStore._conn_locks[*]"))
         with lock:
             s = self._conns.pop(peer, None)
             if s is not None:
@@ -1344,18 +1368,31 @@ class DistributedStore:
 
     def _note_fence(self, shard, err):
         """Adopt the surviving lineage an epoch-fence refusal names:
-        advance this client's epoch for ``shard`` and — when the refuser
-        no longer serves (it was deposed or just demoted itself) — flip
-        the route to the shard's other holder and mark the shard for
-        re-replication (the demoted copy is stale by construction)."""
+        advance this client's epoch for ``shard`` (a locked max-merge —
+        the server-side ``_adopt_epoch`` discipline) and — when the
+        refuser no longer serves (it was deposed or just demoted
+        itself) — flip the route to the shard's other holder and mark
+        the shard for re-replication (the demoted copy is stale by
+        construction).  The flip is recorded PER EPOCH: refusals land on
+        whichever thread sent the frame (fanout pool, heartbeat pinger,
+        async worker), and two racing refusals from one fence event must
+        flip the route ONCE — an unguarded toggle sent the second flip
+        straight back to the deposed rank (ISSUE 14 regression test)."""
         cur, serving = _fence_info(err)
-        if cur > self._epoch[shard]:
-            self._epoch[shard] = cur
-        if not serving:
-            dead = self._route[shard]
-            self._route[shard] = (shard + 1) % self.world \
-                if dead == shard else shard
-            self._failed_over.add(shard)
+        with self._fence_lock:
+            known = self._epoch[shard]
+            if cur > known:
+                self._epoch[shard] = known = cur
+            # flip only on information at least as new as ours (a STALE
+            # refusal must not steer the route away from the lineage we
+            # already follow), and at most once per epoch
+            if not serving and cur == known \
+                    and self._flip_epoch.get(shard) != cur:
+                self._flip_epoch[shard] = cur
+                dead = self._route[shard]
+                self._route[shard] = (shard + 1) % self.world \
+                    if dead == shard else shard
+                self._failed_over.add(shard)
 
     def _rpc_shard(self, shard, op, table, keys, payload=b"", lr=-1.0,
                    width=0, op_timeout=None):
@@ -1432,12 +1469,17 @@ class DistributedStore:
             raise RuntimeError(
                 f"shard {shard}: serving rank {dead} unreachable AND "
                 f"backup rank {alt} not promotable ({e2})") from err
-        if len(raw) >= 8:        # the ack names the resulting epoch
-            self._epoch[shard] = max(self._epoch[shard],
-                                     int(np.frombuffer(raw, np.int64,
-                                                       1)[0]))
-        self._route[shard] = alt
-        self._failed_over.add(shard)
+        with self._fence_lock:
+            if len(raw) >= 8:    # the ack names the resulting epoch
+                self._epoch[shard] = max(self._epoch[shard],
+                                         int(np.frombuffer(raw, np.int64,
+                                                           1)[0]))
+            self._route[shard] = alt
+            # the promotion IS this epoch's route change: a fence
+            # refusal racing in from the deposed primary must not
+            # toggle the route away from the just-promoted holder
+            self._flip_epoch[shard] = self._epoch[shard]
+            self._failed_over.add(shard)
         record_fault("ps_failover_promoted")
         return alt
 
@@ -1505,8 +1547,9 @@ class DistributedStore:
                     # a standby's bring-up mirror-init raced an earlier
                     # promotion): the replica table exists there — adopt
                     # the epoch and treat the init as done
-                    if fence[0] > self._epoch[shard]:
-                        self._epoch[shard] = fence[0]
+                    with self._fence_lock:
+                        if fence[0] > self._epoch[shard]:
+                            self._epoch[shard] = fence[0]
                     return None
                 if not patient or time.monotonic() >= deadline:
                     raise
@@ -2230,7 +2273,7 @@ class DistCacheTable:
         self._freelist = np.arange(L - 1, -1, -1, dtype=np.int64)
         self._nfree = L
         self._tick = 0
-        self._lock = threading.RLock()   # executor prefetch thread + main
+        self._lock = make_rlock("DistCacheTable._lock")   # prefetch + main
         #: (flat, uk, inv, cnt, slots) of the latest lookup — the executor
         #: and the CTR step always update() the exact ids they just looked
         #: up, so the batch partition is computed once, not twice
@@ -2377,6 +2420,8 @@ class DistCacheTable:
         reset victims, register the new keys.  Returns the registered
         (keys, slots)."""
         slots, take, evslots, evkeys = plan
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("cache.evict_commit")
         self._nfree -= take
         if evslots.size:
             self._hdelete(evkeys)
@@ -2404,15 +2449,18 @@ class DistCacheTable:
             order = np.argsort(pk, kind="stable")   # deterministic wire
             pk, pg = pk[order], pg[order]
             if pull_keys is not None and hasattr(self.store, "push_pull"):
+                # lint: held-rpc-ok transactional commit protocol (plan under lock, ONE fallible round trip, then commit)
                 rows = self.store.push_pull(self.table, pk, pg, pull_keys,
                                             self.lr)
             else:
+                # lint: held-rpc-ok same transactional commit round trip (push half)
                 self.store.push(self.table, pk, pg, self.lr)
             self.stats["pushes"] += int(pk.size)
             self.stats["push_rpcs"] += 1
             record_cache("emb_cache_push_rows", int(pk.size))
             record_cache("emb_cache_push_rpcs", 1)
         if rows is None and pull_keys is not None:
+            # lint: held-rpc-ok the refresh pull is the same one fallible round trip
             rows = self.store.pull(self.table, pull_keys)
         return rows
 
@@ -2421,6 +2469,8 @@ class DistCacheTable:
         keys = np.ascontiguousarray(keys, np.int64)
         if self.device:
             return self._lookup_device(keys)
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("cache.lookup")
         sweep = False
         with self._lock:
             if self.read_only:
@@ -2466,8 +2516,12 @@ class DistCacheTable:
             # once, harmlessly), whereas the reverse order would record a
             # version NEWER than the data and hide the stale row from
             # refresh_stale forever
+            # lint: held-rpc-ok transactional miss fill, versions first
             vers = self.store.versions(self.table, mkeys) \
                 if hasattr(self.store, "versions") else None
+            if _race.ACTIVE is not None:   # ISSUE 14: the racing-writer
+                _race.point("cache.miss_fill")   # window (vers -> rows)
+            # lint: held-rpc-ok same transactional miss-fill window
             rows = self.store.pull(self.table, mkeys)
             self.stats["fetches"] += int(mkeys.size)
             self._commit_slots(mkeys, plan)
@@ -2523,6 +2577,8 @@ class DistCacheTable:
         sk = keys[stale]
         rows = np.asarray(self.store.pull(self.table, sk), np.float32)
         sv = vers[stale]
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("cache.refresh_commit")
         refreshed = 0
         with self._lock:
             slots = self._find(sk)

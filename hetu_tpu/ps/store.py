@@ -54,7 +54,7 @@ class _NumpyTable:
     per-row versions).  Used only when g++ is unavailable."""
 
     def __init__(self, rows, width, opt, lr, m1, m2, eps, seed, scale):
-        import threading
+        from ..obs.lock_witness import make_lock
         rng = np.random.RandomState(seed & 0xFFFFFFFF)
         self.data = (rng.uniform(-scale, scale, (rows, width))
                      if scale else np.zeros((rows, width))).astype(np.float32)
@@ -65,7 +65,7 @@ class _NumpyTable:
         self.t = np.zeros(rows, np.int32) if opt == 4 else None
         # concurrent remote pushes arrive from StoreServer handler threads;
         # the native table stripe-locks, this fallback must lock too
-        self._lock = threading.Lock()
+        self._lock = make_lock("_NumpyTable._lock")
 
     def pull(self, keys):
         with self._lock:
@@ -375,9 +375,9 @@ class EmbeddingStore:
         if self._lib:
             self._lib.hetu_ps_ssp_init(self._h, n_workers)
         else:
-            import threading
+            from ..obs.lock_witness import make_condition
             self._clocks = np.zeros(n_workers, np.int64)
-            self._clock_cv = threading.Condition()
+            self._clock_cv = make_condition("EmbeddingStore._clock_cv")
         self.ssp_ready = True
 
     def clock(self, worker):

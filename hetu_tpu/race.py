@@ -1,0 +1,351 @@
+"""Deterministic race harness — seeded barrier scheduling of named
+preemption points (ISSUE 14 tentpole, part 3).
+
+Every race this repo's review logs caught (the router's
+``set_result``/cancel window, the read-only cache's version-vs-rows
+ordering, the commit-vs-evict window) was found by LUCK: a reviewer
+imagining an interleaving the test suite had no way to force.  This
+module makes interleavings first-class, chaos-DSL style::
+
+    HETU_RACE="race:cache.miss_fill|test.write:seed1"
+
+A :class:`RaceSchedule` names two SITES and a seed.  Product code (and
+tests) mark sites with :func:`point` — zero-width — or bracket a region
+with :func:`region`.  When a schedule is installed, the two sites
+RENDEZVOUS: the seed picks a WINNER per pair, the loser thread blocks
+at its site until the winner's region has completed, so the two
+operations execute in a forced, reproducible order — same seed ⇒ same
+interleaving (the determinism test's exact claim), different seeds
+cover both orders.  ``pairs<k>`` repeats the rendezvous k times (a new
+seed draw each pair); ``timeout<ms>`` bounds the wait so a schedule
+whose peer site never executes degrades to ONE counted timeout
+(``concurrency_race_timeouts``) after which the schedule free-runs —
+never a deadlocked suite, never a per-encounter stall on a hot path.
+
+Instrumented sites (the historical hot pairs; tests may mark their own
+with any name):
+
+========================  ==================================================
+``cache.lookup``          host-mode ``DistCacheTable.lookup`` entry (before
+                          the cache lock) — vs evict-commit
+``cache.evict_commit``    ``DistCacheTable._commit_slots`` (victim
+                          tombstoning + registration)
+``cache.miss_fill``       read-only miss path, BETWEEN the versions read
+                          and the row pull (the racing-writer window)
+``cache.refresh_commit``  ``refresh_stale``, after the RPCs, before the
+                          re-validating commit takes the lock
+``router.resolve``        ``ServingRouter._run_batch``, before per-request
+                          future resolution — vs a caller's ``cancel()``
+``router.close``          ``ServingRouter.close``, before rejecting the
+                          still-queued requests
+``exec.resize_world``     ``Executor.resize_world`` entry — vs an
+                          in-flight async step
+``exec.drain_async``      ``Executor._drain_async`` entry (the resize
+                          quiesce leg)
+``elastic.resize``        ``ElasticController._resize`` (the detect→resize
+                          dance, outside the executor)
+========================  ==================================================
+
+Cost discipline (PR 10): the hot-path check is ONE module-global read —
+:data:`ACTIVE` is ``None`` unless a schedule is installed, and
+:func:`point`/:func:`region` sites on dispatch paths guard on it
+inline.  The harness is a TESTING tool: schedules are installed by
+tests (or ``HETU_RACE``), never in production runs.
+
+Forced preemptions actually fired count ``concurrency_preemptions``;
+rendezvous that timed out count ``concurrency_race_timeouts`` (both in
+the ``concurrency_*`` family, ``HetuProfiler.concurrency_counters()``).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from .metrics import record_concurrency
+
+
+class RaceSpecError(ValueError):
+    """Malformed ``HETU_RACE`` spec (loud: a typo'd schedule forcing
+    nothing would make a race test pass vacuously)."""
+
+
+_GRAMMAR = "race:<site_a>|<site_b>:seed<n>[:pairs<k>][:timeout<ms>]"
+
+
+def parse_spec(spec):
+    """``"race:a|b:seed3[:pairs2][:timeout500]"`` →
+    ``(site_a, site_b, seed, pairs, timeout_ms)``."""
+    parts = spec.strip().split(":")
+    if len(parts) < 3 or parts[0] != "race":
+        raise RaceSpecError(f"bad race spec {spec!r}: expected {_GRAMMAR}")
+    sides = parts[1].split("|")
+    if len(sides) != 2 or not sides[0] or not sides[1]:
+        raise RaceSpecError(
+            f"bad race sites {parts[1]!r} in {spec!r}: expected "
+            f"{_GRAMMAR}")
+    if sides[0] == sides[1]:
+        raise RaceSpecError(
+            f"race sites must differ in {spec!r} — ordering a site "
+            f"against itself forces nothing")
+    seed = pairs = None
+    timeout_ms = 2000.0
+    for p in parts[2:]:
+        if p.startswith("seed"):
+            seed = int(p[4:])
+        elif p.startswith("pairs"):
+            pairs = int(p[5:])
+        elif p.startswith("timeout"):
+            timeout_ms = float(p[7:])
+        else:
+            raise RaceSpecError(
+                f"unknown race clause {p!r} in {spec!r}: expected "
+                f"{_GRAMMAR}")
+    if seed is None:
+        raise RaceSpecError(f"race spec {spec!r} missing ':seed<n>'")
+    return sides[0], sides[1], seed, (pairs or 1), timeout_ms
+
+
+class RaceSchedule:
+    """One forced-interleaving schedule over two named sites.
+
+    Semantics per pair: the seed draws a WINNER site, and the two sites
+    RENDEZVOUS — the winner blocks at its site until the loser has
+    ARRIVED at its own (so the forcing cannot be skipped by thread-
+    start timing), then the winner's region runs to completion while
+    the loser stays held, then both proceed.  "A's region completes
+    before B's begins" is therefore a deterministic function of
+    ``(sites, seed, pair index)`` whenever both sites execute; a peer
+    that never arrives times out through (counted).  A re-entry of a
+    site while its pair is already satisfied passes through unforced
+    (schedules force the FIRST k encounters, not every one).
+
+    ``log`` records ``(event, site)`` tuples (``enter`` / ``exit`` /
+    ``forced`` / ``timeout``) for post-mortem inspection.  NOTE the
+    deterministic contract is ``order`` (the drawn winners) and the
+    region-COMPLETION order — which is what the determinism tests
+    assert; the two ``enter`` entries of a pair land in OS-scheduling
+    arrival order, so raw logs from two same-seed runs may differ in
+    that interleaving-irrelevant respect.
+    """
+
+    def __init__(self, site_a, site_b, seed, pairs=1, timeout_ms=2000.0):
+        self.sites = (str(site_a), str(site_b))
+        self.seed = int(seed)
+        self.pairs = max(1, int(pairs))
+        self.timeout_ms = float(timeout_ms)
+        rng = random.Random(self.seed)
+        #: winner site per pair — the whole interleaving decision,
+        #: drawn up front so it is a pure function of (sites, seed)
+        self.order = [self.sites[rng.randrange(2)]
+                      for _ in range(self.pairs)]
+        self._cv = threading.Condition()
+        self._pair = 0
+        self._winner_done = False
+        self._loser_arrived = False
+        #: set on the FIRST rendezvous timeout of the current pair: the
+        #: pair degrades to free-running (every later encounter passes
+        #: straight through) instead of re-paying the timeout per
+        #: encounter — a schedule naming a site that never executes
+        #: costs ONE counted timeout, not one per hot-path hit
+        self._timed_out = False
+        #: per-thread pair index stamped at enter: an exit whose pair
+        #: already closed (a stray extra thread at a hot site) is
+        #: IGNORED instead of corrupting the next pair's state
+        self._tl = threading.local()
+        self.log = []
+
+    @classmethod
+    def from_spec(cls, spec):
+        a, b, seed, pairs, timeout_ms = parse_spec(spec)
+        return cls(a, b, seed, pairs, timeout_ms)
+
+    @classmethod
+    def from_env(cls, env_var="HETU_RACE"):
+        spec = os.environ.get(env_var, "").strip()
+        return cls.from_spec(spec) if spec else None
+
+    # -- site hooks --------------------------------------------------------
+    def enter(self, site):
+        if site not in self.sites:
+            return
+        with self._cv:
+            if self._pair >= self.pairs or self._timed_out:
+                self._tl.entered = None
+                return      # schedule exhausted, or pair degraded free
+            my_pair = self._pair
+            winner = self.order[my_pair]
+            self._tl.entered = my_pair
+            self.log.append(("enter", site))
+            deadline = time.monotonic() + self.timeout_ms / 1e3
+            if site == winner:
+                # rendezvous: the winner HOLDS until the loser is at its
+                # site — without this, a late-starting loser thread
+                # would let the winner's whole region run first and the
+                # forcing silently not happen (review finding: 3/9 runs
+                # flaked on a loaded box).  A pair advancing under us
+                # (another thread satisfied it) releases the wait too.
+                while not self._loser_arrived and not self._winner_done \
+                        and not self._timed_out and self._pair == my_pair:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        self._time_out(site)
+                        return
+                    self._cv.wait(left)
+                return
+            # loser: announce arrival, then hold until the winner's
+            # region completed (or the pair closes under us)
+            self._loser_arrived = True
+            self._cv.notify_all()
+            waited = False
+            while not self._winner_done and not self._timed_out \
+                    and self._pair == my_pair:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._time_out(site)
+                    return
+                waited = True
+                self._cv.wait(left)
+            if waited and (self._winner_done or self._pair != my_pair):
+                self.log.append(("forced", site))
+                record_concurrency("concurrency_preemptions")
+
+    def _time_out(self, site):
+        """First rendezvous timeout of the pair (caller holds the cv):
+        degrade the pair to free-running — ONE counted timeout, every
+        later encounter of either site passes straight through."""
+        self._timed_out = True
+        self._loser_arrived = False
+        self.log.append(("timeout", site))
+        record_concurrency("concurrency_race_timeouts")
+        self._cv.notify_all()
+
+    def exit(self, site):
+        if site not in self.sites:
+            return
+        with self._cv:
+            if self._pair >= self.pairs or self._timed_out:
+                return      # a timed-out schedule stays free-running —
+                            # a late peer must not resurrect half a pair
+            entered = getattr(self._tl, "entered", None)
+            self._tl.entered = None
+            if entered != self._pair:
+                return      # this thread's pair already closed (a stray
+                            # extra thread at a hot site): its exit must
+                            # not corrupt the NEXT pair's state or stall
+                            # that pair's real loser
+            winner = self.order[self._pair]
+            self.log.append(("exit", site))
+            if site == winner:
+                self._winner_done = True
+                self._cv.notify_all()
+            elif self._winner_done:
+                # enter() only releases a loser once the winner's region
+                # completed (timeout and pair-advance early-return
+                # above), so the winner is necessarily done here
+                self._advance()
+
+    def _advance(self):
+        """Both regions of the current pair completed (caller holds the
+        cv): arm the next pair and wake any straddling waiter so it
+        re-checks its pair index instead of sleeping to a timeout."""
+        self._pair += 1
+        self._winner_done = False
+        self._loser_arrived = False
+        self._cv.notify_all()
+
+    @property
+    def complete(self):
+        """True once every scheduled pair has rendezvoused — or the
+        schedule degraded after its one counted timeout (a timed-out
+        schedule forces nothing further, so it IS finished)."""
+        with self._cv:
+            return self._pair >= self.pairs or self._timed_out
+
+
+# ------------------------------------------------------------ active schedule
+
+#: the installed schedule, or None — hot-path sites read this ONE global
+ACTIVE = None
+_install_lock = threading.Lock()
+
+
+def active():
+    """The process-wide schedule, or None (one global read)."""
+    return ACTIVE
+
+
+def install(schedule):
+    """Make ``schedule`` the process-wide forcing schedule; returns the
+    previous one so tests can restore it."""
+    global ACTIVE
+    with _install_lock:
+        prev, ACTIVE = ACTIVE, schedule
+    return prev
+
+
+def install_from_env(env_var="HETU_RACE"):
+    """Install a schedule from ``HETU_RACE`` if set; returns it (or
+    None)."""
+    sched = RaceSchedule.from_env(env_var)
+    if sched is not None:
+        install(sched)
+    return sched
+
+
+def uninstall():
+    """Remove the process-wide schedule (test teardown)."""
+    return install(None)
+
+
+class _Region:
+    """Context manager bracketing a named region (``with
+    race.region("cache.evict_commit"): ...``)."""
+
+    __slots__ = ("site", "_sched")
+
+    def __init__(self, site):
+        self.site = site
+        self._sched = None
+
+    def __enter__(self):
+        s = ACTIVE
+        if s is not None:
+            self._sched = s
+            s.enter(self.site)
+        return self
+
+    def __exit__(self, *exc):
+        if self._sched is not None:
+            self._sched.exit(self.site)
+            self._sched = None
+        return False
+
+
+def region(site):
+    """Bracket a region: the loser site's region cannot START until the
+    winner site's region has COMPLETED."""
+    return _Region(site)
+
+
+def point(site):
+    """A zero-width site: enter+exit immediately (orders the POINT
+    against the peer's region).  No-op (one global read) when no
+    schedule is installed."""
+    s = ACTIVE
+    if s is not None:
+        s.enter(site)
+        s.exit(site)
+
+
+# HETU_RACE=... alone activates the harness for every instrumented site
+# in the process (the chaos-module convention — install_from_env is a
+# no-op without the env var, so normal runs pay one getenv at import)
+if os.environ.get("HETU_RACE", "").strip():
+    install_from_env()
+
+
+__all__ = ["RaceSchedule", "RaceSpecError", "parse_spec", "active",
+           "install", "install_from_env", "uninstall", "region", "point",
+           "ACTIVE"]

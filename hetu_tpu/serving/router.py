@@ -41,7 +41,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from .. import race as _race
 from ..metrics import record_serve, record_serve_latency
+from ..obs.lock_witness import make_condition
 from ..obs.trace import TRACER as _TR
 
 
@@ -85,7 +87,7 @@ class ServingRouter:
         self.queue_limit = int(queue_limit)
         self.refresh_every_batches = int(refresh_every_batches)
         self._q = collections.deque()
-        self._cv = threading.Condition()
+        self._cv = make_condition("ServingRouter._cv")
         self._stop = False
         self._admitted = 0
         self._batches = 0
@@ -113,6 +115,8 @@ class ServingRouter:
             pending = list(self._q)
             self._q.clear()
             self._cv.notify_all()
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("router.close")
         for req in pending:
             # claim first: a caller-cancelled future would otherwise
             # raise InvalidStateError out of set_exception and abort the
@@ -272,6 +276,8 @@ class ServingRouter:
                     r.future.set_exception(e)
             return
         record_serve("serve_responses", n)
+        if _race.ACTIVE is not None:   # ISSUE 14: the set_result/cancel
+            _race.point("router.resolve")   # window
         if tr is not None:
             t_sc = time.perf_counter_ns()
         for i, r in enumerate(reqs):

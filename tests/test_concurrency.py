@@ -1,0 +1,1070 @@
+"""Concurrency verifier (ISSUE 14): static detectors, runtime
+lock-witness, and the deterministic race harness.
+
+Coverage map:
+
+* **static pass** — a synthetic-violation proof per detector
+  (cross-module ABBA, shared-state-without-lock, blocking-under-lock
+  directly and through a call chain, wait-without-predicate-loop,
+  reason-less allowlist markers), the caller-context lock-inheritance
+  negative case, and the repo-wide zero-findings gate
+  (``tools/hetu_lint.py --concurrency``);
+* **lock witness** — off-mode returns plain primitives, synthetic
+  ABBA cycle detection with counters, Condition-wait held-stack
+  correctness, the committed ``artifacts/lock_hierarchy.json`` schema,
+  and the tier-1 smoke: a short wdl-PS training + serving step under a
+  live witness asserts an ACYCLIC merged graph;
+* **race harness** — spec parsing, same-seed determinism, both orders
+  across seeds, the timeout escape, and the two HISTORICAL race-class
+  reproductions: the serving router's ``set_result``/cancel window and
+  the read-only cache's versions-vs-rows ordering — each shown failing
+  against its pre-fix logic and passing against HEAD under the SAME
+  forced interleaving;
+* **fence-adoption regression** — the ``_note_fence`` double-flip /
+  stale-refusal bugs the shared-state detector surfaced in this PR.
+"""
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+from concurrent.futures import InvalidStateError
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import metrics as hmetrics
+from hetu_tpu import race
+from hetu_tpu.obs import lock_witness as lw
+from hetu_tpu.profiler import HetuProfiler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import hetu_lint  # noqa: E402
+
+conc = hetu_lint.concurrency_engine()
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    hmetrics.reset_concurrency_counts()
+    yield
+    race.uninstall()
+    lw.WITNESS.enable(lw._env_on())
+    hmetrics.reset_concurrency_counts()
+
+
+# ===================================================== static: synthetic proofs
+
+def test_static_detects_cross_module_abba():
+    """The growth past PR 5: a cycle whose two edges live in DIFFERENT
+    classes, linked through an attribute resolved to its constructor
+    class — the pattern no per-class pass can see."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._s_lock = threading.Lock()
+                self.cache = Cache()
+            def push(self):
+                with self._s_lock:
+                    self.cache.note()
+
+        class Cache:
+            def __init__(self):
+                self._c_lock = threading.Lock()
+                self.store = Store()
+            def note(self):
+                with self._c_lock:
+                    pass
+            def flush(self):
+                with self._c_lock:
+                    self.store.push()
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("cycle" in f and "Store._s_lock" in f
+               and "Cache._c_lock" in f for f in findings), findings
+
+
+def test_static_detects_multi_item_with_abba():
+    """`with a, b:` acquires left-to-right — one half of an ABBA cycle
+    expressed as a single multi-item with must still produce the edge
+    (review regression)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+            def bwd(self):
+                with self._b_lock, self._a_lock:
+                    pass
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("cycle" in f for f in findings), findings
+
+
+def test_static_detects_reentry_through_call_chain():
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._x_lock = threading.Lock()
+            def outer(self):
+                with self._x_lock:
+                    self.inner()
+            def inner(self):
+                with self._x_lock:
+                    pass
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("self-deadlock" in f for f in findings), findings
+    # the witness factories count as lock constructors too
+    rl = src.replace("threading.Lock()", 'make_rlock("S._x_lock")')
+    assert conc.check_concurrency({"x.py": rl}) == []
+
+
+def test_static_lock_order_allowlist_needs_every_site():
+    """A lock-order-ok marker excuses a cycle only when EVERY site
+    producing the annotated edge carries one — an unannotated duplicate
+    site creates the same cycle on its own (review regression — the
+    first-seen site's marker decided for all of them)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+            def m1(self):
+                with self._a_lock:
+                    with self._b_lock:  # lint: lock-order-ok init-time only
+                        pass
+            def m2(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+            def m3(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("lock-order" in f for f in findings), findings
+    # annotating the remaining a->b site documents the whole edge
+    fixed = src.replace(
+        "with self._b_lock:\n                pass",
+        "with self._b_lock:  # lint: lock-order-ok init-time only\n"
+        "                pass", 1)
+    assert conc.check_concurrency({"x.py": fixed}) == []
+
+
+def test_static_lambda_deferred_body_not_under_lock():
+    """`submit(lambda: self.pull(...))` under a lock runs the pull on
+    the pool thread AFTER the lock is released — scanning the lambda
+    body inline manufactured a false blocking-call-under-lock (review
+    regression)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self, pool, store):
+                self._lock = threading.Lock()
+                self._pool = pool
+                self.store = store
+            def kick(self):
+                with self._lock:
+                    self._pool.submit(lambda: self.store.pull([1]))
+    """)
+    findings = [f for f in conc.check_concurrency({"x.py": src})
+                if "blocking-call-under-lock" in f]
+    assert findings == [], findings
+
+
+def test_static_lambda_thread_target_is_a_plane():
+    """`Thread(target=lambda: ...)` spawns a plane like a named target:
+    writes reached through the lambda's calls must join the shared-
+    state analysis (review regression — only Name/Attribute targets
+    registered, so the lambda's plane silently vanished)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def start(self):
+                threading.Thread(target=lambda: self._bump()).start()
+            def _bump(self):
+                self.n += 1
+            def set(self):
+                self.n = 0
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("shared-state-without-lock" in f and "S.n" in f
+               for f in findings), findings
+
+
+def test_static_reentry_of_param_passed_lock_detected():
+    """A lock the inventory cannot see constructed (handed in via a
+    parameter) is assumed NON-reentrant — silently skipping it would
+    pass a guaranteed self-deadlock through the zero-findings gate
+    (review regression)."""
+    src = textwrap.dedent("""
+        class S:
+            def __init__(self, lock):
+                self._x_lock = lock
+            def outer(self):
+                with self._x_lock:
+                    self.inner()
+            def inner(self):
+                with self._x_lock:
+                    pass
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("self-deadlock" in f and "unknown construction" in f
+               for f in findings), findings
+    # the caller KNOWS it passed an RLock: annotate to document it
+    ok = src.replace("with self._x_lock:\n            self.inner()",
+                     "with self._x_lock:"
+                     "  # lint: reentry-ok ctor passes an RLock\n"
+                     "            self.inner()")
+    assert conc.check_concurrency({"x.py": ok}) == []
+
+
+def test_static_reentry_allowlist_is_per_site():
+    """A reentry-ok marker on ONE re-entry site must not silence a
+    different unannotated site of the same lock, and the unannotated
+    site registering first must not defeat the marker (review
+    regression — the shared-state per-pair rule, applied to reentry)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._x_lock = threading.Lock()
+            def inner(self):
+                with self._x_lock:
+                    pass
+            def a(self):
+                with self._x_lock:  # lint: reentry-ok swapped to RLock at init when threaded
+                    self.inner()
+            def b(self):
+                with self._x_lock:
+                    self.inner()
+    """)
+    findings = [f for f in conc.check_concurrency({"x.py": src})
+                if "lock-reentry" in f]
+    assert len(findings) == 1, findings
+    b_call_ln = src.splitlines().index("            self.inner()",
+                                       src.splitlines().index(
+                                           "    def b(self):")) + 1
+    assert f"x.py:{b_call_ln}:" in findings[0], (b_call_ln, findings)
+
+
+def test_static_detects_shared_state_without_lock():
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def start(self):
+                threading.Thread(target=self._work).start()
+            def _work(self):
+                self.count += 1
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("shared-state-without-lock" in f and "S.count" in f
+               and "_work" in f for f in findings), findings
+    # both writes under the lock -> clean
+    fixed = src.replace("def _work(self):\n        self.count += 1",
+                        "def _work(self):\n        with self._lock:\n"
+                        "            self.count += 1")
+    assert conc.check_concurrency({"x.py": fixed}) == []
+
+
+def test_static_shared_state_inherits_caller_locks():
+    """A helper only ever CALLED under the lock must not be flagged —
+    the `_advance_unlocked` naming convention, checked."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cursor = 0
+            def start(self):
+                threading.Thread(target=self._work).start()
+            def _advance_unlocked(self):
+                self.cursor += 1
+            def _work(self):
+                with self._lock:
+                    self._advance_unlocked()
+            def load(self):
+                with self._lock:
+                    self._advance_unlocked()
+    """)
+    assert conc.check_concurrency({"x.py": src}) == []
+
+
+def test_static_same_named_classes_both_analyzed():
+    """Two files defining one class name must BOTH reach the detectors
+    — a shadowed duplicate silently dropped would make the zero-
+    findings gate vacuous for it (review regression)."""
+    clean = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._x_lock = threading.Lock()
+    """)
+    buggy = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._x_lock = threading.Lock()
+            def outer(self):
+                with self._x_lock:
+                    self.inner()
+            def inner(self):
+                with self._x_lock:
+                    pass
+    """)
+    # the buggy S must be found regardless of which file sorts first
+    for files in ({"a.py": buggy, "zzz.py": clean},
+                  {"a.py": clean, "zzz.py": buggy}):
+        findings = conc.check_concurrency(files)
+        assert any("self-deadlock" in f for f in findings), (files.keys(),
+                                                            findings)
+
+
+def test_static_shared_state_allowlist_is_per_pair():
+    """An unlocked-ok marker on ONE write must not silence a different
+    unguarded pair of the same attribute (review regression)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self.n = 0
+            def start(self):
+                threading.Thread(target=self._w1).start()
+                threading.Thread(target=self._w2).start()
+            def _w1(self):
+                # lint: unlocked-ok single-writer by protocol
+                self.n = 1
+            def _w2(self):
+                self.n = 2
+            def bump(self):
+                self.n = 3
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("shared-state-without-lock" in f and "_w2" in f
+               for f in findings), findings
+
+
+def test_static_detects_blocking_call_under_lock():
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.store = None
+            def refresh(self):
+                with self._lock:
+                    return self.store.pull(1, [2])
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("blocking-call-under-lock" in f and "self.store.pull" in f
+               and "S._lock" in f for f in findings), findings
+    # a justified allowlist marker clears it; the reason is REQUIRED
+    ok = src.replace("return self.store.pull(1, [2])",
+                     "# lint: held-rpc-ok transactional window\n"
+                     "                return self.store.pull(1, [2])")
+    assert conc.check_concurrency({"x.py": ok}) == []
+
+
+def test_static_detects_blocking_through_call_chain():
+    """The exact refresh_stale bug class: the RPC is one call away from
+    the lock hold."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.store = None
+            def _flush(self):
+                self.store.push(1, [2], [3])
+            def lookup(self):
+                with self._lock:
+                    self._flush()
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("blocking-call-under-lock" in f and "_flush" in f
+               and "self.store.push" in f for f in findings), findings
+
+
+def test_static_blocking_fixpoint_terminates_on_mutual_recursion():
+    """Mutually recursive methods reaching a blocking call must not
+    hang the lint gate's fixpoint (review regression: chain-tag
+    re-wrapping made it non-monotone and it looped forever)."""
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.store = None
+            def a(self):
+                self.b()
+                self.store.pull(1)
+            def b(self):
+                self.a()
+            def locked(self):
+                with self._lock:
+                    self.a()
+    """)
+    t0 = time.monotonic()
+    findings = conc.check_concurrency({"x.py": src})
+    assert time.monotonic() - t0 < 5.0, "fixpoint did not terminate"
+    assert any("blocking-call-under-lock" in f and "a()" in f
+               for f in findings), findings
+
+
+def test_static_detects_wait_without_predicate_loop():
+    src = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+            def take(self):
+                with self._cv:
+                    if not self.ready:
+                        self._cv.wait()
+    """)
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("wait-without-predicate-loop" in f for f in findings), \
+        findings
+    looped = src.replace("if not self.ready:", "while not self.ready:")
+    assert conc.check_concurrency({"x.py": looped}) == []
+    # Event.wait has no predicate to re-check — exempt
+    ev = textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._stop_cv = threading.Event()
+            def pause(self):
+                self._stop_cv.wait()
+    """)
+    assert conc.check_concurrency({"x.py": ev}) == []
+
+
+def test_static_allowlist_without_reason_is_a_finding():
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.store = None\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            # lint: held-rpc-ok\n"
+           "            self.store.pull(1)\n")
+    findings = conc.check_concurrency({"x.py": src})
+    assert any("has no reason text" in f for f in findings), findings
+    assert any("blocking-call-under-lock" in f for f in findings), \
+        "a reason-less marker must not silence the finding either"
+
+
+def test_static_repo_wide_clean():
+    """The acceptance gate: zero unjustified findings over the WHOLE
+    package (every plane — ps/, serving/, parallel/, graph/, obs/,
+    data/), i.e. ``tools/hetu_lint.py --concurrency`` exits clean."""
+    findings = hetu_lint.run_concurrency(ROOT)
+    assert findings == [], "\n".join(findings)
+
+
+# ==================================================== runtime: lock witness
+
+def test_witness_off_returns_plain_primitives():
+    assert not lw.WITNESS.on or os.environ.get("HETU_LOCK_WITNESS"), \
+        "witness must default off"
+    lw.WITNESS.enable(False)
+    lk = lw.make_lock("T.off")
+    assert isinstance(lk, type(threading.Lock()))
+    assert not isinstance(lk, lw._WitnessLock)
+    assert isinstance(lw.make_condition("T.off_cv"), threading.Condition)
+
+
+def test_witness_detects_synthetic_abba_cycle():
+    lw.WITNESS.enable(True)
+    lw.WITNESS.reset()
+    a, b = lw.make_lock("W.a"), lw.make_lock("W.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = lw.WITNESS.check()
+    assert cycles and set(cycles[0]) == {"W.a", "W.b"}, cycles
+    rep = lw.WITNESS.report()
+    assert not rep["acyclic"] and rep["levels"] is None
+    c = hmetrics.concurrency_counts()
+    assert c["concurrency_witness_locks"] == 2
+    assert c["concurrency_witness_edges"] == 2
+    assert c["concurrency_witness_cycles"] == 1
+    # deltas: a second check with no new facts records nothing more
+    lw.WITNESS.check()
+    assert hmetrics.concurrency_counts() == c
+    lw.WITNESS.enable(False)
+
+
+def test_witness_condition_wait_releases_held_stack():
+    """cond.wait() inside `with cond:` must pop the held stack — the
+    notifier acquiring the SAME condition under another lock would
+    otherwise record a phantom self-edge/cycle."""
+    lw.WITNESS.enable(True)
+    lw.WITNESS.reset()
+    outer = lw.make_lock("W.outer")
+    cv = lw.make_condition("W.cv")
+    served = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            served.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with outer:
+        with cv:            # acquirable: the waiter released inside wait
+            cv.notify_all()
+    t.join(5)
+    assert served
+    rep = lw.WITNESS.report()
+    assert rep["acyclic"], rep["cycles"]
+    pairs = [(e["from"], e["to"]) for e in rep["edges"]]
+    assert ("W.outer", "W.cv") in pairs
+    assert rep["levels"]["W.outer"] < rep["levels"]["W.cv"]
+    lw.WITNESS.enable(False)
+
+
+def test_witness_condition_wait_restores_nested_depth():
+    """A wait under NESTED acquisition must restore the held-stack
+    entry at its true recursion count — otherwise the post-wait
+    releases delete it early and later orderings go unrecorded (review
+    regression)."""
+    lw.WITNESS.enable(True)
+    lw.WITNESS.reset()
+    cv = lw.make_condition("W.ncv")
+    other = lw.make_lock("W.nother")
+    done = []
+
+    def waiter():
+        with cv:
+            with cv:            # depth 2
+                cv.wait(timeout=5)
+            # back at depth 1: cv must STILL be on the held stack
+            with other:         # must record the cv -> other edge
+                done.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    assert done
+    pairs = [(e["from"], e["to"]) for e in lw.WITNESS.report()["edges"]]
+    assert ("W.ncv", "W.nother") in pairs, pairs
+    lw.WITNESS.enable(False)
+
+
+def test_witness_rlock_reentry_counts_no_self_edge():
+    lw.WITNESS.enable(True)
+    lw.WITNESS.reset()
+    r = lw.make_rlock("W.r")
+    with r:
+        with r:
+            pass
+    rep = lw.WITNESS.report()
+    assert rep["edges"] == []
+    assert rep["locks"]["W.r"]["reentries"] == 1
+    assert rep["locks"]["W.r"]["acquires"] == 1
+    lw.WITNESS.enable(False)
+
+
+def test_witness_smoke_wdl_ps_and_serving_acyclic():
+    """The ISSUE 14 CI satellite: a short wdl-PS training run plus a
+    serving round trip under a live witness — the merged acquisition
+    graph over the cache/store/router locks must be ACYCLIC."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ctr_models_cc", os.path.join(ROOT, "examples", "ctr",
+                                      "models.py"))
+    ctr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ctr)
+
+    lw.WITNESS.enable(True)
+    lw.WITNESS.reset()
+    try:
+        B = 8
+        dv, sv, yv = ctr.synthetic_criteo(B, vocab=300)
+        dense = ht.placeholder_op("dense_cc")
+        sparse = ht.placeholder_op("sparse_cc", dtype=np.int64)
+        y_ = ht.placeholder_op("y_cc")
+        loss = ctr.wdl_criteo(dense, sparse, y_, B, vocab=300, dim=4,
+                              embed_mode="vlru", lr=0.01)[0]
+        ex = ht.Executor(
+            {"train": [loss, ht.optim.SGDOptimizer(0.01).minimize(loss)]},
+            seed=0)
+        for _ in range(3):
+            ex.run("train", feed_dict={dense: dv, sparse: sv, y_: yv})
+
+        from hetu_tpu.serving import InferenceExecutor, ServingRouter
+        rng = np.random.RandomState(0)
+        xs = ht.placeholder_op("xs_cc")
+        w = ht.Variable("ws_cc", value=rng.randn(4, 2).astype(np.float32))
+        iex = InferenceExecutor([ht.matmul_op(xs, w)], buckets=(2, 4))
+        with ServingRouter(iex, max_batch=4, max_wait_ms=3.0) as router:
+            futs = [router.submit({xs: rng.randn(4).astype(np.float32)})
+                    for _ in range(6)]
+            for f in futs:
+                f.result(timeout=30)
+
+        cycles = lw.WITNESS.check()
+        assert cycles == [], f"observed deadlock-able orders: {cycles}"
+        rep = lw.WITNESS.report()
+        names = set(rep["locks"])
+        assert "DistCacheTable._lock" in names, names
+        assert "ServingRouter._cv" in names, names
+        assert rep["acyclic"] and rep["levels"] is not None
+        c = HetuProfiler.concurrency_counters()
+        # the exact lock-class count depends on the store flavour
+        # (native tables skip _NumpyTable._lock) — assert the counter
+        # agrees with the report and covers the two planes above
+        assert c["concurrency_witness_locks"] == len(rep["locks"]) >= 2
+        assert c.get("concurrency_witness_cycles", 0) == 0
+    finally:
+        lw.WITNESS.enable(False)
+
+
+def test_committed_lock_hierarchy_artifact():
+    """The committed witness artifact (tools/gen_lock_hierarchy.py over
+    the training+serving+elastic planes) is acyclic, leveled, and names
+    the documented core hierarchy."""
+    path = os.path.join(ROOT, "artifacts", "lock_hierarchy.json")
+    rep = json.load(open(path))
+    assert rep["acyclic"] and rep["cycles"] == []
+    assert rep["levels"] is not None
+    names = set(rep["locks"])
+    for expected in ("DistCacheTable._lock", "StoreServer._repl_lock",
+                     "DistributedStore._conn_locks[*]",
+                     "ServingRouter._cv", "ChaosInjector._lock"):
+        assert expected in names, (expected, names)
+    lv = rep["levels"]
+    # the documented order: cache -> server repl -> client transport
+    assert lv["DistCacheTable._lock"] < lv["StoreServer._repl_lock"] \
+        < lv["DistributedStore._conn_locks[*]"]
+    assert rep["edges"], "a witness run with no edges witnessed nothing"
+    # every edge endpoint is a known lock with a level
+    for e in rep["edges"]:
+        assert e["from"] in lv and e["to"] in lv and e["count"] >= 1
+        assert lv[e["from"]] < lv[e["to"]]
+
+
+# ================================================== deterministic race harness
+
+def test_race_spec_parse_and_errors():
+    a, b, seed, pairs, tmo = race.parse_spec(
+        "race:cache.miss_fill|test.write:seed7:pairs2:timeout500")
+    assert (a, b, seed, pairs, tmo) == ("cache.miss_fill", "test.write",
+                                        7, 2, 500.0)
+    for bad in ("race:a|a:seed1", "race:a:seed1", "nope:a|b:seed1",
+                "race:a|b:seed1:bogus2", "race:a|b"):
+        with pytest.raises(race.RaceSpecError):
+            race.parse_spec(bad)
+    sched = race.RaceSchedule.from_spec("race:a|b:seed3")
+    assert sched.sites == ("a", "b") and sched.pairs == 1
+
+
+def _forced_order(seed, start_loser_first=True):
+    """Run two region-bracketed ops under seed; return completion order."""
+    sched = race.RaceSchedule("a", "b", seed=seed, timeout_ms=5000)
+    race.install(sched)
+    out = []
+
+    def run(site):
+        with race.region(site):
+            out.append(site)
+
+    loser = "b" if sched.order[0] == "a" else "a"
+    winner = sched.order[0]
+    tl = threading.Thread(target=run, args=(loser,))
+    tw = threading.Thread(target=run, args=(winner,))
+    if start_loser_first:
+        tl.start()
+        time.sleep(0.03)    # loser reaches its site and is HELD there
+        tw.start()
+    else:
+        tw.start()
+        tl.start()
+    tl.join(10)
+    tw.join(10)
+    race.uninstall()
+    return sched, out
+
+
+def test_race_same_seed_same_interleaving():
+    """The determinism contract: same seed => same winner sequence AND
+    the same completion order, run after run."""
+    for seed in (0, 1, 7):
+        s1, o1 = _forced_order(seed)
+        s2, o2 = _forced_order(seed)
+        assert s1.order == s2.order == \
+            race.RaceSchedule("a", "b", seed=seed).order
+        assert o1 == o2 == [s1.order[0],
+                            "b" if s1.order[0] == "a" else "a"]
+    c = hmetrics.concurrency_counts()
+    assert c.get("concurrency_preemptions", 0) >= 6
+    assert c.get("concurrency_race_timeouts", 0) == 0
+
+
+def test_race_seeds_cover_both_orders():
+    winners = {race.RaceSchedule("a", "b", seed=s).order[0]
+               for s in range(16)}
+    assert winners == {"a", "b"}
+
+
+def test_race_stray_thread_does_not_corrupt_next_pair():
+    """A third thread hitting the loser site during pair 0 must not
+    leak state into pair 1 — its late exit is ignored, and pair 1 still
+    forces its real loser/winner deterministically (review
+    regression)."""
+    seed = next(s for s in range(64)
+                if race.RaceSchedule("a", "b", seed=s,
+                                     pairs=2).order == ["b", "b"])
+    sched = race.RaceSchedule("a", "b", seed=seed, pairs=2,
+                              timeout_ms=3000)
+    race.install(sched)
+    out = []
+
+    def loser(tag):
+        with race.region("a"):
+            out.append(tag)
+
+    def winner(tag):
+        with race.region("b"):
+            time.sleep(0.01)
+            out.append(tag)
+
+    try:
+        # pair 0: TWO stray loser threads + the winner
+        l0a = threading.Thread(target=loser, args=("l0a",))
+        l0b = threading.Thread(target=loser, args=("l0b",))
+        l0a.start()
+        l0b.start()
+        time.sleep(0.05)
+        w0 = threading.Thread(target=winner, args=("w0",))
+        w0.start()
+        for t in (l0a, l0b, w0):
+            t.join(10)
+        # pair 1 must still rendezvous: winner first, loser held
+        l1 = threading.Thread(target=loser, args=("l1",))
+        l1.start()
+        time.sleep(0.05)
+        w1 = threading.Thread(target=winner, args=("w1",))
+        w1.start()
+        l1.join(10)
+        w1.join(10)
+    finally:
+        race.uninstall()
+    assert out[0] == "w0", out              # pair 0 forced winner-first
+    assert out.index("w1") < out.index("l1"), out   # pair 1 too
+    assert ("timeout", "a") not in sched.log, sched.log
+    assert ("timeout", "b") not in sched.log, sched.log
+    assert not sched._timed_out
+    assert sched.complete
+
+
+def test_race_timeout_escape_counted():
+    """A schedule whose peer site never executes must NOT deadlock the
+    run: the loser times out through, counted."""
+    seed = next(s for s in range(32)
+                if race.RaceSchedule("a", "b", seed=s).order[0] == "b")
+    sched = race.RaceSchedule("a", "b", seed=seed, timeout_ms=80)
+    race.install(sched)
+    t0 = time.monotonic()
+    race.point("a")         # the loser; winner "b" never arrives
+    dt = time.monotonic() - t0
+    assert 0.05 < dt < 2.0, dt
+    assert ("timeout", "a") in sched.log
+    assert hmetrics.concurrency_counts()["concurrency_race_timeouts"] == 1
+    # degrade-once: later encounters of EITHER site free-run — a hot
+    # per-step site paired with an absent peer costs one timeout total,
+    # not one per step (review regression)
+    t0 = time.monotonic()
+    for _ in range(50):
+        race.point("a")
+        race.point("b")
+    assert time.monotonic() - t0 < 0.5
+    assert hmetrics.concurrency_counts()["concurrency_race_timeouts"] == 1
+    # a degraded schedule forces nothing further: it IS complete
+    assert sched.complete
+    race.uninstall()
+
+
+def test_cstable_flush_survives_concurrent_close():
+    """The checkpoint-barrier flush racing a GC-thread close(): a pool
+    snapshot taken just before close() shuts it down must drain as a
+    no-op, not raise out of the checkpoint save (review regression)."""
+    from hetu_tpu.ps.cstable import CacheSparseTable
+    from hetu_tpu.ps.store import EmbeddingStore
+    t = CacheSparseTable(8, 16, 4, store=EmbeddingStore())
+    # simulate the interleaving deterministically: flush's snapshot
+    # would see this pool; close() (here: shutdown) wins the race
+    t._pool.shutdown(wait=True)
+    t.flush()       # must not raise 'cannot schedule new futures...'
+    t._pool = None
+    t.flush()       # and the pool-already-nulled path stays a no-op
+    t.close()
+
+
+# ------------------------------------ historical repro 1: router cancel race
+
+def _prefix_resolve(future, value):
+    """The PRE-FIX (pre-PR-7-review) router resolution: done()-check
+    then set_result, no claim — the exact window the review closed."""
+    if not future.done():
+        race.point("router.resolve")    # the same product site HEAD hits
+        future.set_result(value)
+
+
+def _cancel_winner_seed():
+    return next(s for s in range(64) if race.RaceSchedule(
+        "router.resolve", "test.cancel", seed=s).order[0] == "test.cancel")
+
+
+def test_race_repro_router_cancel_prefix_logic_fails():
+    """Against the pre-fix logic the forced cancel-inside-the-window
+    interleaving raises InvalidStateError DETERMINISTICALLY (same seed,
+    same failure, twice) — the race class PR 7's review caught by luck
+    is now a repeatable experiment."""
+    from concurrent.futures import Future
+    seed = _cancel_winner_seed()
+    for _ in range(2):      # same seed => same interleaving => same crash
+        sched = race.RaceSchedule("router.resolve", "test.cancel",
+                                  seed=seed, timeout_ms=5000)
+        race.install(sched)
+        fut = Future()
+        err = []
+
+        def batcher():
+            try:
+                _prefix_resolve(fut, 42)
+            except InvalidStateError as e:
+                err.append(e)
+
+        t = threading.Thread(target=batcher)
+        t.start()
+        with race.region("test.cancel"):
+            fut.cancel()
+        t.join(10)
+        race.uninstall()
+        assert err, "pre-fix logic must hit InvalidStateError under the " \
+                    "forced cancel-first interleaving"
+
+
+def test_race_repro_router_cancel_head_survives():
+    """HEAD's router claims every future before resolving: the SAME
+    forced interleaving (cancel ordered before resolution at the same
+    'router.resolve' site) cannot kill the batcher — the cancelled
+    request loses the race, and the router keeps serving."""
+    seed = _cancel_winner_seed()
+    from hetu_tpu.serving import InferenceExecutor, ServingRouter
+    rng = np.random.RandomState(0)
+    wv = rng.randn(3, 2).astype(np.float32)
+    x = ht.placeholder_op("x_rc")
+    w = ht.Variable("w_rc", value=wv.copy())
+    iex = InferenceExecutor([ht.matmul_op(x, w)], buckets=(1, 2))
+    sched = race.RaceSchedule("router.resolve", "test.cancel",
+                              seed=seed, timeout_ms=5000)
+    race.install(sched)
+    try:
+        with ServingRouter(iex, max_batch=1, max_wait_ms=1.0) as router:
+            fut = router.submit({x: np.ones(3, np.float32)})
+            # wait until the batcher is HELD at the resolve site (claim
+            # + inference already happened, resolution has not): the
+            # cancel now lands EXACTLY inside the historical window
+            deadline = time.monotonic() + 10
+            while ("enter", "router.resolve") not in sched.log:
+                assert time.monotonic() < deadline, sched.log
+                time.sleep(0.002)
+            with race.region("test.cancel"):
+                cancelled = fut.cancel()
+            # the batcher claimed the future before inference, so the
+            # forced-first cancel must have LOST...
+            assert not cancelled
+            np.testing.assert_allclose(
+                np.asarray(fut.result(timeout=30)[0]),
+                np.ones(3, np.float32) @ wv, rtol=1e-6)
+            race.uninstall()    # second request: router thread survived
+            fut2 = router.submit({x: np.zeros(3, np.float32)})
+            np.testing.assert_allclose(np.asarray(
+                fut2.result(timeout=30)[0]), np.zeros(2), atol=1e-6)
+    finally:
+        race.uninstall()
+
+
+# --------------------------- historical repro 2: read-only staleness window
+
+def _ro_store(width=4):
+    from hetu_tpu.ps import EmbeddingStore
+    store = EmbeddingStore()
+    tid = store.init_table(16, width, opt="sgd", lr=1.0, init_scale=0.0)
+    return store, tid
+
+
+def _write_winner_seed(site):
+    return next(s for s in range(64) if race.RaceSchedule(
+        site, "test.write", seed=s).order[0] == "test.write")
+
+
+def test_race_repro_readonly_version_order_head_self_heals():
+    """HEAD reads VERSIONS before ROWS in the read-only miss path.  A
+    writer forced between the two RPCs (the historical window) leaves
+    the recorded version OLDER than the data — refresh_stale re-pulls
+    once, harmlessly, and serving converges.  Deterministic: the writer
+    lands inside the window on every run."""
+    from hetu_tpu.ps.dist_store import DistCacheTable
+    seed = _write_winner_seed("cache.miss_fill")
+    for _ in range(2):
+        store, tid = _ro_store()
+        ro = DistCacheTable(store, tid, limit=8, read_only=True)
+        sched = race.RaceSchedule("cache.miss_fill", "test.write",
+                                  seed=seed, timeout_ms=5000)
+        race.install(sched)
+        rows = {}
+
+        def reader():
+            rows["got"] = ro.lookup(np.asarray([3]))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        # the reader is HELD at cache.miss_fill (versions already read);
+        # the winner write lands inside the window, then the pull runs
+        with race.region("test.write"):
+            store.push(tid, np.asarray([3]), -np.ones((1, 4), np.float32))
+        t.join(10)
+        race.uninstall()
+        assert ("forced", "cache.miss_fill") in sched.log, sched.log
+        # the pull ran AFTER the write: data fresh, version stale
+        np.testing.assert_allclose(rows["got"][0],
+                                   np.ones(4, np.float32), rtol=1e-6)
+        # the stale version makes refresh re-pull ONCE (harmless), and
+        # the row stays correct — no permanent invisibility
+        assert ro.refresh_stale() == 1
+        np.testing.assert_allclose(ro.lookup(np.asarray([3]))[0],
+                                   np.ones(4, np.float32), rtol=1e-6)
+
+
+def test_race_repro_readonly_version_order_prefix_logic_stale_forever():
+    """The PRE-FIX order (rows before versions) under the SAME forced
+    interleaving records a version NEWER than the data it serves: the
+    refresh predicate ``server_version > recorded`` is False and the
+    stale row is invisible to refresh_stale FOREVER — deterministically
+    reproduced, twice."""
+    seed = _write_winner_seed("test.prefix_gap")
+    for _ in range(2):
+        store, tid = _ro_store()
+        sched = race.RaceSchedule("test.prefix_gap", "test.write",
+                                  seed=seed, timeout_ms=5000)
+        race.install(sched)
+        state = {}
+
+        def prefix_miss_fill():
+            keys = np.asarray([3])
+            rows = store.pull(tid, keys)            # pre-fix: rows FIRST
+            race.point("test.prefix_gap")           # the racing window
+            vers = store.versions(tid, keys)        # versions second
+            state["rows"], state["vers"] = rows, vers
+
+        t = threading.Thread(target=prefix_miss_fill)
+        t.start()
+        with race.region("test.write"):
+            store.push(tid, np.asarray([3]), -np.ones((1, 4), np.float32))
+        t.join(10)
+        race.uninstall()
+        assert ("forced", "test.prefix_gap") in sched.log, sched.log
+        # stale data, fresh version: the poisonous combination
+        np.testing.assert_allclose(state["rows"][0],
+                                   np.zeros(4, np.float32), atol=0)
+        server_now = store.versions(tid, np.asarray([3]))
+        would_refresh = bool(server_now[0] > state["vers"][0])
+        assert not would_refresh, \
+            "pre-fix order must hide the staleness from refresh forever"
+
+
+# ---------------------------------------- fence-adoption regression (this PR)
+
+def _fence_client(world=2):
+    from hetu_tpu.ps.dist_store import DistributedStore
+    ds = DistributedStore.__new__(DistributedStore)
+    ds.world = world
+    ds._route = list(range(world))
+    ds._epoch = [0] * world
+    ds._fence_lock = threading.Lock()
+    ds._flip_epoch = {}
+    ds._failed_over = set()
+    return ds
+
+
+def test_note_fence_flips_route_once_per_epoch():
+    """The shared-state finding this PR's detector surfaced: two
+    refusals from ONE fence event (racing threads) must flip the route
+    once — the old unguarded toggle flipped the second one straight
+    back onto the deposed rank."""
+    from hetu_tpu.ps.dist_store import EpochFenced
+    ds = _fence_client()
+    err = EpochFenced(1, 3, serving=False)
+    ds._note_fence(1, err)
+    assert ds._epoch[1] == 3 and ds._route[1] == 0
+    ds._note_fence(1, err)      # the racing duplicate
+    assert ds._route[1] == 0, "second refusal flipped the route back"
+    assert 1 in ds._failed_over
+    # a NEW epoch's deposition flips again
+    ds._note_fence(1, EpochFenced(1, 5, serving=False))
+    assert ds._epoch[1] == 5 and ds._route[1] == 1
+
+
+def test_note_fence_ignores_stale_refusals():
+    """A refusal carrying an OLDER epoch than the client already
+    adopted is stale information: it must neither regress the epoch nor
+    steer the route away from the lineage the client follows."""
+    from hetu_tpu.ps.dist_store import EpochFenced
+    ds = _fence_client()
+    ds._note_fence(1, EpochFenced(1, 4, serving=False))
+    assert ds._epoch[1] == 4 and ds._route[1] == 0
+    ds._note_fence(1, EpochFenced(1, 2, serving=False))   # stale
+    assert ds._epoch[1] == 4, "stale refusal regressed the epoch"
+    assert ds._route[1] == 0, "stale refusal moved the route"
+
+
+# ------------------------------------------------------------------- counters
+
+def test_concurrency_counters_clean_run_empty():
+    """The family invariant: no witness, no race schedule => nothing
+    recorded (the counter-coverage self-lint holds the accessor/profiler
+    wiring)."""
+    assert HetuProfiler.concurrency_counters() == {}
+    hmetrics.record_concurrency("concurrency_preemptions", 2)
+    assert HetuProfiler.concurrency_counters() == {
+        "concurrency_preemptions": 2}
+    hmetrics.reset_concurrency_counts()
+    assert hmetrics.concurrency_counts() == {}

@@ -186,6 +186,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_flash_fallback("test_reason")
     metrics.record_fault("test_fault", 2)
     metrics.record_elastic("elastic_shrink")
+    metrics.record_concurrency("concurrency_preemptions")
     metrics.record_remat("remat_layers_rematted", 3)
     metrics.record_cache("emb_cache_hit_rows", 5)
     metrics.record_zero("zero_pad_bytes", 64)
@@ -201,6 +202,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "emb_pallas_fallbacks": metrics.emb_pallas_fallback_counts(),
         "faults": metrics.fault_counts(),
         "elastic": metrics.elastic_counts(),
+        "concurrency": metrics.concurrency_counts(),
         "remat": metrics.remat_counts(),
         "cache": metrics.cache_counts(),
         "zero": metrics.zero_counts(),

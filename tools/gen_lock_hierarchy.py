@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Generate ``artifacts/lock_hierarchy.json`` — the committed
+lock-witness artifact (ISSUE 14 acceptance).
+
+Runs the three host-side planes that carry the system's concurrency
+under ``HETU_LOCK_WITNESS=1`` and exports the merged observed
+acquisition graph:
+
+* **training** — an in-process 2-rank replicated ``DistributedStore``
+  cluster with heartbeats, a training-mode ``DistCacheTable``
+  (lookup/update/flush riding the transactional commit protocol, the
+  replication forward inside the apply critical section), and a small
+  dense ``Executor`` run with a prefetching dataloader (feed-pipeline
+  thread, run-plan and compiled-step-cache locks);
+* **serving** — a dense ``InferenceExecutor`` behind a
+  ``ServingRouter`` (condition-variable admission + batcher thread)
+  and a read-only cache with a version-refresh sweep on its background
+  thread;
+* **elastic** — an ``ElasticController`` over a dp=4 CPU mesh driving
+  a chaos-scheduled shrink and the grow-back (``resize_world``,
+  step-clock kills through the chaos injector's lock).
+
+The exported JSON records each lock CLASS seen (with acquire/re-entry
+counts), every ``held -> acquired`` edge with its count, the
+topological LEVELS of the hierarchy (level 0 = outermost; only defined
+because the graph is ACYCLIC — the script fails loudly on any cycle),
+and the participating threads.  The README "Concurrency model &
+verifier" section documents the same hierarchy; the tier-1 witness
+smoke (``tests/test_concurrency.py``) re-asserts acyclicity on every
+run.
+
+Usage: ``python tools/gen_lock_hierarchy.py [out.json]``
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["HETU_LOCK_WITNESS"] = "1"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu import chaos  # noqa: E402
+from hetu_tpu.obs.lock_witness import WITNESS  # noqa: E402
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def training_plane():
+    """Replicated 2-rank dist store + training cache + dense executor
+    with a prefetching dataloader."""
+    from hetu_tpu.ps.dist_store import DistCacheTable, DistributedStore
+    ports = _free_ports(2)
+    endpoints = [("127.0.0.1", p) for p in ports]
+    stores = [DistributedStore(r, 2, endpoints, port=ports[r],
+                               replication=2, rpc_timeout=5.0,
+                               rpc_retries=2, connect_timeout=2.0)
+              for r in range(2)]
+    try:
+        tid = None
+        for s in stores:
+            tid = s.init_table(64, 8, opt="sgd", lr=0.1, init_scale=0.01)
+        for s in stores:
+            s.start_heartbeat(interval_ms=25)
+        cache = DistCacheTable(stores[0], tid, limit=16, pull_bound=4,
+                               push_bound=2)
+        rng = np.random.RandomState(0)
+        for _ in range(12):
+            ids = rng.randint(0, 64, size=(8,))
+            rows = cache.lookup(ids)
+            cache.update(ids, np.ones_like(rows) * 0.01)
+        cache.flush()
+        stores[0].alive_mask(1000.0)
+        time.sleep(0.1)
+    finally:
+        for s in stores:
+            s.close()
+
+    # dense executor leg: feed pipeline + step cache + run plans
+    from hetu_tpu.data.dataloader import Dataloader
+    rng = np.random.RandomState(1)
+    data = rng.randn(64, 6).astype(np.float32)
+    dl = Dataloader(data, 8, "train", shuffle=False, prefetch=2)
+    x = ht.dataloader_op([dl])
+    w = ht.Variable("w_lh", value=rng.randn(6, 3).astype(np.float32))
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    opt = ht.optim.SGDOptimizer(0.05)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+    for _ in range(6):
+        ex.run("train")
+
+
+def serving_plane():
+    """Router + batcher thread + read-only refresh sweep."""
+    from hetu_tpu.ps import EmbeddingStore
+    from hetu_tpu.ps.dist_store import DistCacheTable
+    from hetu_tpu.serving import InferenceExecutor, ServingRouter
+    rng = np.random.RandomState(2)
+    x = ht.placeholder_op("xs")
+    w = ht.Variable("ws", value=rng.randn(5, 3).astype(np.float32))
+    iex = InferenceExecutor([ht.matmul_op(x, w)], buckets=(2, 4))
+    with ServingRouter(iex, max_batch=4, max_wait_ms=4.0) as router:
+        futs = [router.submit({x: rng.randn(5).astype(np.float32)})
+                for _ in range(10)]
+        for f in futs:
+            f.result(timeout=30)
+
+    store = EmbeddingStore()
+    tid = store.init_table(32, 4, opt="sgd", lr=0.5)
+    ro = DistCacheTable(store, tid, limit=16, read_only=True,
+                       refresh_every=2)
+    ids = np.arange(8)
+    for _ in range(5):
+        ro.lookup(ids)
+    store.push(tid, ids, np.ones((8, 4), np.float32))
+    ro.refresh_stale()
+    ro.refresh_join()
+
+
+def elastic_plane():
+    """Chaos-scheduled shrink at step 2, rejoin, grow-back."""
+    from hetu_tpu.parallel.elastic import (ElasticController, LogicalRank,
+                                           handles_alive_fn)
+    handles = [LogicalRank(r) for r in range(4)]
+    inj = chaos.ChaosInjector.from_spec("7:kill:proc@rank2:step2")
+    for h in handles:
+        inj.register_proc(h.rank, h)
+    prev = chaos.install(inj)
+    try:
+        rng = np.random.RandomState(3)
+        x = ht.placeholder_op("xe")
+        w = ht.Variable("we", value=rng.randn(4, 2).astype(np.float32))
+        loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+        opt = ht.optim.SGDOptimizer(0.05)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                         dist_strategy=ht.dist.DataParallel(num_devices=4))
+        ctl = ElasticController(ex, world=4,
+                                alive_fn=handles_alive_fn(handles),
+                                min_dp=2)
+        for i in range(6):
+            xv = rng.randn(2 * ctl.dp, 4).astype(np.float32)
+            ex.run("train", feed_dict={x: xv})
+            if i == 3:
+                handles[2].rejoin()
+            ctl.poll()
+    finally:
+        chaos.install(prev)
+        for h in handles:
+            h.close()
+
+
+def main(out=None):
+    assert WITNESS.on, "HETU_LOCK_WITNESS must be on before import"
+    out = out or os.path.join(REPO, "artifacts", "lock_hierarchy.json")
+    WITNESS.reset()
+    training_plane()
+    serving_plane()
+    elastic_plane()
+    cycles = WITNESS.check()
+    rep = WITNESS.export(out)
+    print(f"locks={len(rep['locks'])} edges={len(rep['edges'])} "
+          f"threads={len(rep['threads'])} acyclic={rep['acyclic']}")
+    for name in sorted(rep["locks"]):
+        lvl = (rep["levels"] or {}).get(name)
+        print(f"  level {lvl}: {name} ({rep['locks'][name]['kind']}, "
+              f"{rep['locks'][name]['acquires']} acquires)")
+    if cycles:
+        print(f"CYCLES OBSERVED: {cycles}", file=sys.stderr)
+        return 1
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -31,189 +31,66 @@ Checks
    ``pyproject.toml`` carries the equivalent ruff config for
    environments that do).
 
-Usage: ``python tools/hetu_lint.py [root]`` — prints findings, exits
-non-zero if any.  Every check also takes raw source strings so the test
-suite can prove each detector fires on a synthetic violation.
+5. **concurrency** (ISSUE 14): the repo-wide concurrency verifier —
+   lock-order cycles with cross-module held-call propagation,
+   non-reentrant re-entry, shared-state-without-lock from discovered
+   thread entrypoints, blocking-call-under-lock, and
+   condition-wait-without-predicate-loop, with a justified-allowlist
+   mechanism (``# lint: held-rpc-ok <reason>``).  The engine lives in
+   ``hetu_tpu/analysis/concurrency.py`` (loaded by file path so the
+   CLI never imports jax); ``--concurrency`` runs it alone, and it is
+   part of the default ``run_all`` gate.
+
+Usage: ``python tools/hetu_lint.py [--concurrency] [root]`` — prints
+findings, exits non-zero if any.  Every check also takes raw source
+strings so the test suite can prove each detector fires on a synthetic
+violation.
 """
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-LOCK_TOKENS = ("lock", "cond")
-REENTRANT_TYPES = {"RLock", "Condition"}  # Condition defaults to an RLock
+_concurrency_mods = {}      # resolved engine path -> loaded module
+
+
+def concurrency_engine(root=REPO):
+    """The ISSUE 14 static concurrency verifier, loaded by FILE PATH
+    (``hetu_tpu/analysis/concurrency.py`` is stdlib-only; loading it
+    this way keeps the lint CLI independent of the package's jax
+    imports).  Cached PER RESOLVED PATH so linting an alternate
+    checkout analyzes with that checkout's engine, not a stale one."""
+    path = os.path.abspath(
+        os.path.join(root, "hetu_tpu", "analysis", "concurrency.py"))
+    mod = _concurrency_mods.get(path)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            f"_hetu_lint_concurrency_{len(_concurrency_mods)}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _concurrency_mods[path] = mod
+    return mod
 
 
 # --------------------------------------------------------------- lock order
 
-def _lock_attr_of(expr, assigns):
-    """Lock identity of a with-item context expr, or None.
-
-    ``self._x_lock`` -> '_x_lock'; a bare Name resolves through the
-    function's assignments to the self attribute it came from (e.g.
-    ``lock = self._conn_locks.setdefault(...)`` -> '_conn_locks[*]').
-    """
-    if isinstance(expr, ast.Attribute) \
-            and isinstance(expr.value, ast.Name) \
-            and expr.value.id == "self" \
-            and any(t in expr.attr.lower() for t in LOCK_TOKENS):
-        return expr.attr
-    if isinstance(expr, ast.Name):
-        src = assigns.get(expr.id)
-        if src is not None:
-            for sub in ast.walk(src):
-                if isinstance(sub, ast.Attribute) \
-                        and any(t in sub.attr.lower() for t in LOCK_TOKENS):
-                    return sub.attr + "[*]"
-    return None
-
-
-def _name_assigns(func):
-    """name -> value expr for simple assignments inside ``func`` (used to
-    resolve ``with lock:`` back to the self attribute it came from)."""
-    out = {}
-    for node in ast.walk(func):
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    out[tgt.id] = node.value
-                elif isinstance(tgt, (ast.Tuple, ast.List)):
-                    for el in tgt.elts:
-                        if isinstance(el, ast.Name):
-                            out[el.id] = node.value
-    return out
-
-
-class _MethodScan(ast.NodeVisitor):
-    """One method: direct lock acquisitions, nesting edges, and same-class
-    calls made while holding each lock."""
-
-    def __init__(self, assigns):
-        self.assigns = assigns
-        self.held = []
-        self.acquires = set()            # locks acquired anywhere
-        self.edges = set()               # (outer, inner) lexical nesting
-        self.calls = set()               # self.<method>() anywhere
-        self.calls_under = {}            # lock -> {methods called held}
-
-    def visit_With(self, node):
-        ids = [_lock_attr_of(i.context_expr, self.assigns)
-               for i in node.items]
-        ids = [i for i in ids if i is not None]
-        for lid in ids:
-            self.acquires.add(lid)
-            for outer in self.held:
-                self.edges.add((outer, lid))
-        self.held.extend(ids)
-        for stmt in node.body:
-            self.visit(stmt)
-        for _ in ids:
-            self.held.pop()
-
-    def visit_Call(self, node):
-        fn = node.func
-        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
-                and fn.value.id == "self":
-            self.calls.add(fn.attr)
-            for lock in self.held:
-                self.calls_under.setdefault(lock, set()).add(fn.attr)
-        self.generic_visit(node)
-
-
-def _lock_types(cls):
-    """attr -> constructor name for ``self.x = threading.Lock()``-style
-    assignments anywhere in the class body."""
-    out = {}
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1:
-            tgt = node.targets[0]
-            if isinstance(tgt, ast.Attribute) \
-                    and isinstance(tgt.value, ast.Name) \
-                    and tgt.value.id == "self" \
-                    and isinstance(node.value, ast.Call):
-                fn = node.value.func
-                ctor = fn.attr if isinstance(fn, ast.Attribute) else \
-                    fn.id if isinstance(fn, ast.Name) else None
-                if ctor in ("Lock", "RLock", "Condition", "Semaphore"):
-                    out[tgt.attr] = ctor
-    return out
-
-
 def check_lock_order(sources):
-    """``{filename: source}`` -> findings.  Builds a per-class lock
-    acquisition-order graph (lexical nesting + held-call propagation) and
-    reports cycles and non-reentrant re-acquisition."""
-    findings = []
-    for fname, src in sources.items():
-        try:
-            tree = ast.parse(src)
-        except SyntaxError as e:
-            findings.append(f"{fname}: syntax error: {e}")
-            continue
-        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
-            types = _lock_types(cls)
-            scans = {}
-            for meth in [n for n in ast.walk(cls)
-                         if isinstance(n, ast.FunctionDef)]:
-                scan = _MethodScan(_name_assigns(meth))
-                for stmt in meth.body:
-                    scan.visit(stmt)
-                scans[meth.name] = scan
-            # eventual acquisitions per method (fixpoint over self-calls)
-            eventual = {m: set(s.acquires) for m, s in scans.items()}
-            changed = True
-            while changed:
-                changed = False
-                for m, s in scans.items():
-                    for callee in s.calls:
-                        extra = eventual.get(callee, set()) - eventual[m]
-                        if extra:
-                            eventual[m] |= extra
-                            changed = True
-            # edge set: lexical nesting + (held lock -> callee's eventual)
-            edges = set()
-            for m, s in scans.items():
-                edges |= s.edges
-                for lock, callees in s.calls_under.items():
-                    for callee in callees:
-                        for inner in eventual.get(callee, set()):
-                            edges.add((lock, inner))
-            # self-edges: re-entry on a non-reentrant lock
-            graph = {}
-            for a, b in edges:
-                if a == b:
-                    base = a.rstrip("[*]")
-                    if types.get(base, "Lock") not in REENTRANT_TYPES:
-                        findings.append(
-                            f"{fname}: {cls.name}: non-reentrant lock "
-                            f"'{a}' acquired while already held "
-                            f"(self-deadlock)")
-                    continue
-                graph.setdefault(a, set()).add(b)
-            # cycle detection (DFS, white/grey/black)
-            color, stack = {}, []
-
-            def dfs(n):
-                color[n] = 1
-                stack.append(n)
-                for nxt in graph.get(n, ()):
-                    if color.get(nxt, 0) == 1:
-                        cyc = stack[stack.index(nxt):] + [nxt]
-                        findings.append(
-                            f"{fname}: {cls.name}: lock acquisition-order "
-                            f"cycle: {' -> '.join(cyc)}")
-                    elif color.get(nxt, 0) == 0:
-                        dfs(nxt)
-                stack.pop()
-                color[n] = 2
-
-            for n in list(graph):
-                if color.get(n, 0) == 0:
-                    dfs(n)
-    return findings
+    """``{filename: source}`` -> lock-order findings (acquisition-order
+    cycles + non-reentrant re-entry).  Since ISSUE 14 this delegates to
+    the repo-wide concurrency verifier's lock-graph pass
+    (``hetu_tpu/analysis/concurrency.py``: lexical with-nesting +
+    held-call propagation, now ACROSS modules) — one engine, no drift.
+    The full detector set (shared-state, blocking-under-lock,
+    wait-loops) rides :func:`run_concurrency`."""
+    eng = concurrency_engine()
+    model = eng.build_model(sources)
+    # parse failures stay findings (an unparseable file has unanalyzed
+    # locks — the pre-delegation behavior)
+    return model.errors + eng.check_lock_graph(model)
 
 
 # ------------------------------------------------------------------ opcodes
@@ -535,13 +412,27 @@ def _read_tree(root, rel):
     return out
 
 
+def run_concurrency(root=REPO, sources=None):
+    """The ISSUE 14 concurrency verifier over the WHOLE package (every
+    plane: ps/, serving/, parallel/, graph/, obs/, data/ and top-level
+    modules) — also part of :func:`run_all`'s tier-1 gate.  ``sources``
+    lets a caller that already read the tree skip the second disk walk."""
+    eng = concurrency_engine(root)
+    return eng.check_concurrency(
+        sources if sources is not None else eng.scan_package(root))
+
+
 def run_all(root=REPO, style_dirs=("hetu_tpu", "tools")):
     """All checks over the repo; returns the flat findings list."""
     pkg = _read_tree(root, "hetu_tpu")
     ps = {k: v for k, v in pkg.items()
           if k.replace(os.sep, "/").startswith("hetu_tpu/ps/")}
     findings = []
-    findings += check_lock_order(ps)
+    # ISSUE 14: the lock-order pass grew into the repo-wide concurrency
+    # verifier — run_concurrency covers the old ps/-local lock-order
+    # check (same engine, whole package) plus the new detectors; pkg is
+    # the same {relpath: source} map scan_package would rebuild
+    findings += run_concurrency(root, sources=pkg)
     findings += check_opcodes(ps)
     metrics_key = os.path.join("hetu_tpu", "metrics.py")
     profiler_key = os.path.join("hetu_tpu", "profiler.py")
@@ -555,15 +446,26 @@ def run_all(root=REPO, style_dirs=("hetu_tpu", "tools")):
 
 
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
+    conc_only = "--concurrency" in argv
+    if conc_only:
+        argv.remove("--concurrency")
+    if any(a in ("-h", "--help") for a in argv):
+        print("usage: hetu_lint.py [--concurrency] [root]")
+        return 0
+    bad = [a for a in argv if a.startswith("-")]
+    if bad:
+        print(f"hetu_lint: unknown option {bad[0]!r} "
+              f"(usage: hetu_lint.py [--concurrency] [root])")
+        return 2
     root = argv[0] if argv else REPO
-    findings = run_all(root)
+    findings = run_concurrency(root) if conc_only else run_all(root)
     for f in findings:
         print(f"hetu_lint: {f}")
     if findings:
         print(f"hetu_lint: {len(findings)} finding(s)")
         return 1
-    print("hetu_lint: clean")
+    print("hetu_lint: clean" + (" (concurrency)" if conc_only else ""))
     return 0
 
 
