@@ -90,7 +90,8 @@ class GraphInfo:
     """What a lint rule sees: topo + static shapes + executor config."""
 
     def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
-                 feed_values=None, zero=0, serving=False, remat="off"):
+                 feed_values=None, zero=0, serving=False, remat="off",
+                 plan=None):
         self.shapes = shapes
         self.topo = shapes.topo
         self.feeds = feeds
@@ -99,6 +100,12 @@ class GraphInfo:
         self.feed_values = feed_values or {}
         self.mesh = mesh
         self.pipeline = pipeline
+        #: the auto-parallel ParallelPlan the executor will compile under
+        #: (``Executor(plan=...)``) — enables the plan-coverage rule and
+        #: escalates plan-managed mesh-axis findings to errors (an
+        #: unrealizable plan must fail fast, not silently measure the
+        #: wrong program)
+        self.plan = plan
         #: requested ZeRO stage (Executor(zero=...)); 0 = off
         self.zero = int(zero or 0)
         #: True when linting a SERVING fetch set (InferenceExecutor):
@@ -334,16 +341,41 @@ _MESH_AXIS_OPS = {
 }
 
 
+#: axes the auto-parallel strategy space manages — a plan-validated graph
+#: missing one of THESE is an illegal plan (error), while e.g. an 'ep'
+#: sharding replicating on a dp-only plan mesh is the intended dense
+#: fallback (stays a warning)
+_PLAN_AXES = frozenset(("dp", "tp", "pp", "cp"))
+
+
 @rule("mesh-axis")
 def _r_mesh_axis(gi):
     if gi.mesh is None:
         return  # single-device run: fallback paths are the intended paths
     axes = set(gi.mesh.axis_names)
+
+    plan_axes = frozenset()
+    if gi.plan is not None:
+        try:
+            plan_axes = frozenset(a for a, s in gi.plan.mesh_axes().items()
+                                  if s > 1) & _PLAN_AXES
+        except Exception:
+            plan_axes = _PLAN_AXES   # unpriceable plan: stay strict
+
+    def sev(involved):
+        # under Executor(plan=...): an axis the plan ACTUALLY USES going
+        # silently replicated/fallback is an unrealizable plan — fail
+        # fast.  Axes the plan sets to 1 stay warnings: a
+        # pipeline_block-built model under a pp=1 plan (or ring
+        # attention under cp=1) falls back to exactly the
+        # single-stage/dense program the cost model priced.
+        return "error" if set(involved) & plan_axes else "warn"
+
     for node in gi.topo:
         want = _MESH_AXIS_OPS.get(node.op_type)
         if want and not any(a in axes for a in want):
             yield Diagnostic(
-                "mesh-axis", "warn",
+                "mesh-axis", sev(want),
                 f"{node.op_type} '{node.name}' expects mesh axis "
                 f"'{want[0]}' but the executor mesh has axes "
                 f"{sorted(axes)} — it will silently run its "
@@ -355,7 +387,7 @@ def _r_mesh_axis(gi):
                        and a not in axes]
             if missing:
                 yield Diagnostic(
-                    "mesh-axis", "warn",
+                    "mesh-axis", sev(missing),
                     f"sharding of '{node.name}' names mesh axes "
                     f"{missing} absent from the executor mesh "
                     f"{sorted(axes)} — those dims will be REPLICATED",
@@ -399,6 +431,94 @@ def _r_pipeline(gi):
             f"are not contiguous in graph order (first bounce at "
             f"'{first_bounce.name}'); group each stage's ops together",
             first_bounce)
+
+
+@rule("plan-coverage")
+def _r_plan_coverage(gi):
+    """An ``Executor(plan=...)`` graph must actually REALIZE the plan:
+    tp directives need 'tp' shardings on some kernel (``plan.apply`` /
+    ``plan.bind``), pp needs a ``ht.pipeline_block``-built model, cp
+    needs ring/ulysses attention ops, fsdp needs either the ZeRO slab
+    route (``zero>=1``) or 'dp' param shardings.  Anything less silently
+    executes (and measures!) a different program than the plan the
+    search costed."""
+    plan = gi.plan
+    if plan is None:
+        return
+    try:
+        need = plan.mesh_axes()
+        directives = plan.layer_specs()
+    except Exception as e:
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan is not executable as a single mesh: {e}")
+        return
+    axes = set(gi.mesh.axis_names) if gi.mesh is not None else set()
+    missing = sorted(a for a, s in need.items() if s > 1 and a not in axes)
+    if missing:
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan needs mesh axes {missing} but the executor mesh has "
+            f"{sorted(axes)} — pass the plan's own mesh "
+            f"(ParallelPlan.make_mesh) or rebuild the executor without "
+            f"an explicit mesh=")
+
+    def _axes_of(spec):
+        out = set()
+        for a in spec or ():
+            if isinstance(a, (tuple, list)):
+                out.update(a)
+            elif a is not None:
+                out.add(a)
+        return out
+
+    annotated = set()
+    for node in gi.topo:
+        annotated |= _axes_of(getattr(node, "sharding", None))
+
+    def _layers(pred):
+        names = [d["name"] for d in directives if pred(d)]
+        more = f" (+{len(names) - 3} more)" if len(names) > 3 else ""
+        return ", ".join(names[:3]) + more
+
+    if any(d["tp"] > 1 for d in directives) and "tp" not in annotated:
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan assigns tp>1 to layer(s) [{_layers(lambda d: d['tp'] > 1)}] but no "
+            f"graph node carries a 'tp' sharding — the plan was never "
+            f"applied; bind the model layers (plan.bind(layers)) or call "
+            f"plan.apply(layers) before building the executor")
+    if max(s.pp for s in plan.strategies) > 1 \
+            and not any(n.op_type == "PipelineBlock" for n in gi.topo):
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan assigns {max(s.pp for s in plan.strategies)} pipeline "
+            f"stages but the graph has no PipelineBlock — build the "
+            f"model with ht.pipeline_block and the plan's stage "
+            f"assignment")
+    if max(s.cp for s in plan.strategies) > 1 \
+            and not any(n.op_type.startswith(("RingAttention",
+                                              "UlyssesAttention"))
+                        for n in gi.topo):
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan assigns cp={max(s.cp for s in plan.strategies)} "
+            f"context parallelism to layer(s) [{_layers(lambda d: d['cp'] > 1)}] but the "
+            f"graph has no ring/ulysses attention — build attention with "
+            f"context_parallel='ring' (or 'ulysses')")
+    # fires for ANY unrealized fsdp directive — including tp>1 plans
+    # (wants_zero() False, so the slab route never covers them): without
+    # zero or 'dp' param shardings the params replicate and the search's
+    # memory feasibility verdict silently does not hold
+    if any(d["fsdp"] for d in directives) and not gi.zero \
+            and "dp" not in annotated:
+        yield Diagnostic(
+            "plan-coverage", "error",
+            f"plan assigns fsdp to layer(s) [{_layers(lambda d: d['fsdp'])}] but "
+            f"zero= is off and no param carries a 'dp' sharding — the "
+            f"fsdp memory verdict would not hold at runtime; pass "
+            f"Executor(zero=3) (the default when plan= sets the "
+            f"strategy) or apply the plan's param specs")
 
 
 #: attention op types -> (index of k input, index of mask input or None,
@@ -662,7 +782,7 @@ def _r_train_only_serving(gi):
 
 def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
          num_microbatches=None, rules=None, zero=0, serving=False,
-         remat="off"):
+         remat="off", plan=None):
     """Statically verify a fetch subgraph; returns a :class:`LintReport`.
 
     ``feeds``: example values (or bare shapes) for placeholders declared
@@ -672,6 +792,9 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
     (enables the mesh-axis, pipeline-stage, zero-sharding and
     remat-policy rules, and keeps schedule-sensitive lowering on the
     same path the executor uses).
+    ``plan``: the auto-parallel :class:`ParallelPlan` the executor will
+    compile under (``Executor(plan=...)``) — enables the plan-coverage
+    rule and escalates plan-managed mesh-axis findings to errors.
     ``serving=True``: lint the fetches as a SERVING set (enables the
     train-only-op-in-serving rule — what
     ``InferenceExecutor(validate=...)`` runs; pair with
@@ -695,7 +818,7 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
                 feed_values[node] = v
     gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
                    mesh=mesh, pipeline=pipeline, feed_values=feed_values,
-                   zero=zero, serving=serving, remat=remat)
+                   zero=zero, serving=serving, remat=remat, plan=plan)
     diags = []
     selected = RULES if rules is None else {
         name: RULES[name] for name in rules}

@@ -15,9 +15,12 @@ from .cost_model import (HardwareSpec, LayerSpec, MemoryCostModel, Strategy,
                          TimeCostModel, transformer_layer_spec,
                          attention_layer_spec, mlp_layer_spec,
                          embedding_layer_spec, model_layer_specs,
-                         swin_layer_specs, graph_layer_spec)
-from .search import DPAlg, candidate_strategies, search
+                         swin_layer_specs, graph_layer_spec,
+                         graph_layer_specs, bert_split)
+from .search import DPAlg, candidate_strategies, search, search_graph
 from .plan import ParallelPlan
+from .measure import (PlanMeasurement, measure_plan, measure_plans,
+                      plan_diff, format_plan_diff)
 
 
 def calibrate_hardware(mesh=None, mem_bytes=None,
@@ -182,6 +185,8 @@ def long_context_cp_plan(n_devices, mem_bytes=2.5e9, hw=None, layers=4,
 __all__ = ["HardwareSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
            "long_context_cp_plan", "Strategy", "transformer_layer_spec", "attention_layer_spec",
            "mlp_layer_spec", "embedding_layer_spec", "model_layer_specs",
-           "swin_layer_specs",
-           "DPAlg", "candidate_strategies", "search", "ParallelPlan",
+           "swin_layer_specs", "graph_layer_spec", "graph_layer_specs",
+           "bert_split", "DPAlg", "candidate_strategies", "search", "search_graph",
+           "ParallelPlan", "PlanMeasurement", "measure_plan",
+           "measure_plans", "plan_diff", "format_plan_diff",
            "calibrate_hardware", "measure_overlap"]

@@ -181,6 +181,18 @@ class TimeCostModel:
         self.hw = hw
         self.microbatches = max(1, microbatches)
 
+    @classmethod
+    def calibrated(cls, mesh=None, microbatches=1, **probe_kw):
+        """Construct over THIS machine's measured constants: matmul-probe
+        FLOP/s, allreduce bandwidth and the measured compute/comm overlap
+        coefficient from :func:`~hetu_tpu.autoparallel.calibrate_hardware`
+        — the profile leg of the Galvatron workflow wired directly into
+        cost-model construction (previously callers had to plumb the
+        measured spec by hand, so defaults were what actually priced
+        searches)."""
+        spec = HardwareSpec.measure(mesh=mesh, **probe_kw)
+        return cls(spec, microbatches=microbatches)
+
     def layer_time(self, spec: LayerSpec, s: Strategy):
         hw = self.hw
         # fwd+bwd ≈ 3× fwd flops, spread over tp*dp*cp devices (batch over
@@ -410,29 +422,106 @@ def matmul_flops(node, gs, out_shape):
 _matmul_flops = matmul_flops      # original (private) alias, kept
 
 
-def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
-                     count=1):
-    """Derive a :class:`LayerSpec` from a REAL fetch subgraph.
+#: groups "<prefix>.layer<N>.<rest>" node names into one bucket per layer
+#: (the ``models/`` naming convention: bert.layer3.ffn1, gpt2.layer0.attn)
+_LAYER_NAME_RE = None   # compiled lazily (re import stays function-local)
+
+
+def _default_split(node_name):
+    """Bucket key for :func:`graph_layer_specs`' default segmentation, or
+    None to stay in the current bucket."""
+    global _LAYER_NAME_RE
+    if _LAYER_NAME_RE is None:
+        import re
+        _LAYER_NAME_RE = re.compile(r"^(.*?\.layer\d+)(?:\.|$)")
+    m = _LAYER_NAME_RE.match(node_name or "")
+    return m.group(1) if m else None
+
+
+def bert_split(node_name):
+    """:func:`graph_layer_specs` ``split`` for bert-style graphs: the
+    ``<prefix>.layer<N>`` anchors plus explicit stem/head routing —
+    the default split alone merges the trailing MLM head (and pooler)
+    into the LAST encoder layer and the embeddings into the stem."""
+    if not node_name:
+        return None
+    if ".embeddings" in node_name:
+        return "embeddings"
+    if ".mlm_" in node_name or ".pooler" in node_name:
+        return "head"
+    return _default_split(node_name)
+
+
+def graph_layer_specs(fetches, feeds=None, split=None, name="graph",
+                      dtype_bytes=4):
+    """Per-layer :class:`LayerSpec` chain from a REAL fetch subgraph —
+    the end-to-end pricing path (callers previously hand-assembled layer
+    lists from model dims; this walks the graph that will actually
+    compile).
 
     Uses the static shape assignment from
     :func:`hetu_tpu.analysis.infer_graph` (every node's ``(shape, dtype)``
-    with zero FLOPs — no more ``None`` holes), so the cost model prices
-    the graph that will actually compile instead of a hand-derived
-    approximation:
+    with zero FLOPs — no ``None`` holes).  Per bucket:
 
     * ``param_bytes`` — sum over trainable variable leaves,
     * ``fwd_flops`` — 2·M·N·K over every matmul-family node (attention
       score/value contractions counted from q/k shapes),
     * ``act_bytes`` — sum of output bytes over compute nodes (the
       activation liveset upper bound that remat/pipeline p2p trade in).
-    """
+
+    ``split``: callable ``node_name -> bucket key | None`` (None = no
+    opinion).  The default groups by the ``<prefix>.layer<N>`` naming
+    convention the ``models/`` builders follow.  Auto-named compute
+    nodes INHERIT the bucket of their inputs (a matmul consuming
+    ``bert.layer0.ffn1.weight`` belongs to ``bert.layer0``; downstream
+    elementwise ops follow their producers) — layer params are the
+    naming anchors, so attribution tracks dataflow, not topo accidents.
+    A node whose inputs span several buckets joins the latest-created
+    one (a residual add of layer i-1's output and layer i's branch is
+    layer i work); nodes with no named ancestor land in
+    ``"<name>.stem"``.  Pass forward fetches (the loss), not the
+    optimizer op — :class:`TimeCostModel` applies the fwd+bwd
+    multiplier itself.
+
+    Returns the buckets as LayerSpecs in first-seen topo order; a graph
+    with no matching names collapses to one whole-graph spec (exactly
+    :func:`graph_layer_spec`)."""
     import numpy as np
     from ..analysis.shapes import infer_graph
     from ..graph.node import PlaceholderOp
 
+    if split is None:
+        split = _default_split
     gs = infer_graph(fetches, feeds=feeds)
-    params = flops = acts = 0.0
-    attn = False
+    stem = f"{name}.stem"
+    order = []                   # bucket keys, first-seen topo order
+    acc = {}                     # key -> [params, flops, acts, attn]
+    node_bucket = {}             # node -> its bucket key
+
+    def _acc_of(key):
+        if key not in acc:
+            order.append(key)
+            acc[key] = [0.0, 0.0, 0.0, False]
+        return acc[key]
+
+    def _assign(node):
+        key = split(getattr(node, "name", None))
+        if key is None:
+            # inherit from inputs: the latest-created NAMED bucket wins
+            # (stem is the no-opinion bucket — a mask reshape feeding
+            # every attention layer must not capture them)
+            best = -1
+            for inp in getattr(node, "inputs", ()) or ():
+                k = node_bucket.get(inp)
+                if k is not None and k != stem:
+                    idx = order.index(k)
+                    if idx > best:
+                        best, key = idx, k
+        if key is None:
+            key = stem
+        node_bucket[node] = key
+        return key
+
     for node in gs.topo:
         st = gs.struct(node)
         if st is None or isinstance(st, (tuple, list)):
@@ -441,13 +530,19 @@ def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
             else float(dtype_bytes)
         if isinstance(node, PlaceholderOp):
             if node.is_variable and getattr(node, "trainable", False):
-                params += nbytes
+                key = _assign(node)
+                _acc_of(key)[0] += nbytes
+            else:
+                # non-variable placeholders (feeds) anchor nothing: let
+                # compute inherit from params, not from input ids
+                node_bucket[node] = None
             continue
-        acts += nbytes
+        b = _acc_of(_assign(node))
+        b[2] += nbytes
         if node.op_type in _MATMUL_OPS or node.op_type == "Einsum":
             f = _matmul_flops(node, gs, st.shape)
             if f:
-                flops += f
+                b[1] += f
         elif node.op_type.startswith(_ATTN_OPS) and len(node.inputs) >= 2:
             q = gs.shape(node.inputs[0])
             kv = gs.shape(node.inputs[1])
@@ -455,14 +550,31 @@ def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
                 b_h = float(np.prod(q[:-2]))
                 s_q, d = float(q[-2]), float(q[-1])
                 s_kv = float(kv[-2])
-                attn = True
-                flops += 2.0 * 2.0 * b_h * s_q * s_kv * d  # scores + values
-    return LayerSpec(name, params, flops, acts, count=count, attn=attn)
+                b[3] = True
+                b[1] += 2.0 * 2.0 * b_h * s_q * s_kv * d  # scores + values
+    if not acc:
+        return [LayerSpec(name, 0.0, 0.0, 0.0)]
+    return [LayerSpec(k, *acc[k][:3], count=1, attn=acc[k][3])
+            for k in order]
+
+
+def graph_layer_spec(fetches, feeds=None, name="graph", dtype_bytes=4,
+                     count=1):
+    """One fused :class:`LayerSpec` for a REAL fetch subgraph — the
+    single-bucket view of :func:`graph_layer_specs` (same walk, same
+    numbers; ``obs.graph_flops`` and the remat planner read this)."""
+    specs = graph_layer_specs(fetches, feeds=feeds,
+                              split=lambda _n: None, name=name,
+                              dtype_bytes=dtype_bytes)
+    merged = specs[0]
+    merged.name = name
+    merged.count = count
+    return merged
 
 
 __all__ = ["Strategy", "LayerSpec", "HardwareSpec", "MemoryCostModel",
            "TimeCostModel", "transformer_layer_spec",
            "attention_layer_spec", "mlp_layer_spec",
            "embedding_layer_spec", "model_layer_specs",
-           "swin_layer_specs", "graph_layer_spec",
-           "MATMUL_OPS", "matmul_flops"]
+           "swin_layer_specs", "graph_layer_spec", "graph_layer_specs",
+           "bert_split", "MATMUL_OPS", "matmul_flops"]

@@ -13,12 +13,127 @@ from .cost_model import Strategy
 
 class ParallelPlan:
     def __init__(self, specs, strategies, n_devices, est_time=None,
-                 microbatches=1):
+                 microbatches=1, hw=None):
         self.specs = list(specs)
         self.strategies = list(strategies)
         self.n_devices = n_devices
         self.est_time = est_time
         self.microbatches = microbatches
+        #: HardwareSpec the search priced this plan under (plan_diff's
+        #: default when re-pricing per layer)
+        self.hw = hw
+        #: alternate plans from the same search (``search(topk=)``),
+        #: est_time-ordered with this plan first; :meth:`rerank` re-orders
+        #: them from measurements
+        self.candidates = None
+        #: measured step seconds (set by rerank / autoparallel.measure)
+        self.measured_time = None
+        self._layers = None
+
+    # -- executor integration ------------------------------------------------
+    def bind(self, layers):
+        """Remember the model layers this plan should annotate, so
+        ``Executor(plan=...)`` can apply the per-layer directives itself
+        (zero-composition-aware: the executor knows the resolved ZeRO
+        stage, the caller usually does not).  Returns self (chainable)."""
+        self._layers = list(layers)
+        return self
+
+    def realize(self, zero=0, strict=True):
+        """Executor hook: annotate the bound layers (no-op when nothing
+        is bound — dp/fsdp-only plans need no per-layer annotations, and
+        a caller may have applied the plan by hand)."""
+        if self._layers is not None:
+            self.apply(self._layers, strict=strict, zero=zero)
+
+    def wants_zero(self):
+        """True when this plan's ``fsdp`` sharding should be realized by
+        the ZeRO slab machinery (``Executor(zero=3)``, parallel/zero.py)
+        rather than per-param GSPMD annotations: every fsdp directive is
+        tp-unsharded, so no kernel needs a combined (dp, tp) spec.  (A
+        tp-sharded kernel carries an explicit dispatch annotation, which
+        makes its optimizer ineligible for slab packing — those plans
+        keep the GSPMD fsdp path.)"""
+        return any(s.fsdp for s in self.strategies) \
+            and max(s.tp for s in self.strategies) == 1
+
+    def fingerprint(self):
+        """Content hash of everything that makes this plan THIS plan
+        (specs, per-layer strategies, device count, microbatches) — keyed
+        into the compiled-step-cache signature so two executors differing
+        only in plan never alias one executable."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(f"{self.n_devices}|{self.microbatches}".encode())
+        for spec, s in zip(self.specs, self.strategies):
+            h.update(f"|{spec.name}x{spec.count}:{s}".encode())
+        return h.hexdigest()[:16]
+
+    def tag(self):
+        """Short human tag: the uniform strategy string (``pp1-tp1-dp8``),
+        or ``mixed-<fingerprint>`` for heterogeneous plans — labels the
+        per-plan ``step_time_us`` histograms and MFU gauges."""
+        if self.uniform:
+            return str(self.strategies[0])
+        return f"mixed-{self.fingerprint()[:8]}"
+
+    def rerank(self, measurements):
+        """Re-order :attr:`candidates` by MEASURED step time and return
+        the measured-best plan — the feedback leg that lets the search
+        correct a mispriced cost model.
+
+        ``measurements``: the ``autoparallel.measure.measure_plans``
+        result list (matched to candidates by plan identity, falling back
+        to position), a ``{index: seconds}`` dict, or a list of seconds
+        aligned with :attr:`candidates`.  Unmeasured candidates sort
+        after measured ones by predicted time.  Records
+        ``autoparallel_rerank_flips`` when the measured best differs from
+        the predicted best."""
+        from ..metrics import record_autoparallel
+        cands = self.candidates or [self]
+        secs = {}
+        if isinstance(measurements, dict):
+            secs = {int(i): float(s) for i, s in measurements.items()}
+        else:
+            for i, m in enumerate(measurements):
+                plan = getattr(m, "plan", None)
+                s = getattr(m, "seconds", None)
+                if s is None and not hasattr(m, "plan"):
+                    s = float(m)
+                idx = next((j for j, c in enumerate(cands) if c is plan),
+                           i if i < len(cands) else None)
+                if idx is not None and s is not None:
+                    secs[idx] = float(s)
+        for i, s in secs.items():
+            cands[i].measured_time = s
+        order = sorted(
+            range(len(cands)),
+            key=lambda i: (0, secs[i]) if i in secs
+            else (1, cands[i].est_time or 0.0))
+        reordered = [cands[i] for i in order]
+        if reordered[0] is not cands[0]:
+            record_autoparallel("autoparallel_rerank_flips")
+        best = reordered[0]
+        best.candidates = reordered
+        self.candidates = reordered
+        return best
+
+    def make_mesh(self, devices=None):
+        """The plan's mesh over the first ``n_devices`` devices (what
+        ``Executor(plan=...)`` compiles against)."""
+        import jax
+
+        from ..context import make_mesh
+        axes = self.mesh_axes()
+        n = 1
+        for v in axes.values():
+            n *= v
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) < n:
+            raise ValueError(
+                f"plan mesh {axes} needs {n} devices, "
+                f"got {len(devices)}")
+        return make_mesh(axes, devices[:n])
 
     # -- mesh emission -------------------------------------------------------
     @property
@@ -55,7 +170,7 @@ class ParallelPlan:
         from ..parallel.strategies import DataParallel, ModelParallel
         axes = self.mesh_axes()
         if set(axes) <= {"dp"}:
-            return DataParallel()
+            return DataParallel(num_devices=self.n_devices)
         return ModelParallel(axes)
 
     # -- layer sharding directives ------------------------------------------
@@ -101,7 +216,7 @@ class ParallelPlan:
             })
         return out
 
-    def apply(self, layers, strict=True):
+    def apply(self, layers, strict=True, zero=0):
         """Annotate model layers in place.
 
         ``layers``: sequence of objects exposing (any of) ``weight_var`` /
@@ -111,13 +226,21 @@ class ParallelPlan:
         kernel over 'dp' (ZeRO-style param sharding — without this the
         MemoryCostModel's feasibility verdict would not hold at runtime).
 
+        ``zero``: the executor's resolved ZeRO stage.  When it is on and
+        :meth:`wants_zero` holds, the fsdp directives are realized by the
+        slab machinery (``parallel/zero.py``) and the per-param 'dp'
+        dispatch here is SKIPPED — an annotated param would make its
+        optimizer ineligible for slab packing, so dispatching both would
+        silently disable the very mechanism meant to realize the plan
+        (the double-sharding trap ``Executor(plan=...)`` guards).
+
         Stage ('pp') directives cannot restructure an already-built model:
         they are realized by building with ``ht.pipeline_block``; with
         ``strict=True`` (default) a plan that needs pp raises here instead
         of silently executing un-pipelined.
         """
         import warnings
-        from ..parallel.dispatch import dispatch
+        from ..parallel.dispatch import apply_plan_directive
         directives = self.layer_specs()
         if len(layers) != len(directives):
             raise ValueError(
@@ -141,31 +264,9 @@ class ParallelPlan:
                 raise ValueError(msg)
             warnings.warn(msg)
 
-        def _kernels(layer):
-            ks = list(getattr(layer, "in_kernels", []) or []) \
-                + list(getattr(layer, "out_kernels", []) or [])
-            w = getattr(layer, "weight_var", None)
-            if w is not None and w not in ks:
-                ks.append(w)
-            return ks
-
+        fsdp_via_zero = bool(zero) and self.wants_zero()
         for layer, d in zip(layers, directives):
-            if d["tp"] > 1:
-                for v in getattr(layer, "in_kernels", []):
-                    dispatch(v, d["kernel_spec"])
-                for v in getattr(layer, "out_kernels", []):
-                    dispatch(v, d["out_kernel_spec"])
-                w = getattr(layer, "weight_var", None)
-                if w is not None and not getattr(layer, "in_kernels", None):
-                    dispatch(w, d["kernel_spec"])
-            if d["fsdp"]:
-                # ZeRO-style: params sharded over 'dp'; XLA inserts the
-                # all-gather before use. tp-sharded kernels already carry
-                # the combined (dp, tp) spec from the branch above; this
-                # covers the remaining (tp-unsharded) kernels
-                for v in _kernels(layer):
-                    if getattr(v, "sharding", None) is None:
-                        dispatch(v, d["param_spec"])
+            apply_plan_directive(layer, d, fsdp_via_zero=fsdp_via_zero)
         return directives
 
     def describe(self):

@@ -73,10 +73,16 @@ class DPAlg:
 
     def __init__(self, specs, n_devices, hw=None, microbatches=1,
                  remat=False, allow_pp=True, allow_fsdp=True, max_tp=None,
-                 allow_cp=False, max_cp=None, max_dp=None):
+                 allow_cp=False, max_cp=None, max_dp=None, calibrate=False):
         self.specs = list(specs)
-        # unspecified hardware: prefer the committed on-chip calibration
-        # artifact over the built-in defaults (profile→search workflow)
+        # unspecified hardware: live calibration when asked for
+        # (``calibrate=True`` — the profile leg of the Galvatron workflow
+        # wired straight into construction; pass a mesh to also measure
+        # collective bandwidth/overlap over it), else the committed
+        # on-chip calibration artifact, else the built-in defaults
+        if hw is None and calibrate:
+            hw = HardwareSpec.measure(
+                mesh=calibrate if calibrate is not True else None)
         self.hw = hw or HardwareSpec.from_artifact() or HardwareSpec()
         self.mem = MemoryCostModel(self.hw, microbatches, remat)
         self.time = TimeCostModel(self.hw, microbatches)
@@ -146,33 +152,76 @@ class DPAlg:
 
 
 def search(specs, n_devices, hw=None, microbatches=1, remat=False,
-           uniform=False, **kw):
+           uniform=False, topk=1, calibrate=False, **kw):
     """Top-level search → :class:`ParallelPlan`.
 
     ``uniform=True`` restricts to one strategy for all layers (the common
     deployment case; also what the executor's single-mesh emission needs).
+    ``calibrate=True`` (or a mesh) measures the HardwareSpec live instead
+    of artifact/defaults when ``hw`` is not given.
+    ``topk > 1`` additionally attaches the k best feasible UNIFORM
+    alternates as ``plan.candidates`` (est_time-ordered, the returned
+    plan first) — the measurement loop
+    (``autoparallel.measure.measure_plans`` → ``plan.rerank``) runs these
+    for real and re-orders them by measured step time.
     """
+    from ..metrics import record_autoparallel
     from .plan import ParallelPlan
     alg = DPAlg(specs, n_devices, hw=hw, microbatches=microbatches,
-                remat=remat, **kw)
-    if uniform:
-        best = (float("inf"), None)
+                remat=remat, calibrate=calibrate, **kw)
+    # feasible uniform chains, fastest first (the uniform answer AND the
+    # alternate pool for topk — a DP primary's alternates are the uniform
+    # plans the executor could equally compile)
+    scored = []
+    if uniform or topk > 1:          # only these paths consume the sweep
         for s in alg.cands:
             strategies = [s] * len(specs)
             if not alg.mem.fits(specs, strategies):
                 continue
-            t = alg.time.total(specs, strategies)
-            if t < best[0]:
-                best = (t, strategies)
-        t, strategies = best
+            scored.append((alg.time.total(specs, strategies), strategies))
+        scored.sort(key=lambda e: e[0])
+    if uniform:
+        t, strategies = scored[0] if scored else (float("inf"), None)
     else:
         t, strategies = alg.fit()
     if strategies is None:
         raise ValueError(
             "no feasible strategy under the memory budget; raise mem_bytes, "
             "enable remat, or increase device count")
-    return ParallelPlan(specs, strategies, n_devices, est_time=t,
-                        microbatches=microbatches)
+    plan = ParallelPlan(specs, strategies, n_devices, est_time=t,
+                        microbatches=microbatches, hw=alg.hw)
+    if topk > 1:
+        cands = [plan]
+        for tt, st in scored:
+            if len(cands) >= topk:
+                break
+            if st == plan.strategies:
+                continue
+            alt = ParallelPlan(specs, st, n_devices, est_time=tt,
+                               microbatches=microbatches, hw=alg.hw)
+            cands.append(alt)
+        cands.sort(key=lambda p: p.est_time)
+        plan.candidates = cands
+    record_autoparallel("autoparallel_plans_searched")
+    return plan
 
 
-__all__ = ["DPAlg", "candidate_strategies", "search"]
+def search_graph(fetches, n_devices, feeds=None, hw=None, calibrate=False,
+                 split=None, dtype_bytes=4, name="graph", **kw):
+    """Search a REAL fetch subgraph end-to-end: per-layer
+    :class:`LayerSpec`s inferred from the graph that will actually
+    compile (:func:`~hetu_tpu.autoparallel.cost_model.graph_layer_specs`
+    — shape-inferred params/FLOPs/activations bucketed by the
+    ``<prefix>.layer<N>`` naming convention, or a custom ``split``), then
+    the standard layerwise DP.  Pass FORWARD fetches (the loss), not the
+    optimizer op — the time model applies the fwd+bwd multiplier itself.
+
+    ``feeds``: example values/shapes for placeholders declared without a
+    static shape (same contract as ``ht.lint``)."""
+    from .cost_model import graph_layer_specs
+    specs = graph_layer_specs(fetches, feeds=feeds, split=split,
+                              name=name, dtype_bytes=dtype_bytes)
+    return search(specs, n_devices, hw=hw, calibrate=calibrate, **kw)
+
+
+__all__ = ["DPAlg", "candidate_strategies", "search", "search_graph"]

@@ -1480,9 +1480,31 @@ class Executor:
         else:
             self.eval_node_dict = {"default": list(eval_node_dict)}
         # ZeRO-style weight-update sharding (parallel/zero.py): kwarg wins,
-        # then HETU_ZERO, then the strategy's own zero= setting — resolved
-        # to a stage AFTER dist_strategy lands (below)
+        # then HETU_ZERO, then the strategy's own zero= setting, then the
+        # plan's fsdp default — resolved to a stage AFTER dist_strategy
+        # lands (below)
         zero_arg = kwargs.pop("zero", None)
+        # plan=: a searched ParallelPlan (hetu_tpu.autoparallel) drives
+        # the whole distribution setup — its mesh axes become the
+        # executor mesh, its strategy the dist_strategy, its fsdp axis
+        # routes through the ZeRO slab machinery (ONE sharding mechanism,
+        # never two), and its fingerprint keys the compiled-step cache so
+        # candidate plans measured back-to-back each get (exactly) one
+        # compile.  The plan is validated against the graph by the
+        # mesh-axis / pipeline-stage / plan-coverage lints BEFORE any
+        # compile — an illegal plan fails at construction with the
+        # offending layer + creation site, not minutes into XLA.
+        self.plan = kwargs.pop("plan", None)
+        self._plan_fingerprint = None
+        if self.plan is not None:
+            self._plan_fingerprint = self.plan.fingerprint()
+            if dist_strategy is None:
+                dist_strategy = self.plan.strategy()
+            if mesh is None:
+                mesh = self.plan.make_mesh()
+            if pipeline is not None and num_microbatches is None \
+                    and self.plan.microbatches > 1:
+                num_microbatches = self.plan.microbatches
         # 'bfloat16' runs fp32 matmuls as single-pass bf16 on the MXU (the
         # TPU mixed-precision fast path); None keeps jax's default
         self.matmul_precision = matmul_precision
@@ -1603,7 +1625,19 @@ class Executor:
             zero_arg = _os.environ.get("HETU_ZERO") or None
         if zero_arg is None:
             zero_arg = getattr(dist_strategy, "zero", None) or None
+        if zero_arg is None and self.plan is not None \
+                and self.plan.wants_zero():
+            # the plan's fsdp directives carry ZeRO-3 semantics in the
+            # memory model (params+states+grads / dp); realize them
+            # through the PR 6 slab machinery rather than a second
+            # (per-param GSPMD) mechanism
+            zero_arg = 3
         self.zero = _zero.resolve_stage(zero_arg)
+        if self.plan is not None:
+            # annotate bound layers now — BEFORE variables materialize
+            # (placement honors node.sharding at init) — with the
+            # resolved ZeRO stage, so fsdp is realized exactly once
+            self.plan.realize(zero=self.zero)
 
         # materialize variables once, shared across subgraphs
         all_fetches = [n for fl in self.eval_node_dict.values() for n in fl
@@ -2056,7 +2090,9 @@ class Executor:
         cross-checks) run here, so a broken graph fails at construction
         with the node name + creation site instead of minutes into XLA
         tracing.  Fed-value shapes are checked per ``run()``."""
-        if self.validate == "off":
+        if self.validate == "off" and self.plan is None:
+            # validate='off' silences the lint — but never the plan gate
+            # (below): a plan-driven executor always lints the plan rules
             return
         from ..analysis import lint as lint_graph
         # remat is a training-graph concern: eval subgraphs sharing the
@@ -2066,6 +2102,7 @@ class Executor:
         any_grads = any(getattr(s, "grad_ops", None)
                         for s in self.subexecutors.values())
         first = next(iter(self.eval_node_dict), None)
+        plan_cov = {}    # subgraph -> its plan-coverage errors (plan= only)
         for name, fetches in self.eval_node_dict.items():
             sub_grads = getattr(self.subexecutors.get(name), "grad_ops",
                                 None)
@@ -2075,14 +2112,55 @@ class Executor:
                 report = lint_graph(fetches, mesh=self.mesh,
                                     pipeline=self.pipeline,
                                     num_microbatches=self.num_microbatches,
-                                    zero=self.zero, remat=lint_remat)
+                                    zero=self.zero, remat=lint_remat,
+                                    plan=self.plan)
             except Exception as e:
+                if self.plan is not None:
+                    # with a plan attached the gate below is load-bearing:
+                    # a crashed lint would let an unrealizable plan
+                    # compile the WRONG program and the measurement loop
+                    # would time it — fail instead of warn
+                    raise
                 # the analyzer must never be the thing that breaks a
                 # working graph — report and continue
                 warnings.warn(f"graph lint crashed on subgraph "
                               f"'{name}': {type(e).__name__}: {e}",
                               RuntimeWarning)
                 continue
+            if self.plan is not None:
+                # the plan gate: an illegal plan must fail BEFORE compile
+                # regardless of validate='warn' — silently executing a
+                # plan that cannot be realized (tp never applied, pp
+                # never pipelined, a plan axis missing from the mesh)
+                # would produce measurements of the WRONG program
+                plan_bad = [
+                    d for d in report.diagnostics
+                    if not d.internal and d.severity == "error"
+                    and d.rule in ("mesh-axis", "pipeline-stage")]
+                if plan_bad:
+                    from ..analysis.lint import GraphValidationError
+                    raise GraphValidationError(
+                        f"plan validation failed on subgraph '{name}' "
+                        f"(plan {self.plan.tag()}):\n" +
+                        "\n".join(f"  {d}" for d in plan_bad))
+                # plan COVERAGE is an executor-level property: an
+                # auxiliary fetch set (a grad-norm scalar, an eval head)
+                # need not contain the plan-annotated kernels — the plan
+                # is realized if ANY subgraph carries it.  Withhold this
+                # subgraph's coverage errors (and strip them from the
+                # report so validate='warn'/'error' does not surface a
+                # per-subgraph false alarm); the gate after the loop
+                # raises if EVERY subgraph missed.
+                cov = [d for d in report.diagnostics
+                       if not d.internal and d.severity == "error"
+                       and d.rule == "plan-coverage"]
+                plan_cov[name] = cov
+                if cov:
+                    cov_ids = {id(d) for d in cov}
+                    report.diagnostics = [d for d in report.diagnostics
+                                          if id(d) not in cov_ids]
+            if self.validate == "off":
+                continue          # plan gate only — the lint stays silenced
             if report.diagnostics:
                 if self.validate == "error":
                     report.raise_errors(all_severities=True)
@@ -2091,6 +2169,16 @@ class Executor:
                     f"in subgraph '{name}' "
                     f"(Executor(validate='off') silences):\n{report}",
                     UserWarning)
+        if self.plan is not None and plan_cov \
+                and all(plan_cov.values()):
+            # no subgraph realizes the plan — the unrealized directives
+            # are a property of the whole executor, reported once
+            from ..analysis.lint import GraphValidationError
+            worst = max(plan_cov.items(), key=lambda kv: len(kv[1]))
+            raise GraphValidationError(
+                f"plan validation failed (plan {self.plan.tag()}): no "
+                f"subgraph realizes the plan — subgraph '{worst[0]}':\n"
+                + "\n".join(f"  {d}" for d in worst[1]))
 
     def _check_feeds(self, sub, feed_dict):
         """Fed values vs declared placeholder shapes/dtypes — the run-time

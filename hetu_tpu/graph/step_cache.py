@@ -234,18 +234,25 @@ def signature(sub):
             # contract is "del executor closes them"
             raise _Uncachable("PS-backed subgraph pins host resources")
         import jax
-        # v3: traced-lr schedules are part of the program (hashed in
+        # v4: traced-lr schedules are part of the program (hashed in
         # _hash_optimizer); the env gate flips every optimizer between
         # the traced and host-input paths, so it keys the signature too.
         # ex.remat is the ISSUE 13 POLICY string, and the auto/full
         # segment plan's decision fingerprint rides along — two policies
         # (or two auto plans under different HBM budgets) must never
-        # alias one compiled executable
-        _feed(h, "v3", os.environ.get("HETU_TRACED_LR", "1"),
+        # alias one compiled executable.  The auto-parallel plan
+        # fingerprint (ISSUE 15) keys candidate plans measured
+        # back-to-back: node shardings already hash below, but a plan can
+        # differ with identical annotations (fsdp-via-zero defaults,
+        # microbatch pricing) — and the measurement loop's
+        # one-compile-per-candidate accounting needs distinct candidates
+        # to be distinct entries
+        _feed(h, "v4", os.environ.get("HETU_TRACED_LR", "1"),
               jax.__version__, jax.default_backend(),
               _mesh_fingerprint(ex.mesh),
               ex.compute_dtype, ex.matmul_precision, ex.remat,
               getattr(sub, "_remat_fingerprint", None),
+              getattr(ex, "_plan_fingerprint", None),
               ex.pipeline, ex.num_microbatches, sub.name, sub.training,
               ex.zero, os.environ.get("HETU_ZERO_BUCKET_MB", ""),
               type(ex.dist_strategy).__name__ if ex.dist_strategy else "")

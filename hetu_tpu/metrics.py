@@ -297,6 +297,45 @@ def reset_concurrency_counts():
     _concurrency.reset()
 
 
+# ------------------------------------------------- autoparallel-loop counters
+# The auto-parallel search/execute/measure loop (``autoparallel/``)
+# records its lifecycle here: searches that produced a plan
+# (``autoparallel_plans_searched`` — one per :func:`search`/
+# :func:`search_graph` call), candidate executables built fresh by the
+# measurement pass (``autoparallel_plans_compiled`` — a compiled-step
+# cache miss while measuring) vs candidates whose executable was REUSED
+# (``autoparallel_candidate_cache_hits`` — the one-compile-per-candidate
+# claim: re-measuring a plan must hit, not rebuild), candidates actually
+# run for measured step times (``autoparallel_plans_measured``), and
+# re-ranks where the MEASURED ordering overturned the predicted best
+# (``autoparallel_rerank_flips`` — each flip is a mispricing the
+# feedback loop corrected).  Invariant (asserted by the tests): a run
+# that never searches or measures plans records nothing.  Surfaced by
+# ``HetuProfiler.autoparallel_counters()`` and ``tools/plan_diff.py``.
+
+_autoparallel = REGISTRY.counter_family(
+    "autoparallel",
+    "auto-parallel loop events: plans searched/compiled/measured, "
+    "candidate executable reuse, measured re-rank flips (empty without "
+    "autoparallel use)")
+
+
+def record_autoparallel(kind, n=1):
+    """Count ``n`` auto-parallel loop events of ``kind`` (searches,
+    candidate compiles/cache hits, measurements, rerank flips)."""
+    if n:
+        _autoparallel.inc(str(kind), int(n))
+
+
+def autoparallel_counts():
+    """{kind: count} snapshot of auto-parallel loop counters."""
+    return _autoparallel.counts()
+
+
+def reset_autoparallel_counts():
+    _autoparallel.reset()
+
+
 # ------------------------------------------------- cache / sparse-RPC counters
 # The HET embedding cache (``ps/dist_store.py:DistCacheTable``) and the
 # sparse transport (``DistributedStore.pull/push/push_pull``) record their
@@ -645,6 +684,7 @@ _FAMILIES = {
     "elastic": _elastic,
     "concurrency": _concurrency,
     "remat": _remat,
+    "autoparallel": _autoparallel,
     "cache": _cache,
     "zero": _zero,
     "step_cache": _step_cache,

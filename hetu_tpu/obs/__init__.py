@@ -123,6 +123,32 @@ def graph_flops(fetches, feeds=None, train=True):
     return (3.0 if train else 1.0) * float(spec.fwd_flops)
 
 
+#: bf16 peak FLOP/s per chip by device_kind prefix (public TPU spec
+#: sheets), most-specific prefix first.  THE one table — ``bench.py``
+#: and ``autoparallel.measure`` both resolve through
+#: :func:`device_peak_flops`, so a new device kind lands here once.
+TPU_PEAK_BY_KIND = (
+    ("TPU v6 lite", 918e12), ("TPU v6", 918e12),     # Trillium
+    ("TPU v5 lite", 197e12), ("TPU v5p", 459e12), ("TPU v5", 459e12),
+    ("TPU v4", 275e12), ("TPU v3", 123e12), ("TPU v2", 46e12),
+)
+
+
+def device_peak_flops():
+    """(peak_flops_per_chip, device_kind).  Unknown TPU kinds get the
+    most conservative (smallest) table entry so MFU cannot be inflated
+    by a lookup miss; non-TPU backends get a nominal 50 TF placeholder
+    (their MFU is a relative gauge, never the headline number)."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    if jax.default_backend() != "tpu":
+        return 50e12, kind
+    for prefix, peak in TPU_PEAK_BY_KIND:
+        if str(kind).startswith(prefix):
+            return peak, kind
+    return min(p for _, p in TPU_PEAK_BY_KIND), kind
+
+
 def record_mfu(label, flops_per_step, step_time_s, peak_flops):
     """Compute and publish the per-run ``mfu`` + ``step_time_ms``
     gauges: ``flops_per_step`` (see :func:`graph_flops`) over measured
@@ -140,4 +166,5 @@ __all__ = ["TRACER", "span", "event", "enabled", "enable",
            "set_track_name", "clear_trace", "flow_begin", "flow_end",
            "trace_events", "export_chrome_trace", "registry",
            "metrics_dump", "prometheus_text", "reset_all_metrics",
-           "graph_flops", "record_mfu"]
+           "graph_flops", "record_mfu", "device_peak_flops",
+           "TPU_PEAK_BY_KIND"]

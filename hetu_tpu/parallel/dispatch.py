@@ -52,3 +52,35 @@ def dispatch(node, parts):
     """
     node.sharding = parts if isinstance(parts, PartitionSpec) else _to_spec(parts)
     return node
+
+
+def apply_plan_directive(layer, directive, fsdp_via_zero=False):
+    """Attach one auto-parallel layer directive
+    (:meth:`hetu_tpu.autoparallel.ParallelPlan.layer_specs`) to a model
+    layer through this module's annotation machinery: column-parallel
+    ``kernel_spec`` on ``in_kernels``, row-parallel ``out_kernel_spec``
+    on ``out_kernels`` (the canonical Megatron pair), and — unless
+    ``fsdp_via_zero`` says the executor's ZeRO slab packing realizes the
+    fsdp sharding instead — the 'dp' ``param_spec`` on every remaining
+    un-annotated kernel (ZeRO-style GSPMD param sharding)."""
+    if directive["tp"] > 1:
+        for v in getattr(layer, "in_kernels", []) or []:
+            dispatch(v, directive["kernel_spec"])
+        for v in getattr(layer, "out_kernels", []) or []:
+            dispatch(v, directive["out_kernel_spec"])
+        w = getattr(layer, "weight_var", None)
+        if w is not None and not getattr(layer, "in_kernels", None):
+            dispatch(w, directive["kernel_spec"])
+    if directive["fsdp"] and not fsdp_via_zero:
+        # ZeRO-style: params sharded over 'dp'; XLA inserts the
+        # all-gather before use. tp-sharded kernels already carry the
+        # combined (dp, tp) spec from the branch above; this covers the
+        # remaining (tp-unsharded) kernels
+        ks = list(getattr(layer, "in_kernels", []) or []) \
+            + list(getattr(layer, "out_kernels", []) or [])
+        w = getattr(layer, "weight_var", None)
+        if w is not None and w not in ks:
+            ks.append(w)
+        for v in ks:
+            if getattr(v, "sharding", None) is None:
+                dispatch(v, directive["param_spec"])

@@ -238,7 +238,7 @@ class HetuProfiler:
         """{family: {kind: count}} over EVERY counter family on the
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
-        elastic, cache, zero, step_cache, run_plan, serve,
+        elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
         ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
@@ -330,6 +330,23 @@ class HetuProfiler:
         and no race schedule installed reports an empty dict."""
         from .metrics import concurrency_counts
         return concurrency_counts()
+
+    @staticmethod
+    def autoparallel_counters():
+        """{kind: count} of auto-parallel loop events
+        (``hetu_tpu.metrics`` registry; ``autoparallel/``): plans
+        searched (``autoparallel_plans_searched`` — one per
+        ``search``/``search_graph`` call), candidate executables built
+        fresh during measurement (``autoparallel_plans_compiled``) vs
+        reused through the compiled-step cache
+        (``autoparallel_candidate_cache_hits`` — one compile per
+        distinct candidate, re-measures hit), candidates run for
+        measured step times (``autoparallel_plans_measured``), and
+        measured re-ranks that overturned the predicted best
+        (``autoparallel_rerank_flips``).  A run that never searches or
+        measures plans reports an empty dict."""
+        from .metrics import autoparallel_counts
+        return autoparallel_counts()
 
     @staticmethod
     def cache_counters():

@@ -188,6 +188,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_elastic("elastic_shrink")
     metrics.record_concurrency("concurrency_preemptions")
     metrics.record_remat("remat_layers_rematted", 3)
+    metrics.record_autoparallel("autoparallel_plans_searched")
     metrics.record_cache("emb_cache_hit_rows", 5)
     metrics.record_zero("zero_pad_bytes", 64)
     metrics.record_step_cache("step_cache_hit")
@@ -204,6 +205,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "elastic": metrics.elastic_counts(),
         "concurrency": metrics.concurrency_counts(),
         "remat": metrics.remat_counts(),
+        "autoparallel": metrics.autoparallel_counts(),
         "cache": metrics.cache_counts(),
         "zero": metrics.zero_counts(),
         "step_cache": metrics.step_cache_counts(),

@@ -210,7 +210,7 @@ def _audit_config(name, backend, args):
     # an order of magnitude for a TPU layout); the real roofline comes
     # from tools/calibrate_tpu.py's measured constants at a healthy
     # window.  bytes_accessed stays in the detail as a CPU diagnostic.
-    V5E_PEAK_FLOPS = 197e12   # bf16, public spec (bench._TPU_PEAK_BY_KIND)
+    V5E_PEAK_FLOPS = 197e12   # bf16, public spec (obs.TPU_PEAK_BY_KIND)
     xla_flops = float(cost.get("flops", 0.0))
     compute_s = xla_flops / V5E_PEAK_FLOPS
     projection = {
