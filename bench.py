@@ -1640,6 +1640,12 @@ def _child_main(args):
         print(json.dumps(bench_serve(smoke=args.smoke,
                                      n_requests=args.steps)))
         return
+    if args.config == "decode":
+        # host-side decode-serving acceptance: continuous batching vs
+        # request-level scheduling over the same jitted step (ISSUE 16)
+        print(json.dumps(bench_decode(smoke=args.smoke,
+                                      n_requests=args.steps)))
+        return
     if args.config == "partition":
         # host-side partition-tolerance acceptance: chaos partition DSL,
         # fencing epochs, 2-cell geo-replicated serving (ISSUE 8)
@@ -2998,6 +3004,248 @@ def bench_serve(smoke=True, n_requests=None, seed=0):
     }
 
 
+def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
+    """ISSUE 16 acceptance: continuous-batching autoregressive decode.
+
+    A zipf-sized seeded request stream (prompt lengths and generation
+    budgets both skewed) decodes greedily through the
+    ``hetu_tpu.serving.decode`` plane — incremental per-layer KV caches
+    bucketed on the serving ladder, one jitted step per
+    ``(batch_bucket, len_bucket)`` pair — under two scheduling policies:
+
+    * **continuous** (the tentpole): sequences join/leave the in-flight
+      batch per token, freed KV slots recycled immediately;
+    * **request-level** (the baseline): joins only into an EMPTY engine,
+      so the whole batch drains at the pace of its slowest sequence.
+
+    Gates: the two policies produce BITWISE-identical token streams
+    (scheduling must not change results); continuous beats request-level
+    on tokens/s with a no-worse p99 time-to-token; the counter proof of
+    the compile-once steady state holds over the stream (real compiles +
+    serve-cache reuses == dispatch-plan misses == distinct bucket pairs,
+    every other step a ``plan_cache_hit``); zero rejections.  A third
+    leg times one incremental decode step against the naive full
+    re-prefill forward at every measured cache length — the
+    O(1)-vs-O(len) per-token claim.  Host-side scheduling dominates the
+    measured deltas, so CPU is a faithful backend for the policy
+    comparison (the jitted step is the same program either way)."""
+    import jax
+    from hetu_tpu import metrics as ht_metrics
+    from hetu_tpu.models import GPT2Config, gpt2_decode_graph
+    from hetu_tpu.models.gpt2 import gpt2_lm_graph
+    from hetu_tpu.serving import (DecodeEngine, DecodeRouter,
+                                  InferenceExecutor)
+    from hetu_tpu.serving.decode import _DecodeRequest
+
+    if write_artifact is None:
+        write_artifact = not smoke
+    n_requests = int(n_requests or (16 if smoke else 100))
+    max_slots = 4 if smoke else 8
+    max_len = 32 if smoke else 64
+    gen_cap = 6 if smoke else 12
+    cfg = GPT2Config.tiny(n_positions=2 * max_len, batch_size=1,
+                          seq_len=max_len)
+
+    # the seeded zipf stream: most prompts short, a heavy tail, capped so
+    # prompt + generation always fits max_len
+    rng = np.random.RandomState(seed)
+    plens = np.minimum(rng.zipf(1.5, n_requests), max_len // 2)
+    news = np.minimum(rng.zipf(1.6, n_requests) + 1, gen_cap)
+    prompts = [rng.randint(1, cfg.vocab_size, int(l)).astype(np.int32)
+               for l in plens]
+
+    def one_pass(continuous):
+        ht_metrics.reset_all()
+        feeds, logits, caches, _ = gpt2_decode_graph(cfg, max_len=max_len)
+        eng = DecodeEngine(feeds, logits, caches, max_slots=max_slots,
+                           max_len=max_len, seed=0)
+        lat_ms = []          # time-to-token over EVERY emitted token
+        with DecodeRouter(eng, queue_limit=n_requests + 8,
+                          max_wait_ms=5.0,
+                          continuous=continuous) as router:
+            t0 = time.monotonic()
+            streams = []
+            for j in range(n_requests):
+                t_sub = time.monotonic()
+                s = router.submit(prompts[j],
+                                  max_new_tokens=int(news[j]))
+                for i in range(int(news[j])):
+                    s.token(i).add_done_callback(
+                        lambda f, t=t_sub: lat_ms.append(
+                            (time.monotonic() - t) * 1e3)
+                        if not f.cancelled() and f.exception() is None
+                        else None)
+                streams.append(s)
+            tokens = [s.result(timeout=600) for s in streams]
+            wall_s = time.monotonic() - t0
+        return {
+            "tokens": tokens,
+            "lat_ms": lat_ms,
+            "wall_s": wall_s,
+            "tps": sum(len(t) for t in tokens) / wall_s,
+            "decode": ht_metrics.decode_counts(),
+            "serve": ht_metrics.serve_counts(),
+            "run_plan": ht_metrics.run_plan_counts(),
+            "step_cache": ht_metrics.step_cache_counts(),
+            "ladder": (len(eng.batch_ladder), len(eng.len_ladder)),
+        }
+
+    def run_stream(continuous):
+        # warmup pass: populate the process-wide serve cache so the
+        # measured pass times SCHEDULING, not first-touch XLA compiles
+        # (the steady state a long-lived server actually runs in; the
+        # measured pass's counters still prove the compile-once claim —
+        # its builds all land as step_cache_serve_hits)
+        one_pass(continuous)
+        return one_pass(continuous)
+
+    cont = run_stream(continuous=True)
+    reql = run_stream(continuous=False)
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q))
+
+    # --- incremental KV cache vs naive re-prefill, per cache length ------
+    # This leg uses a WIDER model than the policy streams above: the
+    # O(1)-vs-O(len) claim is about device math, and on the tiny stream
+    # model the per-step host scheduling overhead (~1ms on CPU) would
+    # drown the length-dependent term at small L.  The engine's max_len
+    # leaves headroom above the largest measured length so the timed
+    # steps never exhaust the cache and drop the sequence mid-measure.
+    lengths = (8, 16, 32) if smoke else (8, 16, 32, 64)
+    reps = 5 if smoke else 9
+    kv_max_len = 128
+    kvcfg = GPT2Config.tiny(n_positions=2 * kv_max_len, batch_size=1,
+                            seq_len=kv_max_len, n_embd=384, n_layer=4,
+                            n_head=4)
+    feeds, logits, caches, _ = gpt2_decode_graph(kvcfg,
+                                                 max_len=kv_max_len)
+    eng = DecodeEngine(feeds, logits, caches, max_slots=1,
+                       max_len=kv_max_len, seed=0)
+    per_len = []
+    for L in lengths:
+        req = _DecodeRequest(np.full(L, 3, np.int32),
+                             max_new=reps + 4, eos_id=None, fid=None)
+        eng.join(req)
+        for _ in range(L - 1):        # prefill to position L-1
+            eng.step()
+        eng.step()                    # warmup the generate-leg compile
+        ts = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            eng.step()
+            ts.append(time.perf_counter() - t)
+        eng.abort(RuntimeError("bench drain"))
+        incr_ms = float(min(ts)) * 1e3
+        # the naive alternative: one FULL forward over the L-token
+        # prefix for every generated token, including the host-side
+        # fetch + argmax the engine's step also pays
+        lcfg = GPT2Config.tiny(n_positions=2 * kv_max_len, batch_size=1,
+                               seq_len=L, n_embd=384, n_layer=4,
+                               n_head=4)
+        f2, _loss, logits2 = gpt2_lm_graph(lcfg)
+        iex_full = InferenceExecutor([logits2], buckets=(1,), seed=0,
+                                     validate="off", donate=False)
+        fn = iex_full.compiled(1)
+        ids = np.full((1, L), 3, np.int32)
+        fd = {iex_full._k(f2["input_ids"]): ids}
+        jax.block_until_ready(fn(iex_full.params, fd))    # warmup
+        ts = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = fn(iex_full.params, fd)
+            row = np.asarray(out[0]).reshape(L, -1)[L - 1]
+            int(np.argmax(row))
+            ts.append(time.perf_counter() - t)
+        reprefill_ms = float(min(ts)) * 1e3
+        per_len.append({"len": L, "incremental_ms": round(incr_ms, 3),
+                        "reprefill_ms": round(reprefill_ms, 3),
+                        "speedup": round(reprefill_ms / incr_ms, 2)})
+
+    # --- the acceptance gates --------------------------------------------
+    bitwise = cont["tokens"] == reql["tokens"]
+    steps_n = cont["decode"]["decode_steps"]
+    pairs = cont["run_plan"].get("plan_cache_miss", 0)
+    compiles = (cont["serve"].get("serve_bucket_compiles", 0)
+                + cont["step_cache"].get("step_cache_serve_hit", 0))
+    compile_once = (pairs > 0 and compiles == pairs
+                    and cont["run_plan"].get("plan_cache_hit", 0)
+                    == steps_n - pairs
+                    and pairs <= cont["ladder"][0] * cont["ladder"][1])
+    kv_wins = all(r["incremental_ms"] < r["reprefill_ms"]
+                  for r in per_len)
+    no_rejects = (cont["decode"].get("decode_rejections", 0) == 0
+                  and reql["decode"].get("decode_rejections", 0) == 0)
+    cont_p99 = pct(cont["lat_ms"], 99)
+    req_p99 = pct(reql["lat_ms"], 99)
+    perf_ok = cont["tps"] > reql["tps"] and cont_p99 <= req_p99
+    ok = bitwise and compile_once and kv_wins and no_rejects \
+        and (perf_ok or smoke)     # the perf margin gates the full run
+
+    result = {
+        "metric": "decode_tokens_per_s",
+        "value": round(cont["tps"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(cont["tps"] / reql["tps"], 3) if ok else 0.0,
+        "extra": {
+            "baseline_def": "continuous-batching tokens/s over request-"
+                            "level batching of the SAME seeded zipf "
+                            "stream (bitwise-identical token streams "
+                            "required); 0.0 unless every gate held: "
+                            "compile-once per (batch,len) bucket pair "
+                            "with plan-cache-hit steady state, "
+                            "incremental KV step faster than re-prefill "
+                            "at every measured length, zero rejections, "
+                            "and (full runs) better tokens/s at "
+                            "no-worse p99 time-to-token",
+            **_provenance({"n_requests": n_requests,
+                           "max_slots": max_slots, "max_len": max_len,
+                           "gen_cap": gen_cap, "zipf_prompt_a": 1.5,
+                           "zipf_gen_a": 1.6, "n_embd": cfg.n_embd,
+                           "n_layer": cfg.n_layer, "seed": seed,
+                           "kv_leg_n_embd": 384, "kv_leg_n_layer": 4,
+                           "kv_leg_max_len": kv_max_len,
+                           "smoke": bool(smoke)}),
+            "continuous": {
+                "tokens_per_s": round(cont["tps"], 1),
+                "p50_ms": round(pct(cont["lat_ms"], 50), 2),
+                "p99_ms": round(cont_p99, 2),
+                "wall_s": round(cont["wall_s"], 2),
+                "counters": cont["decode"],
+            },
+            "request_level": {
+                "tokens_per_s": round(reql["tps"], 1),
+                "p50_ms": round(pct(reql["lat_ms"], 50), 2),
+                "p99_ms": round(req_p99, 2),
+                "wall_s": round(reql["wall_s"], 2),
+                "counters": reql["decode"],
+            },
+            "streams_bitwise_equal": bitwise,
+            "compile_once": {
+                "decode_steps": int(steps_n),
+                "bucket_pairs": int(pairs),
+                "serve_bucket_compiles": int(
+                    cont["serve"].get("serve_bucket_compiles", 0)),
+                "step_cache_serve_hits": int(
+                    cont["step_cache"].get("step_cache_serve_hit", 0)),
+                "plan_cache_hits": int(
+                    cont["run_plan"].get("plan_cache_hit", 0)),
+                "holds": bool(compile_once),
+            },
+            "kv_cache_vs_reprefill": per_len,
+            "kv_incremental_wins_every_length": kv_wins,
+            "total_tokens": int(sum(len(t) for t in cont["tokens"])),
+            "backend": jax.default_backend(),
+        },
+    }
+    if write_artifact:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts", "decode_bench.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 def bench_trace(steps=5, kill_step=2, smoke=True, write_artifact=None):
     """ISSUE 10 demo: one unified telemetry trace of the framework's
     signature behaviours — ``artifacts/trace_step.json``.
@@ -3840,8 +4088,8 @@ if __name__ == "__main__":
     p.add_argument("--config", default="bert",
                    choices=["bert", "resnet18", "wdl", "moe", "attn",
                             "chaos", "failover", "emb", "zero", "serve",
-                            "partition", "overhead", "trace", "elastic",
-                            "remat"])
+                            "decode", "partition", "overhead", "trace",
+                            "elastic", "remat"])
     p.add_argument("--remat", default=None,
                    choices=["off", "dots", "full", "offload", "auto"],
                    help="bert: selective-remat policy for the flagship "
@@ -3888,7 +4136,9 @@ if __name__ == "__main__":
                         "partition_smoke.json); overhead: the CI parity/"
                         "plan-cache gate (no artifact write); elastic: "
                         "the chaos-driven dp=4 kill+rejoin run "
-                        "(artifacts/elastic_smoke.json)")
+                        "(artifacts/elastic_smoke.json); decode: the "
+                        "16-request stream with all gates but the strict "
+                        "perf margin (no artifact write)")
     p.add_argument("--steps", type=int, default=None,
                    help=f"timed steps (default {DEFAULT_STEPS}; smaller on "
                         "the CPU fallback unless given explicitly)")
@@ -3896,8 +4146,8 @@ if __name__ == "__main__":
     if os.environ.get(CHILD_ENV_FLAG):
         _child_main(args)
     elif args.config in ("chaos", "failover", "emb", "zero", "serve",
-                         "partition", "overhead", "trace", "elastic",
-                         "remat"):
+                         "decode", "partition", "overhead", "trace",
+                         "elastic", "remat"):
         # host-side metrics: no TPU probe loop (backend-agnostic), but
         # still a budgeted child so a wedged backend import can't hang
         # the harness

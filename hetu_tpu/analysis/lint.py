@@ -36,6 +36,12 @@ Rule catalog (see README "Static analysis & graph validation"):
   optimizer subgraphs); a dropout node is a warning (it lowers to
   identity under ``training=False``, but its presence usually means the
   fetch set was lifted from a training head)
+* ``decode-incompatible-op`` (error) — only under ``lint(decode=True)``
+  (the ``InferenceExecutor(decode=True)`` validation path): an op whose
+  lowering cannot run under incremental one-token decode — full-sequence
+  attention (use ``sdpa_decode_op`` over a ``kv_cache_append_op`` cache)
+  or batch-coupled statistics (BatchNorm — breaks the decode
+  bitwise-stability guarantee under continuous batching)
 * ``feed-schema-churn`` (warn, RUNTIME) — emitted by the executor's
   run-plan cache (``graph/run_plan.py``), not a static pass: successive
   ``run()`` calls keep missing the plan cache because a fed
@@ -91,7 +97,7 @@ class GraphInfo:
 
     def __init__(self, shapes: GraphShapes, feeds, mesh=None, pipeline=None,
                  feed_values=None, zero=0, serving=False, remat="off",
-                 plan=None):
+                 plan=None, decode=False):
         self.shapes = shapes
         self.topo = shapes.topo
         self.feeds = feeds
@@ -111,6 +117,10 @@ class GraphInfo:
         #: True when linting a SERVING fetch set (InferenceExecutor):
         #: enables the train-only-op-in-serving rule
         self.serving = bool(serving)
+        #: True when the fetch set is an incremental-DECODE step
+        #: (InferenceExecutor(decode=True), hetu_tpu.serving.decode):
+        #: enables the decode-incompatible-op rule
+        self.decode = bool(decode)
         #: requested remat policy (Executor(remat=...)) — raw, NOT
         #: resolved: the remat-policy rule diagnoses unknown names
         self.remat = remat
@@ -778,11 +788,64 @@ def _r_train_only_serving(gi):
                 node)
 
 
+#: op types whose lowering cannot run under INCREMENTAL decode — they
+#: consume the full sequence axis in one shot (the decode step sees one
+#: token; a full-sequence attention in the step graph would attend over
+#: whatever single token it was handed and silently emit garbage) — with
+#: the incremental replacement to name in the diagnostic
+_DECODE_INCOMPATIBLE_SEQ = {
+    "ScaledDotProductAttention",
+    "ScaledDotProductAttentionMasked",
+    "ScaledDotProductAttentionBias",
+    "ScaledDotProductAttentionMaskedBias",
+    "ScaledDotProductAttentionVarlen",
+    "RingAttention",
+    "RingAttentionMasked",
+    "UlyssesAttention",
+    "UlyssesAttentionMasked",
+}
+#: op types that carry BATCH-coupled running state — under continuous
+#: batching the batch composition changes every token, so their
+#: statistics would depend on which sequences happen to share the step
+#: (breaking the bitwise-stability guarantee: same sequence, different
+#: batch mates, different tokens)
+_DECODE_INCOMPATIBLE_STATE = {"BatchNorm"}
+
+
+@rule("decode-incompatible-op")
+def _r_decode_incompatible(gi):
+    """An incremental-decode step graph
+    (``InferenceExecutor(decode=True)``) must be runnable one token at a
+    time: full-sequence attention ops and batch-statistics ops are
+    rejected at construction with their creation site, naming the
+    incremental replacement."""
+    if not gi.decode:
+        return
+    for node in gi.topo:
+        if node.op_type in _DECODE_INCOMPATIBLE_SEQ:
+            yield Diagnostic(
+                "decode-incompatible-op", "error",
+                f"{node.op_type} '{node.name}' consumes the full "
+                f"sequence axis in one shot — an incremental decode "
+                f"step sees ONE token per call and would silently "
+                f"attend over nothing; use sdpa_decode_op over a KV "
+                f"cache maintained by kv_cache_append_op instead", node)
+        elif node.op_type in _DECODE_INCOMPATIBLE_STATE:
+            yield Diagnostic(
+                "decode-incompatible-op", "error",
+                f"{node.op_type} '{node.name}' computes batch-coupled "
+                f"statistics — under continuous batching the batch "
+                f"composition changes every token, so its output would "
+                f"depend on which sequences share the step (the "
+                f"bitwise-stability guarantee cannot hold); use "
+                f"LayerNorm (per-row statistics) instead", node)
+
+
 # ----------------------------------------------------------------- entry
 
 def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
          num_microbatches=None, rules=None, zero=0, serving=False,
-         remat="off", plan=None):
+         remat="off", plan=None, decode=False):
     """Statically verify a fetch subgraph; returns a :class:`LintReport`.
 
     ``feeds``: example values (or bare shapes) for placeholders declared
@@ -799,6 +862,9 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
     train-only-op-in-serving rule — what
     ``InferenceExecutor(validate=...)`` runs; pair with
     ``training=False``).
+    ``decode=True``: the fetch set is an incremental-decode STEP
+    (``InferenceExecutor(decode=True)``) — enables the
+    decode-incompatible-op rule.
     ``rules``: optional iterable of rule names to run (default: all
     registered rules).
     """
@@ -818,7 +884,8 @@ def lint(fetches, feeds=None, mesh=None, pipeline=None, training=True,
                 feed_values[node] = v
     gi = GraphInfo(shapes, _normalize_feeds(feeds, shapes.topo),
                    mesh=mesh, pipeline=pipeline, feed_values=feed_values,
-                   zero=zero, serving=serving, remat=remat, plan=plan)
+                   zero=zero, serving=serving, remat=remat, plan=plan,
+                   decode=decode)
     diags = []
     selected = RULES if rules is None else {
         name: RULES[name] for name in rules}

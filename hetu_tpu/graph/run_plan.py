@@ -546,4 +546,35 @@ class PlanCache:
         warnings.warn(str(diag), UserWarning, stacklevel=5)
 
 
-__all__ = ["RunPlan", "PlanCache", "feed_pipeline_enabled"]
+class KeyedPlanCache:
+    """Keyed dispatch-plan cache for planes that resolve their own step
+    closures (the decode engine's per-(batch, len)-bucket step plans:
+    feed-key order, donation layout, placement — the serving analogue of
+    what :class:`RunPlan` prebinds per schema).  Same accounting contract
+    as :class:`PlanCache`: every lookup records ``plan_cache_hit`` or
+    ``plan_cache_miss``, so the steady-state claim — the 100-request
+    stream's per-token dispatch is a plan-cache hit — is provable from
+    the one counter family the overhead bench already watches."""
+
+    def __init__(self, max_entries=32):
+        self.plans = OrderedDict()
+        self.max = max(1, int(max_entries))
+
+    def lookup(self, key, build):
+        """The plan for ``key`` — built by ``build()`` on first sight,
+        replayed from the cache (LRU-refreshed) after."""
+        plan = self.plans.get(key)
+        if plan is not None:
+            self.plans.move_to_end(key)
+            record_run_plan("plan_cache_hit")
+            return plan
+        record_run_plan("plan_cache_miss")
+        plan = build()
+        self.plans[key] = plan
+        while len(self.plans) > self.max:
+            self.plans.popitem(last=False)
+        return plan
+
+
+__all__ = ["RunPlan", "PlanCache", "KeyedPlanCache",
+           "feed_pipeline_enabled"]

@@ -274,18 +274,27 @@ def serve_signature(iex, bucket):
     fetch subgraph (PS embedding leaves INCLUDED — their rows ride as
     per-call inputs, keyed like any feed) + the padded batch bucket +
     everything that shapes the compiled program (backend, mesh, donation,
-    RNG seed — the serving key is baked into the trace).  A rebuilt
-    :class:`~hetu_tpu.serving.InferenceExecutor` over a structurally
-    identical graph reuses the compiled executable per bucket instead of
-    retracing (the serving analogue of the training step cache; restart
-    reuse across processes rides ``HETU_COMPILE_CACHE_DIR`` exactly like
-    training)."""
+    RNG seed — the serving key is baked into the trace; the auto-parallel
+    plan fingerprint when the executor compiles under ``plan=``).  A
+    rebuilt :class:`~hetu_tpu.serving.InferenceExecutor` over a
+    structurally identical graph reuses the compiled executable per
+    bucket instead of retracing (the serving analogue of the training
+    step cache; restart reuse across processes rides
+    ``HETU_COMPILE_CACHE_DIR`` exactly like training).
+
+    ``bucket``: the padded batch bucket (int), or a (batch_bucket,
+    len_bucket) pair for the autoregressive-decode plane — each pair
+    pins its own executable, which is what lets the decode counters
+    prove at most one compile per (batch, len) bucket pair."""
     h = hashlib.sha256()
     try:
         import jax
-        _feed(h, "serve-v1", jax.__version__, jax.default_backend(),
-              _mesh_fingerprint(iex.mesh), int(bucket),
-              bool(iex.donate), iex.seed)
+        bkey = tuple(int(b) for b in bucket) \
+            if isinstance(bucket, (tuple, list)) else int(bucket)
+        _feed(h, "serve-v2", jax.__version__, jax.default_backend(),
+              _mesh_fingerprint(iex.mesh), bkey,
+              bool(iex.donate), iex.seed,
+              getattr(iex, "_plan_fingerprint", None))
         _hash_nodes(h, iex.topo, iex.fetches, iex._k)
     except _Uncachable:
         return None

@@ -542,6 +542,53 @@ def reset_serve_counts():
     _serve_latency.reset()
 
 
+# ------------------------------------------------------- decode counters
+# The autoregressive-decode serving plane (``hetu_tpu.serving.decode``)
+# records its token/batch behaviour here: tokens emitted to streams
+# (``decode_tokens``), sequences joining (``decode_joins``) and leaving
+# (``decode_leaves``) the in-flight continuous batch, KV-cache slots
+# recycled to a later sequence (``decode_slot_recycles``), engine steps
+# (``decode_steps`` — one jitted decode call per token batch) split into
+# the per-row prefill/generate accounting (``decode_prefill_rows``: rows
+# that consumed a PROMPT token, building KV cache without emitting;
+# ``decode_generate_rows``: rows that consumed a generated token), bucket
+# ladder growths (``decode_batch_grows`` / ``decode_len_grows`` — each one
+# is at most one fresh compile, the compile-once-per-(batch, len) bucket
+# claim), queue-full rejections (``decode_rejections``), and the
+# device-resident KV-cache footprint high-water mark
+# (``decode_kv_bytes_hw`` — gauge semantics: the recorded value is the MAX
+# ever seen).  Surfaced by ``HetuProfiler.decode_counters()`` and
+# ``bench.py --config decode``; a process that never decodes reports an
+# empty dict.
+
+_decode = REGISTRY.counter_family(
+    "decode",
+    "continuous-batching autoregressive decode events (empty in a "
+    "process that never decodes)")
+
+
+def record_decode(kind, n=1):
+    """Count ``n`` decode events of ``kind``; kinds ending in ``_hw``
+    are high-water gauges (the stored value is the max seen)."""
+    kind = str(kind)
+    if kind.endswith("_hw"):
+        _decode.max_gauge(kind, int(n))
+    elif n:
+        _decode.inc(kind, int(n))
+
+
+def decode_counts():
+    """{kind: count} snapshot of decode counters."""
+    return _decode.counts()
+
+
+def reset_decode_counts():
+    """Reset the decode counters AND the per-token latency histogram —
+    one decode run's telemetry, one reset."""
+    _decode.reset()
+    _decode_latency.reset()
+
+
 # --------------------------------------------------- latency histograms
 # Log-bucketed distributions (``obs.registry.Histogram``: 8 buckets per
 # octave, p50/p90/p99 accessors) — the mean-only counters above cannot
@@ -597,6 +644,27 @@ def record_serve_latency(kind, us):
 def serve_latency_stats():
     """{kind: histogram snapshot} for the serving latency families."""
     return _serve_latency.snapshot()
+
+
+# Decode latency: per-token inter-emission latency (``token`` — one
+# observation per token STREAMED to a caller, the number a serving SLO is
+# written against), per-request join wait (``join_wait`` — submit ->
+# joined the in-flight batch), and per-engine-step device call (``step``).
+_decode_latency = REGISTRY.histogram(
+    "decode_latency_us",
+    "decode latency: per-token emission, per-request join wait, and "
+    "per-step device call, microseconds")
+
+
+def record_decode_latency(kind, us):
+    """Observe one decode latency sample (``kind``: ``token`` per emitted
+    token, ``join_wait`` per joined request, ``step`` per engine step)."""
+    _decode_latency.observe(us, label=kind)
+
+
+def decode_latency_stats():
+    """{kind: histogram snapshot} for the decode latency families."""
+    return _decode_latency.snapshot()
 
 
 # Executor step wall time, labeled by subexecutor name.  OFF by default:
@@ -690,6 +758,7 @@ _FAMILIES = {
     "step_cache": _step_cache,
     "run_plan": _run_plan,
     "serve": _serve,
+    "decode": _decode,
     "ps_rpc_bytes": _rpc_bytes,
 }
 

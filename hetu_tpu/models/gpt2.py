@@ -109,6 +109,114 @@ def gpt2_lm_graph(cfg, name="gpt2"):
     return {"input_ids": input_ids, "labels": labels}, loss, logits
 
 
+class _DecodeBlockLayer:
+    """Per-block kernel handles for ``ParallelPlan.bind``/``apply``:
+    column-parallel q/k/v + mlp_fc, row-parallel o + mlp_proj (the
+    canonical Megatron pair) — lets a searched tp plan annotate the
+    decode graph exactly like the training model's layers."""
+
+    def __init__(self, in_kernels, out_kernels):
+        self.in_kernels = in_kernels
+        self.out_kernels = out_kernels
+
+
+def _block_decode(cfg, x, k_cache, v_cache, positions, name):
+    """One-token decode of :func:`_block`: identical weights BY NAME
+    (``.ln1``/``.attn.{q,k,v,o}``/``.ln2``/``.mlp_fc``/``.mlp_proj``),
+    attention against the bucketed KV cache through the flash kernel's
+    q_len=1 entry instead of the full sequence.  No dropout: decode is a
+    serving graph.  Returns (x, new_k_cache, new_v_cache, layer)."""
+    dk = cfg.n_embd // cfg.n_head
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln1")(x)
+
+    def heads(t):
+        # (B, n_embd) -> (B, H, 1, dk); -1 keeps the graph batch-agnostic
+        # (decode buckets the batch dim at runtime)
+        t = ops.array_reshape_op(t, output_shape=(-1, 1, cfg.n_head, dk))
+        return ops.transpose_op(t, perm=(0, 2, 1, 3))
+
+    lq = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.q")
+    lk = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.k")
+    lv = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.v")
+    lo = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.o")
+    q = heads(lq(h))
+    kc = ops.kv_cache_append_op(k_cache, heads(lk(h)), positions)
+    vc = ops.kv_cache_append_op(v_cache, heads(lv(h)), positions)
+    att = ops.sdpa_decode_op(q, kc, vc, positions)       # (B, H, 1, dk)
+    att = ops.transpose_op(att, perm=(0, 2, 1, 3))
+    att = ops.array_reshape_op(att, output_shape=(-1, cfg.n_embd))
+    x = x + lo(att)
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln2")(x)
+    fc = Linear(cfg.n_embd, 4 * cfg.n_embd, activation="gelu",
+                initializer=init.GenTruncatedNormal(0.0, 0.02),
+                name=name + ".mlp_fc")
+    proj = Linear(4 * cfg.n_embd, cfg.n_embd,
+                  initializer=init.GenTruncatedNormal(0.0, 0.02),
+                  name=name + ".mlp_proj")
+    x = x + proj(fc(h))
+    layer = _DecodeBlockLayer(
+        [lq.weight_var, lk.weight_var, lv.weight_var, fc.weight_var],
+        [lo.weight_var, proj.weight_var])
+    return x, kc, vc, layer
+
+
+def gpt2_decode_graph(cfg, max_len=None, name="gpt2"):
+    """One-token autoregressive decode graph over per-layer KV caches.
+
+    Weight names match :func:`gpt2_lm_graph` exactly, so a trained
+    checkpoint (or a live Executor) loads into the decode executor BY
+    NAME with zero conversion.  Feeds (all batch-leading, bucketed by the
+    decode engine at runtime):
+
+    * ``input_ids`` (B, 1) int32 — the one token each sequence consumes
+      this step (a prompt token during prefill, the previous sample
+      during generation)
+    * ``positions`` (B,) int32 — the cache row that token writes; keys
+      beyond it stay invisible to the q_len=1 attention
+    * ``k_cache_i`` / ``v_cache_i`` (B, n_head, L, head_dim) per layer —
+      the device-resident caches, fed back from the previous step's
+      fetches (donated: XLA updates them in place)
+
+    Returns ``(feeds, logits, cache_fetches, layers)``: ``feeds`` maps
+    the names above to placeholder nodes, ``logits`` is (B, vocab) for
+    the fed token, ``cache_fetches`` is [k0', v0', k1', v1', ...] (the
+    appended caches, in feed order), and ``layers`` are per-block kernel
+    handles for ``ParallelPlan.bind`` (tp-sharded decode)."""
+    max_len = int(max_len or cfg.n_positions)
+    dk = cfg.n_embd // cfg.n_head
+    shape = (cfg.batch_size, 1)
+    ids = placeholder_op("input_ids", shape=shape, dtype=np.int32)
+    positions = placeholder_op("positions", shape=(cfg.batch_size,),
+                               dtype=np.int32)
+    wte = init.truncated_normal((cfg.vocab_size, cfg.n_embd), 0.0, 0.02,
+                                name=name + ".wte")
+    wpe = init.truncated_normal((cfg.n_positions, cfg.n_embd), 0.0, 0.01,
+                                name=name + ".wpe")
+    x = ops.embedding_lookup_op(wte, ids)                # (B, 1, n_embd)
+    x = ops.array_reshape_op(x, output_shape=(-1, cfg.n_embd))
+    x = x + ops.embedding_lookup_op(wpe, positions)      # (B, n_embd)
+    feeds = {"input_ids": ids, "positions": positions}
+    cache_fetches, layers = [], []
+    for i in range(cfg.n_layer):
+        kc = placeholder_op(
+            f"k_cache_{i}", dtype=np.float32,
+            shape=(cfg.batch_size, cfg.n_head, max_len, dk))
+        vc = placeholder_op(
+            f"v_cache_{i}", dtype=np.float32,
+            shape=(cfg.batch_size, cfg.n_head, max_len, dk))
+        feeds[f"k_cache_{i}"] = kc
+        feeds[f"v_cache_{i}"] = vc
+        x, kc2, vc2, layer = _block_decode(cfg, x, kc, vc, positions,
+                                           f"{name}.h{i}")
+        cache_fetches += [kc2, vc2]
+        layers.append(layer)
+    x = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln_f")(x)
+    logits = Linear(cfg.n_embd, cfg.vocab_size,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".lm_head")(x)
+    return feeds, logits, cache_fetches, layers
+
+
 def synthetic_lm_batch(cfg, seed=0):
     """Next-token synthetic batch: ids shifted left for labels."""
     rng = np.random.RandomState(seed)

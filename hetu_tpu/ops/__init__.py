@@ -37,6 +37,7 @@ from .moe import (topk_gate_op, ktop1_gate_op, sam_gate_op,
                   sparse_combine_op)
 from .attention import (sdpa_op, sdpa_masked_op, sdpa_bias_op,
                         sdpa_masked_bias_op, sdpa_varlen_op,
+                        sdpa_decode_op, kv_cache_append_op,
                         ring_attention_op, ulysses_attention_op)
 from .matmul import einsum_op
 from .rnn import rnn_op, lstm_op, gru_op

@@ -327,6 +327,71 @@ def _sdpa_varlen(c, q, k, v, lengths, causal=False, scale=None):
 sdpa_varlen_op = def_op("ScaledDotProductAttentionVarlen", _sdpa_varlen)
 
 
+def _decode_gate_reason(k_cache):
+    """Why a decode step leaves the flash path (None = flash-able).  The
+    decode gate keys on the KV-CACHE length — the axis the kernel tiles
+    and the axis that grows as generation proceeds — not the base gate's
+    q_len (always 1 in decode, where the base gate would refuse every
+    step)."""
+    be = jax.default_backend()
+    if be != "tpu":
+        return f"backend:{be}"
+    s_kv = k_cache.shape[-2]
+    if s_kv < _FLASH_MIN_LEN:
+        return f"decode_below_gate:kv{s_kv}<{_FLASH_MIN_LEN}"
+    if s_kv % 128:
+        return f"decode_kv_ragged:kv{s_kv}"
+    return None
+
+
+def dispatch_sdpa_decode(q, k_cache, v_cache, positions, scale=None):
+    """One autoregressive decode step against a bucketed KV cache — the
+    degenerate q_len=1 entry of the flash kernel's lengths path.
+
+    ``q``: the current token's query, (B, H, 1, D).  ``k_cache`` /
+    ``v_cache``: (B, H, L, D) with the new token already appended at
+    ``positions`` (see ``kv_cache_append_op``).  ``positions``: (B,)
+    int — the row each sequence just wrote; keys beyond it are invisible
+    (so ``causal`` is implied: the query IS the last valid key).  On TPU
+    a cache at a mod-128 bucket >= the flash gate rides the kernel's
+    lengths path (fully-masked key blocks cost no FLOPs — exactly where
+    a long cache pays); anything else is the counted jnp reference."""
+    lengths = positions.astype(jnp.int32) + 1
+    reason = _decode_gate_reason(k_cache)
+    if reason is None:
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k_cache, v_cache, causal=False,
+                               scale=scale, lengths=lengths)
+    _note_flash_fallback(reason)
+    s_kv = k_cache.shape[-2]
+    cols = jnp.arange(s_kv)[None, None, None, :]
+    mask = cols < lengths[:, None, None, None]
+    return sdpa_reference(q, k_cache, v_cache, scale=scale, mask=mask)
+
+
+def _sdpa_decode(c, q, k_cache, v_cache, positions, scale=None):
+    return dispatch_sdpa_decode(q, k_cache, v_cache, positions,
+                                scale=scale)
+
+
+sdpa_decode_op = def_op("ScaledDotProductAttentionDecode", _sdpa_decode)
+
+
+def _kv_cache_append(c, cache, new, positions):
+    """Append one (B, H, 1, D) token row into the (B, H, L, D) cache at
+    each sequence's own position — a batched dynamic_update_slice, the
+    incremental write that makes a generation O(S) total attention work
+    instead of re-prefill's O(S^2).  Out-of-range positions clamp (XLA
+    dynamic_update_slice semantics): an idle batch slot fed position 0
+    merely rewrites a row the next join resets anyway."""
+    def upd(c_hld, n_h1d, p):
+        return jax.lax.dynamic_update_slice(c_hld, n_h1d, (0, p, 0))
+    return jax.vmap(upd)(cache, new, positions.astype(jnp.int32))
+
+
+kv_cache_append_op = def_op("KVCacheAppend", _kv_cache_append)
+
+
 def _has_cp(mesh):
     return mesh is not None and "cp" in mesh.axis_names \
         and mesh.shape["cp"] > 1

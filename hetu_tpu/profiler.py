@@ -239,7 +239,7 @@ class HetuProfiler:
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
         elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
-        ps_rpc_bytes.  The per-family
+        decode, ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -251,14 +251,17 @@ class HetuProfiler:
         registry's log-bucketed histograms (count/sum/min/max/mean/
         p50/p90/p99 per label): ``ps_rpc_us`` per opcode (+ payload
         bytes), ``serve_latency_us`` (per-request queue wait /
-        per-batch device call), ``step_time_us`` per subexecutor
-        (opt-in — ``metrics.enable_step_timing`` or
+        per-batch device call), ``decode_latency_us`` (time-to-token /
+        join wait / engine step on the decode plane), ``step_time_us``
+        per subexecutor (opt-in — ``metrics.enable_step_timing`` or
         ``HETU_STEP_TIMING=1``), and the per-run ``mfu`` /
         ``step_time_ms`` gauges."""
-        from .metrics import (rpc_stats, run_gauges, serve_latency_stats,
+        from .metrics import (decode_latency_stats, rpc_stats,
+                              run_gauges, serve_latency_stats,
                               step_time_stats)
         return {"ps_rpc": rpc_stats(),
                 "serve_latency_us": serve_latency_stats(),
+                "decode_latency_us": decode_latency_stats(),
                 "step_time_us": step_time_stats(),
                 "gauges": run_gauges()}
 
@@ -415,6 +418,25 @@ class HetuProfiler:
         dict."""
         from .metrics import serve_counts
         return serve_counts()
+
+    @staticmethod
+    def decode_counters():
+        """{kind: count} of continuous-batching autoregressive-decode
+        events (``hetu_tpu.metrics`` registry): tokens streamed to
+        callers (``decode_tokens``), sequences joining/leaving the
+        in-flight batch (``decode_joins`` / ``decode_leaves``), KV-cache
+        slots recycled to a later sequence (``decode_slot_recycles``),
+        engine steps (``decode_steps`` — one jitted call per token
+        batch) with their per-row prefill/generate split
+        (``decode_prefill_rows`` / ``decode_generate_rows``), bucket
+        ladder growths (``decode_batch_grows`` / ``decode_len_grows`` —
+        each at most one fresh compile), queue-full rejections, and the
+        device-resident KV-cache footprint high-water mark
+        (``decode_kv_bytes_hw`` — a max gauge, not a sum).  Per-token
+        latency rides ``metrics.decode_latency_stats()``.  A process
+        that never decodes reports an empty dict."""
+        from .metrics import decode_counts
+        return decode_counts()
 
     @staticmethod
     def fault_counters():

@@ -15,6 +15,13 @@ is the serving half the training executor never had:
 * Read-mostly embedding serving rides
   ``DistCacheTable(read_only=True)`` + PR 4's replicated store: a killed
   shard primary fails over inside the batch's pull with zero restarts.
+* :class:`DecodeEngine` / :class:`DecodeRouter` (ISSUE 16) —
+  continuous-batching autoregressive decode over device-resident
+  incremental KV caches: per-token join/leave with slot recycling,
+  bucketed batch/length growth compiling once per
+  ``(batch_bucket, len_bucket)`` pair, per-token futures on
+  :class:`DecodeStream`, optional tp-sharded steps via a bound
+  ``ParallelPlan`` — results bitwise-independent of batch composition.
 * :class:`CellMap` / :class:`CellHead` — geo-replicated serving cells:
   disjoint rank sets each serving local traffic off the read-only
   cache, surviving a cross-cell network partition (reads keep flowing,
@@ -27,8 +34,10 @@ and ``bench.py --config partition`` (cross-cell partition + heal with
 zero local rejections and post-heal fsck convergence).
 """
 from .cells import CellHead, CellMap
+from .decode import DecodeEngine, DecodeRouter, DecodeStream
 from .executor import InferenceExecutor, default_buckets
 from .router import ServingRouter, ServeRejected
 
 __all__ = ["InferenceExecutor", "ServingRouter", "ServeRejected",
-           "default_buckets", "CellMap", "CellHead"]
+           "default_buckets", "CellMap", "CellHead",
+           "DecodeEngine", "DecodeRouter", "DecodeStream"]

@@ -1,0 +1,608 @@
+"""Continuous-batching autoregressive decode over device-resident KV caches.
+
+The request-level :class:`~hetu_tpu.serving.ServingRouter` answers one
+forward pass per request; autoregressive generation answers one forward
+pass per TOKEN, and the naive loop re-runs the whole prefix every step.
+This module is the serving plane for that workload:
+
+* **Incremental KV cache.**  Each decode step feeds exactly one token per
+  sequence through the q_len=1 attention entry
+  (:func:`~hetu_tpu.ops.sdpa_decode_op`) against per-layer caches of
+  shape ``(batch_bucket, heads, len_bucket, head_dim)`` — the bucketed,
+  slot-major realization of the paper's per-sequence
+  ``(layers, 2, max_len, heads, head_dim)`` cache.  Caches live on
+  device for the whole generation: the engine feeds the previous step's
+  fetched cache arrays straight back into the next jitted call (donated,
+  so XLA appends in place) and never round-trips them through the host.
+
+* **Bucketed growth, compile-once steady state.**  Both the batch dim and
+  the cache length walk the same flash-legal ladder serving uses
+  (:func:`~hetu_tpu.serving.default_buckets`: powers of two, then
+  multiples of 128).  One jitted step exists per ``(batch_bucket,
+  len_bucket)`` pair — built through the process-wide serve cache
+  (``serve_bucket_compiles`` counts real builds) and dispatched through a
+  per-engine :class:`~hetu_tpu.graph.run_plan.KeyedPlanCache`
+  (``plan_cache_hit`` is the steady-state proof: after warmup every
+  token batch dispatches with zero Python planning and zero compiles).
+
+* **Continuous batching.**  Sequences join and leave the in-flight batch
+  PER TOKEN: a new request occupies a free KV-cache slot at the next
+  step boundary (no waiting for the current batch to drain), a finished
+  sequence frees its slot immediately for the next joiner
+  (``decode_slot_recycles``).  Prompt ingestion reuses the decode step
+  (one prompt token per step — ``decode_prefill_rows``), so a joining
+  sequence never stalls the sequences already generating.
+
+* **Bitwise stability.**  A sequence's tokens do not depend on its batch
+  mates: each slot attends only to its own cache rows ``0..position``
+  (the per-row length mask), idle slots contribute nothing, and greedy
+  argmax is deterministic — the same prompt decodes to the identical
+  token stream whatever else shares the batch.
+
+* **Per-token streaming.**  :meth:`DecodeRouter.submit` returns a
+  :class:`DecodeStream`: per-token ``concurrent.futures.Future``s
+  (``stream.token(i)``), iteration (``for tok in stream``), and a
+  whole-sequence ``stream.result()``.  Backpressure is explicit —
+  a full queue raises :class:`~hetu_tpu.serving.ServeRejected`.
+
+Threading: the router's loop thread OWNS the engine (slots, caches,
+compiled steps) — no lock guards engine state because exactly one thread
+touches it after ``start()``.  The queue hands off under
+``DecodeRouter._cv``; each stream has its own ``DecodeStream._lock``.
+Neither is ever held across a device call or while acquiring the other,
+so the PR 14 witness hierarchy stays acyclic.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import warnings
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import race as _race
+from ..graph.run_plan import KeyedPlanCache
+from ..graph import step_cache
+from ..metrics import record_decode, record_decode_latency
+from ..obs.lock_witness import make_condition, make_lock
+from ..obs.trace import TRACER as _TR
+from .executor import InferenceExecutor, default_buckets
+from .router import ServeRejected
+
+
+class DecodeStream:
+    """Per-request handle: tokens stream out as the engine emits them.
+
+    ``token(i)`` returns a Future for the i-th generated token (resolved
+    in emission order; failed with ``IndexError`` if generation finishes
+    before ``i`` tokens).  Iterating yields tokens until the sequence
+    finishes.  ``result(timeout)`` blocks for the full token list.  A
+    router/engine failure fails every outstanding future AND
+    ``result()`` with the same exception."""
+
+    def __init__(self, prompt_len, max_new_tokens):
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self._lock = make_lock("DecodeStream._lock")
+        self._futs = []
+        self._tokens = []
+        self._final = Future()
+
+    # -- consumer side -----------------------------------------------------
+
+    def token(self, i):
+        """Future for the ``i``-th generated token."""
+        i = int(i)
+        with self._lock:
+            done_short = self._final.done() and i >= len(self._tokens)
+            while len(self._futs) <= i:
+                self._futs.append(Future())
+            fut = self._futs[i]
+        if done_short and fut.set_running_or_notify_cancel():
+            # the sequence already finished with fewer tokens: a future
+            # created now would otherwise never resolve
+            fut.set_exception(IndexError(
+                f"generation finished after {len(self._tokens)} tokens"))
+        return fut
+
+    def result(self, timeout=None):
+        """Block for the complete generated-token list."""
+        return self._final.result(timeout)
+
+    @property
+    def done(self):
+        return self._final.done()
+
+    @property
+    def n_tokens(self):
+        with self._lock:
+            return len(self._tokens)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            try:
+                yield self.token(i).result()
+            except Exception:
+                # IndexError past the end, CancelledError, or the
+                # engine's failure — iteration just stops; result()
+                # re-raises real failures for callers who care
+                return
+            i += 1
+
+    # -- engine side (router loop thread only) -----------------------------
+
+    def _emit(self, tok):
+        with self._lock:
+            while len(self._futs) <= len(self._tokens):
+                self._futs.append(Future())
+            fut = self._futs[len(self._tokens)]
+            self._tokens.append(int(tok))
+        # resolve OUTSIDE the stream lock: a done-callback attached by
+        # the consumer runs in this thread and must not run under (or
+        # re-acquire) our lock
+        if fut.set_running_or_notify_cancel():
+            fut.set_result(int(tok))
+
+    def _finish(self):
+        with self._lock:
+            tokens = list(self._tokens)
+            extra = self._futs[len(tokens):]
+        for f in extra:
+            if f.set_running_or_notify_cancel():
+                f.set_exception(IndexError(
+                    f"generation finished after {len(tokens)} tokens"))
+        if self._final.set_running_or_notify_cancel():
+            self._final.set_result(tokens)
+
+    def _fail(self, exc):
+        with self._lock:
+            done = len(self._tokens)
+            pending = self._futs[done:]
+        for f in pending:
+            if f.set_running_or_notify_cancel():
+                f.set_exception(exc)
+        if self._final.set_running_or_notify_cancel():
+            self._final.set_exception(exc)
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_arrival",
+                 "fid")
+
+    def __init__(self, prompt, max_new, eos_id, fid):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.stream = DecodeStream(len(prompt), max_new)
+        self.t_arrival = time.monotonic()
+        self.fid = fid
+
+
+class _Sequence:
+    """One in-flight sequence's slot state (router loop thread only)."""
+
+    __slots__ = ("req", "ptr", "emitted", "t_last", "fid")
+
+    def __init__(self, req):
+        self.req = req
+        self.ptr = 0          # next prompt index to consume
+        self.emitted = 0
+        self.t_last = time.monotonic()
+        self.fid = None       # decode.join flow id (set at join)
+
+
+class DecodeEngine:
+    """KV-cache decode executor: slots, bucket ladders, compiled steps.
+
+    Built from :func:`~hetu_tpu.models.gpt2_decode_graph`'s return value
+    (any graph with the same feed contract works): ``feeds`` maps
+    ``input_ids`` (B, 1) / ``positions`` (B,) / per-layer cache
+    placeholders to nodes, ``logits`` is the (B, vocab) fetch,
+    ``cache_fetches`` the appended caches in feed order.
+
+    ``max_slots`` caps the in-flight batch (the top of the batch-bucket
+    ladder); ``max_len`` caps the cache length (prompt + generated).
+    ``plan=`` accepts a searched :class:`~hetu_tpu.parallel.ParallelPlan`
+    (tp-sharded decode) — it is realized strictly at construction and
+    gated by the ``plan-coverage`` lint, exactly like training.
+
+    NOT thread-safe by design: the owning :class:`DecodeRouter` loop
+    thread (or a single test thread) makes every call after
+    construction.  Device calls happen with no lock held."""
+
+    def __init__(self, feeds, logits, cache_fetches, weights=None, *,
+                 max_slots=8, max_len=128, plan=None, mesh=None,
+                 seed=0, donate=True, validate="error"):
+        self.iex = InferenceExecutor(
+            [logits] + list(cache_fetches), weights=weights,
+            buckets=default_buckets(max_slots), mesh=mesh, seed=seed,
+            donate=donate, validate=validate, plan=plan, decode=True)
+        self.max_len = int(max_len)
+        self.batch_ladder = self.iex.buckets
+        self.len_ladder = tuple(b for b in default_buckets(self.max_len))
+        self.cache_names = [n for n in feeds
+                            if n not in ("input_ids", "positions")]
+        # placeholder node -> executor feed key, by feed NAME
+        self._fk = {name: self.iex._k(node) for name, node in feeds.items()}
+        ck0 = feeds[self.cache_names[0]]
+        self._heads, self._head_dim = ck0.shape[1], ck0.shape[3]
+        self._cache_dtype = np.dtype(getattr(ck0, "dtype", np.float32))
+        # dispatch plans: one per (batch_bucket, len_bucket) —
+        # plan_cache_hit here is the steady-state proof
+        self._plans = KeyedPlanCache(
+            max_entries=len(self.batch_ladder) * len(self.len_ladder))
+        self.bb = self.batch_ladder[0]
+        self.lb = self.len_ladder[0]
+        self.slots = [None] * self.bb
+        self._used = [False] * self.bb       # slot served a sequence before
+        self.tokens = np.zeros(self.bb, np.int32)
+        self.positions = np.zeros(self.bb, np.int32)
+        self.caches = {name: self._alloc(self.bb, self.lb)
+                       for name in self.cache_names}
+        self._note_kv_bytes()
+
+    # -- memory ------------------------------------------------------------
+
+    def _alloc(self, bb, lb):
+        import jax.numpy as jnp
+        z = jnp.zeros((bb, self._heads, lb, self._head_dim),
+                      self._cache_dtype)
+        return self.iex._place(z)
+
+    def _note_kv_bytes(self):
+        record_decode("decode_kv_bytes_hw",
+                      sum(int(c.nbytes) for c in self.caches.values()))
+
+    @property
+    def kv_bytes(self):
+        return sum(int(c.nbytes) for c in self.caches.values())
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def active(self):
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def idle(self):
+        return self.active == 0
+
+    def capacity(self):
+        """Free sequence slots, counting batch-ladder headroom."""
+        return self.batch_ladder[-1] - self.active
+
+    # -- bucket growth -----------------------------------------------------
+
+    def _next_bucket(self, ladder, cur):
+        for b in ladder:
+            if b > cur:
+                return b
+        return None
+
+    def _grow_batch(self):
+        import jax.numpy as jnp
+        nb = self._next_bucket(self.batch_ladder, self.bb)
+        if nb is None:
+            raise RuntimeError(f"no free slot at max batch bucket {self.bb}")
+        pad = nb - self.bb
+        self.caches = {
+            name: self.iex._place(
+                jnp.pad(c, ((0, pad), (0, 0), (0, 0), (0, 0))))
+            for name, c in self.caches.items()}
+        self.slots += [None] * pad
+        self._used += [False] * pad
+        self.tokens = np.concatenate([self.tokens,
+                                      np.zeros(pad, np.int32)])
+        self.positions = np.concatenate([self.positions,
+                                         np.zeros(pad, np.int32)])
+        self.bb = nb
+        record_decode("decode_batch_grows")
+        self._note_kv_bytes()
+
+    def _grow_len_if_needed(self):
+        import jax.numpy as jnp
+        need = max((int(self.positions[i]) for i, s in enumerate(self.slots)
+                    if s is not None), default=-1)
+        if need < self.lb:
+            return
+        lb = self.lb
+        while lb <= need:
+            lb = self._next_bucket(self.len_ladder, lb)
+            if lb is None:
+                raise RuntimeError(
+                    f"cache position {need} exceeds max_len {self.max_len}")
+            record_decode("decode_len_grows")
+        pad = lb - self.lb
+        self.caches = {
+            name: self.iex._place(
+                jnp.pad(c, ((0, 0), (0, 0), (0, pad), (0, 0))))
+            for name, c in self.caches.items()}
+        self.lb = lb
+        self._note_kv_bytes()
+
+    # -- join / leave ------------------------------------------------------
+
+    def join(self, req):
+        """Seat ``req`` in a free KV-cache slot (growing the batch bucket
+        if every slot is taken); its first prompt token decodes at the
+        next :meth:`step`."""
+        slot = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+        if slot is None:
+            self._grow_batch()
+            slot = next(i for i, s in enumerate(self.slots) if s is None)
+        seq = _Sequence(req)
+        self.slots[slot] = seq
+        self.tokens[slot] = req.prompt[0]
+        self.positions[slot] = 0
+        if self._used[slot]:
+            record_decode("decode_slot_recycles")
+        self._used[slot] = True
+        record_decode("decode_joins")
+        record_decode_latency(
+            "join_wait", (time.monotonic() - req.t_arrival) * 1e6)
+        if _TR.on:
+            if req.fid is not None:
+                _TR.flow_end("decode.request", req.fid, cat="decode")
+            seq.fid = _TR.flow_begin("decode.join", cat="decode")
+        return slot
+
+    def _leave(self, slot):
+        seq = self.slots[slot]
+        self.slots[slot] = None
+        self.tokens[slot] = 0
+        self.positions[slot] = 0
+        record_decode("decode_leaves")
+        seq.req.stream._finish()
+
+    def abort(self, exc):
+        """Fail every in-flight stream and clear the batch (router
+        close / fatal step error)."""
+        for i, seq in enumerate(self.slots):
+            if seq is not None:
+                self.slots[i] = None
+                self.tokens[i] = 0
+                self.positions[i] = 0
+                seq.req.stream._fail(exc)
+
+    # -- the decode step ---------------------------------------------------
+
+    def _step_fn(self):
+        """The jitted step for the CURRENT (batch_bucket, len_bucket):
+        dispatched through the keyed plan cache (hit = zero planning),
+        built at most once per pair through the process-wide serve cache
+        (``serve_bucket_compiles`` counts real builds)."""
+        key = (self.bb, self.lb)
+
+        def build():
+            return step_cache.lookup_or_build_serve(
+                self.iex, key, self.iex._infer_fn())
+
+        return self._plans.lookup(key, build)
+
+    def step(self):
+        """Decode ONE token batch: every active slot consumes its pending
+        token (prompt or previous sample), caches append in place, rows
+        past their prompt emit.  Returns the number of tokens emitted."""
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return 0
+        self._grow_len_if_needed()
+        fn = self._step_fn()
+        t0 = time.perf_counter_ns()
+        feeds = {
+            self._fk["input_ids"]:
+                np.ascontiguousarray(self.tokens.reshape(self.bb, 1)),
+            self._fk["positions"]: np.ascontiguousarray(self.positions),
+        }
+        for name in self.cache_names:
+            feeds[self._fk[name]] = self.caches[name]
+        # the caches are DONATED device arrays fed straight back from the
+        # previous step's fetches — no host round-trip (_place_feed's
+        # np.asarray would force one, so the engine bypasses infer_rows)
+        with warnings.catch_warnings():
+            # ids/positions are int32 inputs with no matching output
+            # buffer; only the caches can (and do) donate
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            outs = fn(self.iex.params, feeds)
+        logits = np.asarray(outs[0])
+        for name, new in zip(self.cache_names, outs[1:]):
+            self.caches[name] = new
+        record_decode("decode_steps")
+        emitted = 0
+        now = time.monotonic()
+        for i in active:
+            seq = self.slots[i]
+            self.positions[i] += 1
+            if seq.ptr < len(seq.req.prompt) - 1:
+                # mid-prompt: next prompt token, nothing to emit yet
+                seq.ptr += 1
+                self.tokens[i] = seq.req.prompt[seq.ptr]
+                record_decode("decode_prefill_rows")
+                continue
+            # this row's logits are live: greedy argmax (deterministic
+            # first-max tie-break keeps decode bitwise stable)
+            tok = int(np.argmax(logits[i]))
+            seq.ptr = len(seq.req.prompt)
+            seq.emitted += 1
+            emitted += 1
+            record_decode("decode_generate_rows")
+            record_decode("decode_tokens")
+            record_decode_latency("token", (now - seq.t_last) * 1e6)
+            seq.t_last = now
+            if _TR.on and seq.fid is not None:
+                _TR.flow_end("decode.join", seq.fid, cat="decode")
+                seq.fid = None
+            seq.req.stream._emit(tok)
+            self.tokens[i] = tok
+            done = (seq.emitted >= seq.req.max_new
+                    or (seq.req.eos_id is not None
+                        and tok == seq.req.eos_id))
+            if not done and int(self.positions[i]) >= self.max_len:
+                done = True     # cache exhausted: stop cleanly
+            if done:
+                self._leave(i)
+        t1 = time.perf_counter_ns()
+        record_decode_latency("step", (t1 - t0) / 1e3)
+        if _TR.on:
+            _TR.complete("decode.step", t0, t1, cat="decode",
+                         args={"batch": self.bb, "len": self.lb,
+                               "rows": len(active), "emitted": emitted})
+        return emitted
+
+
+class DecodeRouter:
+    """Bounded-queue continuous-batching front end for one
+    :class:`DecodeEngine`.
+
+    ``submit`` admits a prompt and returns a :class:`DecodeStream`; the
+    loop thread seats waiting requests into free slots at every step
+    boundary (``continuous=True``) and runs decode steps while any
+    sequence is in flight.  ``continuous=False`` is the request-level
+    baseline the benchmark compares against: joins happen only when the
+    engine is EMPTY (the whole batch runs to completion first — the
+    slowest sequence holds everyone else's slot hostage), with the same
+    arrival-anchored ``max_wait_ms`` fill window the request router
+    uses.  ``close()`` rejects the queue and fails in-flight streams
+    with :class:`~hetu_tpu.serving.ServeRejected`."""
+
+    def __init__(self, engine, queue_limit=64, max_wait_ms=2.0,
+                 continuous=True, start=True):
+        self.engine = engine
+        self.queue_limit = int(queue_limit)
+        self.max_wait_ms = float(max_wait_ms)
+        self.continuous = bool(continuous)
+        self._q = collections.deque()
+        self._cv = make_condition("DecodeRouter._cv")
+        self._stop = False
+        self._thread = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        with self._cv:
+            if self._thread is not None or self._stop:
+                return self
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="hetu-decode-router")
+            self._thread.start()
+        return self
+
+    def close(self, timeout=None):
+        with self._cv:
+            self._stop = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+        if _race.ACTIVE is not None:   # ISSUE 14 preemption point
+            _race.point("decode.close")
+        for req in pending:
+            req.stream._fail(
+                ServeRejected("router closed with the request queued"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # the loop thread has exited: engine state is safe to touch here
+        self.engine.abort(
+            ServeRejected("router closed mid-generation"))
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def queue_depth(self):
+        with self._cv:
+            return len(self._q)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=16, eos_id=None):
+        """Admit one prompt (1-D int token ids).  Returns a
+        :class:`DecodeStream`.  Raises
+        :class:`~hetu_tpu.serving.ServeRejected` when the queue is full,
+        the router is closed, or the sequence cannot fit ``max_len``."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new - 1 > self.engine.max_len:
+            record_decode("decode_rejections")
+            raise ServeRejected(
+                f"prompt {prompt.size} + {max_new} new tokens exceeds the "
+                f"engine's max_len {self.engine.max_len}")
+        fid = _TR.flow_begin("decode.request", cat="decode") \
+            if _TR.on else None
+        req = _DecodeRequest(prompt, max_new, eos_id, fid)
+        with self._cv:
+            if self._stop:
+                record_decode("decode_rejections")
+                raise ServeRejected("router is closed")
+            if len(self._q) >= self.queue_limit:
+                record_decode("decode_rejections")
+                raise ServeRejected(
+                    f"decode queue full ({self.queue_limit} waiting) — "
+                    f"shed load upstream and retry")
+            self._q.append(req)
+            self._cv.notify()
+        return req.stream
+
+    # -- the loop ----------------------------------------------------------
+
+    def _take_joins(self):
+        """Requests to seat before the next step (empty list: just step),
+        or None at shutdown.  Continuous mode joins at every step
+        boundary; request-level mode only into an EMPTY engine, after
+        the arrival-anchored fill window."""
+        with self._cv:
+            while True:
+                if self._stop:
+                    return None
+                cap = self.engine.capacity()
+                busy = not self.engine.idle
+                if self._q and cap > 0 and (self.continuous or not busy):
+                    if not self.continuous:
+                        deadline = (self._q[0].t_arrival
+                                    + self.max_wait_ms / 1e3)
+                        while (len(self._q) < cap and not self._stop):
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cv.wait(left)
+                        if self._stop:
+                            return None
+                        cap = self.engine.capacity()
+                    n = min(len(self._q), cap)
+                    return [self._q.popleft() for _ in range(n)]
+                if busy:
+                    return []
+                self._cv.wait(0.05)
+
+    def _loop(self):
+        while True:
+            joins = self._take_joins()
+            if joins is None:
+                return
+            for req in joins:
+                self.engine.join(req)
+            if _race.ACTIVE is not None:   # the join/step boundary
+                _race.point("decode.step")
+            if not self.engine.idle:
+                try:
+                    self.engine.step()
+                except Exception as e:    # noqa: BLE001 — every in-flight
+                    self.engine.abort(e)  # stream must learn its fate; the
+                                          # router keeps serving new work
+
+
+__all__ = ["DecodeEngine", "DecodeRouter", "DecodeStream"]

@@ -100,14 +100,37 @@ class InferenceExecutor:
     :func:`default_buckets`).
     ``validate``: ``'error'`` (default — train-only nodes are rejected at
     construction), ``'warn'``, or ``'off'``.
+    ``plan``: a searched :class:`~hetu_tpu.autoparallel.ParallelPlan` —
+    the executor compiles on the plan's own mesh (unless ``mesh=`` is
+    given), realizes any bound layer directives, and the plan-coverage
+    lint gates construction: a tp plan whose layers were never bound
+    fails fast instead of silently serving a replicated program.
+    ``decode=True``: the fetch set is an incremental-decode step
+    (``hetu_tpu.serving.decode``) — enables the ``decode-incompatible-op``
+    lint rule, so an op whose lowering cannot run one token at a time
+    (trains state, consumes the full sequence axis non-causally) is
+    rejected at construction with its creation site.
     """
 
     def __init__(self, fetches, weights=None, buckets=None, max_batch=128,
-                 mesh=None, seed=0, validate="error", donate=True):
+                 mesh=None, seed=0, validate="error", donate=True,
+                 plan=None, decode=False):
         import jax
         if isinstance(fetches, Op):
             fetches = [fetches]
         self.fetches = list(fetches)
+        self.plan = plan
+        self._plan_fingerprint = None
+        if plan is not None:
+            # realize BEFORE topo/lint: bound layer directives annotate
+            # graph nodes, and both the lowering and the plan-coverage
+            # rule read those annotations.  zero=0: serving has no
+            # optimizer state, so the ZeRO slab route never applies.
+            plan.realize(zero=0, strict=True)
+            self._plan_fingerprint = plan.fingerprint()
+            if mesh is None:
+                mesh = plan.make_mesh()
+        self.decode = bool(decode)
         self.topo = topo_sort([f for f in self.fetches if f is not None])
         self.mesh = mesh
         self.seed = int(seed)
@@ -175,7 +198,8 @@ class InferenceExecutor:
         from ..analysis import lint as lint_graph
         try:
             report = lint_graph(self.fetches, mesh=self.mesh,
-                                training=False, serving=True)
+                                training=False, serving=True,
+                                decode=self.decode, plan=self.plan)
         except Exception as e:
             warnings.warn(f"serving graph lint crashed: "
                           f"{type(e).__name__}: {e}", RuntimeWarning)

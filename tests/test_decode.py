@@ -1,0 +1,345 @@
+"""ISSUE 16 acceptance: continuous-batching autoregressive decode —
+incremental KV-cache parity with full-sequence greedy, bitwise stability
+across batch compositions, per-token join/leave with slot recycling,
+compile-once per (batch_bucket, len_bucket) with a plan-cache-hit steady
+state, the ``decode-incompatible-op`` lint, decode trace spans/flows,
+and tp-sharded decode through a searched ParallelPlan.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from hetu_tpu import metrics, obs                         # noqa: E402
+from hetu_tpu.models import GPT2Config, gpt2_decode_graph  # noqa: E402
+from hetu_tpu.models.gpt2 import gpt2_lm_graph             # noqa: E402
+from hetu_tpu.profiler import HetuProfiler                 # noqa: E402
+from hetu_tpu.serving import (DecodeEngine, DecodeRouter,  # noqa: E402
+                              InferenceExecutor, ServeRejected)
+
+_CFG = GPT2Config.tiny(n_positions=64, batch_size=1, seq_len=16)
+_MAX_LEN = 16
+
+
+@pytest.fixture(scope="module")
+def decode_graph():
+    """One tiny decode graph shared by the module (weight init is
+    seed-deterministic, so every engine over it serves identical
+    weights)."""
+    return gpt2_decode_graph(_CFG, max_len=_MAX_LEN)
+
+
+def _engine(decode_graph, **kw):
+    feeds, logits, caches, _layers = decode_graph
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_len", _MAX_LEN)
+    return DecodeEngine(feeds, logits, caches, seed=0, **kw)
+
+
+# ----------------------------------------------------- correctness / parity
+
+def test_decode_matches_full_sequence_greedy(decode_graph):
+    """The tentpole correctness claim: one-token-at-a-time decode over
+    the incremental KV cache produces EXACTLY the token stream of greedy
+    re-prefill with the full-sequence training graph (same weights BY
+    NAME)."""
+    eng = _engine(decode_graph, max_slots=2)
+    w = {eng.iex.var_names[n]: np.asarray(eng.iex.params[eng.iex._k(n)])
+         for n in eng.iex.var_nodes}
+    f2, _loss, logits2 = gpt2_lm_graph(_CFG)
+    iex_full = InferenceExecutor([logits2], weights=w, buckets=(1,),
+                                 seed=0, validate="off")
+    fn_full = iex_full.compiled(1)
+    prompt, max_new = [5, 9, 13], 8
+    seq, ref = list(prompt), []
+    for _ in range(max_new):
+        ids = np.zeros((1, _CFG.seq_len), np.int32)
+        ids[0, :len(seq)] = seq
+        outs = fn_full(iex_full.params,
+                       {iex_full._k(f2["input_ids"]): ids})
+        row = np.asarray(outs[0]).reshape(
+            _CFG.seq_len, _CFG.vocab_size)[len(seq) - 1]
+        ref.append(int(np.argmax(row)))
+        seq.append(ref[-1])
+    with DecodeRouter(eng) as router:
+        got = router.submit(prompt, max_new_tokens=max_new).result(
+            timeout=120)
+    assert got == ref
+
+
+def test_decode_bitwise_stable_across_batch_mates(decode_graph):
+    """The same prompt decodes to the identical token stream whatever
+    else shares the in-flight batch: each slot attends only to its own
+    cache rows, and greedy argmax is deterministic."""
+    eng = _engine(decode_graph)
+    prompt = [7, 3, 11]
+    with DecodeRouter(eng) as router:
+        solo = router.submit(prompt, max_new_tokens=6).result(timeout=120)
+        streams = [router.submit(p, max_new_tokens=6)
+                   for p in (prompt, [2], [9, 4, 1, 8], [1, 1])]
+        crowded = [s.result(timeout=120) for s in streams]
+    assert crowded[0] == solo
+    assert len(solo) == 6
+
+
+# ---------------------------------------------- continuous batching plane
+
+def test_continuous_join_leave_slot_recycle(decode_graph):
+    """Sequences join and leave the in-flight batch per token; freed
+    KV-cache slots are recycled by later joiners; counters account for
+    every row."""
+    metrics.reset_decode_counts()
+    eng = _engine(decode_graph, max_slots=2)
+    prompts = [([3], 2), ([5, 6], 4), ([7, 8, 9], 3), ([11], 5)]
+    with DecodeRouter(eng, queue_limit=8) as router:
+        streams = [router.submit(p, max_new_tokens=n) for p, n in prompts]
+        outs = [s.result(timeout=120) for s in streams]
+    for (p, n), toks in zip(prompts, outs):
+        assert len(toks) == n
+    c = HetuProfiler.decode_counters()
+    assert c["decode_joins"] == 4 and c["decode_leaves"] == 4
+    # 4 sequences through <= 2 slots: at least two slots were reused
+    assert c["decode_slot_recycles"] >= 2
+    assert c["decode_tokens"] == sum(n for _, n in prompts)
+    # every prompt token past the first is a prefill row
+    assert c["decode_prefill_rows"] == sum(len(p) - 1 for p, _ in prompts)
+    assert c["decode_kv_bytes_hw"] > 0
+    assert eng.idle and eng.capacity() == 2
+
+
+def test_backpressure_and_too_long_rejection(decode_graph):
+    eng = _engine(decode_graph, max_slots=2)
+    router = DecodeRouter(eng, queue_limit=1, start=False)
+    try:
+        router.submit([1], max_new_tokens=2)
+        with pytest.raises(ServeRejected, match="queue full"):
+            router.submit([2], max_new_tokens=2)
+        with pytest.raises(ServeRejected, match="max_len"):
+            router.submit(list(range(10)), max_new_tokens=_MAX_LEN)
+    finally:
+        router.close()
+    with pytest.raises(ServeRejected, match="closed"):
+        router.submit([1], max_new_tokens=2)
+
+
+def test_stream_token_futures_and_iteration(decode_graph):
+    """Per-token futures resolve in emission order; iteration yields the
+    whole stream; past-the-end futures fail with IndexError."""
+    eng = _engine(decode_graph, max_slots=2)
+    with DecodeRouter(eng) as router:
+        s = router.submit([5, 2], max_new_tokens=3)
+        first = s.token(0).result(timeout=120)
+        rest = s.result(timeout=120)
+        assert rest[0] == first and len(rest) == 3
+        assert list(s) == rest
+        with pytest.raises(IndexError):
+            s.token(10).result(timeout=5)
+        assert s.n_tokens == 3 and s.done
+
+
+def test_router_close_fails_inflight_and_queued(decode_graph):
+    eng = _engine(decode_graph, max_slots=1)
+    router = DecodeRouter(eng, queue_limit=8, start=False)
+    queued = router.submit([1, 2], max_new_tokens=4)
+    router.close()
+    with pytest.raises(ServeRejected):
+        queued.result(timeout=5)
+
+
+# --------------------------------------- compile-once / plan-cache steady state
+
+def test_compile_once_per_bucket_pair_over_stream():
+    """Over a stream of requests, the engine compiles AT MOST once per
+    (batch_bucket, len_bucket) pair — every other step dispatches
+    through a plan-cache hit (the steady-state claim)."""
+    feeds, logits, caches, _ = gpt2_decode_graph(_CFG, max_len=_MAX_LEN)
+    metrics.reset_all()
+    eng = DecodeEngine(feeds, logits, caches, max_slots=4,
+                       max_len=_MAX_LEN, seed=0)
+    rng = np.random.RandomState(0)
+    with DecodeRouter(eng, queue_limit=64) as router:
+        streams = []
+        for _ in range(24):
+            plen = int(rng.zipf(1.8)) % 4 + 1
+            prompt = rng.randint(1, _CFG.vocab_size, plen)
+            streams.append(router.submit(prompt, max_new_tokens=3))
+        for s in streams:
+            s.result(timeout=300)
+    decode = metrics.decode_counts()
+    serve = metrics.serve_counts()
+    rp = metrics.run_plan_counts()
+    steps = decode["decode_steps"]
+    pairs = rp.get("plan_cache_miss", 0)
+    assert steps > pairs, "stream too short to show a steady state"
+    # one dispatch-plan miss per distinct (batch, len) bucket pair, and
+    # one real compile per miss — everything else is a hit
+    assert serve["serve_bucket_compiles"] + \
+        metrics.step_cache_counts().get("step_cache_serve_hit", 0) == pairs
+    assert rp["plan_cache_hit"] == steps - pairs
+    # the ladders bound the pairs: batch in {1,2,4}, len in buckets(16)
+    assert pairs <= len(eng.batch_ladder) * len(eng.len_ladder)
+
+
+# ------------------------------------------------------------ lint gate
+
+def test_decode_incompatible_op_lint_at_construction():
+    """A full-sequence attention op in a decode-plane executor is a
+    construction-time error naming the offending op's creation site."""
+    import hetu_tpu as ht
+    q = ht.placeholder_op("q", shape=(2, 2, 8, 4))
+    k = ht.placeholder_op("k", shape=(2, 2, 8, 4))
+    v = ht.placeholder_op("v", shape=(2, 2, 8, 4))
+    att = ht.ops.sdpa_op(q, k, v, causal=True)   # the flagged line
+    with pytest.raises(ValueError) as ei:
+        InferenceExecutor([att], decode=True, validate="error",
+                          buckets=(2,))
+    msg = str(ei.value)
+    assert "decode-incompatible-op" in msg
+    assert "sdpa_decode_op" in msg          # the fix is named
+    assert "test_decode.py" in msg          # creation-site provenance
+
+
+def test_decode_lint_passes_decode_graph(decode_graph):
+    """The real decode graph is clean under the decode plane lint (the
+    fixture engine already constructed with validate='error', but assert
+    explicitly against the rule registry)."""
+    from hetu_tpu.analysis.lint import lint
+    feeds, logits, caches, _ = decode_graph
+    report = lint([logits] + list(caches), serving=True, decode=True)
+    assert not [d for d in report.diagnostics
+                if d.rule == "decode-incompatible-op"]
+
+
+# ------------------------------------------------------------ observability
+
+def test_decode_trace_spans_and_flows(decode_graph):
+    """Every token batch is one ``decode.step`` span; request→join→emit
+    is stitched with flow arrows, and the join→emit flow terminator is
+    timestamp-contained in a decode.step span (machine-checked)."""
+    obs.enable(False)
+    obs.clear_trace()
+    eng = _engine(decode_graph, max_slots=2)
+    obs.enable(True)
+    try:
+        with DecodeRouter(eng) as router:
+            s1 = router.submit([5, 9], max_new_tokens=3)
+            s2 = router.submit([7], max_new_tokens=2)
+            s1.result(timeout=120)
+            s2.result(timeout=120)
+    finally:
+        obs.enable(False)
+    evs = obs.trace_events()
+    obs.clear_trace()
+    steps = [e for e in evs if e.get("ph") == "X"
+             and e["name"] == "decode.step"]
+    assert steps, "no decode.step spans traced"
+    for e in steps:
+        assert {"batch", "len", "rows", "emitted"} <= set(e["args"])
+    # flows pair by id: one request flow and one join flow per sequence
+    for flow in ("decode.request", "decode.join"):
+        starts = {e["id"] for e in evs
+                  if e.get("ph") == "s" and e["name"] == flow}
+        ends = {e["id"] for e in evs
+                if e.get("ph") == "f" and e["name"] == flow}
+        assert starts and starts == ends, flow
+    # ts containment: every join->emit terminator lands inside a step
+    spans = [(e["ts"], e["ts"] + e["dur"]) for e in steps]
+    for e in evs:
+        if e.get("ph") == "f" and e["name"] == "decode.join":
+            assert any(t0 <= e["ts"] <= t1 for t0, t1 in spans), \
+                "decode.join emit flow outside every decode.step span"
+
+
+def test_decode_counters_accessor_registered():
+    """The decode family rides the one-registry profiler view (the
+    counter-coverage gate)."""
+    metrics.reset_decode_counts()
+    assert HetuProfiler.decode_counters() == {}
+    metrics.record_decode("decode_tokens", 3)
+    assert HetuProfiler.decode_counters() == {"decode_tokens": 3}
+    assert HetuProfiler.all_counters()["decode"] == {"decode_tokens": 3}
+    metrics.reset_decode_counts()
+
+
+# ------------------------------------------------------------ tp-sharded decode
+
+def _tp_plan(layers=None):
+    from hetu_tpu.autoparallel import transformer_layer_spec
+    from hetu_tpu.autoparallel.cost_model import Strategy
+    from hetu_tpu.autoparallel.plan import ParallelPlan
+    spec = transformer_layer_spec(_CFG.n_embd, 1, _CFG.n_head,
+                                  name="blk", count=_CFG.n_layer)
+    plan = ParallelPlan([spec], [Strategy(pp=1, tp=2, dp=1)], 2,
+                        est_time=1e-3)
+    if layers is not None:
+        plan.bind(layers)
+    return plan
+
+
+def test_decode_with_tp_plan_matches_unsharded():
+    """A searched tp=2 plan bound to the decode blocks shards the step
+    over the mesh and still produces the unsharded token stream."""
+    feeds, logits, caches, layers = gpt2_decode_graph(_CFG,
+                                                      max_len=_MAX_LEN)
+    eng0 = DecodeEngine(feeds, logits, caches, max_slots=2,
+                        max_len=_MAX_LEN, seed=0)
+    with DecodeRouter(eng0) as router:
+        want = router.submit([5, 9, 13], max_new_tokens=4).result(
+            timeout=120)
+    feeds, logits, caches, layers = gpt2_decode_graph(_CFG,
+                                                      max_len=_MAX_LEN)
+    eng = DecodeEngine(feeds, logits, caches, max_slots=2,
+                       max_len=_MAX_LEN, seed=0,
+                       plan=_tp_plan(layers))
+    assert eng.iex.mesh is not None and "tp" in eng.iex.mesh.axis_names
+    assert eng.iex._plan_fingerprint is not None
+    with DecodeRouter(eng) as router:
+        got = router.submit([5, 9, 13], max_new_tokens=4).result(
+            timeout=120)
+    assert got == want
+
+
+def test_decode_unbound_tp_plan_fails_plan_coverage():
+    """A tp plan that never bound the decode layers annotates nothing —
+    the plan-coverage lint rejects the executor at construction instead
+    of silently serving an unsharded program."""
+    feeds, logits, caches, _layers = gpt2_decode_graph(_CFG,
+                                                       max_len=_MAX_LEN)
+    with pytest.raises(ValueError, match="plan-coverage"):
+        DecodeEngine(feeds, logits, caches, max_slots=2,
+                     max_len=_MAX_LEN, seed=0, plan=_tp_plan(None))
+
+
+# ------------------------------------------------------------ bench smoke
+
+@pytest.mark.timeout(300)
+def test_decode_bench_smoke():
+    """The committed ``artifacts/decode_bench.json`` is the full-stream
+    version of this run: every acceptance gate must already hold on the
+    lean smoke stream (the full run only adds scale and the strict perf
+    margin)."""
+    import bench
+    res = bench.bench_decode(smoke=True, write_artifact=False)
+    assert res["metric"] == "decode_tokens_per_s"
+    extra = res["extra"]
+    # scheduling must not change results
+    assert extra["streams_bitwise_equal"] is True
+    # the compile-once steady state: real builds + serve-cache reuses
+    # account for EVERY distinct (batch, len) bucket pair, and every
+    # other step dispatches through a plan_cache_hit
+    co = extra["compile_once"]
+    assert co["holds"] is True
+    assert (co["serve_bucket_compiles"] + co["step_cache_serve_hits"]
+            == co["bucket_pairs"] > 0)
+    assert co["plan_cache_hits"] == co["decode_steps"] - co["bucket_pairs"]
+    # O(1) incremental step vs O(len) re-prefill at every measured length
+    assert extra["kv_incremental_wins_every_length"] is True
+    for row in extra["kv_cache_vs_reprefill"]:
+        assert row["incremental_ms"] < row["reprefill_ms"], row
+    assert extra["continuous"]["counters"].get("decode_rejections", 0) == 0
+    assert extra["total_tokens"] > 0
+    assert res["vs_baseline"] > 0, res

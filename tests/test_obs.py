@@ -196,6 +196,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_run_plan("feed_pipeline_depth_hw", 2)
     metrics.record_serve("serve_requests", 4)
     metrics.record_serve("serve_queue_depth_hw", 9)
+    metrics.record_decode("decode_tokens", 7)
+    metrics.record_decode("decode_kv_bytes_hw", 4096)
     metrics.record_rpc("OP_PULL", 100.0, 2048)
     dump = obs.metrics_dump()
     legacy = {
@@ -211,11 +213,14 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "step_cache": metrics.step_cache_counts(),
         "run_plan": metrics.run_plan_counts(),
         "serve": metrics.serve_counts(),
+        "decode": metrics.decode_counts(),
     }
     for fam, want in legacy.items():
         assert dump["counters"][fam] == want, fam
     assert legacy["faults"] == {"test_fault": 2}
     assert legacy["serve"]["serve_queue_depth_hw"] == 9
+    assert legacy["decode"] == {"decode_tokens": 7,
+                                "decode_kv_bytes_hw": 4096}
     assert dump["counters"]["ps_rpc_bytes"] == {"OP_PULL": 2048}
     assert dump["histograms"]["ps_rpc_us"]["OP_PULL"]["count"] == 1
     # the one-call profiler view is the same registry

@@ -135,6 +135,19 @@ def serving_plane():
     ro.refresh_stale()
     ro.refresh_join()
 
+    # decode leg: continuous-batching router loop + per-token stream
+    # futures (DecodeRouter._cv hand-off, DecodeStream._lock emission)
+    from hetu_tpu.models import GPT2Config, gpt2_decode_graph
+    from hetu_tpu.serving import DecodeEngine, DecodeRouter
+    dcfg = GPT2Config.tiny(n_positions=32, batch_size=1)
+    dfeeds, dlogits, dcaches, _ = gpt2_decode_graph(dcfg, max_len=16)
+    eng = DecodeEngine(dfeeds, dlogits, dcaches, max_slots=2, max_len=16)
+    with DecodeRouter(eng, queue_limit=8) as dr:
+        streams = [dr.submit([3 + i, 5], max_new_tokens=3)
+                   for i in range(3)]
+        for s in streams:
+            s.result(timeout=60)
+
 
 def elastic_plane():
     """Chaos-scheduled shrink at step 2, rejoin, grow-back."""
