@@ -47,8 +47,25 @@ just means; ``--config serve`` adds ``latency_hist_ms`` /
 run with a mid-run primary kill — step spans, per-opcode RPC spans,
 fault point events, serving + feed-pipeline tracks.
 
+``artifacts/fleet_bench.json`` (``bench.py --config fleet``, ISSUE 17)
+is the fleet-tier acceptance: ``slo`` (interactive p99 vs target, both
+runs), ``scaling`` (the autoscaler's resize timeline on the admission
+clock — ``{admitted, kind, from_replicas, to_replicas, p99_ms,
+load_factor}`` — plus ``replicas_hw``), ``rejections`` /
+``per_class_rejections`` (structured ``serve_rejection_reason`` counts;
+the family counts at ServeRejected CONSTRUCTION, so internal dispatch
+retries against a freshly killed replica can appear as ``draining``
+entries that were absorbed, never user-visible — the per-class dict is
+the door-visible truth), ``bounded_queues`` (max per-replica pending vs
+``queue_limit``; a chaos-run survivor may briefly hold up to 2x while
+ADOPTING a dead replica's rescued queue), ``spin_up`` (scale-out's
+``step_cache_serve_hit`` vs ``serve_bucket_compiles`` deltas) and
+``chaos`` (the ``kill:replica`` run: restarts=0, failed futures,
+bitwise response parity on requests admitted in both runs).
+
 Chaos/robustness artifacts (``chaos``, ``failover``, ``serve``,
-``partition``) additionally follow a shared convention in ``extra``:
+``partition``, ``fleet``) additionally follow a shared convention in
+``extra``:
 ``restarts``/``resumes`` (must be 0 for the transparent-recovery
 configs), ``fault_counters`` (the chaos run's evidence),
 ``clean_run_counters`` (must be ``{}``), and loss/response parity flags

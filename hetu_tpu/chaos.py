@@ -34,6 +34,16 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   its admission count through :meth:`ChaosInjector.on_request` — a
   serving process has no training steps, so "kill the primary mid-load"
   needs its own trigger;
+* **fleet replica kills** (ISSUE 17) — ``kill:replica@<idx>:req<n>``
+  fail-stops serving replica ``idx`` of a
+  :class:`~hetu_tpu.serving.fleet.FrontDoor` once the front door has
+  admitted ``n`` requests.  The clock is the DOOR's admission count
+  (every admission calls :meth:`ChaosInjector.on_request` before
+  dispatch), so the kill lands at a deterministic point in the request
+  stream; targets volunteer via :meth:`ChaosInjector.register_replica`
+  and die via their ``stop()`` (the router fail-stops at its next batch
+  boundary, leaving its queue for the front door to rescue).  Like
+  every kill, it consumes no RNG draw and fires at most once;
 * **network partitions** —
   ``partition:rank<a>[+rank<b>...]|rank<c>[+rank<d>...]@step<n>[:heal<m>]``
   drops every frame BOTH directions between the two rank sets from the
@@ -57,6 +67,7 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="7:kill:primary@shard1:step3"
     HETU_CHAOS="7:kill:backup@shard1:step3"
     HETU_CHAOS="7:kill:primary@shard1:req200"
+    HETU_CHAOS="7:kill:replica@1:req40"
     HETU_CHAOS="7:partition:rank0|rank1@step3:heal7"
     HETU_CHAOS="7:partition:rank0+rank1|rank2+rank3@step3"
 
@@ -172,10 +183,18 @@ def _parse_fault(part):
         #   role kills, resolved against the live serving/holding sets at
         #   fire time; req<n> fires on the serving router's admission
         #   clock instead of the training step clock)
+        # | kill:replica@<idx>:req<n>  (ISSUE 17: fleet serving-replica
+        #   kill on the FRONT DOOR's admission clock, resolved against
+        #   register_replica'd handles)
         try:
             _, rest = part.split(":", 1)
             what, where = rest.split("@", 1)
             target, when = where.split(":", 1)
+            if what == "replica":
+                if not when.startswith("req"):
+                    raise ValueError(part)
+                return {"kind": "kill_replica", "idx": int(target),
+                        "req": int(when[len("req"):])}
             if what in ("primary", "backup"):
                 if not target.startswith("shard"):
                     raise ValueError(part)
@@ -207,8 +226,9 @@ def _parse_fault(part):
         except (ValueError, IndexError):
             raise ChaosSpecError(
                 f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>,"
-                f" kill:proc@rank<r>:{{after<ms>|step<n>}}, or "
-                f"kill:{{primary,backup}}@shard<s>:{{step<n>|req<n>}}"
+                f" kill:proc@rank<r>:{{after<ms>|step<n>}}, "
+                f"kill:{{primary,backup}}@shard<s>:{{step<n>|req<n>}}, or "
+                f"kill:replica@<idx>:req<n>"
                 ) from None
     if "=" not in part:
         raise ChaosSpecError(f"bad fault {part!r}: expected <kind>=<prob>"
@@ -267,6 +287,7 @@ class ChaosInjector:
         self._lock = make_lock("ChaosInjector._lock")
         self._servers = {}          # rank -> StoreServer
         self._procs = {}            # rank -> proc handle (step-clock kills)
+        self._replicas = {}         # idx -> fleet replica handle (ISSUE 17)
         self._fired = set()         # one-shot kill faults already fired
         #: the step clock partitions level-trigger on (fed by on_step);
         #: -1 = the executor never reported a step, so no partition is
@@ -362,6 +383,17 @@ class ChaosInjector:
         launcher's monitor loop has no step clock)."""
         with self._lock:
             self._procs[rank] = handle
+
+    def register_replica(self, idx, handle):
+        """A fleet serving replica volunteers as the kill target for
+        ``kill:replica@<idx>:req<n>`` — anything with a ``stop()`` (the
+        :class:`~hetu_tpu.serving.fleet.FrontDoor` registers its replica
+        records, whose ``stop()`` fail-stops the replica's router at the
+        next batch boundary).  The clock is the FRONT DOOR's admission
+        count, so the kill lands at a deterministic point in the request
+        stream regardless of how dispatch spread earlier requests."""
+        with self._lock:
+            self._replicas[int(idx)] = handle
 
     def _resolve_role_kill(self, fault):
         """The registered server currently filling the fault's replica
@@ -477,17 +509,36 @@ class ChaosInjector:
         the ADMISSION clock (``kill:{primary,backup}@shard<s>:req<n>``)
         once ``admitted`` requests have entered the router — the serving
         analogue of :meth:`on_step` (a serving process has no training
-        steps to schedule against).  Each fault fires at most once; the
-        same quiet/loud split as on_step applies when no registered
-        server fills the role."""
+        steps to schedule against) — and any fleet replica kill
+        (``kill:replica@<idx>:req<n>``, ISSUE 17) once the FRONT DOOR's
+        admission count reaches ``n``.  Each fault fires at most once;
+        the same quiet/loud split as on_step applies when no registered
+        target fills the role (for replica kills: against the
+        ``register_replica`` registry).  Replica routers report their
+        own smaller admission counts here too — harmless, since a
+        replica's count can never exceed the door's, so a fleet-clock
+        fault always fires first at the door."""
         killed, missing = [], []
         with self._lock:
             for i, f in enumerate(self.faults):
                 if i in self._fired or f.get("req") is None \
                         or admitted < f["req"] \
-                        or f["kind"] not in ("kill_primary", "kill_backup"):
+                        or f["kind"] not in ("kill_primary", "kill_backup",
+                                             "kill_replica"):
                     continue
                 self._fired.add(i)
+                if f["kind"] == "kill_replica":
+                    handle = self._replicas.get(f["idx"])
+                    if handle is not None:
+                        killed.append((f["idx"], handle,
+                                       "chaos_kill_replica"))
+                    elif not self._replicas:
+                        # same quiet/loud split as kill:ps — with OTHER
+                        # replicas registered the target presumably
+                        # lives behind a different front door
+                        missing.append(f"kill:replica@{f['idx']}"
+                                       f":req{f['req']}")
+                    continue
                 self._collect_role_kill(
                     f, f"kill:{f['kind'][len('kill_'):]}"
                        f"@shard{f['shard']}:req{f['req']}",

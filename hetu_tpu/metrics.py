@@ -589,6 +589,81 @@ def reset_decode_counts():
     _decode_latency.reset()
 
 
+# --------------------------------------------- serving rejection reasons
+# ISSUE 17: every :class:`ServeRejected` now carries a structured
+# ``reason`` from the closed taxonomy ``queue_full | over_max_len |
+# deadline | shed:<class> | draining``, and every raise site counts it
+# here keyed BY that reason — bench artifacts and tests read this family
+# instead of string-matching exception text.  The legacy ``serve`` /
+# ``decode`` families keep their coarse ``*_rejections`` totals; this is
+# the per-cause breakdown.
+
+_serve_reject = REGISTRY.counter_family(
+    "serve_rejection_reason",
+    "serving rejections keyed by structured ServeRejected reason "
+    "(queue_full | over_max_len | deadline | shed:<class> | draining)")
+
+
+def record_serve_rejection(reason, n=1):
+    """Count ``n`` rejections with structured ``reason`` (one of the
+    ``ServeRejected.REASONS`` taxonomy, e.g. ``shed:best_effort``)."""
+    if n:
+        _serve_reject.inc(str(reason), int(n))
+
+
+def serve_rejection_counts():
+    """{reason: count} snapshot of structured serving rejections."""
+    return _serve_reject.counts()
+
+
+def reset_serve_rejection_counts():
+    _serve_reject.reset()
+
+
+# --------------------------------------------------------- fleet counters
+# The replica-set serving tier (``hetu_tpu.serving.fleet``) records its
+# lifecycle here: requests admitted at the front door
+# (``fleet_admitted``) and dispatched to a replica (``fleet_dispatch``),
+# replicas added (``fleet_scale_out``) / retired (``fleet_scale_in``),
+# dead-or-wedged replicas ejected from dispatch
+# (``fleet_replica_ejected``) and re-admitted after recovery
+# (``fleet_replica_readmitted``), queued requests rescued off a dead or
+# draining replica onto a survivor (``fleet_rescued`` — the graceful-
+# degradation path: admitted work is handed over, not failed), admitted
+# requests whose future ultimately failed (``fleet_request_failures`` —
+# the bench gates this at zero), SLO-autoscaler polls
+# (``fleet_autoscaler_polls``) and resizes refused at the min/max bound
+# (``fleet_scale_refused``), and the live-replica high-water mark
+# (``fleet_replicas_hw`` — gauge semantics: the recorded value is the
+# MAX ever seen).  Surfaced by ``HetuProfiler.fleet_counters()`` and
+# ``bench.py --config fleet``; a process with no fleet reports an empty
+# dict.
+
+_fleet = REGISTRY.counter_family(
+    "fleet",
+    "replica-set serving-tier events (empty in a process that never "
+    "runs a FrontDoor)")
+
+
+def record_fleet(kind, n=1):
+    """Count ``n`` fleet events of ``kind``; kinds ending in ``_hw``
+    are high-water gauges (the stored value is the max seen)."""
+    kind = str(kind)
+    if kind.endswith("_hw"):
+        _fleet.max_gauge(kind, int(n))
+    elif n:
+        _fleet.inc(kind, int(n))
+
+
+def fleet_counts():
+    """{kind: count} snapshot of fleet serving-tier counters."""
+    return _fleet.counts()
+
+
+def reset_fleet_counts():
+    _fleet.reset()
+
+
 # --------------------------------------------------- latency histograms
 # Log-bucketed distributions (``obs.registry.Histogram``: 8 buckets per
 # octave, p50/p90/p99 accessors) — the mean-only counters above cannot
@@ -759,6 +834,8 @@ _FAMILIES = {
     "run_plan": _run_plan,
     "serve": _serve,
     "decode": _decode,
+    "serve_rejection_reason": _serve_reject,
+    "fleet": _fleet,
     "ps_rpc_bytes": _rpc_bytes,
 }
 

@@ -11,6 +11,6 @@ from .preduce import PartialReduce, preduce_mean, preduce_scatter_mean
 from . import zero
 from .zero import ZeroPlan, ZeroBucket
 from . import elastic
-from .elastic import ElasticController, LogicalRank
+from .elastic import ElasticController, FlapDamper, LogicalRank
 from . import remat
 from .remat import RematPlan, RematSegment

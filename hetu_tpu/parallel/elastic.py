@@ -133,6 +133,46 @@ class LogicalRank:
                 f"{'alive' if self.alive else 'dead'}>")
 
 
+class FlapDamper:
+    """Consecutive-poll grace gate — the flap-damping half of the
+    elastic machinery, extracted (ISSUE 17) so the serving fleet's SLO
+    autoscaler reuses it instead of reinventing it.
+
+    A keyed condition must hold for ``grace`` CONSECUTIVE polls before
+    :meth:`ready` returns True; a single False poll resets the streak.
+    The elastic controller keys it by rejoining rank (a flapping rank
+    must not thrash recompiles); the autoscaler keys it by resize
+    direction (a noisy p99 must not thrash replica churn).  Poll-driven
+    single-caller like its owners — no lock."""
+
+    def __init__(self, grace):
+        self.grace = max(1, int(grace))
+        self._seen = {}
+
+    def ready(self, key, ok):
+        """Record one poll of ``key``'s condition; True once it has held
+        ``grace`` consecutive polls (and keeps returning True until the
+        condition breaks or :meth:`clear`)."""
+        if not ok:
+            self._seen.pop(key, None)
+            return False
+        n = self._seen.get(key, 0) + 1
+        self._seen[key] = n
+        return n >= self.grace
+
+    def streak(self, key):
+        """Current consecutive-ok count for ``key``."""
+        return self._seen.get(key, 0)
+
+    def clear(self, key=None):
+        """Reset one key's streak (or every streak): the caller acted on
+        the signal, the next decision starts from fresh evidence."""
+        if key is None:
+            self._seen.clear()
+        else:
+            self._seen.pop(key, None)
+
+
 def handles_alive_fn(handles):
     """``alive_fn`` over a list of :class:`LogicalRank` handles —
     deterministic liveness for the step-clock chaos tests (a kill at
@@ -193,7 +233,7 @@ class ElasticController:
         #: from_dp/to_dp, the ranks involved, and recovery_ms (detection
         #: poll -> resized executor ready to step)
         self.events = []
-        self._rejoin_seen = {}
+        self._rejoin = FlapDamper(self.rejoin_grace)
 
     @property
     def dp(self):
@@ -244,18 +284,18 @@ class ElasticController:
                 record_elastic("elastic_dead_rank", len(dead))
                 return self._resize("shrink", survivors, dead, step, t0)
 
-        backs = [r for r in range(self.world)
-                 if r not in self.active and mask[r]
-                 and r not in unreachable]
+        backs = frozenset(r for r in range(self.world)
+                          if r not in self.active and mask[r]
+                          and r not in unreachable)
         ready = []
-        for r in backs:
-            seen = self._rejoin_seen.get(r, 0) + 1
-            self._rejoin_seen[r] = seen
-            if seen >= self.rejoin_grace:
+        for r in range(self.world):
+            if r in self.active:
+                continue
+            # one damper poll per standby rank: a rank seen back for
+            # rejoin_grace consecutive polls is ready; a rank that
+            # flapped away restarts its grace (ok=False resets)
+            if self._rejoin.ready(r, r in backs):
                 ready.append(r)
-        for r in list(self._rejoin_seen):
-            if r not in backs:
-                self._rejoin_seen.pop(r)    # flapped: restart the grace
         if ready:
             record_elastic("elastic_rejoin", len(ready))
             if self.store is not None and self.re_replicate_on_rejoin \
@@ -284,7 +324,7 @@ class ElasticController:
             self.ex.resize_world(new_active)
         self.active = list(new_active)
         for r in changed:
-            self._rejoin_seen.pop(r, None)
+            self._rejoin.clear(r)
         ms = (time.perf_counter() - t0) * 1e3
         record_elastic(f"elastic_{kind}")
         record_elastic("elastic_resize_ms", max(1, int(round(ms))))
@@ -295,5 +335,5 @@ class ElasticController:
         return ev
 
 
-__all__ = ["ElasticController", "LogicalRank", "alive_mask",
+__all__ = ["ElasticController", "FlapDamper", "LogicalRank", "alive_mask",
            "handles_alive_fn", "preduce_mean"]

@@ -239,7 +239,8 @@ class HetuProfiler:
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
         elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
-        decode, ps_rpc_bytes.  The per-family
+        decode, serve_rejection_reason, fleet, ps_rpc_bytes.  The
+        per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -437,6 +438,37 @@ class HetuProfiler:
         that never decodes reports an empty dict."""
         from .metrics import decode_counts
         return decode_counts()
+
+    @staticmethod
+    def serve_rejection_counters():
+        """{reason: count} of serving rejections keyed by the structured
+        ``ServeRejected.reason`` taxonomy (``queue_full`` |
+        ``over_max_len`` | ``deadline`` | ``shed:<class>`` |
+        ``draining``) — the per-cause breakdown behind the coarse
+        ``*_rejections`` totals in ``serve_counters`` /
+        ``decode_counters``.  Bench artifacts and tests read this
+        instead of string-matching exception text."""
+        from .metrics import serve_rejection_counts
+        return serve_rejection_counts()
+
+    @staticmethod
+    def fleet_counters():
+        """{kind: count} of replica-set serving-tier events
+        (``hetu_tpu.metrics`` registry): front-door admissions and
+        replica dispatches (``fleet_admitted`` / ``fleet_dispatch``),
+        replicas added/retired (``fleet_scale_out`` /
+        ``fleet_scale_in``), dead-or-wedged ejections and post-recovery
+        re-admissions (``fleet_replica_ejected`` /
+        ``fleet_replica_readmitted``), queued requests rescued onto a
+        survivor (``fleet_rescued``), admitted requests whose future
+        failed (``fleet_request_failures`` — the fleet bench gates this
+        at zero), autoscaler polls and bound-refused resizes
+        (``fleet_autoscaler_polls`` / ``fleet_scale_refused``), and the
+        live-replica high-water mark (``fleet_replicas_hw`` — a max
+        gauge, not a sum).  A process with no FrontDoor reports an
+        empty dict."""
+        from .metrics import fleet_counts
+        return fleet_counts()
 
     @staticmethod
     def fault_counters():

@@ -27,6 +27,14 @@ is the serving half the training executor never had:
   cache, surviving a cross-cell network partition (reads keep flowing,
   writes are epoch-fenced) and converging via epoch-checked
   re-replication at heal.
+* :class:`FrontDoor` / :class:`SLOAutoscaler` (ISSUE 17) — the fleet
+  tier: N router replicas behind one door with load-aware dispatch,
+  class-based admission control (``interactive | batch | best_effort``
+  shed lowest-first as structured :class:`ServeRejected` reasons),
+  per-class deadlines rejected at the door, heartbeat
+  ejection/rescue/re-admission, p99-SLO autoscaling on the elastic
+  plane's flap-damping machinery, and graceful drain that hands queued
+  work to survivors.
 
 Proven end-to-end by ``bench.py --config serve`` (zipf request stream,
 p50/p99/QPS, chaos primary-kill mid-load with bitwise response parity)
@@ -36,8 +44,10 @@ zero local rejections and post-heal fsck convergence).
 from .cells import CellHead, CellMap
 from .decode import DecodeEngine, DecodeRouter, DecodeStream
 from .executor import InferenceExecutor, default_buckets
+from .fleet import CLASSES, FrontDoor, SLOAutoscaler
 from .router import ServingRouter, ServeRejected
 
 __all__ = ["InferenceExecutor", "ServingRouter", "ServeRejected",
            "default_buckets", "CellMap", "CellHead",
-           "DecodeEngine", "DecodeRouter", "DecodeStream"]
+           "DecodeEngine", "DecodeRouter", "DecodeStream",
+           "FrontDoor", "SLOAutoscaler", "CLASSES"]

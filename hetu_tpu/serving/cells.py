@@ -36,11 +36,36 @@ from .router import ServeRejected
 class CellMap:
     """Disjoint, exhaustively tagged rank sets: ``{"west": [0, 1],
     "east": [2, 3]}``.  Validation is loud — an untagged or doubly
-    tagged rank would silently mis-route a scenario's traffic."""
+    tagged rank would silently mis-route a scenario's traffic.
+
+    A cell value may also be the dict form ``{"ranks": [...],
+    "replicas": N}`` (ISSUE 17): ``replicas`` sizes the cell's serving
+    replica set — the ``n_replicas`` a :class:`~hetu_tpu.serving.fleet.
+    FrontDoor` fronting the cell starts with (:meth:`replicas`
+    reads it back, default 1).  Rank semantics are unchanged."""
 
     def __init__(self, cells):
-        self.cells = {str(name): sorted(int(r) for r in ranks)
-                      for name, ranks in dict(cells).items()}
+        self.cells = {}
+        self._replicas = {}
+        for name, spec in dict(cells).items():
+            name = str(name)
+            if isinstance(spec, dict):
+                ranks = spec["ranks"]
+                n_rep = int(spec.get("replicas", 1))
+                if n_rep < 1:
+                    raise ValueError(
+                        f"cell {name!r} asks for {n_rep} replicas — a "
+                        f"cell serves with at least one")
+                extra = set(spec) - {"ranks", "replicas"}
+                if extra:
+                    raise ValueError(
+                        f"cell {name!r} spec has unknown keys "
+                        f"{sorted(extra)} (known: ranks, replicas)")
+                self._replicas[name] = n_rep
+            else:
+                ranks = spec
+                self._replicas[name] = 1
+            self.cells[name] = sorted(int(r) for r in ranks)
         self._cell_of = {}
         for name, ranks in self.cells.items():
             if not ranks:
@@ -65,6 +90,13 @@ class CellMap:
         """The ranks tagged into ``cell``."""
         return list(self.cells[cell])
 
+    def replicas(self, cell):
+        """The cell's serving replica-set size (dict-form cell specs;
+        1 for plain rank-list cells)."""
+        if cell not in self.cells:
+            raise KeyError(cell)
+        return self._replicas.get(cell, 1)
+
     def is_local(self, cell, rank):
         return self._cell_of.get(int(rank)) == cell
 
@@ -81,8 +113,10 @@ class CellMap:
 
 class CellHead:
     """One cell's serving head: the cell-local store client, its
-    read-only embedding cache, and the :class:`ServingRouter` fronting
-    the cell's :class:`InferenceExecutor`.
+    read-only embedding cache, and the router fronting the cell's
+    :class:`InferenceExecutor` — a :class:`ServingRouter`, or a
+    :class:`~hetu_tpu.serving.fleet.FrontDoor` over a replica set
+    (duck-typed: anything with ``submit``/``close``).
 
     Keeps PER-CELL counters (admitted / answered / rejections / errors)
     so a scenario can assert "the local cell kept serving: rejections=0"

@@ -170,15 +170,16 @@ class DecodeStream:
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_arrival",
-                 "fid")
+                 "fid", "deadline")
 
-    def __init__(self, prompt, max_new, eos_id, fid):
+    def __init__(self, prompt, max_new, eos_id, fid, deadline=None):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.eos_id = eos_id
         self.stream = DecodeStream(len(prompt), max_new)
         self.t_arrival = time.monotonic()
         self.fid = fid
+        self.deadline = deadline   # absolute monotonic, or None
 
 
 class _Sequence:
@@ -368,6 +369,33 @@ class DecodeEngine:
                 self.positions[i] = 0
                 seq.req.stream._fail(exc)
 
+    def evict_expired(self, now=None):
+        """Deadline eviction (ISSUE 17 satellite): a seated sequence
+        whose per-request deadline has passed leaves the batch NOW — its
+        remaining token futures fail fast with
+        ``ServeRejected('deadline')`` and the KV slot frees for the next
+        join — instead of a stalled consumer holding a decode slot until
+        ``max_new``.  Counted as ``decode_deadline_evictions``.  Router
+        loop thread only, like every engine call.  Returns the number
+        evicted."""
+        now = time.monotonic() if now is None else now
+        evicted = 0
+        for i, seq in enumerate(self.slots):
+            if seq is None or seq.req.deadline is None:
+                continue
+            if now >= seq.req.deadline:
+                self.slots[i] = None
+                self.tokens[i] = 0
+                self.positions[i] = 0
+                record_decode("decode_leaves")
+                record_decode("decode_deadline_evictions")
+                seq.req.stream._fail(ServeRejected(
+                    "deadline",
+                    f"decode deadline passed after {seq.emitted} of "
+                    f"{seq.req.max_new} tokens"))
+                evicted += 1
+        return evicted
+
     # -- the decode step ---------------------------------------------------
 
     def _step_fn(self):
@@ -471,14 +499,21 @@ class DecodeRouter:
     with :class:`~hetu_tpu.serving.ServeRejected`."""
 
     def __init__(self, engine, queue_limit=64, max_wait_ms=2.0,
-                 continuous=True, start=True):
+                 continuous=True, start=True, name=""):
         self.engine = engine
+        self.name = str(name)
         self.queue_limit = int(queue_limit)
         self.max_wait_ms = float(max_wait_ms)
         self.continuous = bool(continuous)
         self._q = collections.deque()
         self._cv = make_condition("DecodeRouter._cv")
         self._stop = False
+        self._draining = False
+        self._killed = False
+        self._active_ct = 0       # loop's mirror of engine.active (under _cv)
+        now = time.monotonic()
+        self.hb_ts = now          # loop heartbeat (under _cv)
+        self.progress_ts = now    # last step that made progress (under _cv)
         self._thread = None
         if start:
             self.start()
@@ -504,12 +539,13 @@ class DecodeRouter:
             _race.point("decode.close")
         for req in pending:
             req.stream._fail(
-                ServeRejected("router closed with the request queued"))
+                ServeRejected("draining",
+                              "router closed with the request queued"))
         if self._thread is not None:
             self._thread.join(timeout)
         # the loop thread has exited: engine state is safe to touch here
         self.engine.abort(
-            ServeRejected("router closed mid-generation"))
+            ServeRejected("draining", "router closed mid-generation"))
         return self
 
     def __enter__(self):
@@ -523,13 +559,103 @@ class DecodeRouter:
         with self._cv:
             return len(self._q)
 
+    # -- fleet replica contract (ISSUE 17) ---------------------------------
+
+    @property
+    def pending(self):
+        """Queued + in-flight sequence count — the front door's per-
+        replica load signal (``_active_ct`` is the loop's own mirror of
+        ``engine.active``, so no cross-thread engine reads)."""
+        with self._cv:
+            return len(self._q) + self._active_ct
+
+    def health(self):
+        """Point-in-time health snapshot for the front door's sweep —
+        same shape as ``ServingRouter.health``."""
+        with self._cv:
+            return {"pending": len(self._q) + self._active_ct,
+                    "queued": len(self._q),
+                    "inflight": self._active_ct,
+                    "hb_ts": self.hb_ts,
+                    "progress_ts": self.progress_ts,
+                    "killed": self._killed,
+                    "draining": self._draining,
+                    "stopped": self._stop}
+
+    def stop_admitting(self):
+        """Graceful-drain step 1: reject new submits (``draining``)
+        while the loop keeps decoding queued + in-flight sequences."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    def drain(self, timeout=10.0):
+        """Block until the queue is empty and every seated sequence
+        finished (call :meth:`stop_admitting` first).  Returns True when
+        drained, False on timeout or a killed loop."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cv:
+            while self._q or self._active_ct:
+                if self._killed or self._thread is None:
+                    return False
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.05))
+            return True
+
+    def detach_queue(self):
+        """Remove and return every QUEUED (not yet seated) request — the
+        front door hands them to a surviving replica via :meth:`adopt`.
+        Streams travel with their request, so consumers keep their
+        handles."""
+        with self._cv:
+            orphans = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+            return orphans
+
+    def adopt(self, reqs):
+        """Admit requests detached from another decode replica; arrival
+        timestamps and deadlines are preserved, and ``queue_limit`` is
+        bypassed by design (rescue must not re-reject admitted work).
+        Returns the count."""
+        reqs = list(reqs)
+        if not reqs:
+            return 0
+        with self._cv:
+            if self._stop or self._killed:
+                raise ServeRejected(
+                    "draining", "cannot adopt into a stopped router")
+            self._q.extend(reqs)
+            self._cv.notify_all()
+        return len(reqs)
+
+    def kill(self):
+        """Chaos fail-stop: the loop exits at its next boundary WITHOUT
+        touching the queue (the front door rescues it), and fails every
+        SEATED stream fast — mid-generation KV state dies with the
+        replica, exactly like a real process kill.  New submits are
+        rejected (``draining``)."""
+        with self._cv:
+            self._killed = True
+            self._cv.notify_all()
+
     # -- admission ---------------------------------------------------------
 
-    def submit(self, prompt_ids, max_new_tokens=16, eos_id=None):
+    def submit(self, prompt_ids, max_new_tokens=16, eos_id=None,
+               deadline_ms=None):
         """Admit one prompt (1-D int token ids).  Returns a
         :class:`DecodeStream`.  Raises
-        :class:`~hetu_tpu.serving.ServeRejected` when the queue is full,
-        the router is closed, or the sequence cannot fit ``max_len``."""
+        :class:`~hetu_tpu.serving.ServeRejected` when the queue is full
+        (``queue_full``), the router is closed/draining (``draining``),
+        or the sequence cannot fit ``max_len`` (``over_max_len``).
+
+        ``deadline_ms``: per-request completion budget from SUBMIT time.
+        A request still queued past it fails fast at seat time; a seated
+        sequence that outlives it is EVICTED mid-generation — remaining
+        futures fail with reason ``deadline`` and the KV slot frees for
+        the next join (``decode_deadline_evictions``)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -539,18 +665,26 @@ class DecodeRouter:
         if prompt.size + max_new - 1 > self.engine.max_len:
             record_decode("decode_rejections")
             raise ServeRejected(
+                "over_max_len",
                 f"prompt {prompt.size} + {max_new} new tokens exceeds the "
                 f"engine's max_len {self.engine.max_len}")
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
         fid = _TR.flow_begin("decode.request", cat="decode") \
             if _TR.on else None
-        req = _DecodeRequest(prompt, max_new, eos_id, fid)
+        req = _DecodeRequest(prompt, max_new, eos_id, fid, deadline)
         with self._cv:
-            if self._stop:
+            if self._stop or self._killed:
                 record_decode("decode_rejections")
-                raise ServeRejected("router is closed")
+                raise ServeRejected("draining", "router is closed")
+            if self._draining:
+                record_decode("decode_rejections")
+                raise ServeRejected("draining",
+                                    "router is draining — not admitting")
             if len(self._q) >= self.queue_limit:
                 record_decode("decode_rejections")
                 raise ServeRejected(
+                    "queue_full",
                     f"decode queue full ({self.queue_limit} waiting) — "
                     f"shed load upstream and retry")
             self._q.append(req)
@@ -566,7 +700,7 @@ class DecodeRouter:
         the arrival-anchored fill window."""
         with self._cv:
             while True:
-                if self._stop:
+                if self._stop or self._killed:
                     return None
                 cap = self.engine.capacity()
                 busy = not self.engine.idle
@@ -574,35 +708,66 @@ class DecodeRouter:
                     if not self.continuous:
                         deadline = (self._q[0].t_arrival
                                     + self.max_wait_ms / 1e3)
-                        while (len(self._q) < cap and not self._stop):
+                        while (len(self._q) < cap and not self._stop
+                               and not self._killed):
                             left = deadline - time.monotonic()
                             if left <= 0:
                                 break
                             self._cv.wait(left)
-                        if self._stop:
+                        if self._stop or self._killed:
                             return None
                         cap = self.engine.capacity()
                     n = min(len(self._q), cap)
                     return [self._q.popleft() for _ in range(n)]
                 if busy:
                     return []
+                self.hb_ts = time.monotonic()   # idle loop still beats
                 self._cv.wait(0.05)
 
     def _loop(self):
         while True:
             joins = self._take_joins()
             if joins is None:
+                with self._cv:
+                    killed = self._killed
+                if killed:
+                    # fail-stop: seated sequences die with the replica
+                    # (their KV state is gone); the QUEUE stays intact
+                    # for the front door's rescue
+                    self.engine.abort(
+                        ServeRejected("draining", "replica killed"))
                 return
+            now = time.monotonic()
             for req in joins:
+                if req.deadline is not None and now >= req.deadline:
+                    # expired while queued: fail fast at seat time
+                    # instead of burning a KV slot on a dead deadline
+                    record_decode("decode_deadline_evictions")
+                    req.stream._fail(ServeRejected(
+                        "deadline",
+                        "decode deadline passed waiting for a slot"))
+                    continue
                 self.engine.join(req)
             if _race.ACTIVE is not None:   # the join/step boundary
                 _race.point("decode.step")
             if not self.engine.idle:
                 try:
+                    self.engine.evict_expired()
                     self.engine.step()
                 except Exception as e:    # noqa: BLE001 — every in-flight
                     self.engine.abort(e)  # stream must learn its fate; the
                                           # router keeps serving new work
+            with self._cv:
+                active = self.engine.active
+                # a completed step with seated rows IS progress (tokens
+                # moved); a truly wedged step never reaches this line
+                progressed = bool(joins) or active != self._active_ct
+                self._active_ct = active
+                now = time.monotonic()
+                self.hb_ts = now
+                if progressed or active:
+                    self.progress_ts = now
+                self._cv.notify_all()   # drain() waits on this
 
 
 __all__ = ["DecodeEngine", "DecodeRouter", "DecodeStream"]

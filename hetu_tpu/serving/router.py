@@ -31,6 +31,18 @@ count to the active :class:`~hetu_tpu.chaos.ChaosInjector`
 (``on_request``), so ``kill:primary@shard<s>:req<n>`` schedules a
 primary kill mid-load — the serving analogue of the step-scheduled kills
 training chaos uses.
+
+Fleet integration (ISSUE 17): a router can serve as ONE REPLICA behind
+:class:`~hetu_tpu.serving.fleet.FrontDoor`.  The replica contract is the
+small surface the front door drives: ``pending``/``health()`` (load +
+heartbeat snapshot under the router's own lock), ``stop_admitting()`` →
+``drain()`` (graceful retirement: reject new work with reason
+``draining``, finish the queue and the in-flight batch), ``kill()``
+(chaos fail-stop: the batcher exits at the next batch boundary WITHOUT
+touching the queue, so the front door can ``detach_queue()`` the
+orphaned requests and ``adopt()`` them into a survivor), and a ``name``
+that suffixes the ``serve_latency_us`` labels (``batch@r0``) so
+per-replica health is scored from the shared histogram.
 """
 from __future__ import annotations
 
@@ -42,14 +54,38 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import race as _race
-from ..metrics import record_serve, record_serve_latency
+from ..metrics import (record_serve, record_serve_latency,
+                       record_serve_rejection)
 from ..obs.lock_witness import make_condition
 from ..obs.trace import TRACER as _TR
 
 
 class ServeRejected(RuntimeError):
-    """Explicit backpressure: the request was NOT admitted (queue full or
-    router closed) — shed load upstream and retry later."""
+    """Explicit backpressure: the request was NOT admitted — shed load
+    upstream and retry later.
+
+    Every instance carries a structured ``reason`` from the CLOSED
+    taxonomy below (plus the parameterized ``shed:<class>`` form) and an
+    optional admission ``klass``; construction counts the reason into
+    the ``serve_rejection_reason`` metrics family, so artifacts and
+    tests read ``exc.reason`` / the counter instead of string-matching
+    exception text.
+    """
+
+    #: the closed reason taxonomy; ``shed:<class>`` is the one
+    #: parameterized form (class-based admission shedding)
+    REASONS = ("queue_full", "over_max_len", "deadline", "draining")
+
+    def __init__(self, reason, detail="", klass=None):
+        reason = str(reason)
+        if reason not in self.REASONS and not reason.startswith("shed:"):
+            raise ValueError(
+                f"unknown ServeRejected reason {reason!r} — taxonomy is "
+                f"{list(self.REASONS)} or 'shed:<class>'")
+        self.reason = reason
+        self.klass = klass
+        record_serve_rejection(reason)
+        super().__init__(f"{reason}: {detail}" if detail else reason)
 
 
 class _Request:
@@ -73,12 +109,16 @@ class ServingRouter:
     embedding staleness sweep every N batches (0 = never — call
     ``iex.refresh_embeddings()`` yourself).  ``start=False`` builds the
     router paused (tests exercising the backpressure path); call
-    :meth:`start`.
+    :meth:`start`.  ``name``: replica label — suffixes the
+    ``serve_latency_us`` histogram labels (``batch@<name>``) so a fleet
+    scores each replica separately off the shared registry.
     """
 
     def __init__(self, iex, max_batch=None, max_wait_ms=2.0,
-                 queue_limit=256, refresh_every_batches=0, start=True):
+                 queue_limit=256, refresh_every_batches=0, start=True,
+                 name=""):
         self.iex = iex
+        self.name = str(name)
         self.max_batch = min(int(max_batch or iex.max_batch),
                              iex.max_batch)
         if self.max_batch < 1:
@@ -86,9 +126,20 @@ class ServingRouter:
         self.max_wait_ms = float(max_wait_ms)
         self.queue_limit = int(queue_limit)
         self.refresh_every_batches = int(refresh_every_batches)
+        # latency labels: suffixed per replica when named, so fleet
+        # health scoring can read one replica's distribution
+        self._lat_queue_wait = f"queue_wait@{self.name}" if self.name \
+            else "queue_wait"
+        self._lat_batch = f"batch@{self.name}" if self.name else "batch"
         self._q = collections.deque()
         self._cv = make_condition("ServingRouter._cv")
         self._stop = False
+        self._draining = False
+        self._killed = False
+        self._inflight = 0
+        now = time.monotonic()
+        self.hb_ts = now          # batcher-loop heartbeat (under _cv)
+        self.progress_ts = now    # last COMPLETED batch (under _cv)
         self._admitted = 0
         self._batches = 0
         self._thread = None
@@ -123,7 +174,8 @@ class ServingRouter:
             # rejection of every later pending request
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(
-                    ServeRejected("router closed with the request queued"))
+                    ServeRejected("draining",
+                                  "router closed with the request queued"))
         if self._thread is not None:
             self._thread.join(timeout)
         return self
@@ -139,6 +191,95 @@ class ServingRouter:
         with self._cv:
             return len(self._q)
 
+    # -- fleet replica contract (ISSUE 17) ---------------------------------
+
+    @property
+    def pending(self):
+        """Queued + in-flight request count — the front door's per-
+        replica load signal (least-loaded dispatch keys on this)."""
+        with self._cv:
+            return len(self._q) + self._inflight
+
+    def health(self):
+        """Point-in-time health snapshot for the front door's sweep:
+        load, the batcher-loop heartbeat / last-progress timestamps
+        (wedge = pending work but a stale heartbeat), and the lifecycle
+        flags.  One lock hold, plain dict out."""
+        with self._cv:
+            return {"pending": len(self._q) + self._inflight,
+                    "queued": len(self._q),
+                    "inflight": self._inflight,
+                    "hb_ts": self.hb_ts,
+                    "progress_ts": self.progress_ts,
+                    "killed": self._killed,
+                    "draining": self._draining,
+                    "stopped": self._stop}
+
+    def stop_admitting(self):
+        """Graceful-drain step 1: new ``submit`` calls are rejected with
+        reason ``draining`` while the batcher keeps working the queue
+        (step 2 is :meth:`drain`, step 3 :meth:`close`)."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    def drain(self, timeout=10.0):
+        """Block until the queue is empty and no batch is in flight
+        (call :meth:`stop_admitting` first or this may never converge).
+        Returns True when drained, False on timeout or a killed
+        batcher."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cv:
+            while self._q or self._inflight:
+                if self._killed or self._thread is None:
+                    return False
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.05))
+            return True
+
+    def detach_queue(self):
+        """Remove and return every QUEUED (not yet batch-claimed)
+        request — the front door hands them to a surviving replica via
+        :meth:`adopt` instead of failing admitted work."""
+        with self._cv:
+            orphans = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+            return orphans
+
+    def adopt(self, reqs):
+        """Admit requests detached from another replica.  Arrival
+        timestamps are preserved (head-of-line deadlines anchor at the
+        ORIGINAL arrival, so rescued work ships promptly) and the
+        ``queue_limit`` is deliberately bypassed: rescue must not
+        re-reject already-admitted requests.  Returns the count."""
+        reqs = list(reqs)
+        if not reqs:
+            return 0
+        with self._cv:
+            if self._stop or self._killed:
+                raise ServeRejected(
+                    "draining", "cannot adopt into a stopped router")
+            self._q.extend(reqs)
+            self._admitted += len(reqs)
+            record_serve("serve_queue_depth_hw", len(self._q))
+            self._cv.notify_all()
+        return len(reqs)
+
+    def kill(self):
+        """Chaos fail-stop: the batcher exits at its NEXT batch boundary
+        without touching the queue — queued requests stay put for the
+        front door to rescue (``detach_queue`` → ``adopt``), and a batch
+        already on the device completes normally.  The failure model is
+        fail-stop-at-a-boundary: no partial batch is ever half-answered,
+        which is what keeps the fleet's bitwise-response guarantee for
+        admitted requests.  New submits are rejected (``draining``)."""
+        with self._cv:
+            self._killed = True
+            self._cv.notify_all()
+
     # -- admission ---------------------------------------------------------
 
     def submit(self, feed_dict):
@@ -146,15 +287,20 @@ class ServingRouter:
         WITHOUT the batch dim — the batcher stacks).  Returns a Future
         resolving to one value per executor fetch (row ``i`` of
         batch-derived fetches; whole value otherwise).  Raises
-        :class:`ServeRejected` when the queue is full or the router is
-        closed."""
+        :class:`ServeRejected` when the queue is full (reason
+        ``queue_full``) or the router is closed / draining / killed
+        (reason ``draining``)."""
         req = _Request(feed_dict)
         with self._cv:
-            if self._stop:
-                raise ServeRejected("router is closed")
+            if self._stop or self._killed:
+                raise ServeRejected("draining", "router is closed")
+            if self._draining:
+                raise ServeRejected("draining",
+                                    "router is draining — not admitting")
             if len(self._q) >= self.queue_limit:
                 record_serve("serve_rejections")
                 raise ServeRejected(
+                    "queue_full",
                     f"request queue full ({self.queue_limit} waiting) — "
                     f"shed load upstream and retry")
             self._q.append(req)
@@ -176,8 +322,9 @@ class ServingRouter:
         at shutdown."""
         with self._cv:
             while not self._q:
-                if self._stop:
+                if self._stop or self._killed:
                     return None
+                self.hb_ts = time.monotonic()   # idle loop still beats
                 self._cv.wait(0.05)
             # the deadline anchors at the oldest request's ARRIVAL, not
             # at the moment the batcher got back around to the queue — a
@@ -185,13 +332,21 @@ class ServingRouter:
             # failover pull, a cold compile) ships immediately instead
             # of waiting up to a second full window
             deadline = self._q[0].t_arrival + self.max_wait_ms / 1e3
-            while len(self._q) < self.max_batch and not self._stop:
+            while len(self._q) < self.max_batch and not self._stop \
+                    and not self._killed:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
                 self._cv.wait(left)
+            if self._killed:
+                # fail-stop at the batch boundary: leave the queue
+                # intact for the front door's rescue
+                return None
             n = min(len(self._q), self.max_batch)
-            return [self._q.popleft() for _ in range(n)], self._admitted
+            reqs = [self._q.popleft() for _ in range(n)]
+            self._inflight += n
+            self.hb_ts = time.monotonic()
+            return reqs, self._admitted
 
     def _loop(self):
         while True:
@@ -209,6 +364,12 @@ class ServingRouter:
                 groups.setdefault(self._schema(r), []).append(r)
             for group in groups.values():
                 self._run_batch(group, admitted)
+            with self._cv:
+                self._inflight -= len(reqs)
+                now = time.monotonic()
+                self.hb_ts = now
+                self.progress_ts = now
+                self._cv.notify_all()   # drain() waits on this
 
     @staticmethod
     def _schema(req):
@@ -242,7 +403,8 @@ class ServingRouter:
         # batching/backpressure problem, not a model problem)
         now = time.monotonic()
         for r in reqs:
-            record_serve_latency("queue_wait", (now - r.t_arrival) * 1e6)
+            record_serve_latency(self._lat_queue_wait,
+                                 (now - r.t_arrival) * 1e6)
         tr = _TR if _TR.on else None
         if tr is not None:
             t_asm = time.perf_counter_ns()
@@ -263,7 +425,7 @@ class ServingRouter:
             # one; no runtime shape guessing to mis-scatter
             outs, rows_per_req = self.iex.infer_rows(stacked)
             t_done = time.perf_counter_ns()
-            record_serve_latency("batch", (t_done - t_call) / 1e3)
+            record_serve_latency(self._lat_batch, (t_done - t_call) / 1e3)
             if tr is not None:
                 tr.complete("serve.device_call", t_call, t_done,
                             cat="serve", args={"n": n})

@@ -194,6 +194,64 @@ def test_chaos_proc_step_kill_missing_handle_is_loud():
     assert fault_counts().get("chaos_kill_target_missing", 0) == 0
 
 
+def test_chaos_replica_kill_spec_parses():
+    """``kill:replica@<idx>:req<n>`` — the fleet-tier replica kill on
+    the FRONT DOOR's admission clock (ISSUE 17 satellite)."""
+    _, faults = chaos.parse_spec("7:kill:replica@1:req40")
+    assert faults == [{"kind": "kill_replica", "idx": 1, "req": 40}]
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec("7:kill:replica@1:step40")    # req clock only
+
+
+def test_chaos_replica_kill_fires_once_on_admission_clock():
+    """The replica kill fires its register_replica'd handle exactly once,
+    at exactly its admission count, and draws nothing from the RNG — a
+    schedule mixing it with probabilistic faults stays deterministic."""
+    reset_faults()
+    spec = "11:drop=0.2,kill:replica@1:req5"
+    inj = chaos.ChaosInjector.from_spec(spec)
+    reps = {i: _FakeProc() for i in range(2)}
+    for i, h in reps.items():
+        inj.register_replica(i, h)
+    assert inj.on_request(4) == []
+    assert reps[1].stopped == 0
+    assert inj.on_request(5) == [1]
+    assert reps[1].stopped == 1 and reps[0].stopped == 0
+    assert inj.on_request(5) == []      # one-shot
+    assert reps[1].stopped == 1
+    assert fault_counts().get("chaos_kill_replica") == 1
+    # determinism: same seed + same event order ⇒ same transport stream,
+    # kill present or not (replica kills draw nothing from the RNG)
+    a = chaos.ChaosInjector.from_spec(spec)
+    b = chaos.ChaosInjector.from_spec("11:drop=0.2")
+    a.register_replica(1, _FakeProc())
+    seq_a = []
+    for i in range(100):
+        if i == 50:
+            a.on_request(5)
+        seq_a.append(a.on_send(i % 3, 1))
+    assert seq_a == [b.on_send(i % 3, 1) for i in range(100)]
+
+
+def test_chaos_replica_kill_missing_handle_is_loud():
+    """A replica kill with NO registered replicas warns + counts; with
+    OTHER replicas registered it is a quiet no-op (the target lives
+    behind a different front door — chaos.py's kill:ps convention)."""
+    reset_faults()
+    inj = chaos.ChaosInjector.from_spec("7:kill:replica@1:req2")
+    with pytest.warns(RuntimeWarning, match="kill:replica@1:req2"):
+        assert inj.on_request(2) == []
+    assert fault_counts().get("chaos_kill_target_missing") == 1
+    reset_faults()
+    inj2 = chaos.ChaosInjector.from_spec("7:kill:replica@1:req2")
+    inj2.register_replica(0, _FakeProc())
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert inj2.on_request(2) == []
+    assert fault_counts().get("chaos_kill_target_missing", 0) == 0
+
+
 def test_partition_spec_parses():
     _, faults = chaos.parse_spec("7:partition:rank0|rank1@step3:heal7")
     assert faults == [{"kind": "partition", "a": frozenset({0}),

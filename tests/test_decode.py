@@ -116,14 +116,17 @@ def test_backpressure_and_too_long_rejection(decode_graph):
     router = DecodeRouter(eng, queue_limit=1, start=False)
     try:
         router.submit([1], max_new_tokens=2)
-        with pytest.raises(ServeRejected, match="queue full"):
+        with pytest.raises(ServeRejected) as ei:
             router.submit([2], max_new_tokens=2)
-        with pytest.raises(ServeRejected, match="max_len"):
+        assert ei.value.reason == "queue_full"      # structured taxonomy
+        with pytest.raises(ServeRejected) as ei:
             router.submit(list(range(10)), max_new_tokens=_MAX_LEN)
+        assert ei.value.reason == "over_max_len"
     finally:
         router.close()
-    with pytest.raises(ServeRejected, match="closed"):
+    with pytest.raises(ServeRejected) as ei:
         router.submit([1], max_new_tokens=2)
+    assert ei.value.reason == "draining"
 
 
 def test_stream_token_futures_and_iteration(decode_graph):
@@ -148,6 +151,62 @@ def test_router_close_fails_inflight_and_queued(decode_graph):
     router.close()
     with pytest.raises(ServeRejected):
         queued.result(timeout=5)
+
+
+# ---------------------------------------------- per-request deadlines (ISSUE 17)
+
+def test_decode_deadline_expired_in_queue_fails_fast(decode_graph):
+    """A queued request whose deadline passes before it gets a slot is
+    failed with the structured ``deadline`` reason WHEN the loop next
+    looks at the queue — it never occupies a slot, and the requests
+    behind it still run."""
+    metrics.reset_decode_counts()
+    eng = _engine(decode_graph, max_slots=1)
+    router = DecodeRouter(eng, queue_limit=8, start=False)
+    try:
+        doomed = router.submit([1, 2], max_new_tokens=2, deadline_ms=0.01)
+        live = router.submit([3, 2], max_new_tokens=2)
+        import time as _t
+        _t.sleep(0.05)                  # deadline long gone before start
+        router.start()
+        with pytest.raises(ServeRejected) as ei:
+            doomed.result(timeout=30)
+        assert ei.value.reason == "deadline"
+        assert live.result(timeout=60)  # the non-deadlined mate finishes
+        c = metrics.decode_counts()
+        assert c.get("decode_deadline_evictions", 0) == 1
+    finally:
+        router.close()
+
+
+def test_decode_deadline_mid_generation_evicts_and_frees_slot(decode_graph):
+    """A deadline that lands MID-generation evicts the seated sequence at
+    the next step boundary: its stream fails with reason ``deadline``,
+    the slot is recycled (a follow-up sequence runs through the same
+    1-slot engine), and the eviction is counted.  Driven through
+    ``evict_expired``'s explicit clock so the test is deterministic
+    regardless of compile-cache warmth."""
+    import time as _t
+
+    from hetu_tpu.serving.decode import _DecodeRequest
+    metrics.reset_decode_counts()
+    eng = _engine(decode_graph, max_slots=1)
+    req = _DecodeRequest(np.asarray([1, 2], np.int32), _MAX_LEN - 2,
+                         None, None, deadline=_t.monotonic() + 1000.0)
+    eng.join(req)
+    eng.step()
+    eng.step()                          # genuinely mid-generation
+    assert eng.evict_expired(now=req.deadline - 1.0) == 0   # not yet due
+    assert eng.evict_expired(now=req.deadline + 1.0) == 1   # due: evicts
+    with pytest.raises(ServeRejected) as ei:
+        req.stream.result(timeout=5)
+    assert ei.value.reason == "deadline"
+    assert eng.idle and eng.capacity() == 1
+    c = metrics.decode_counts()
+    assert c.get("decode_deadline_evictions", 0) == 1
+    # the freed slot seats new work through a live router
+    with DecodeRouter(eng, queue_limit=8) as router:
+        assert router.submit([3, 2], max_new_tokens=2).result(timeout=60)
 
 
 # --------------------------------------- compile-once / plan-cache steady state

@@ -198,6 +198,9 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_serve("serve_queue_depth_hw", 9)
     metrics.record_decode("decode_tokens", 7)
     metrics.record_decode("decode_kv_bytes_hw", 4096)
+    metrics.record_serve_rejection("shed:batch")
+    metrics.record_fleet("fleet_admitted", 6)
+    metrics.record_fleet("fleet_replicas_hw", 3)
     metrics.record_rpc("OP_PULL", 100.0, 2048)
     dump = obs.metrics_dump()
     legacy = {
@@ -214,6 +217,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "run_plan": metrics.run_plan_counts(),
         "serve": metrics.serve_counts(),
         "decode": metrics.decode_counts(),
+        "serve_rejection_reason": metrics.serve_rejection_counts(),
+        "fleet": metrics.fleet_counts(),
     }
     for fam, want in legacy.items():
         assert dump["counters"][fam] == want, fam
@@ -221,6 +226,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
     assert legacy["serve"]["serve_queue_depth_hw"] == 9
     assert legacy["decode"] == {"decode_tokens": 7,
                                 "decode_kv_bytes_hw": 4096}
+    assert legacy["serve_rejection_reason"] == {"shed:batch": 1}
+    assert legacy["fleet"] == {"fleet_admitted": 6, "fleet_replicas_hw": 3}
     assert dump["counters"]["ps_rpc_bytes"] == {"OP_PULL": 2048}
     assert dump["histograms"]["ps_rpc_us"]["OP_PULL"]["count"] == 1
     # the one-call profiler view is the same registry

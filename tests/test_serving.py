@@ -269,17 +269,20 @@ def test_queue_full_is_explicit_rejection_not_growth():
     try:
         futs = [r.submit({x: np.zeros((3,), np.float32)})
                 for _ in range(3)]
-        with pytest.raises(ServeRejected, match="queue full"):
+        with pytest.raises(ServeRejected) as ei:
             r.submit({x: np.zeros((3,), np.float32)})
+        assert ei.value.reason == "queue_full"      # structured taxonomy
         assert hmetrics.serve_counts()["serve_rejections"] == 1
+        assert hmetrics.serve_rejection_counts()["queue_full"] == 1
         assert r.queue_depth == 3
         r.start()                       # backpressure over: drain
         for f in futs:
             f.result(timeout=30)
     finally:
         r.close()
-    with pytest.raises(ServeRejected, match="closed"):
+    with pytest.raises(ServeRejected) as ei:
         r.submit({x: np.zeros((3,), np.float32)})
+    assert ei.value.reason == "draining"
 
 
 def test_close_rejects_still_queued_requests():
@@ -288,8 +291,9 @@ def test_close_rejects_still_queued_requests():
     r = ServingRouter(iex, queue_limit=8, start=False)
     fut = r.submit({x: np.zeros((3,), np.float32)})
     r.close()
-    with pytest.raises(ServeRejected, match="closed"):
+    with pytest.raises(ServeRejected) as ei:
         fut.result(timeout=5)
+    assert ei.value.reason == "draining"
 
 
 def test_close_survives_cancelled_queued_request():
@@ -304,8 +308,9 @@ def test_close_survives_cancelled_queued_request():
     assert doomed.cancel()              # still PENDING: cancel succeeds
     r.close()                           # must not raise
     assert doomed.cancelled()
-    with pytest.raises(ServeRejected, match="closed"):
+    with pytest.raises(ServeRejected) as ei:
         live.result(timeout=5)
+    assert ei.value.reason == "draining"
 
 
 def test_cancelled_request_does_not_kill_the_batcher():

@@ -16,6 +16,11 @@ acquisition graph:
   ``ServingRouter`` (condition-variable admission + batcher thread)
   and a read-only cache with a version-refresh sweep on its background
   thread;
+* **fleet** — a ``FrontDoor`` over two router replicas (ISSUE 17):
+  admission + health sweep under the door lock nesting into replica
+  condition variables, done-callbacks taking the door lock from
+  replica loop threads, a chaos replica kill with detach/adopt queue
+  rescue, an autoscaler poll and the graceful drain;
 * **elastic** — an ``ElasticController`` over a dp=4 CPU mesh driving
   a chaos-scheduled shrink and the grow-back (``resize_world``,
   step-clock kills through the chaos injector's lock).
@@ -149,6 +154,44 @@ def serving_plane():
             s.result(timeout=60)
 
 
+def fleet_plane():
+    """Fleet tier (ISSUE 17): FrontDoor over two router replicas —
+    admission under the door lock nesting into replica cv reads, done-
+    callbacks taking the door lock from replica loop threads, a chaos
+    replica kill with queue rescue (detach/adopt), an autoscaler poll,
+    and the graceful drain/close path."""
+    from hetu_tpu.serving import (FrontDoor, InferenceExecutor,
+                                  ServingRouter, SLOAutoscaler)
+    rng = np.random.RandomState(4)
+    x = ht.placeholder_op("xf")
+    w = ht.Variable("wf", value=rng.randn(5, 3).astype(np.float32))
+    y = ht.matmul_op(x, w)
+
+    def mk(idx):
+        return ServingRouter(InferenceExecutor([y], buckets=(4,)),
+                             max_batch=4, max_wait_ms=2.0,
+                             queue_limit=16, name=f"r{idx}")
+
+    inj = chaos.ChaosInjector.from_spec("7:kill:replica@0:req6")
+    prev = chaos.install(inj)
+    try:
+        door = FrontDoor(mk, 2, health_every_ms=0.0)
+        scaler = SLOAutoscaler(door, p99_target_ms=1e6, min_replicas=1,
+                               max_replicas=2)
+        futs = [door.submit({x: rng.randn(5).astype(np.float32)})
+                for _ in range(8)]
+        time.sleep(0.1)
+        scaler.poll()           # sweep: eject the killed replica, rescue
+        for f in futs:
+            try:
+                f.result(timeout=30)
+            except Exception:   # noqa: BLE001 — per-request fate only
+                pass
+        door.close()
+    finally:
+        chaos.install(prev)
+
+
 def elastic_plane():
     """Chaos-scheduled shrink at step 2, rejoin, grow-back."""
     from hetu_tpu.parallel.elastic import (ElasticController, LogicalRank,
@@ -187,6 +230,7 @@ def main(out=None):
     WITNESS.reset()
     training_plane()
     serving_plane()
+    fleet_plane()
     elastic_plane()
     cycles = WITNESS.check()
     rep = WITNESS.export(out)
