@@ -47,6 +47,24 @@ just means; ``--config serve`` adds ``latency_hist_ms`` /
 run with a mid-run primary kill — step spans, per-opcode RPC spans,
 fault point events, serving + feed-pipeline tracks.
 
+``artifacts/decode_bench.json`` (``bench.py --config decode``, ISSUE 16
+schema v2 per ISSUE 18) compares continuous (chunked-prefill),
+token-by-token and request-level decoding of one seeded zipf stream in
+interleaved best-of rounds: per-leg ``tokens_per_s``/``p50_ms``/
+``p99_ms`` + decode counters, ``streams_bitwise_equal`` across all
+three, ``compile_once`` (``bucket_keys`` now counts ``(batch, len)``
+pairs PLUS chunked ``(batch, chunk, len)`` triples against
+``bucket_key_bound``), ``prefill`` (chunked steps, steps saved vs
+token-by-token, skipped logits fetches), ``ttft_vs_token_by_token``
+(per-prompt-length chunked vs token-by-token time-to-first-token,
+measured directly on engines, min over reps; ``ttft_wins_every_length``
+gates it), ``ttft_histogram`` (the ``ttft`` label of
+``decode_latency_us`` — one observation per stream,
+``ttft_counted_per_stream``), ``prefix_cache`` (pool-stream hit/miss/
+eviction counts, ``hit_rate``, ``prefill_rows_cold`` vs ``_warm``, and
+the warm run's bitwise parity with its cold reference) and the ISSUE 16
+``kv_cache_vs_reprefill`` per-length leg.
+
 ``artifacts/fleet_bench.json`` (``bench.py --config fleet``, ISSUE 17)
 is the fleet-tier acceptance: ``slo`` (interactive p99 vs target, both
 runs), ``scaling`` (the autoscaler's resize timeline on the admission

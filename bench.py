@@ -3341,23 +3341,47 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
     * **request-level** (the baseline): joins only into an EMPTY engine,
       so the whole batch drains at the pace of its slowest sequence.
 
-    Gates: the two policies produce BITWISE-identical token streams
-    (scheduling must not change results); continuous beats request-level
-    on tokens/s with a no-worse p99 time-to-token; the counter proof of
-    the compile-once steady state holds over the stream (real compiles +
-    serve-cache reuses == dispatch-plan misses == distinct bucket pairs,
-    every other step a ``plan_cache_hit``); zero rejections.  A third
-    leg times one incremental decode step against the naive full
-    re-prefill forward at every measured cache length — the
-    O(1)-vs-O(len) per-token claim.  Host-side scheduling dominates the
-    measured deltas, so CPU is a faithful backend for the policy
-    comparison (the jitted step is the same program either way)."""
+    ISSUE 18 (v2) adds prompt-INGESTION legs on top:
+
+    * **token-by-token** (the PR 16 ingestion baseline): the same
+      continuous stream with no chunked entry — every prompt token is
+      one engine step;
+    * the continuous leg now runs CHUNKED prefill (``max_chunk=8``):
+      prompts ingest in ``ceil(P/chunk)`` mixed-batch steps through the
+      q_len=C graph entry, pure-prefill steps skip the logits D2H;
+    * **prefix**: a popularity-skewed pool stream decoded twice through
+      chunked engines — cold (reference) and with a
+      :class:`PrefixKVStore`, whose hits seat repeat prompts with their
+      KV rows pre-filled and skip prefill outright;
+    * **ttft**: time-to-first-token measured directly on engines (join
+      -> first emitted token, min over reps) at controlled prompt
+      lengths, chunked vs token-by-token.
+
+    Gates: ALL policy/ingestion legs produce BITWISE-identical token
+    streams (scheduling and ingestion mode must not change results);
+    continuous beats request-level on tokens/s with a no-worse p99
+    time-to-token, and chunked tokens/s is no worse than token-by-token;
+    chunked TTFT beats token-by-token at EVERY measured prompt length;
+    the prefix run's streams match its cold reference with hits > 0 and
+    prefill rows saved; every stream records exactly one ``ttft``
+    histogram observation; the counter proof of the compile-once steady
+    state holds over the chunked stream (real compiles + serve-cache
+    reuses == dispatch-plan misses == distinct bucket keys — ``(batch,
+    len)`` pairs and ``(batch, chunk, len)`` triples — every other step
+    a ``plan_cache_hit``); zero rejections.  A further leg times one
+    incremental decode step against the naive full re-prefill forward at
+    every measured cache length — the O(1)-vs-O(len) per-token claim.
+    Host-side scheduling dominates the measured deltas, so CPU is a
+    faithful backend for the policy comparison (the jitted step is the
+    same program either way)."""
     import jax
     from hetu_tpu import metrics as ht_metrics
-    from hetu_tpu.models import GPT2Config, gpt2_decode_graph
+    from hetu_tpu.models import (GPT2Config, gpt2_decode_chunked_graph,
+                                 gpt2_decode_graph)
     from hetu_tpu.models.gpt2 import gpt2_lm_graph
+    from hetu_tpu.profiler import HetuProfiler
     from hetu_tpu.serving import (DecodeEngine, DecodeRouter,
-                                  InferenceExecutor)
+                                  InferenceExecutor, PrefixKVStore)
     from hetu_tpu.serving.decode import _DecodeRequest
 
     if write_artifact is None:
@@ -3377,22 +3401,30 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
     prompts = [rng.randint(1, cfg.vocab_size, int(l)).astype(np.int32)
                for l in plens]
 
-    def one_pass(continuous):
-        ht_metrics.reset_all()
+    def mk_engine(chunked, store=None):
         feeds, logits, caches, _ = gpt2_decode_graph(cfg, max_len=max_len)
-        eng = DecodeEngine(feeds, logits, caches, max_slots=max_slots,
-                           max_len=max_len, seed=0)
+        kw = {}
+        if chunked:
+            cf, cl, cc, _ = gpt2_decode_chunked_graph(cfg, max_len=max_len)
+            kw = {"chunked": (cf, cl, cc), "max_chunk": 8}
+        return DecodeEngine(feeds, logits, caches, max_slots=max_slots,
+                            max_len=max_len, seed=0, prefix_store=store,
+                            **kw)
+
+    def one_pass(continuous, chunked, store=None, reqs=None):
+        ht_metrics.reset_all()
+        eng = mk_engine(chunked, store=store)
         lat_ms = []          # time-to-token over EVERY emitted token
-        with DecodeRouter(eng, queue_limit=n_requests + 8,
+        rq = reqs if reqs is not None else list(zip(prompts, news))
+        with DecodeRouter(eng, queue_limit=len(rq) + 8,
                           max_wait_ms=5.0,
                           continuous=continuous) as router:
             t0 = time.monotonic()
             streams = []
-            for j in range(n_requests):
+            for p, nw in rq:
                 t_sub = time.monotonic()
-                s = router.submit(prompts[j],
-                                  max_new_tokens=int(news[j]))
-                for i in range(int(news[j])):
+                s = router.submit(p, max_new_tokens=int(nw))
+                for i in range(int(nw)):
                     s.token(i).add_done_callback(
                         lambda f, t=t_sub: lat_ms.append(
                             (time.monotonic() - t) * 1e3)
@@ -3401,6 +3433,7 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                 streams.append(s)
             tokens = [s.result(timeout=600) for s in streams]
             wall_s = time.monotonic() - t0
+        lat = HetuProfiler.latency_stats().get("decode_latency_us", {})
         return {
             "tokens": tokens,
             "lat_ms": lat_ms,
@@ -3410,20 +3443,49 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
             "serve": ht_metrics.serve_counts(),
             "run_plan": ht_metrics.run_plan_counts(),
             "step_cache": ht_metrics.step_cache_counts(),
-            "ladder": (len(eng.batch_ladder), len(eng.len_ladder)),
+            "prefix_ct": ht_metrics.prefix_cache_counts(),
+            "ttft_hist": lat.get("ttft", {}),
+            "ladder": (len(eng.batch_ladder), len(eng.len_ladder),
+                       len(eng.chunk_ladder)),
         }
 
-    def run_stream(continuous):
-        # warmup pass: populate the process-wide serve cache so the
-        # measured pass times SCHEDULING, not first-touch XLA compiles
-        # (the steady state a long-lived server actually runs in; the
-        # measured pass's counters still prove the compile-once claim —
-        # its builds all land as step_cache_serve_hits)
-        one_pass(continuous)
-        return one_pass(continuous)
+    # Warmup passes populate the process-wide serve cache so the
+    # measured passes time SCHEDULING, not first-touch XLA compiles (the
+    # steady state a long-lived server actually runs in; the measured
+    # passes' counters still prove the compile-once claim — their builds
+    # all land as step_cache_serve_hits).  The legs then run in
+    # INTERLEAVED rounds with best-of on tokens/s: shared-host
+    # contention and allocator warm-up drift only ever SLOW a pass and
+    # hit whichever leg is running, so sequential legs would fold
+    # process age into the policy comparison; interleaving gives every
+    # leg the same noise exposure and the fastest pass is the
+    # least-noise estimate of each (counters and token streams are
+    # deterministic across passes — any pass serves as the proof).
+    legs = {"tok": (True, False),    # PR 16 token-by-token ingestion
+            "cont": (True, True),    # chunked continuous (the tentpole)
+            "reql": (False, False)}  # request-level baseline
+    for continuous, chunked in legs.values():
+        one_pass(continuous, chunked)
+    passes = {k: [] for k in legs}
+    for _ in range(1 if smoke else 4):
+        for k, (continuous, chunked) in legs.items():
+            passes[k].append(one_pass(continuous, chunked))
+    tok, cont, reql = (max(passes[k], key=lambda p: p["tps"])
+                       for k in ("tok", "cont", "reql"))
 
-    cont = run_stream(continuous=True)
-    reql = run_stream(continuous=False)
+    # --- shared-prefix KV reuse: popularity-skewed pool stream ----------
+    # The same chunked engine decodes the pool stream cold (reference)
+    # and with a PrefixKVStore; repeats must HIT, skip their prefill,
+    # and still produce the cold run's exact tokens.
+    pool_n = max(4, n_requests // 8)
+    pool = [rng.randint(1, cfg.vocab_size,
+                        int(rng.randint(4, max_len // 2 + 1))
+                        ).astype(np.int32) for _ in range(pool_n)]
+    picks = np.minimum(rng.zipf(1.3, n_requests) - 1, pool_n - 1)
+    pref_reqs = [(pool[int(k)], int(min(rng.zipf(1.6) + 1, gen_cap)))
+                 for k in picks]
+    pref_cold = one_pass(True, True, reqs=pref_reqs)
+    pref_warm = one_pass(True, True, store=PrefixKVStore(), reqs=pref_reqs)
 
     def pct(xs, q):
         return float(np.percentile(np.asarray(xs), q))
@@ -3485,8 +3547,46 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                         "reprefill_ms": round(reprefill_ms, 3),
                         "speedup": round(reprefill_ms / incr_ms, 2)})
 
+    # --- time-to-first-token: chunked vs token-by-token ingestion --------
+    # Measured directly on engines (join -> stream complete with
+    # max_new=1), min over reps after a compile-warmup rep.  Chunked
+    # ingestion pays ceil(L/chunk) steps where token-by-token pays L, so
+    # the win is structural, not a timing accident.
+    ttft_lens = (4, 8, 16) if smoke else (4, 8, 16, 24)
+    ttft_reps = 3 if smoke else 5
+    engines = {"token_by_token": mk_engine(chunked=False),
+               "chunked": mk_engine(chunked=True)}
+    ttft_rows = []
+    for L in ttft_lens:
+        prompt = np.full(L, 3, np.int32)
+        ms, toks = {}, {}
+        for name, eng in engines.items():
+            best = None
+            for r in range(ttft_reps + 1):     # rep 0: compile warmup
+                req = _DecodeRequest(prompt, max_new=1, eos_id=None,
+                                     fid=None)
+                t = time.perf_counter()
+                eng.join(req)
+                while eng.active:
+                    eng.step()
+                dt = (time.perf_counter() - t) * 1e3
+                toks[name] = req.stream.result(timeout=60)
+                if r:
+                    best = dt if best is None else min(best, dt)
+            ms[name] = best
+        ttft_rows.append({
+            "prompt_len": int(L),
+            "token_by_token_ms": round(ms["token_by_token"], 3),
+            "chunked_ms": round(ms["chunked"], 3),
+            "speedup": round(ms["token_by_token"] / ms["chunked"], 2),
+            "bitwise_equal": toks["token_by_token"] == toks["chunked"],
+        })
+    ttft_wins = all(r["chunked_ms"] < r["token_by_token_ms"]
+                    and r["bitwise_equal"] for r in ttft_rows)
+
     # --- the acceptance gates --------------------------------------------
-    bitwise = cont["tokens"] == reql["tokens"]
+    bitwise = (cont["tokens"] == reql["tokens"]
+               and cont["tokens"] == tok["tokens"])
     steps_n = cont["decode"]["decode_steps"]
     pairs = cont["run_plan"].get("plan_cache_miss", 0)
     compiles = (cont["serve"].get("serve_bucket_compiles", 0)
@@ -3494,15 +3594,26 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
     compile_once = (pairs > 0 and compiles == pairs
                     and cont["run_plan"].get("plan_cache_hit", 0)
                     == steps_n - pairs
-                    and pairs <= cont["ladder"][0] * cont["ladder"][1])
+                    and pairs <= cont["ladder"][0] * cont["ladder"][1]
+                    * cont["ladder"][2])
     kv_wins = all(r["incremental_ms"] < r["reprefill_ms"]
                   for r in per_len)
-    no_rejects = (cont["decode"].get("decode_rejections", 0) == 0
-                  and reql["decode"].get("decode_rejections", 0) == 0)
+    no_rejects = all(leg["decode"].get("decode_rejections", 0) == 0
+                     for leg in (cont, reql, tok, pref_warm))
+    pc = pref_warm["prefix_ct"]
+    hits = pc.get("prefix_cache_hits", 0)
+    misses = pc.get("prefix_cache_misses", 0)
+    prefix_ok = (pref_warm["tokens"] == pref_cold["tokens"]
+                 and hits > 0
+                 and pref_warm["decode"].get("decode_prefill_rows", 0)
+                 < pref_cold["decode"].get("decode_prefill_rows", 0))
+    ttft_counted = cont["ttft_hist"].get("count", 0) == n_requests
     cont_p99 = pct(cont["lat_ms"], 99)
     req_p99 = pct(reql["lat_ms"], 99)
-    perf_ok = cont["tps"] > reql["tps"] and cont_p99 <= req_p99
+    perf_ok = (cont["tps"] > reql["tps"] and cont_p99 <= req_p99
+               and cont["tps"] >= tok["tps"])
     ok = bitwise and compile_once and kv_wins and no_rejects \
+        and ttft_wins and prefix_ok and ttft_counted \
         and (perf_ok or smoke)     # the perf margin gates the full run
 
     result = {
@@ -3511,21 +3622,33 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
         "unit": "tokens/s",
         "vs_baseline": round(cont["tps"] / reql["tps"], 3) if ok else 0.0,
         "extra": {
-            "baseline_def": "continuous-batching tokens/s over request-"
-                            "level batching of the SAME seeded zipf "
-                            "stream (bitwise-identical token streams "
-                            "required); 0.0 unless every gate held: "
-                            "compile-once per (batch,len) bucket pair "
-                            "with plan-cache-hit steady state, "
-                            "incremental KV step faster than re-prefill "
-                            "at every measured length, zero rejections, "
-                            "and (full runs) better tokens/s at "
-                            "no-worse p99 time-to-token",
+            "baseline_def": "chunked continuous-batching tokens/s over "
+                            "request-level batching of the SAME seeded "
+                            "zipf stream (bitwise-identical token "
+                            "streams required across continuous, "
+                            "request-level AND token-by-token "
+                            "ingestion); 0.0 unless every gate held: "
+                            "compile-once per (batch,len) pair and "
+                            "(batch,chunk,len) triple with "
+                            "plan-cache-hit steady state, incremental "
+                            "KV step faster than re-prefill at every "
+                            "measured length, chunked TTFT faster than "
+                            "token-by-token at every measured prompt "
+                            "length, prefix-cache hits with prefill "
+                            "rows saved and a bitwise-equal stream, one "
+                            "ttft histogram observation per stream, "
+                            "zero rejections, and (full runs) better "
+                            "tokens/s at no-worse p99 time-to-token "
+                            "with chunked tokens/s no worse than "
+                            "token-by-token",
             **_provenance({"n_requests": n_requests,
                            "max_slots": max_slots, "max_len": max_len,
                            "gen_cap": gen_cap, "zipf_prompt_a": 1.5,
                            "zipf_gen_a": 1.6, "n_embd": cfg.n_embd,
                            "n_layer": cfg.n_layer, "seed": seed,
+                           "max_chunk": 8, "prefix_pool": pool_n,
+                           "zipf_pool_a": 1.3,
+                           "ttft_lens": list(ttft_lens),
                            "kv_leg_n_embd": 384, "kv_leg_n_layer": 4,
                            "kv_leg_max_len": kv_max_len,
                            "smoke": bool(smoke)}),
@@ -3543,10 +3666,20 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                 "wall_s": round(reql["wall_s"], 2),
                 "counters": reql["decode"],
             },
+            "token_by_token": {
+                "tokens_per_s": round(tok["tps"], 1),
+                "p50_ms": round(pct(tok["lat_ms"], 50), 2),
+                "p99_ms": round(pct(tok["lat_ms"], 99), 2),
+                "wall_s": round(tok["wall_s"], 2),
+                "counters": tok["decode"],
+            },
             "streams_bitwise_equal": bitwise,
             "compile_once": {
                 "decode_steps": int(steps_n),
-                "bucket_pairs": int(pairs),
+                "bucket_keys": int(pairs),
+                "bucket_key_bound": int(cont["ladder"][0]
+                                        * cont["ladder"][1]
+                                        * cont["ladder"][2]),
                 "serve_bucket_compiles": int(
                     cont["serve"].get("serve_bucket_compiles", 0)),
                 "step_cache_serve_hits": int(
@@ -3554,6 +3687,33 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                 "plan_cache_hits": int(
                     cont["run_plan"].get("plan_cache_hit", 0)),
                 "holds": bool(compile_once),
+            },
+            "prefill": {
+                "steps": int(cont["decode"].get(
+                    "decode_prefill_steps", 0)),
+                "steps_saved_vs_token_by_token": int(cont["decode"].get(
+                    "decode_prefill_steps_saved", 0)),
+                "logits_fetches_skipped": int(cont["decode"].get(
+                    "decode_logits_skipped", 0)),
+            },
+            "ttft_vs_token_by_token": ttft_rows,
+            "ttft_wins_every_length": ttft_wins,
+            "ttft_histogram": cont["ttft_hist"],
+            "ttft_counted_per_stream": ttft_counted,
+            "prefix_cache": {
+                "hits": int(hits),
+                "misses": int(misses),
+                "hit_rate": round(hits / max(1, hits + misses), 3),
+                "hit_rows": int(pc.get("prefix_cache_hit_rows", 0)),
+                "evictions": int(pc.get("prefix_cache_evictions", 0)),
+                "bytes_hw": int(pc.get("prefix_cache_bytes_hw", 0)),
+                "prefill_rows_cold": int(pref_cold["decode"].get(
+                    "decode_prefill_rows", 0)),
+                "prefill_rows_warm": int(pref_warm["decode"].get(
+                    "decode_prefill_rows", 0)),
+                "streams_bitwise_equal": pref_warm["tokens"]
+                == pref_cold["tokens"],
+                "holds": bool(prefix_ok),
             },
             "kv_cache_vs_reprefill": per_len,
             "kv_incremental_wins_every_length": kv_wins,

@@ -282,10 +282,12 @@ def serve_signature(iex, bucket):
     step cache; restart reuse across processes rides
     ``HETU_COMPILE_CACHE_DIR`` exactly like training).
 
-    ``bucket``: the padded batch bucket (int), or a (batch_bucket,
-    len_bucket) pair for the autoregressive-decode plane — each pair
-    pins its own executable, which is what lets the decode counters
-    prove at most one compile per (batch, len) bucket pair."""
+    ``bucket``: the padded batch bucket (int), or a tuple for the
+    autoregressive-decode plane — a (batch_bucket, len_bucket) pair for
+    the one-token entry, a (batch_bucket, chunk_bucket, len_bucket)
+    triple for the chunked-prefill entry (ISSUE 18) — each key pins its
+    own executable, which is what lets the decode counters prove at
+    most one compile per bucket key."""
     h = hashlib.sha256()
     try:
         import jax

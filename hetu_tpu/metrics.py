@@ -557,7 +557,13 @@ def reset_serve_counts():
 # claim), queue-full rejections (``decode_rejections``), and the
 # device-resident KV-cache footprint high-water mark
 # (``decode_kv_bytes_hw`` — gauge semantics: the recorded value is the MAX
-# ever seen).  Surfaced by ``HetuProfiler.decode_counters()`` and
+# ever seen).  Chunked prefill (ISSUE 18) adds the prompt-ingestion
+# accounting: ``decode_prefill_steps`` (steps that ran the q_len=C
+# chunked entry), ``decode_prefill_steps_saved`` (dispatches a chunked
+# step avoided vs the token-by-token path: the widest row's chunk minus
+# one, per chunked step), and ``decode_logits_skipped`` (steps that
+# skipped the (batch, vocab) logits D2H because no row was past its
+# prompt).  Surfaced by ``HetuProfiler.decode_counters()`` and
 # ``bench.py --config decode``; a process that never decodes reports an
 # empty dict.
 
@@ -587,6 +593,46 @@ def reset_decode_counts():
     one decode run's telemetry, one reset."""
     _decode.reset()
     _decode_latency.reset()
+
+
+# ------------------------------------------------- prefix-cache counters
+# The shared-prefix KV store (``hetu_tpu.serving.prefix_cache``, ISSUE
+# 18) records its reuse economics here: lookups that found a usable
+# stored prefix (``prefix_cache_hits``) vs not (``prefix_cache_misses``),
+# the total KV-cache ROWS those hits seated pre-filled — i.e. prompt
+# tokens whose prefill was skipped outright (``prefix_cache_hit_rows``),
+# snapshots inserted (``prefix_cache_inserts``) and deduplicated against
+# an existing key (``prefix_cache_dup_inserts``), entries LRU-evicted to
+# stay under the capacity bound (``prefix_cache_evictions``) with the
+# bytes they freed (``prefix_cache_evicted_bytes``), and the store's
+# resident-bytes high-water mark (``prefix_cache_bytes_hw`` — gauge
+# semantics: the recorded value is the MAX ever seen).  Surfaced by
+# ``HetuProfiler.prefix_cache_counters()`` and the decode bench; a
+# process with no prefix store reports an empty dict.
+
+_prefix_cache = REGISTRY.counter_family(
+    "prefix_cache",
+    "shared-prefix KV snapshot reuse events (empty in a process with "
+    "no PrefixKVStore)")
+
+
+def record_prefix_cache(kind, n=1):
+    """Count ``n`` prefix-cache events of ``kind``; kinds ending in
+    ``_hw`` are high-water gauges (the stored value is the max seen)."""
+    kind = str(kind)
+    if kind.endswith("_hw"):
+        _prefix_cache.max_gauge(kind, int(n))
+    elif n:
+        _prefix_cache.inc(kind, int(n))
+
+
+def prefix_cache_counts():
+    """{kind: count} snapshot of prefix-cache counters."""
+    return _prefix_cache.counts()
+
+
+def reset_prefix_cache_counts():
+    _prefix_cache.reset()
 
 
 # --------------------------------------------- serving rejection reasons
@@ -724,16 +770,20 @@ def serve_latency_stats():
 # Decode latency: per-token inter-emission latency (``token`` — one
 # observation per token STREAMED to a caller, the number a serving SLO is
 # written against), per-request join wait (``join_wait`` — submit ->
-# joined the in-flight batch), and per-engine-step device call (``step``).
+# joined the in-flight batch), per-request time-to-first-token (``ttft``
+# — submit -> FIRST generated token, the prompt-ingestion latency
+# chunked prefill attacks; distinct from the steady-state ``token``
+# gap), and per-engine-step device call (``step``).
 _decode_latency = REGISTRY.histogram(
     "decode_latency_us",
-    "decode latency: per-token emission, per-request join wait, and "
-    "per-step device call, microseconds")
+    "decode latency: per-token emission, per-request join wait, "
+    "time-to-first-token, and per-step device call, microseconds")
 
 
 def record_decode_latency(kind, us):
     """Observe one decode latency sample (``kind``: ``token`` per emitted
-    token, ``join_wait`` per joined request, ``step`` per engine step)."""
+    token, ``join_wait`` per joined request, ``ttft`` once per stream at
+    its first generated token, ``step`` per engine step)."""
     _decode_latency.observe(us, label=kind)
 
 
@@ -834,6 +884,7 @@ _FAMILIES = {
     "run_plan": _run_plan,
     "serve": _serve,
     "decode": _decode,
+    "prefix_cache": _prefix_cache,
     "serve_rejection_reason": _serve_reject,
     "fleet": _fleet,
     "ps_rpc_bytes": _rpc_bytes,

@@ -4,7 +4,8 @@ graft entry and examples share one implementation)."""
 from .bert import (BertConfig, bert_model, bert_pretrain_graph,
                    bert_pooler, bert_classify_graph)
 from .gpt2 import (GPT2Config, gpt2_model, gpt2_lm_graph,
-                   gpt2_decode_graph, synthetic_lm_batch)
+                   gpt2_decode_graph, gpt2_decode_chunked_graph,
+                   synthetic_lm_batch)
 from .t5 import (T5Config, t5_encoder, t5_decoder, t5_seq2seq_graph,
                  synthetic_seq2seq_batch)
 from .vit import (ViTConfig, vit_model, vit_classify_graph,

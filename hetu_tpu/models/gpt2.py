@@ -217,6 +217,115 @@ def gpt2_decode_graph(cfg, max_len=None, name="gpt2"):
     return feeds, logits, cache_fetches, layers
 
 
+def _block_decode_chunked(cfg, x, ids, k_cache, v_cache, positions, valid,
+                          name):
+    """Chunked-prefill twin of :func:`_block_decode` (ISSUE 18): the
+    residual stream is (B*C, n_embd) for a (B, C) token chunk, weights
+    identical BY NAME, the cache write masked by ``valid`` (rows past a
+    sequence's real consumption keep the old cache bytes) and attention
+    through the q_len=C entry with causal-within-chunk masking.
+    Returns (x, new_k_cache, new_v_cache, layer)."""
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln1")(x)
+
+    def heads(t):
+        # (B*C, n_embd) -> (B, H, C, dk), (B, C) recovered from ids
+        return ops.split_heads_chunk_op(t, ids, n_head=cfg.n_head)
+
+    lq = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.q")
+    lk = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.k")
+    lv = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.v")
+    lo = Linear(cfg.n_embd, cfg.n_embd, name=name + ".attn.o")
+    q = heads(lq(h))
+    kc = ops.kv_cache_append_op(k_cache, heads(lk(h)), positions, valid)
+    vc = ops.kv_cache_append_op(v_cache, heads(lv(h)), positions, valid)
+    att = ops.sdpa_prefill_op(q, kc, vc, positions)      # (B, H, C, dk)
+    att = ops.merge_heads_chunk_op(att)                  # (B*C, n_embd)
+    x = x + lo(att)
+    h = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln2")(x)
+    fc = Linear(cfg.n_embd, 4 * cfg.n_embd, activation="gelu",
+                initializer=init.GenTruncatedNormal(0.0, 0.02),
+                name=name + ".mlp_fc")
+    proj = Linear(4 * cfg.n_embd, cfg.n_embd,
+                  initializer=init.GenTruncatedNormal(0.0, 0.02),
+                  name=name + ".mlp_proj")
+    x = x + proj(fc(h))
+    layer = _DecodeBlockLayer(
+        [lq.weight_var, lk.weight_var, lv.weight_var, fc.weight_var],
+        [lo.weight_var, proj.weight_var])
+    return x, kc, vc, layer
+
+
+def gpt2_decode_chunked_graph(cfg, max_len=None, chunk=4, name="gpt2"):
+    """Chunked-prefill autoregressive decode graph (ISSUE 18): each step
+    consumes a (B, C) token CHUNK instead of one token per sequence, so
+    a P-token prompt ingests in ceil(P/C) dispatches instead of P.
+
+    Weight names match :func:`gpt2_decode_graph` / :func:`gpt2_lm_graph`
+    exactly — the decode engine loads this graph's executor FROM the
+    primary executor's params so both entries serve the same bytes.
+    Feeds (batch AND chunk dim bucketed by the engine at runtime —
+    ``chunk`` here only sizes the nominal placeholders):
+
+    * ``input_ids`` (B, C) int32 — up to C prompt tokens per sequence
+      this step (generating rows ride along with their one token at
+      column 0)
+    * ``positions`` (B,) int32 — the cache row of each sequence's FIRST
+      chunk token
+    * ``valid`` (B,) int32 — how many chunk columns each sequence
+      actually consumes (0 for idle slots); rows ``>= valid`` neither
+      write the cache nor reach the logits
+    * ``k_cache_i`` / ``v_cache_i`` (B, n_head, L, head_dim) per layer —
+      donated, fed back from the previous step's fetches
+
+    Returns ``(feeds, logits, cache_fetches, layers)`` like the
+    one-token graph; ``logits`` is (B, vocab) for each sequence's LAST
+    consumed chunk token (gathered before ln_f/lm_head so the vocab
+    projection stays B-row)."""
+    max_len = int(max_len or cfg.n_positions)
+    chunk = int(chunk)
+    dk = cfg.n_embd // cfg.n_head
+    ids = placeholder_op("input_ids", shape=(cfg.batch_size, chunk),
+                         dtype=np.int32)
+    positions = placeholder_op("positions", shape=(cfg.batch_size,),
+                               dtype=np.int32)
+    valid = placeholder_op("valid", shape=(cfg.batch_size,),
+                           dtype=np.int32)
+    wte = init.truncated_normal((cfg.vocab_size, cfg.n_embd), 0.0, 0.02,
+                                name=name + ".wte")
+    wpe = init.truncated_normal((cfg.n_positions, cfg.n_embd), 0.0, 0.01,
+                                name=name + ".wpe")
+    pos2d = ops.chunk_positions_op(positions, ids,
+                                   limit=cfg.n_positions)   # (B, C)
+    x = ops.embedding_lookup_op(wte, ids)             # (B, C, n_embd)
+    x = ops.array_reshape_op(x, output_shape=(-1, cfg.n_embd))
+    pe = ops.embedding_lookup_op(wpe, pos2d)          # (B, C, n_embd)
+    pe = ops.array_reshape_op(pe, output_shape=(-1, cfg.n_embd))
+    x = x + pe
+    feeds = {"input_ids": ids, "positions": positions, "valid": valid}
+    cache_fetches, layers = [], []
+    for i in range(cfg.n_layer):
+        kc = placeholder_op(
+            f"k_cache_{i}", dtype=np.float32,
+            shape=(cfg.batch_size, cfg.n_head, max_len, dk))
+        vc = placeholder_op(
+            f"v_cache_{i}", dtype=np.float32,
+            shape=(cfg.batch_size, cfg.n_head, max_len, dk))
+        feeds[f"k_cache_{i}"] = kc
+        feeds[f"v_cache_{i}"] = vc
+        x, kc2, vc2, layer = _block_decode_chunked(
+            cfg, x, ids, kc, vc, positions, valid, f"{name}.h{i}")
+        cache_fetches += [kc2, vc2]
+        layers.append(layer)
+    # each sequence's last consumed row, BEFORE ln_f/lm_head: LayerNorm
+    # is row-wise so the gather commutes, and the vocab matmul shrinks C×
+    x = ops.chunk_emit_gather_op(x, ids, valid)       # (B, n_embd)
+    x = LayerNorm(cfg.n_embd, cfg.layer_norm_epsilon, name + ".ln_f")(x)
+    logits = Linear(cfg.n_embd, cfg.vocab_size,
+                    initializer=init.GenTruncatedNormal(0.0, 0.02),
+                    name=name + ".lm_head")(x)
+    return feeds, logits, cache_fetches, layers
+
+
 def synthetic_lm_batch(cfg, seed=0):
     """Next-token synthetic batch: ids shifted left for labels."""
     rng = np.random.RandomState(seed)

@@ -377,19 +377,151 @@ def _sdpa_decode(c, q, k_cache, v_cache, positions, scale=None):
 sdpa_decode_op = def_op("ScaledDotProductAttentionDecode", _sdpa_decode)
 
 
-def _kv_cache_append(c, cache, new, positions):
-    """Append one (B, H, 1, D) token row into the (B, H, L, D) cache at
-    each sequence's own position — a batched dynamic_update_slice, the
-    incremental write that makes a generation O(S) total attention work
-    instead of re-prefill's O(S^2).  Out-of-range positions clamp (XLA
-    dynamic_update_slice semantics): an idle batch slot fed position 0
-    merely rewrites a row the next join resets anyway."""
-    def upd(c_hld, n_h1d, p):
-        return jax.lax.dynamic_update_slice(c_hld, n_h1d, (0, p, 0))
-    return jax.vmap(upd)(cache, new, positions.astype(jnp.int32))
+def _kv_cache_append(c, cache, new, positions, valid=None):
+    """Append (B, H, C, D) token rows into the (B, H, L, D) cache at
+    ``positions[b] .. positions[b]+C`` — a batched dynamic_update_slice,
+    the incremental write that makes a generation O(S) total attention
+    work instead of re-prefill's O(S^2).  C=1 is the classic decode
+    write; C>1 is a chunked-prefill write (ISSUE 18).
+
+    ``valid`` (optional 4th graph input, (B,) int): rows ``>= valid[b]``
+    of the chunk are NOT written — the old cache bytes are preserved via
+    a select, not a shorter slice, so a ragged chunk (a row consuming
+    fewer than C prompt tokens, or an idle slot with valid=0) leaves the
+    cache bitwise-identical to the token-by-token path.  That byte-level
+    path-independence is what makes shared-prefix KV snapshots safe to
+    reuse across ingestion modes.  The engine guarantees positions+C
+    never exceeds the bucketed L (out-of-range starts clamp under XLA
+    dynamic_update_slice semantics and would shift the write window)."""
+    positions = positions.astype(jnp.int32)
+    if valid is None:
+        def upd(c_hld, n_hcd, p):
+            return jax.lax.dynamic_update_slice(c_hld, n_hcd, (0, p, 0))
+        return jax.vmap(upd)(cache, new, positions)
+    chunk = new.shape[-2]
+    keep = (jnp.arange(chunk)[None, :, None]
+            < valid.astype(jnp.int32)[:, None, None])  # (B, C, 1)
+
+    def updv(c_hld, n_hcd, p, k_c1):
+        old = jax.lax.dynamic_slice(
+            c_hld, (0, p, 0), (c_hld.shape[0], chunk, c_hld.shape[2]))
+        return jax.lax.dynamic_update_slice(
+            c_hld, jnp.where(k_c1, n_hcd, old), (0, p, 0))
+    return jax.vmap(updv)(cache, new, positions, keep)
 
 
 kv_cache_append_op = def_op("KVCacheAppend", _kv_cache_append)
+
+
+def _prefill_gate_reason(q, k_cache):
+    """Why a chunked-prefill step leaves the flash path (None =
+    flash-able).  Like the decode gate it keys on the KV-cache length
+    (the tiled axis); additionally the per-batch position offsets mean
+    kernel-causal (bottom-right-aligned diagonal) cannot express the
+    mask, so the kernel is entered through its full-mask path — legal
+    only when q_len also tiles."""
+    be = jax.default_backend()
+    if be != "tpu":
+        return f"backend:{be}"
+    s_kv = k_cache.shape[-2]
+    if s_kv < _FLASH_MIN_LEN:
+        return f"prefill_below_gate:kv{s_kv}<{_FLASH_MIN_LEN}"
+    if s_kv % 128:
+        return f"prefill_kv_ragged:kv{s_kv}"
+    if q.shape[-2] % 128 and q.shape[-2] != s_kv:
+        return f"prefill_chunk_ragged:q{q.shape[-2]}"
+    return None
+
+
+def dispatch_sdpa_prefill(q, k_cache, v_cache, positions, scale=None):
+    """A chunked prefill step against a bucketed KV cache — the q_len=C
+    generalization of ``dispatch_sdpa_decode`` (ISSUE 18).
+
+    ``q``: this chunk's queries, (B, H, C, D).  ``k_cache`` /
+    ``v_cache``: (B, H, L, D) with the chunk's rows already appended at
+    ``positions..positions+C`` (see ``kv_cache_append_op``).
+    ``positions``: (B,) int — the cache row of each sequence's FIRST
+    chunk token; chunk-local query j may see keys ``< positions+j+1``
+    (causal-within-chunk, everything before the chunk visible).  The
+    per-batch offsets put TPU dispatch on the kernel's full-mask path
+    (kernel-causal can't shift its diagonal per batch row); elsewhere
+    the counted jnp reference.  Rows past a sequence's real prompt are
+    masked by the CALLER's cache-write ``valid`` and sliced away by the
+    emit gather — their outputs are don't-cares here."""
+    chunk = q.shape[-2]
+    lengths = (positions.astype(jnp.int32)[:, None]
+               + 1 + jnp.arange(chunk, dtype=jnp.int32)[None, :])  # (B, C)
+    s_kv = k_cache.shape[-2]
+    cols = jnp.arange(s_kv, dtype=jnp.int32)
+    mask = cols[None, None, None, :] < lengths[:, None, :, None]
+    reason = _prefill_gate_reason(q, k_cache)
+    if reason is None:
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k_cache, v_cache, causal=False,
+                               scale=scale, mask=mask)
+    _note_flash_fallback(reason)
+    return sdpa_reference(q, k_cache, v_cache, scale=scale, mask=mask)
+
+
+def _sdpa_prefill(c, q, k_cache, v_cache, positions, scale=None):
+    return dispatch_sdpa_prefill(q, k_cache, v_cache, positions,
+                                 scale=scale)
+
+
+sdpa_prefill_op = def_op("ScaledDotProductAttentionPrefill", _sdpa_prefill)
+
+
+def _chunk_positions(c, positions, ids, limit=None):
+    """Per-token cache positions for a (B, C) chunk:
+    ``positions[b] + j`` for chunk-local token j, clamped to
+    ``limit - 1`` so idle slots / ragged tails index a real (ignored)
+    position-embedding row.  Shape-agnostic: one graph retraces per fed
+    (B, C)."""
+    chunk = ids.shape[-1]
+    p = (positions.astype(jnp.int32)[:, None]
+         + jnp.arange(chunk, dtype=jnp.int32)[None, :])
+    if limit is not None:
+        p = jnp.minimum(p, jnp.int32(limit - 1))
+    return p
+
+
+chunk_positions_op = def_op("ChunkPositions", _chunk_positions)
+
+
+def _split_heads_chunk(c, t, ids, n_head=1):
+    """(B*C, H*D) projected activations -> (B, H, C, D) heads, with the
+    (B, C) shape recovered from the ``ids`` feed (shape-agnostic chunk
+    twin of the decode graph's q_len=1 reshape)."""
+    b, chunk = ids.shape
+    return t.reshape(b, chunk, n_head, -1).transpose(0, 2, 1, 3)
+
+
+split_heads_chunk_op = def_op("SplitHeadsChunk", _split_heads_chunk)
+
+
+def _merge_heads_chunk(c, att):
+    """(B, H, C, D) attention outputs -> (B*C, H*D) for the residual
+    stream."""
+    b, h, chunk, d = att.shape
+    return att.transpose(0, 2, 1, 3).reshape(b * chunk, h * d)
+
+
+merge_heads_chunk_op = def_op("MergeHeadsChunk", _merge_heads_chunk)
+
+
+def _chunk_emit_gather(c, hidden, ids, valid):
+    """Pick each sequence's LAST consumed chunk row out of the (B*C, E)
+    hidden stream: row ``valid[b] - 1`` (clamped into the chunk) of
+    batch b -> (B, E).  Sliced before ln_f/lm_head so a chunked step
+    pays the vocab projection for B rows, not B*C."""
+    b, chunk = ids.shape
+    e = hidden.shape[-1]
+    h3 = hidden.reshape(b, chunk, e)
+    rows = jnp.clip(valid.astype(jnp.int32) - 1, 0, chunk - 1)
+    return jnp.take_along_axis(h3, rows[:, None, None], axis=1)[:, 0, :]
+
+
+chunk_emit_gather_op = def_op("ChunkEmitGather", _chunk_emit_gather)
 
 
 def _has_cp(mesh):

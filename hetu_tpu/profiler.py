@@ -239,8 +239,8 @@ class HetuProfiler:
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
         elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
-        decode, serve_rejection_reason, fleet, ps_rpc_bytes.  The
-        per-family
+        decode, prefix_cache, serve_rejection_reason, fleet,
+        ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -253,7 +253,8 @@ class HetuProfiler:
         p50/p90/p99 per label): ``ps_rpc_us`` per opcode (+ payload
         bytes), ``serve_latency_us`` (per-request queue wait /
         per-batch device call), ``decode_latency_us`` (time-to-token /
-        join wait / engine step on the decode plane), ``step_time_us``
+        join wait / time-to-first-token ``ttft`` / engine step on the
+        decode plane), ``step_time_us``
         per subexecutor (opt-in — ``metrics.enable_step_timing`` or
         ``HETU_STEP_TIMING=1``), and the per-run ``mfu`` /
         ``step_time_ms`` gauges."""
@@ -431,13 +432,35 @@ class HetuProfiler:
         batch) with their per-row prefill/generate split
         (``decode_prefill_rows`` / ``decode_generate_rows``), bucket
         ladder growths (``decode_batch_grows`` / ``decode_len_grows`` —
-        each at most one fresh compile), queue-full rejections, and the
+        each at most one fresh compile), queue-full rejections, the
         device-resident KV-cache footprint high-water mark
-        (``decode_kv_bytes_hw`` — a max gauge, not a sum).  Per-token
-        latency rides ``metrics.decode_latency_stats()``.  A process
-        that never decodes reports an empty dict."""
+        (``decode_kv_bytes_hw`` — a max gauge, not a sum), and the
+        chunked-prefill accounting (ISSUE 18): steps through the
+        q_len=C entry (``decode_prefill_steps``), dispatches saved vs
+        token-by-token ingestion (``decode_prefill_steps_saved``), and
+        logits D2H copies skipped on pure-prefill steps
+        (``decode_logits_skipped``).  Per-token latency rides
+        ``metrics.decode_latency_stats()``.  A process that never
+        decodes reports an empty dict."""
         from .metrics import decode_counts
         return decode_counts()
+
+    @staticmethod
+    def prefix_cache_counters():
+        """{kind: count} of shared-prefix KV-store events
+        (``hetu_tpu.metrics`` registry, ISSUE 18): lookups that seated a
+        sequence with pre-filled cache rows (``prefix_cache_hits``) vs
+        not (``prefix_cache_misses``), prompt tokens whose prefill was
+        skipped outright (``prefix_cache_hit_rows``), snapshots stored /
+        deduplicated (``prefix_cache_inserts`` /
+        ``prefix_cache_dup_inserts``), LRU evictions and the bytes they
+        freed (``prefix_cache_evictions`` /
+        ``prefix_cache_evicted_bytes``), and the resident-bytes
+        high-water mark (``prefix_cache_bytes_hw`` — a max gauge, not a
+        sum).  A process with no :class:`PrefixKVStore` reports an
+        empty dict."""
+        from .metrics import prefix_cache_counts
+        return prefix_cache_counts()
 
     @staticmethod
     def serve_rejection_counters():

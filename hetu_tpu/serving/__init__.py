@@ -22,6 +22,13 @@ is the serving half the training executor never had:
   ``(batch_bucket, len_bucket)`` pair, per-token futures on
   :class:`DecodeStream`, optional tp-sharded steps via a bound
   ``ParallelPlan`` — results bitwise-independent of batch composition.
+* Chunked prefill + :class:`PrefixKVStore` (ISSUE 18) — prompt
+  ingestion in ``ceil(P/chunk)`` mixed-batch steps through a q_len=C
+  graph entry (one compile per ``(batch, chunk, len)`` bucket triple,
+  pure-prefill steps skip the logits D2H), and shared-prefix KV
+  snapshots seating repeat prompts with their cache rows pre-filled —
+  prefill skipped outright, token streams bitwise-equal to the
+  token-by-token path in every mode.
 * :class:`CellMap` / :class:`CellHead` — geo-replicated serving cells:
   disjoint rank sets each serving local traffic off the read-only
   cache, surviving a cross-cell network partition (reads keep flowing,
@@ -45,9 +52,10 @@ from .cells import CellHead, CellMap
 from .decode import DecodeEngine, DecodeRouter, DecodeStream
 from .executor import InferenceExecutor, default_buckets
 from .fleet import CLASSES, FrontDoor, SLOAutoscaler
+from .prefix_cache import PrefixKVStore
 from .router import ServingRouter, ServeRejected
 
 __all__ = ["InferenceExecutor", "ServingRouter", "ServeRejected",
            "default_buckets", "CellMap", "CellHead",
            "DecodeEngine", "DecodeRouter", "DecodeStream",
-           "FrontDoor", "SLOAutoscaler", "CLASSES"]
+           "PrefixKVStore", "FrontDoor", "SLOAutoscaler", "CLASSES"]

@@ -33,6 +33,31 @@ This module is the serving plane for that workload:
   (one prompt token per step — ``decode_prefill_rows``), so a joining
   sequence never stalls the sequences already generating.
 
+* **Chunked prefill (ISSUE 18).**  With a ``chunked=`` graph entry
+  (:func:`~hetu_tpu.models.gpt2_decode_chunked_graph`) prompt ingestion
+  consumes up to C tokens per sequence per step through the q_len=C
+  attention entry (:func:`~hetu_tpu.ops.sdpa_prefill_op`) — a P-token
+  prompt costs ``ceil(P/C)`` dispatches instead of P.  Chunk sizes walk
+  their own flash-legal ladder; a step's chunk is the smallest bucket
+  covering the largest prompt remainder, generating rows ride along
+  Sarathi-style with their one token at column 0 (mixed batches — a
+  long joining prompt never stalls emission), and steps where no row is
+  past its prompt skip the logits D2H entirely
+  (``decode_logits_skipped``).  One jitted step per ``(batch_bucket,
+  chunk_bucket, len_bucket)`` triple, through the same serve cache +
+  keyed plan cache; single-token steps keep dispatching the PR 16
+  q_len=1 entry unchanged.  Masked cache writes keep the KV bytes
+  bitwise-identical to the token-by-token path at every chunk boundary.
+
+* **Shared-prefix KV reuse (ISSUE 18).**  With a ``prefix_store=``
+  (:class:`~hetu_tpu.serving.PrefixKVStore`) the engine snapshots each
+  prompt's KV rows at its first generated token and seats a later
+  request whose prompt extends a stored prefix with those rows
+  pre-filled — the shared part's prefill is skipped outright
+  (``prefix_cache_hits`` / ``prefix_cache_hit_rows``), and because
+  cache bytes are ingestion-mode-independent the hit's token stream is
+  bitwise-equal to the cold path.
+
 * **Bitwise stability.**  A sequence's tokens do not depend on its batch
   mates: each slot attends only to its own cache rows ``0..position``
   (the per-row length mask), idle slots contribute nothing, and greedy
@@ -210,13 +235,25 @@ class DecodeEngine:
     (tp-sharded decode) — it is realized strictly at construction and
     gated by the ``plan-coverage`` lint, exactly like training.
 
+    ``chunked=`` accepts a second graph entry ``(feeds, logits,
+    cache_fetches)`` from
+    :func:`~hetu_tpu.models.gpt2_decode_chunked_graph` (same weight
+    names, extra ``valid`` feed): its executor is loaded FROM the
+    primary executor's params — never independently initialized, so
+    both entries serve the same weight bytes — and prompt ingestion
+    runs ``ceil(P/C)`` chunked steps instead of P.  ``max_chunk`` caps
+    the chunk ladder (default ``min(32, max_len)``).  ``prefix_store=``
+    accepts a :class:`~hetu_tpu.serving.PrefixKVStore` for shared-
+    prefix KV reuse (may be shared across engines).
+
     NOT thread-safe by design: the owning :class:`DecodeRouter` loop
     thread (or a single test thread) makes every call after
     construction.  Device calls happen with no lock held."""
 
     def __init__(self, feeds, logits, cache_fetches, weights=None, *,
                  max_slots=8, max_len=128, plan=None, mesh=None,
-                 seed=0, donate=True, validate="error"):
+                 seed=0, donate=True, validate="error",
+                 chunked=None, max_chunk=None, prefix_store=None):
         self.iex = InferenceExecutor(
             [logits] + list(cache_fetches), weights=weights,
             buckets=default_buckets(max_slots), mesh=mesh, seed=seed,
@@ -231,10 +268,38 @@ class DecodeEngine:
         ck0 = feeds[self.cache_names[0]]
         self._heads, self._head_dim = ck0.shape[1], ck0.shape[3]
         self._cache_dtype = np.dtype(getattr(ck0, "dtype", np.float32))
-        # dispatch plans: one per (batch_bucket, len_bucket) —
-        # plan_cache_hit here is the steady-state proof
+        self.ciex = None
+        self.chunk_ladder = (1,)
+        self.chunk_top = 1
+        self.prefix = prefix_store
+        if chunked is not None:
+            if plan is not None:
+                raise ValueError(
+                    "chunked prefill under a tp plan is not supported: "
+                    "bind the plan to the one-token entry only")
+            cfeeds, clogits, ccaches = chunked
+            # the chunked executor MUST serve the primary's exact weight
+            # bytes: independent construction would re-init every
+            # variable from fold_in(seed, topo_index) over a DIFFERENT
+            # topo order, silently diverging the two entries
+            w = {self.iex.var_names[n]:
+                 np.asarray(self.iex.params[self.iex._k(n)])
+                 for n in self.iex.var_nodes}
+            self.ciex = InferenceExecutor(
+                [clogits] + list(ccaches), weights=w,
+                buckets=default_buckets(max_slots), mesh=mesh, seed=seed,
+                donate=donate, validate=validate, decode=True)
+            top = int(max_chunk) if max_chunk else min(32, self.max_len)
+            self.chunk_ladder = tuple(default_buckets(max(2, top)))
+            self.chunk_top = self.chunk_ladder[-1]
+            self._cfk = {name: self.ciex._k(node)
+                         for name, node in cfeeds.items()}
+        # dispatch plans: one per (batch, len) pair for the one-token
+        # entry plus one per (batch, chunk, len) triple for the chunked
+        # entry — plan_cache_hit here is the steady-state proof
         self._plans = KeyedPlanCache(
-            max_entries=len(self.batch_ladder) * len(self.len_ladder))
+            max_entries=(len(self.batch_ladder) * len(self.len_ladder)
+                         * (1 + len(self.chunk_ladder))))
         self.bb = self.batch_ladder[0]
         self.lb = self.len_ladder[0]
         self.slots = [None] * self.bb
@@ -303,10 +368,15 @@ class DecodeEngine:
         record_decode("decode_batch_grows")
         self._note_kv_bytes()
 
-    def _grow_len_if_needed(self):
+    def _grow_len_if_needed(self, span=1):
+        """Ensure the cache length bucket covers every active position
+        plus ``span`` rows about to be written (span > 1: a chunked
+        step's write window — dynamic_update_slice CLAMPS out-of-range
+        starts, which would shift the window onto wrong rows, so the
+        bucket must cover it up front)."""
         import jax.numpy as jnp
         need = max((int(self.positions[i]) for i, s in enumerate(self.slots)
-                    if s is not None), default=-1)
+                    if s is not None), default=-1) + int(span) - 1
         if need < self.lb:
             return
         lb = self.lb
@@ -329,16 +399,30 @@ class DecodeEngine:
     def join(self, req):
         """Seat ``req`` in a free KV-cache slot (growing the batch bucket
         if every slot is taken); its first prompt token decodes at the
-        next :meth:`step`."""
+        next :meth:`step`.  With a prefix store, a prompt extending a
+        stored prefix seats with its first ``m`` cache rows pre-filled
+        (``ptr`` / ``positions`` start at ``m``): the shared prefix's
+        prefill never runs."""
         slot = next((i for i, s in enumerate(self.slots) if s is None),
                     None)
         if slot is None:
             self._grow_batch()
             slot = next(i for i, s in enumerate(self.slots) if s is None)
         seq = _Sequence(req)
+        m, rows = 0, None
+        if self.prefix is not None:
+            m, rows = self.prefix.lookup(req.prompt)
         self.slots[slot] = seq
-        self.tokens[slot] = req.prompt[0]
-        self.positions[slot] = 0
+        seq.ptr = m
+        self.tokens[slot] = req.prompt[m]
+        self.positions[slot] = m
+        if m:
+            # the snapshot rows land at 0..m-1: grow the length bucket
+            # first (the fresh padding is all-zero, like a cold slot)
+            self._grow_len_if_needed()
+            for name in self.cache_names:
+                self.caches[name] = self.iex._place(
+                    self.caches[name].at[slot, :, :m, :].set(rows[name]))
         if self._used[slot]:
             record_decode("decode_slot_recycles")
         self._used[slot] = True
@@ -411,20 +495,122 @@ class DecodeEngine:
 
         return self._plans.lookup(key, build)
 
+    def _chunk_step_fn(self, chunk):
+        """The jitted chunked-prefill step for the CURRENT
+        (batch_bucket, chunk_bucket, len_bucket) triple — a 3-tuple key
+        in the same keyed plan cache (the one-token entry's 2-tuples
+        never collide), built at most once per triple through the same
+        process-wide serve cache."""
+        key = (self.bb, chunk, self.lb)
+
+        def build():
+            return step_cache.lookup_or_build_serve(
+                self.ciex, key, self.ciex._infer_fn())
+
+        return self._plans.lookup(key, build)
+
+    def _pick_chunk(self, active):
+        """Chunk bucket for this step: the smallest ladder bucket
+        covering the largest per-row token demand (prompt remainder for
+        mid-prompt rows, 1 for generating rows), shrunk while the write
+        window would overrun ``max_len``, then shrunk again to the
+        Sarathi-style mixed-batch efficiency floor: every row in a
+        chunked step computes q_len=C, so a generating row (1 useful
+        token) wastes C-1 padded row-tokens — the chunk shrinks while
+        that waste exceeds the useful prefill volume (at least half the
+        step's padded token volume must be prompt ingestion).  A lone
+        prompt in an idle engine keeps the full chunk (best TTFT); a
+        full batch of generators admitting one straggler prompt falls
+        back toward the one-token entry instead of taxing every
+        generator C-fold.  1 = run the one-token entry (no chunked
+        graph, or nothing to chunk)."""
+        if self.ciex is None:
+            return 1
+        want, gen = 1, 0
+        for i in active:
+            seq = self.slots[i]
+            rem = len(seq.req.prompt) - seq.ptr
+            if rem > want:
+                want = rem
+            if rem <= 1:
+                gen += 1
+        if want <= 1:
+            return 1
+        want = min(want, self.chunk_top)
+        c = next(b for b in self.chunk_ladder if b >= want)
+        maxp = max(int(self.positions[i]) for i in active)
+        while c > 1 and maxp + c > self.max_len:
+            c = max(b for b in self.chunk_ladder if b < c)
+        pre = len(active) - gen
+        while c > 1 and gen * (c - 1) > pre * c:
+            c = max(b for b in self.chunk_ladder if b < c)
+        return c
+
+    def _emit_token(self, i, seq, tok, now):
+        """Post-argmax bookkeeping shared by the one-token and chunked
+        paths: counters, latency (``token`` + first-token ``ttft``),
+        prefix-snapshot insert, stream emission, and the done check.
+        Returns 1 (one token emitted)."""
+        seq.emitted += 1
+        record_decode("decode_generate_rows")
+        record_decode("decode_tokens")
+        record_decode_latency("token", (now - seq.t_last) * 1e6)
+        if seq.emitted == 1:
+            record_decode_latency(
+                "ttft", (now - seq.req.t_arrival) * 1e6)
+            if self.prefix is not None:
+                self._prefix_insert(i, seq)
+        seq.t_last = now
+        if _TR.on and seq.fid is not None:
+            _TR.flow_end("decode.join", seq.fid, cat="decode")
+            seq.fid = None
+        seq.req.stream._emit(tok)
+        self.tokens[i] = tok
+        done = (seq.emitted >= seq.req.max_new
+                or (seq.req.eos_id is not None
+                    and tok == seq.req.eos_id))
+        if not done and int(self.positions[i]) >= self.max_len:
+            done = True     # cache exhausted: stop cleanly
+        if done:
+            self._leave(i)
+        return 1
+
+    def _prefix_insert(self, i, seq):
+        """Snapshot slot ``i``'s prompt KV rows into the prefix store —
+        called at the FIRST generated token, when rows ``0..P-1`` hold
+        exactly the prompt's KV (the sampled token is not yet written)
+        and, by the masked-append invariant, the same bytes whatever
+        ingestion path produced them."""
+        p = len(seq.req.prompt)
+        if p < self.prefix.min_tokens:
+            return
+        rows = {name: self.caches[name][i, :, :p, :]
+                for name in self.cache_names}
+        self.prefix.insert(seq.req.prompt, rows)
+
     def step(self):
-        """Decode ONE token batch: every active slot consumes its pending
-        token (prompt or previous sample), caches append in place, rows
-        past their prompt emit.  Returns the number of tokens emitted."""
+        """Decode ONE batch step: every active slot consumes its pending
+        token(s), caches append in place, rows past their prompt emit.
+        With a chunked entry, steps where some row still owes multiple
+        prompt tokens run the q_len=C chunked path (generating rows ride
+        along); otherwise the PR 16 one-token path runs unchanged.
+        Returns the number of tokens emitted."""
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
+        chunk = self._pick_chunk(active)
+        if chunk > 1:
+            return self._step_chunked(active, chunk)
         self._grow_len_if_needed()
         fn = self._step_fn()
         t0 = time.perf_counter_ns()
+        # fed as COPIES: jax's CPU client may alias an aligned numpy
+        # feed zero-copy, and the engine mutates tokens/positions right
+        # after dispatch — without the logits D2H sync (skipped on
+        # pure-prefill steps) an aliased feed would race the device read
         feeds = {
-            self._fk["input_ids"]:
-                np.ascontiguousarray(self.tokens.reshape(self.bb, 1)),
-            self._fk["positions"]: np.ascontiguousarray(self.positions),
+            self._fk["input_ids"]: self.tokens.reshape(self.bb, 1).copy(),
+            self._fk["positions"]: self.positions.copy(),
         }
         for name in self.cache_names:
             feeds[self._fk[name]] = self.caches[name]
@@ -437,7 +623,14 @@ class DecodeEngine:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             outs = fn(self.iex.params, feeds)
-        logits = np.asarray(outs[0])
+        # the logits D2H is paid only when some row will read it — a
+        # pure-prefill step never looks at outs[0] (ISSUE 18 satellite)
+        if any(self.slots[i].ptr >= len(self.slots[i].req.prompt) - 1
+               for i in active):
+            logits = np.asarray(outs[0])
+        else:
+            logits = None
+            record_decode("decode_logits_skipped")
         for name, new in zip(self.cache_names, outs[1:]):
             self.caches[name] = new
         record_decode("decode_steps")
@@ -456,30 +649,93 @@ class DecodeEngine:
             # first-max tie-break keeps decode bitwise stable)
             tok = int(np.argmax(logits[i]))
             seq.ptr = len(seq.req.prompt)
-            seq.emitted += 1
-            emitted += 1
-            record_decode("decode_generate_rows")
-            record_decode("decode_tokens")
-            record_decode_latency("token", (now - seq.t_last) * 1e6)
-            seq.t_last = now
-            if _TR.on and seq.fid is not None:
-                _TR.flow_end("decode.join", seq.fid, cat="decode")
-                seq.fid = None
-            seq.req.stream._emit(tok)
-            self.tokens[i] = tok
-            done = (seq.emitted >= seq.req.max_new
-                    or (seq.req.eos_id is not None
-                        and tok == seq.req.eos_id))
-            if not done and int(self.positions[i]) >= self.max_len:
-                done = True     # cache exhausted: stop cleanly
-            if done:
-                self._leave(i)
+            emitted += self._emit_token(i, seq, tok, now)
         t1 = time.perf_counter_ns()
         record_decode_latency("step", (t1 - t0) / 1e3)
         if _TR.on:
             _TR.complete("decode.step", t0, t1, cat="decode",
                          args={"batch": self.bb, "len": self.lb,
                                "rows": len(active), "emitted": emitted})
+        return emitted
+
+    def _step_chunked(self, active, chunk):
+        """One chunked-prefill step: each active row consumes up to
+        ``chunk`` pending tokens (its prompt remainder, or its one
+        generated token at column 0), the caches take a masked multi-row
+        append, and only rows that finished their prompt read logits —
+        a pure-prefill chunk skips the D2H entirely."""
+        self._grow_len_if_needed(span=chunk)
+        fn = self._chunk_step_fn(chunk)
+        t0 = time.perf_counter_ns()
+        ids = np.zeros((self.bb, chunk), np.int32)
+        valid = np.zeros(self.bb, np.int32)
+        consume = {}
+        emit_rows = []
+        for i in active:
+            seq = self.slots[i]
+            rem = len(seq.req.prompt) - seq.ptr
+            if rem > 0:
+                n = min(rem, chunk)
+                ids[i, :n] = seq.req.prompt[seq.ptr:seq.ptr + n]
+            else:
+                n = 1
+                ids[i, 0] = self.tokens[i]
+            valid[i] = n
+            consume[i] = n
+            if seq.ptr + n >= len(seq.req.prompt):
+                emit_rows.append(i)
+        feeds = {
+            self._cfk["input_ids"]: ids,
+            self._cfk["positions"]: self.positions.copy(),
+            self._cfk["valid"]: valid,
+        }
+        for name in self.cache_names:
+            feeds[self._cfk[name]] = self.caches[name]
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            outs = fn(self.ciex.params, feeds)
+        if emit_rows:
+            logits = np.asarray(outs[0])
+        else:
+            logits = None
+            record_decode("decode_logits_skipped")
+        for name, new in zip(self.cache_names, outs[1:]):
+            self.caches[name] = new
+        record_decode("decode_steps")
+        record_decode("decode_prefill_steps")
+        # dispatches saved vs token-by-token: the widest row would have
+        # needed max(consume) one-token steps; this step is one
+        record_decode("decode_prefill_steps_saved",
+                      max(consume.values()) - 1)
+        emitted = 0
+        now = time.monotonic()
+        for i in active:
+            seq = self.slots[i]
+            n = consume[i]
+            self.positions[i] += n
+            plen = len(seq.req.prompt)
+            if seq.ptr + n < plen:
+                # still mid-prompt after this chunk
+                seq.ptr += n
+                self.tokens[i] = seq.req.prompt[seq.ptr]
+                record_decode("decode_prefill_rows", n)
+                continue
+            # prompt finished this step (n-1 of the consumed tokens were
+            # prefill rows, the last is the generate row) or the row was
+            # already generating (n == 1, zero prefill rows)
+            prefill_rows = (plen - seq.ptr - 1) if seq.ptr < plen else 0
+            record_decode("decode_prefill_rows", prefill_rows)
+            seq.ptr = plen
+            tok = int(np.argmax(logits[i]))
+            emitted += self._emit_token(i, seq, tok, now)
+        t1 = time.perf_counter_ns()
+        record_decode_latency("step", (t1 - t0) / 1e3)
+        if _TR.on:
+            _TR.complete("decode.step", t0, t1, cat="decode",
+                         args={"batch": self.bb, "len": self.lb,
+                               "chunk": chunk, "rows": len(active),
+                               "emitted": emitted})
         return emitted
 
 
@@ -568,6 +824,22 @@ class DecodeRouter:
         ``engine.active``, so no cross-thread engine reads)."""
         with self._cv:
             return len(self._q) + self._active_ct
+
+    @property
+    def pending_steps(self):
+        """Estimated engine STEPS queued ahead of a new request — the
+        front door's deadline-gate signal (ISSUE 18 satellite).  A
+        queued prompt costs ``ceil(prompt_len / chunk_top)`` prefill
+        steps (prompt_len with no chunked entry, where chunk_top is 1),
+        not the one step per request ``pending`` implies — long-prompt
+        backlogs would otherwise admit doomed requests.  In-flight
+        sequences count one step each (their next token is one step
+        away; ``chunk_top`` is immutable after engine construction, so
+        the cross-thread read is safe)."""
+        ct = max(1, int(getattr(self.engine, "chunk_top", 1)))
+        with self._cv:
+            q = sum((len(r.prompt) + ct - 1) // ct for r in self._q)
+            return q + self._active_ct
 
     def health(self):
         """Point-in-time health snapshot for the front door's sweep —

@@ -341,10 +341,18 @@ class FrontDoor:
                 # estimated wait on the best replica: batches ahead of
                 # us (its pending over its batch size) plus our own, at
                 # its recent per-batch cost — unmeetable means reject at
-                # the door, not a timeout inside a batch
+                # the door, not a timeout inside a batch.  Decode
+                # replicas expose pending_steps (ISSUE 18): a queued
+                # PROMPT costs ceil(prompt_len/chunk) prefill steps, not
+                # one, so the drain estimate folds prompt length in
                 best = order[0]
-                per_batch = max(1, int(getattr(best.router, "max_batch", 1)))
-                batches = best.router.pending // per_batch + 1
+                steps = getattr(best.router, "pending_steps", None)
+                if steps is not None:
+                    batches = int(steps) + 1
+                else:
+                    per_batch = max(
+                        1, int(getattr(best.router, "max_batch", 1)))
+                    batches = best.router.pending // per_batch + 1
                 if batches * best.cost_ms > dl_ms:
                     raise ServeRejected(
                         "deadline",

@@ -385,20 +385,36 @@ def test_decode_bench_smoke():
     res = bench.bench_decode(smoke=True, write_artifact=False)
     assert res["metric"] == "decode_tokens_per_s"
     extra = res["extra"]
-    # scheduling must not change results
+    # scheduling AND ingestion mode must not change results
     assert extra["streams_bitwise_equal"] is True
     # the compile-once steady state: real builds + serve-cache reuses
-    # account for EVERY distinct (batch, len) bucket pair, and every
-    # other step dispatches through a plan_cache_hit
+    # account for EVERY distinct bucket key — (batch, len) pairs and
+    # (batch, chunk, len) triples — and every other step dispatches
+    # through a plan_cache_hit
     co = extra["compile_once"]
     assert co["holds"] is True
     assert (co["serve_bucket_compiles"] + co["step_cache_serve_hits"]
-            == co["bucket_pairs"] > 0)
-    assert co["plan_cache_hits"] == co["decode_steps"] - co["bucket_pairs"]
+            == co["bucket_keys"] > 0)
+    assert co["plan_cache_hits"] == co["decode_steps"] - co["bucket_keys"]
     # O(1) incremental step vs O(len) re-prefill at every measured length
     assert extra["kv_incremental_wins_every_length"] is True
     for row in extra["kv_cache_vs_reprefill"]:
         assert row["incremental_ms"] < row["reprefill_ms"], row
+    # ISSUE 18: chunked TTFT beats token-by-token at every measured
+    # prompt length with bitwise-equal first tokens
+    assert extra["ttft_wins_every_length"] is True
+    for row in extra["ttft_vs_token_by_token"]:
+        assert row["chunked_ms"] < row["token_by_token_ms"], row
+    # the chunked stream actually saved prefill steps
+    assert extra["prefill"]["steps_saved_vs_token_by_token"] > 0
+    # repeated-prefix requests hit the store, skip prefill rows, and
+    # still match the cold run bitwise
+    assert extra["prefix_cache"]["holds"] is True
+    assert extra["prefix_cache"]["hits"] > 0
+    assert (extra["prefix_cache"]["prefill_rows_warm"]
+            < extra["prefix_cache"]["prefill_rows_cold"])
+    # one ttft histogram observation per stream
+    assert extra["ttft_counted_per_stream"] is True
     assert extra["continuous"]["counters"].get("decode_rejections", 0) == 0
     assert extra["total_tokens"] > 0
     assert res["vs_baseline"] > 0, res

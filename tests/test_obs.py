@@ -201,6 +201,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_serve_rejection("shed:batch")
     metrics.record_fleet("fleet_admitted", 6)
     metrics.record_fleet("fleet_replicas_hw", 3)
+    metrics.record_prefix_cache("prefix_cache_hits", 2)
+    metrics.record_prefix_cache("prefix_cache_bytes_hw", 512)
     metrics.record_rpc("OP_PULL", 100.0, 2048)
     dump = obs.metrics_dump()
     legacy = {
@@ -219,6 +221,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "decode": metrics.decode_counts(),
         "serve_rejection_reason": metrics.serve_rejection_counts(),
         "fleet": metrics.fleet_counts(),
+        "prefix_cache": metrics.prefix_cache_counts(),
     }
     for fam, want in legacy.items():
         assert dump["counters"][fam] == want, fam
@@ -228,6 +231,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
                                 "decode_kv_bytes_hw": 4096}
     assert legacy["serve_rejection_reason"] == {"shed:batch": 1}
     assert legacy["fleet"] == {"fleet_admitted": 6, "fleet_replicas_hw": 3}
+    assert legacy["prefix_cache"] == {"prefix_cache_hits": 2,
+                                      "prefix_cache_bytes_hw": 512}
     assert dump["counters"]["ps_rpc_bytes"] == {"OP_PULL": 2048}
     assert dump["histograms"]["ps_rpc_us"]["OP_PULL"]["count"] == 1
     # the one-call profiler view is the same registry

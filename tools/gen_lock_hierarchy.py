@@ -141,15 +141,24 @@ def serving_plane():
     ro.refresh_join()
 
     # decode leg: continuous-batching router loop + per-token stream
-    # futures (DecodeRouter._cv hand-off, DecodeStream._lock emission)
-    from hetu_tpu.models import GPT2Config, gpt2_decode_graph
-    from hetu_tpu.serving import DecodeEngine, DecodeRouter
+    # futures (DecodeRouter._cv hand-off, DecodeStream._lock emission),
+    # with the ISSUE 18 chunked-prefill entry and a shared-prefix KV
+    # store (PrefixKVStore._lock: snapshot insert at first token from
+    # the loop thread, trie lookup at join — leaf level, nothing nests
+    # under it)
+    from hetu_tpu.models import (GPT2Config, gpt2_decode_chunked_graph,
+                                 gpt2_decode_graph)
+    from hetu_tpu.serving import DecodeEngine, DecodeRouter, PrefixKVStore
     dcfg = GPT2Config.tiny(n_positions=32, batch_size=1)
     dfeeds, dlogits, dcaches, _ = gpt2_decode_graph(dcfg, max_len=16)
-    eng = DecodeEngine(dfeeds, dlogits, dcaches, max_slots=2, max_len=16)
+    cfeeds, clogits, ccaches, _ = gpt2_decode_chunked_graph(dcfg,
+                                                            max_len=16)
+    eng = DecodeEngine(dfeeds, dlogits, dcaches, max_slots=2, max_len=16,
+                       chunked=(cfeeds, clogits, ccaches), max_chunk=4,
+                       prefix_store=PrefixKVStore(capacity_bytes=1 << 20))
     with DecodeRouter(eng, queue_limit=8) as dr:
-        streams = [dr.submit([3 + i, 5], max_new_tokens=3)
-                   for i in range(3)]
+        streams = [dr.submit([3 + (i % 2), 5, 7, 2], max_new_tokens=3)
+                   for i in range(4)]
         for s in streams:
             s.result(timeout=60)
 
