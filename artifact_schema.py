@@ -62,8 +62,17 @@ gates it), ``ttft_histogram`` (the ``ttft`` label of
 ``decode_latency_us`` — one observation per stream,
 ``ttft_counted_per_stream``), ``prefix_cache`` (pool-stream hit/miss/
 eviction counts, ``hit_rate``, ``prefill_rows_cold`` vs ``_warm``, and
-the warm run's bitwise parity with its cold reference) and the ISSUE 16
-``kv_cache_vs_reprefill`` per-length leg.
+the warm run's bitwise parity with its cold reference), the ISSUE 16
+``kv_cache_vs_reprefill`` per-length leg, and the ISSUE 19
+``recovery`` leg (schema v3): a 2-replica decode FrontDoor under a
+``kill:replica@0:tok<n>`` chaos fault on the engine's token clock —
+``kill_spec``, ``failed_streams`` (must be 0), ``restarts`` (must be
+0), ``streams_bitwise_equal_to_unkilled``, the ``decode_recovery_*``
+``counters`` + fleet counters, ``reseat_latency_us`` (the ``recovery``
+label of ``decode_latency_us`` — one observation per reseated
+stream), and ``zero_survivor`` (killing a 1-replica door's only
+replica: every in-flight stream fails loudly with
+``recovery_exhausted`` and ``partials_attached``).
 
 ``artifacts/fleet_bench.json`` (``bench.py --config fleet``, ISSUE 17)
 is the fleet-tier acceptance: ``slo`` (interactive p99 vs target, both

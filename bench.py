@@ -3357,6 +3357,13 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
       -> first emitted token, min over reps) at controlled prompt
       lengths, chunked vs token-by-token.
 
+    ISSUE 19 (v3) adds the RECOVERY legs: a 2-replica decode FrontDoor
+    under a ``kill:replica@0:tok<n>`` chaos fault on the engine's own
+    token clock — every in-flight stream migrated to the survivor and
+    bitwise-equal to the unkilled reference with zero failures and zero
+    restarts — plus a zero-survivor kill that must fail loudly
+    (``recovery_exhausted`` + partial tokens), never hang.
+
     Gates: ALL policy/ingestion legs produce BITWISE-identical token
     streams (scheduling and ingestion mode must not change results);
     continuous beats request-level on tokens/s with a no-worse p99
@@ -3487,6 +3494,120 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
     pref_cold = one_pass(True, True, reqs=pref_reqs)
     pref_warm = one_pass(True, True, store=PrefixKVStore(), reqs=pref_reqs)
 
+    # --- exactly-once stream recovery: mid-generation replica kill -------
+    # A 2-replica decode FrontDoor (chunked engines, one SHARED
+    # PrefixKVStore) decodes a slice of the zipf stream while
+    # ``kill:replica@0:tok<n>`` fail-stops replica 0 on its own
+    # deterministic token clock; the door's sweep detaches the seated
+    # streams with their journals and resurrects them on the survivor.
+    # Gates: zero failed streams, zero restarts (the dead replica is
+    # never rebuilt), every stream bitwise-equal to the uninterrupted
+    # single-engine reference, and the decode_recovery counters + the
+    # ``recovery`` decode-latency label tell a consistent timeline
+    # (every detached stream reseated, one latency observation each).
+    # A second leg kills the ONLY replica of a 1-replica door: every
+    # in-flight stream must fail LOUDLY — structured
+    # ``recovery_exhausted`` with the partial tokens attached — never
+    # hang silently.
+    from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.serving import FrontDoor, ServeRejected
+
+    rec_n = min(n_requests, 8 if smoke else 24)
+    rec_reqs = list(zip(prompts, news))[:rec_n]
+    rec_total = int(sum(int(nw) for _, nw in rec_reqs))
+    kill_tok = max(3, rec_total // 8)
+    rec_ref = one_pass(True, True, reqs=rec_reqs)["tokens"]
+
+    def _poll_fleet(door, streams, timeout=300.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            door.poll()
+            if all(s.done for s in streams):
+                return True
+            time.sleep(0.005)
+        return False
+
+    ht_metrics.reset_all()
+    rec_store = PrefixKVStore()
+    inj = chaos_mod.ChaosInjector.from_spec(
+        f"{seed}:kill:replica@0:tok{kill_tok}")
+    prev_inj = chaos_mod.install(inj)
+    try:
+        # wedge_timeout pushed out of the way: a first-touch bucket
+        # compile inside a step would otherwise read as a wedge on CPU
+        door = FrontDoor(
+            lambda idx: DecodeRouter(mk_engine(True, store=rec_store),
+                                     queue_limit=rec_n + 8,
+                                     name=f"recb{idx}"),
+            2, health_every_ms=1e9, wedge_timeout_ms=1e9)
+        try:
+            t0 = time.monotonic()
+            rec_streams = [door.submit(p, max_new_tokens=int(nw))
+                           for p, nw in rec_reqs]
+            rec_done = _poll_fleet(door, rec_streams)
+            rec_wall = time.monotonic() - t0
+            rec_tokens, rec_failed = [], 0
+            for s in rec_streams:
+                try:
+                    rec_tokens.append(s.result(timeout=60))
+                except Exception:
+                    rec_failed += 1
+                    rec_tokens.append(None)
+        finally:
+            door.close()
+    finally:
+        chaos_mod.install(prev_inj)
+    rec_c = ht_metrics.decode_recovery_counts()
+    rec_fleet = ht_metrics.fleet_counts()
+    rec_lat = HetuProfiler.latency_stats().get(
+        "decode_latency_us", {}).get("recovery", {})
+    rec_restarts = int(rec_fleet.get("fleet_scale_out", 0)) - 2
+    rec_ok = (rec_done and rec_failed == 0
+              and rec_tokens == rec_ref
+              and rec_fleet.get("fleet_replica_ejected", 0) == 1
+              and rec_fleet.get("fleet_request_failures", 0) == 0
+              and rec_restarts == 0
+              and rec_c.get("decode_recovery_reseated", 0) >= 1
+              and rec_c.get("decode_recovery_reseated", 0)
+              == rec_c.get("decode_recovery_detached", 0)
+              and rec_c.get("decode_recovery_exhausted", 0) == 0
+              and int(rec_lat.get("count", 0))
+              == rec_c.get("decode_recovery_reseated", 0)
+              and ht_metrics.fault_counts().get(
+                  "chaos_kill_replica", 0) == 1)
+
+    ht_metrics.reset_all()
+    inj0 = chaos_mod.ChaosInjector.from_spec(
+        f"{seed}:kill:replica@0:tok3")
+    prev_inj = chaos_mod.install(inj0)
+    exhausted, zs_partials_ok = 0, True
+    try:
+        door = FrontDoor(
+            lambda idx: DecodeRouter(mk_engine(True), queue_limit=16,
+                                     name=f"recz{idx}"),
+            1, health_every_ms=1e9, wedge_timeout_ms=1e9)
+        try:
+            zs = [door.submit(np.full(4, 3 + i, np.int32),
+                              max_new_tokens=gen_cap) for i in range(3)]
+            _poll_fleet(door, zs, timeout=120.0)
+            for s in zs:
+                try:
+                    s.result(timeout=60)
+                    zs_partials_ok = False     # nothing may "succeed"
+                except ServeRejected as exc:
+                    if exc.reason == "recovery_exhausted":
+                        exhausted += 1
+                        zs_partials_ok = zs_partials_ok \
+                            and isinstance(exc.partial, list) \
+                            and len(exc.partial) >= 1
+        finally:
+            door.close()
+    finally:
+        chaos_mod.install(prev_inj)
+    exhaust_ok = (exhausted >= 1 and zs_partials_ok
+                  and ht_metrics.decode_recovery_counts().get(
+                      "decode_recovery_exhausted", 0) == exhausted)
+
     def pct(xs, q):
         return float(np.percentile(np.asarray(xs), q))
 
@@ -3614,6 +3735,7 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                and cont["tps"] >= tok["tps"])
     ok = bitwise and compile_once and kv_wins and no_rejects \
         and ttft_wins and prefix_ok and ttft_counted \
+        and rec_ok and exhaust_ok \
         and (perf_ok or smoke)     # the perf margin gates the full run
 
     result = {
@@ -3637,7 +3759,14 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                             "length, prefix-cache hits with prefill "
                             "rows saved and a bitwise-equal stream, one "
                             "ttft histogram observation per stream, "
-                            "zero rejections, and (full runs) better "
+                            "zero rejections, a mid-generation "
+                            "kill:replica@0:tok<n> recovery leg with "
+                            "zero failed streams / zero restarts and "
+                            "every stream bitwise-equal to the "
+                            "unkilled reference (and a zero-survivor "
+                            "kill failing loudly with "
+                            "recovery_exhausted + partial tokens), "
+                            "and (full runs) better "
                             "tokens/s at no-worse p99 time-to-token "
                             "with chunked tokens/s no worse than "
                             "token-by-token",
@@ -3651,6 +3780,8 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                            "ttft_lens": list(ttft_lens),
                            "kv_leg_n_embd": 384, "kv_leg_n_layer": 4,
                            "kv_leg_max_len": kv_max_len,
+                           "recovery_streams": rec_n,
+                           "recovery_kill_tok": int(kill_tok),
                            "smoke": bool(smoke)}),
             "continuous": {
                 "tokens_per_s": round(cont["tps"], 1),
@@ -3717,6 +3848,25 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
             },
             "kv_cache_vs_reprefill": per_len,
             "kv_incremental_wins_every_length": kv_wins,
+            "recovery": {
+                "kill_spec": f"kill:replica@0:tok{kill_tok}",
+                "streams": int(rec_n),
+                "failed_streams": int(rec_failed),
+                "restarts": int(rec_restarts),
+                "streams_bitwise_equal_to_unkilled":
+                    rec_tokens == rec_ref,
+                "counters": {k: int(v) for k, v in rec_c.items()},
+                "fleet": {k: int(v) for k, v in rec_fleet.items()},
+                "reseat_latency_us": rec_lat,
+                "wall_s": round(rec_wall, 2),
+                "holds": bool(rec_ok),
+                "zero_survivor": {
+                    "streams": 3,
+                    "recovery_exhausted": int(exhausted),
+                    "partials_attached": bool(zs_partials_ok),
+                    "holds": bool(exhaust_ok),
+                },
+            },
             "total_tokens": int(sum(len(t) for t in cont["tokens"])),
             "backend": jax.default_backend(),
         },

@@ -44,6 +44,13 @@ parsed from ``HETU_CHAOS=<seed>:<spec>[,<spec>...]`` drives
   and die via their ``stop()`` (the router fail-stops at its next batch
   boundary, leaving its queue for the front door to rescue).  Like
   every kill, it consumes no RNG draw and fires at most once;
+  ``kill:replica@<idx>:tok<n>`` (ISSUE 19) schedules the same kill on
+  the DECODE ENGINE's own emitted-token clock instead — the victim's
+  router loop reports cumulative tokens to
+  :meth:`ChaosInjector.on_token` after every step, so the kill lands
+  MID-GENERATION at an exact, replayable token count (the admission
+  clock cannot reach inside a generation), exercising the in-flight
+  stream recovery path (``detach_inflight`` → continuation adoption);
 * **network partitions** —
   ``partition:rank<a>[+rank<b>...]|rank<c>[+rank<d>...]@step<n>[:heal<m>]``
   drops every frame BOTH directions between the two rank sets from the
@@ -68,6 +75,7 @@ fault list; probabilities in [0, 1], durations in milliseconds)::
     HETU_CHAOS="7:kill:backup@shard1:step3"
     HETU_CHAOS="7:kill:primary@shard1:req200"
     HETU_CHAOS="7:kill:replica@1:req40"
+    HETU_CHAOS="7:kill:replica@0:tok16"
     HETU_CHAOS="7:partition:rank0|rank1@step3:heal7"
     HETU_CHAOS="7:partition:rank0+rank1|rank2+rank3@step3"
 
@@ -186,15 +194,23 @@ def _parse_fault(part):
         # | kill:replica@<idx>:req<n>  (ISSUE 17: fleet serving-replica
         #   kill on the FRONT DOOR's admission clock, resolved against
         #   register_replica'd handles)
+        # | kill:replica@<idx>:tok<n>  (ISSUE 19: MID-GENERATION decode
+        #   replica kill on the victim engine's own deterministic
+        #   emitted-token clock — fires once replica <idx> has emitted
+        #   n tokens, landing inside a generation regardless of how the
+        #   door spread the request stream)
         try:
             _, rest = part.split(":", 1)
             what, where = rest.split("@", 1)
             target, when = where.split(":", 1)
             if what == "replica":
-                if not when.startswith("req"):
-                    raise ValueError(part)
-                return {"kind": "kill_replica", "idx": int(target),
-                        "req": int(when[len("req"):])}
+                if when.startswith("req"):
+                    return {"kind": "kill_replica", "idx": int(target),
+                            "req": int(when[len("req"):])}
+                if when.startswith("tok"):
+                    return {"kind": "kill_replica", "idx": int(target),
+                            "tok": int(when[len("tok"):])}
+                raise ValueError(part)
             if what in ("primary", "backup"):
                 if not target.startswith("shard"):
                     raise ValueError(part)
@@ -228,7 +244,7 @@ def _parse_fault(part):
                 f"bad kill fault {part!r}: expected kill:ps@rank<r>:step<s>,"
                 f" kill:proc@rank<r>:{{after<ms>|step<n>}}, "
                 f"kill:{{primary,backup}}@shard<s>:{{step<n>|req<n>}}, or "
-                f"kill:replica@<idx>:req<n>"
+                f"kill:replica@<idx>:{{req<n>|tok<n>}}"
                 ) from None
     if "=" not in part:
         raise ChaosSpecError(f"bad fault {part!r}: expected <kind>=<prob>"
@@ -543,6 +559,35 @@ class ChaosInjector:
                     f, f"kill:{f['kind'][len('kill_'):]}"
                        f"@shard{f['shard']}:req{f['req']}",
                     killed, missing)
+        return self._finish_kills(killed, missing)
+
+    # -- token-count-scheduled kills (decode serving, ISSUE 19) ------------
+    def on_token(self, idx, total):
+        """Decode-replica hook: fires ``kill:replica@<idx>:tok<n>`` once
+        replica ``idx``'s OWN engine has emitted ``total`` >= n tokens —
+        the engine's deterministic token clock, reported by the decode
+        router loop after every step.  The door's admission clock cannot
+        place a kill MID-GENERATION (dispatch spreads requests across
+        replicas, and a request admits long before its tokens flow);
+        this clock lands the kill inside a generation at an exact,
+        replayable point.  Each fault fires at most once, with no RNG
+        draw (transport fault decisions are unperturbed), and the same
+        quiet/loud split as :meth:`on_request` applies against the
+        ``register_replica`` registry."""
+        killed, missing = [], []
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if i in self._fired or f.get("tok") is None \
+                        or f["kind"] != "kill_replica" \
+                        or f["idx"] != idx or total < f["tok"]:
+                    continue
+                self._fired.add(i)
+                handle = self._replicas.get(f["idx"])
+                if handle is not None:
+                    killed.append((f["idx"], handle, "chaos_kill_replica"))
+                elif not self._replicas:
+                    missing.append(
+                        f"kill:replica@{f['idx']}:tok{f['tok']}")
         return self._finish_kills(killed, missing)
 
     # -- launcher-level child kills ----------------------------------------

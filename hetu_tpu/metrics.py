@@ -505,7 +505,9 @@ def reset_run_plan_counts():
 # a legal bucket (the micro-batcher's waste: real rows =
 # ``serve_batch_rows - serve_pad_rows``), queue-full rejections
 # (``serve_rejections`` — the backpressure path), PS failovers absorbed
-# MID-SERVE (``serve_failovers``), per-bucket executable builds
+# MID-SERVE (``serve_failovers``), dispatched batches re-run ONCE after
+# a transient device-call failure before their futures fail
+# (``serve_batch_retries``, ISSUE 19), per-bucket executable builds
 # (``serve_bucket_compiles`` — the compile-once claim is exactly "this
 # equals the number of distinct buckets used"), read-only embedding
 # refreshes (``serve_emb_refresh_rows``), and the queue-depth high-water
@@ -633,6 +635,53 @@ def prefix_cache_counts():
 
 def reset_prefix_cache_counts():
     _prefix_cache.reset()
+
+
+# --------------------------------------------- decode recovery counters
+# Exactly-once stream migration (ISSUE 19): when the fleet sweep ejects
+# a dead/wedged decode replica, every SEATED in-flight generation is
+# detached as a continuation request (``decode_recovery_detached`` —
+# the host-side emitted-token journal becomes the replay prompt suffix
+# and the stream's replay epoch is bumped, fencing the old engine) and
+# re-seated on a survivor through the chunked-prefill entry
+# (``decode_recovery_reseated``).  ``decode_recovery_replayed_rows``
+# counts the KV rows the survivor actually re-prefilled,
+# ``decode_recovery_prefix_assisted`` the rows a PrefixKVStore hit
+# seated for free (the two partition the continuation prompt).
+# ``decode_recovery_exhausted`` counts streams the door failed FAST
+# instead of resurrecting (retry budget, deadline estimator, or zero
+# survivors — the failure carries ``DecodeStream.partial()``),
+# ``decode_recovery_retries`` second-and-later recoveries of the same
+# stream, and ``decode_recovery_fenced`` stale emissions a migrated-away
+# replica attempted that the epoch fence dropped (each one a token that
+# would have been delivered TWICE without the fence).  Surfaced by
+# ``HetuProfiler.decode_recovery_counters()`` and the decode bench's
+# recovery leg; a process that never migrates a stream reports an empty
+# dict.
+
+_decode_recovery = REGISTRY.counter_family(
+    "decode_recovery",
+    "exactly-once in-flight decode stream migration events (empty in a "
+    "process that never recovers a stream)")
+
+
+def record_decode_recovery(kind, n=1):
+    """Count ``n`` stream-recovery events of ``kind``; kinds ending in
+    ``_hw`` are high-water gauges (the stored value is the max seen)."""
+    kind = str(kind)
+    if kind.endswith("_hw"):
+        _decode_recovery.max_gauge(kind, int(n))
+    elif n:
+        _decode_recovery.inc(kind, int(n))
+
+
+def decode_recovery_counts():
+    """{kind: count} snapshot of decode stream-recovery counters."""
+    return _decode_recovery.counts()
+
+
+def reset_decode_recovery_counts():
+    _decode_recovery.reset()
 
 
 # --------------------------------------------- serving rejection reasons
@@ -773,17 +822,21 @@ def serve_latency_stats():
 # joined the in-flight batch), per-request time-to-first-token (``ttft``
 # — submit -> FIRST generated token, the prompt-ingestion latency
 # chunked prefill attacks; distinct from the steady-state ``token``
-# gap), and per-engine-step device call (``step``).
+# gap), per-engine-step device call (``step``), and detach->reseat
+# migration latency for recovered in-flight streams (``recovery`` — one
+# observation per continuation seated on a survivor, ISSUE 19).
 _decode_latency = REGISTRY.histogram(
     "decode_latency_us",
     "decode latency: per-token emission, per-request join wait, "
-    "time-to-first-token, and per-step device call, microseconds")
+    "time-to-first-token, per-step device call, and detach->reseat "
+    "stream recovery, microseconds")
 
 
 def record_decode_latency(kind, us):
     """Observe one decode latency sample (``kind``: ``token`` per emitted
     token, ``join_wait`` per joined request, ``ttft`` once per stream at
-    its first generated token, ``step`` per engine step)."""
+    its first generated token, ``step`` per engine step, ``recovery``
+    per migrated continuation at reseat)."""
     _decode_latency.observe(us, label=kind)
 
 
@@ -885,6 +938,7 @@ _FAMILIES = {
     "serve": _serve,
     "decode": _decode,
     "prefix_cache": _prefix_cache,
+    "decode_recovery": _decode_recovery,
     "serve_rejection_reason": _serve_reject,
     "fleet": _fleet,
     "ps_rpc_bytes": _rpc_bytes,

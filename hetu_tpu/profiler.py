@@ -239,8 +239,8 @@ class HetuProfiler:
         observability registry in one call (``hetu_tpu.metrics``
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
         elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
-        decode, prefix_cache, serve_rejection_reason, fleet,
-        ps_rpc_bytes.  The per-family
+        decode, prefix_cache, decode_recovery, serve_rejection_reason,
+        fleet, ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -253,8 +253,9 @@ class HetuProfiler:
         p50/p90/p99 per label): ``ps_rpc_us`` per opcode (+ payload
         bytes), ``serve_latency_us`` (per-request queue wait /
         per-batch device call), ``decode_latency_us`` (time-to-token /
-        join wait / time-to-first-token ``ttft`` / engine step on the
-        decode plane), ``step_time_us``
+        join wait / time-to-first-token ``ttft`` / engine step /
+        detach->reseat stream ``recovery`` on the decode plane),
+        ``step_time_us``
         per subexecutor (opt-in — ``metrics.enable_step_timing`` or
         ``HETU_STEP_TIMING=1``), and the per-run ``mfu`` /
         ``step_time_ms`` gauges."""
@@ -463,13 +464,33 @@ class HetuProfiler:
         return prefix_cache_counts()
 
     @staticmethod
+    def decode_recovery_counters():
+        """{kind: count} of exactly-once in-flight stream migrations
+        (``hetu_tpu.metrics`` registry, ISSUE 19): streams detached off
+        a dead/wedged replica with their emitted-token journal
+        (``decode_recovery_detached``) and re-seated on a survivor
+        through chunked prefill (``decode_recovery_reseated``), the KV
+        rows that reseat actually re-prefilled
+        (``decode_recovery_replayed_rows``) vs seated free off a
+        PrefixKVStore hit (``decode_recovery_prefix_assisted``),
+        streams failed fast with ``recovery_exhausted`` instead of
+        resurrected (``decode_recovery_exhausted``), second-and-later
+        recoveries of one stream (``decode_recovery_retries``), and
+        stale emissions the replay-epoch fence dropped
+        (``decode_recovery_fenced``).  Detach->reseat latency rides the
+        ``recovery`` label of ``metrics.decode_latency_stats()``.  A
+        process that never migrates a stream reports an empty dict."""
+        from .metrics import decode_recovery_counts
+        return decode_recovery_counts()
+
+    @staticmethod
     def serve_rejection_counters():
         """{reason: count} of serving rejections keyed by the structured
         ``ServeRejected.reason`` taxonomy (``queue_full`` |
         ``over_max_len`` | ``deadline`` | ``shed:<class>`` |
-        ``draining``) — the per-cause breakdown behind the coarse
-        ``*_rejections`` totals in ``serve_counters`` /
-        ``decode_counters``.  Bench artifacts and tests read this
+        ``recovery_exhausted`` | ``draining``) — the per-cause breakdown
+        behind the coarse ``*_rejections`` totals in ``serve_counters``
+        / ``decode_counters``.  Bench artifacts and tests read this
         instead of string-matching exception text."""
         from .metrics import serve_rejection_counts
         return serve_rejection_counts()

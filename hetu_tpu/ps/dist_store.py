@@ -72,6 +72,7 @@ makes safe.  The chaos DSL reproduces the failure deterministically
 """
 from __future__ import annotations
 
+import gc
 import itertools
 import os
 import queue
@@ -94,6 +95,29 @@ from ..obs.trace import TRACER as _TR
 # value uniqueness at import time (runtime twin of the tools/hetu_lint.py
 # protocol check) and names frames in errors/chaos logs via op_name().
 from .opcodes import defop as _defop, frame_repr, op_name
+
+# A cyclic-GC pass can run an ``Executor.__del__`` → ``close()`` chain
+# while the interrupted frame sits inside a native store call and sibling
+# objects are destructed in arbitrary order — teardown reached from a GC
+# finalizer must not touch the native store (see DistCacheTable.close).
+# The flag is a plain module global: GC callbacks and the finalizers they
+# trigger run on the collecting thread, and a concurrent close() on
+# another thread spuriously skipping a flush only costs bounded staleness.
+_GC_ACTIVE = False
+
+
+def _gc_phase(phase, info):
+    global _GC_ACTIVE
+    _GC_ACTIVE = phase == "start"
+
+
+gc.callbacks.append(_gc_phase)
+
+
+def _in_gc_pass():
+    """True while a cyclic-GC collection is running on this process."""
+    return _GC_ACTIVE
+
 
 OP_PULL = _defop("OP_PULL", 1)
 OP_PUSH = _defop("OP_PUSH", 2)
@@ -3092,14 +3116,20 @@ class DistCacheTable:
     def close(self):
         """Flush pending grads; safe to call repeatedly / at teardown.
 
-        During interpreter finalization the flush is SKIPPED: pushing
-        through numpy/ctypes while the runtime is being torn down
-        segfaults (observed via ``Executor.__del__`` at process exit),
-        and pending grads are bounded-staleness state — anything that
-        must be durable goes through an explicit ``flush``/checkpoint
-        from live code (``Executor.save`` already calls ``ps_flush``)."""
+        During interpreter finalization OR a garbage-collection pass the
+        flush is SKIPPED: pushing through numpy/ctypes while the runtime
+        is being torn down segfaults (observed via ``Executor.__del__``
+        at process exit), and a GC-triggered ``__del__`` can reach this
+        close while the interrupted main-thread frame sits INSIDE a
+        native push on a store whose peers are being destructed in the
+        same pass in arbitrary order (observed as a segfault in
+        ``PSAgent.rows`` mid-collection) — finalizer context must never
+        touch the native store.  Pending grads are bounded-staleness
+        state; anything that must be durable goes through an explicit
+        ``flush``/checkpoint from live code (``Executor.save`` already
+        calls ``ps_flush``)."""
         import sys
-        if sys.is_finalizing():
+        if sys.is_finalizing() or _in_gc_pass():
             return
         try:
             self.flush()

@@ -38,6 +38,15 @@ with any name):
                           future resolution — vs a caller's ``cancel()``
 ``router.close``          ``ServingRouter.close``, before rejecting the
                           still-queued requests
+``decode.step``           ``DecodeRouter._loop``, the join/step boundary
+``decode.close``          ``DecodeRouter.close``, before failing the
+                          still-queued streams
+``recovery.detach``       ``DecodeRouter.detach_inflight``, after the
+                          seated mirror is taken, before the journal
+                          snapshots (ISSUE 19) — vs close/adopt
+``recovery.adopt``        ``DecodeRouter.adopt``, before the rescued
+                          requests enter the survivor's queue — vs the
+                          survivor's own close
 ``exec.resize_world``     ``Executor.resize_world`` entry — vs an
                           in-flight async step
 ``exec.drain_async``      ``Executor._drain_async`` entry (the resize

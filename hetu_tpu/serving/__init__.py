@@ -42,6 +42,18 @@ is the serving half the training executor never had:
   ejection/rescue/re-admission, p99-SLO autoscaling on the elastic
   plane's flap-damping machinery, and graceful drain that hands queued
   work to survivors.
+* Exactly-once stream recovery (ISSUE 19) — in-flight decode
+  generations SURVIVE replica death: the stream's host-side
+  emitted-token journal is detached with the queue when the sweep
+  ejects a dead/wedged replica, replayed through chunked prefill on
+  the least-loaded survivor (:class:`PrefixKVStore` consulted first)
+  under a bumped replay epoch that fences the dead replica's late
+  emissions — already-resolved ``token(i)`` futures never re-fire and
+  the recovered stream is bitwise-equal to an unkilled run.
+  Resurrection is gated (retry budget, deadline estimator, survivor
+  existence); a doomed stream fails fast with
+  ``ServeRejected('recovery_exhausted')`` carrying
+  ``DecodeStream.partial()``.
 
 Proven end-to-end by ``bench.py --config serve`` (zipf request stream,
 p50/p99/QPS, chaos primary-kill mid-load with bitwise response parity)

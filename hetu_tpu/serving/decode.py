@@ -70,12 +70,25 @@ This module is the serving plane for that workload:
   whole-sequence ``stream.result()``.  Backpressure is explicit —
   a full queue raises :class:`~hetu_tpu.serving.ServeRejected`.
 
+* **Exactly-once stream recovery (ISSUE 19).**  The stream's host-side
+  token list is the REPLAY JOURNAL: when a fleet replica dies (or
+  wedges) mid-generation, :meth:`DecodeRouter.detach_inflight` turns
+  every seated sequence into a *continuation request* — original
+  prompt + journal as the new prompt, remaining ``max_new``, same
+  stream, original deadline — that a survivor re-ingests through
+  chunked prefill (prefix store consulted first) and continues from
+  the next token index.  The detach atomically bumps the stream's
+  replay epoch, fencing every late emission from the dead replica:
+  already-resolved ``token(i)`` futures never re-fire, no token is
+  delivered twice or skipped, and greedy argmax over the replayed
+  history makes the full stream bitwise-equal to an unkilled run.
+
 Threading: the router's loop thread OWNS the engine (slots, caches,
 compiled steps) — no lock guards engine state because exactly one thread
-touches it after ``start()``.  The queue hands off under
-``DecodeRouter._cv``; each stream has its own ``DecodeStream._lock``.
-Neither is ever held across a device call or while acquiring the other,
-so the PR 14 witness hierarchy stays acyclic.
+touches it after ``start()``.  The queue and the seated-request mirror
+hand off under ``DecodeRouter._cv``; each stream has its own
+``DecodeStream._lock``.  Neither is ever held across a device call or
+while acquiring the other, so the PR 14 witness hierarchy stays acyclic.
 """
 from __future__ import annotations
 
@@ -87,10 +100,12 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import race as _race
 from ..graph.run_plan import KeyedPlanCache
 from ..graph import step_cache
-from ..metrics import record_decode, record_decode_latency
+from ..metrics import (record_decode, record_decode_latency,
+                       record_decode_recovery)
 from ..obs.lock_witness import make_condition, make_lock
 from ..obs.trace import TRACER as _TR
 from .executor import InferenceExecutor, default_buckets
@@ -105,7 +120,18 @@ class DecodeStream:
     before ``i`` tokens).  Iterating yields tokens until the sequence
     finishes.  ``result(timeout)`` blocks for the full token list.  A
     router/engine failure fails every outstanding future AND
-    ``result()`` with the same exception."""
+    ``result()`` with the same exception.
+
+    The host-side ``_tokens`` list doubles as the REPLAY JOURNAL for
+    exactly-once stream migration (ISSUE 19): when the replica holding
+    this stream dies mid-generation, the front door detaches the stream
+    with its journal and re-seats it on a survivor as a continuation
+    request (prompt + journal re-prefilled, generation resumed at the
+    next index).  ``_detach`` bumps the stream's replay EPOCH atomically
+    with the journal snapshot; every engine-side mutation carries the
+    epoch its request was built under, so a stale replica — wedged in a
+    device call when the door gave up on it, then waking later — cannot
+    re-fire an already-resolved future or double-deliver a token."""
 
     def __init__(self, prompt_len, max_new_tokens):
         self.prompt_len = int(prompt_len)
@@ -113,6 +139,7 @@ class DecodeStream:
         self._lock = make_lock("DecodeStream._lock")
         self._futs = []
         self._tokens = []
+        self._epoch = 0
         self._final = Future()
 
     # -- consumer side -----------------------------------------------------
@@ -145,6 +172,20 @@ class DecodeStream:
         with self._lock:
             return len(self._tokens)
 
+    @property
+    def epoch(self):
+        """Current replay epoch (bumped once per detach/migration)."""
+        with self._lock:
+            return self._epoch
+
+    def partial(self):
+        """Tokens generated SO FAR — a copy of the replay journal.
+        Attached to a ``recovery_exhausted`` failure so a consumer
+        keeps the partial generation instead of losing it with the
+        replica (ISSUE 19 satellite)."""
+        with self._lock:
+            return list(self._tokens)
+
     def __iter__(self):
         i = 0
         while True:
@@ -159,20 +200,42 @@ class DecodeStream:
 
     # -- engine side (router loop thread only) -----------------------------
 
-    def _emit(self, tok):
+    def _detach(self):
+        """Bump the replay epoch and snapshot the journal ATOMICALLY —
+        the one operation behind stream migration.  Every emission the
+        old replica attempts after this point is fenced (its request
+        carries the stale epoch), so the snapshot is exact: the
+        continuation replays precisely the tokens consumers were
+        delivered, then appends.  Returns ``(new_epoch, journal)``."""
         with self._lock:
+            self._epoch += 1
+            return self._epoch, list(self._tokens)
+
+    def _emit(self, tok, epoch=None):
+        """Deliver one token.  ``epoch`` is the replay epoch of the
+        emitting request; a stale epoch (the stream migrated away) is a
+        no-op returning False.  Returns the journal length after the
+        append — 1 means this was the stream's FIRST token ever (the
+        ttft observation), regardless of which replica delivered it."""
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return False
             while len(self._futs) <= len(self._tokens):
                 self._futs.append(Future())
             fut = self._futs[len(self._tokens)]
             self._tokens.append(int(tok))
+            count = len(self._tokens)
         # resolve OUTSIDE the stream lock: a done-callback attached by
         # the consumer runs in this thread and must not run under (or
         # re-acquire) our lock
         if fut.set_running_or_notify_cancel():
             fut.set_result(int(tok))
+        return count
 
-    def _finish(self):
+    def _finish(self, epoch=None):
         with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return False
             tokens = list(self._tokens)
             extra = self._futs[len(tokens):]
         for f in extra:
@@ -181,9 +244,12 @@ class DecodeStream:
                     f"generation finished after {len(tokens)} tokens"))
         if self._final.set_running_or_notify_cancel():
             self._final.set_result(tokens)
+        return True
 
-    def _fail(self, exc):
+    def _fail(self, exc, epoch=None):
         with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return False
             done = len(self._tokens)
             pending = self._futs[done:]
         for f in pending:
@@ -191,11 +257,12 @@ class DecodeStream:
                 f.set_exception(exc)
         if self._final.set_running_or_notify_cancel():
             self._final.set_exception(exc)
+        return True
 
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_arrival",
-                 "fid", "deadline")
+                 "fid", "deadline", "epoch", "retries", "detached_ts")
 
     def __init__(self, prompt, max_new, eos_id, fid, deadline=None):
         self.prompt = prompt
@@ -205,6 +272,41 @@ class _DecodeRequest:
         self.t_arrival = time.monotonic()
         self.fid = fid
         self.deadline = deadline   # absolute monotonic, or None
+        self.epoch = 0             # stream replay epoch this req emits under
+        self.retries = 0           # continuation builds for this stream
+        self.detached_ts = None    # set on continuations: detach time
+
+
+def _continuation(req):
+    """Continuation request for a detached in-flight stream (ISSUE 19):
+    the original prompt plus the emitted-token journal becomes the new
+    prompt (re-ingested through chunked prefill on the survivor, prefix
+    store consulted first), ``max_new`` shrinks to the remaining budget,
+    and the SAME stream travels along — generation resumes at the next
+    token index, so already-resolved ``token(i)`` futures never re-fire.
+    The journal snapshot and the epoch bump are one atomic operation
+    (``DecodeStream._detach``), fencing every later emission from the
+    dead replica."""
+    stream = req.stream
+    epoch, journal = stream._detach()
+    base = np.asarray(req.prompt, np.int32)[:stream.prompt_len]
+    cont = _DecodeRequest.__new__(_DecodeRequest)
+    cont.prompt = np.concatenate(
+        [base, np.asarray(journal, np.int32)]) if journal else base
+    cont.max_new = stream.max_new_tokens - len(journal)
+    cont.eos_id = req.eos_id
+    cont.stream = stream
+    cont.t_arrival = req.t_arrival      # deadline math stays submit-anchored
+    cont.fid = _TR.flow_begin("decode.recovery", cat="decode") \
+        if _TR.on else None             # the eject->reseat flow arrow
+    cont.deadline = req.deadline
+    cont.epoch = epoch
+    cont.retries = req.retries + 1
+    cont.detached_ts = time.monotonic()
+    record_decode_recovery("decode_recovery_detached")
+    if cont.retries > 1:
+        record_decode_recovery("decode_recovery_retries")
+    return cont
 
 
 class _Sequence:
@@ -427,11 +529,23 @@ class DecodeEngine:
             record_decode("decode_slot_recycles")
         self._used[slot] = True
         record_decode("decode_joins")
-        record_decode_latency(
-            "join_wait", (time.monotonic() - req.t_arrival) * 1e6)
+        if req.detached_ts is not None:
+            # a migrated continuation reseats here: the journal replay is
+            # the prompt suffix, minus whatever the prefix store seated
+            record_decode_recovery("decode_recovery_reseated")
+            record_decode_recovery("decode_recovery_replayed_rows",
+                                   max(0, len(req.prompt) - m))
+            if m:
+                record_decode_recovery("decode_recovery_prefix_assisted", m)
+            record_decode_latency(
+                "recovery", (time.monotonic() - req.detached_ts) * 1e6)
+        else:
+            record_decode_latency(
+                "join_wait", (time.monotonic() - req.t_arrival) * 1e6)
         if _TR.on:
             if req.fid is not None:
-                _TR.flow_end("decode.request", req.fid, cat="decode")
+                _TR.flow_end("decode.recovery" if req.detached_ts is not None
+                             else "decode.request", req.fid, cat="decode")
             seq.fid = _TR.flow_begin("decode.join", cat="decode")
         return slot
 
@@ -441,17 +555,20 @@ class DecodeEngine:
         self.tokens[slot] = 0
         self.positions[slot] = 0
         record_decode("decode_leaves")
-        seq.req.stream._finish()
+        seq.req.stream._finish(seq.req.epoch)
 
     def abort(self, exc):
         """Fail every in-flight stream and clear the batch (router
-        close / fatal step error)."""
+        close / fatal step error).  Epoch-fenced: a stream the front
+        door already migrated to a survivor ignores this replica's
+        abort — closing a dead replica must not kill its rescued
+        streams."""
         for i, seq in enumerate(self.slots):
             if seq is not None:
                 self.slots[i] = None
                 self.tokens[i] = 0
                 self.positions[i] = 0
-                seq.req.stream._fail(exc)
+                seq.req.stream._fail(exc, seq.req.epoch)
 
     def evict_expired(self, now=None):
         """Deadline eviction (ISSUE 17 satellite): a seated sequence
@@ -476,7 +593,7 @@ class DecodeEngine:
                 seq.req.stream._fail(ServeRejected(
                     "deadline",
                     f"decode deadline passed after {seq.emitted} of "
-                    f"{seq.req.max_new} tokens"))
+                    f"{seq.req.max_new} tokens"), seq.req.epoch)
                 evicted += 1
         return evicted
 
@@ -550,21 +667,34 @@ class DecodeEngine:
         """Post-argmax bookkeeping shared by the one-token and chunked
         paths: counters, latency (``token`` + first-token ``ttft``),
         prefix-snapshot insert, stream emission, and the done check.
-        Returns 1 (one token emitted)."""
+        Returns 1 (one token emitted), or 0 when the stream's replay
+        epoch fenced the emission — the stream migrated to a survivor
+        while this replica was still stepping, so the stale seat is
+        dropped without touching the stream (exactly-once delivery)."""
+        count = seq.req.stream._emit(tok, seq.req.epoch)
+        if count is False:
+            self.slots[i] = None
+            self.tokens[i] = 0
+            self.positions[i] = 0
+            record_decode("decode_leaves")
+            record_decode_recovery("decode_recovery_fenced")
+            return 0
         seq.emitted += 1
         record_decode("decode_generate_rows")
         record_decode("decode_tokens")
         record_decode_latency("token", (now - seq.t_last) * 1e6)
-        if seq.emitted == 1:
+        if count == 1:
+            # the stream's first token EVER (journal length 1) — a
+            # continuation of a mid-prefill kill still records ttft
+            # exactly once, anchored to the original submit
             record_decode_latency(
                 "ttft", (now - seq.req.t_arrival) * 1e6)
-            if self.prefix is not None:
-                self._prefix_insert(i, seq)
+        if seq.emitted == 1 and self.prefix is not None:
+            self._prefix_insert(i, seq)
         seq.t_last = now
         if _TR.on and seq.fid is not None:
             _TR.flow_end("decode.join", seq.fid, cat="decode")
             seq.fid = None
-        seq.req.stream._emit(tok)
         self.tokens[i] = tok
         done = (seq.emitted >= seq.req.max_new
                 or (seq.req.eos_id is not None
@@ -767,6 +897,20 @@ class DecodeRouter:
         self._draining = False
         self._killed = False
         self._active_ct = 0       # loop's mirror of engine.active (under _cv)
+        # seated-request mirror (under _cv): the requests behind
+        # _active_ct.  Updated at POP time in _take_joins — before the
+        # step, not after — so a replica that wedges inside a device
+        # call with an empty queue still reports its in-flight batch
+        # (the ISSUE 19 wedge-eject fix), and the front door's
+        # detach_inflight can rescue seated streams without the loop
+        # thread's cooperation.
+        self._seated = []
+        #: fleet replica index for the chaos token clock — set by the
+        #: FrontDoor at registration; the loop reports cumulative
+        #: emitted tokens to ChaosInjector.on_token for deterministic
+        #: mid-generation kill:replica@<idx>:tok<n> faults
+        self.chaos_idx = None
+        self._tokens_total = 0    # loop thread only
         now = time.monotonic()
         self.hb_ts = now          # loop heartbeat (under _cv)
         self.progress_ts = now    # last step that made progress (under _cv)
@@ -844,10 +988,14 @@ class DecodeRouter:
     def health(self):
         """Point-in-time health snapshot for the front door's sweep —
         same shape as ``ServingRouter.health``."""
+        ct = max(1, int(getattr(self.engine, "chunk_top", 1)))
         with self._cv:
+            q_steps = sum((len(r.prompt) + ct - 1) // ct
+                          for r in self._q)
             return {"pending": len(self._q) + self._active_ct,
                     "queued": len(self._q),
                     "inflight": self._active_ct,
+                    "pending_steps": q_steps + self._active_ct,
                     "hb_ts": self.hb_ts,
                     "progress_ts": self.progress_ts,
                     "killed": self._killed,
@@ -887,14 +1035,41 @@ class DecodeRouter:
             self._cv.notify_all()
             return orphans
 
+    def detach_inflight(self):
+        """Remove and return every SEATED in-flight sequence as a
+        CONTINUATION request (ISSUE 19) — prompt + emitted-token
+        journal, original arrival/deadline, retry count bumped.  The
+        front door re-seats them on a survivor via :meth:`adopt`, and
+        the journal snapshot bumps each stream's replay epoch, so this
+        works on a WEDGED replica too: whatever its stuck loop emits
+        after this point is fenced, not double-delivered.  Streams that
+        already finished (or already migrated away) are skipped."""
+        with self._cv:
+            seated = list(self._seated)
+            self._seated = []
+            self._active_ct = 0
+            self._cv.notify_all()
+        if _race.ACTIVE is not None:   # recovery vs close interleavings
+            _race.point("recovery.detach")
+        conts = []
+        for req in seated:
+            stream = req.stream
+            if stream.done or req.epoch != stream.epoch:
+                continue
+            conts.append(_continuation(req))
+        return conts
+
     def adopt(self, reqs):
-        """Admit requests detached from another decode replica; arrival
+        """Admit requests detached from another decode replica —
+        queued orphans and in-flight continuations alike; arrival
         timestamps and deadlines are preserved, and ``queue_limit`` is
         bypassed by design (rescue must not re-reject admitted work).
         Returns the count."""
         reqs = list(reqs)
         if not reqs:
             return 0
+        if _race.ACTIVE is not None:   # recovery vs close interleavings
+            _race.point("recovery.adopt")
         with self._cv:
             if self._stop or self._killed:
                 raise ServeRejected(
@@ -905,10 +1080,12 @@ class DecodeRouter:
 
     def kill(self):
         """Chaos fail-stop: the loop exits at its next boundary WITHOUT
-        touching the queue (the front door rescues it), and fails every
-        SEATED stream fast — mid-generation KV state dies with the
-        replica, exactly like a real process kill.  New submits are
-        rejected (``draining``)."""
+        touching the queue or the seated streams — the front door
+        rescues the queue via :meth:`detach_queue` and resurrects
+        in-flight generations via :meth:`detach_inflight` (their
+        emitted-token journals live host-side; only the KV state dies
+        with the replica).  Streams nobody detaches are failed by
+        :meth:`close`.  New submits are rejected (``draining``)."""
         with self._cv:
             self._killed = True
             self._cv.notify_all()
@@ -990,7 +1167,16 @@ class DecodeRouter:
                             return None
                         cap = self.engine.capacity()
                     n = min(len(self._q), cap)
-                    return [self._q.popleft() for _ in range(n)]
+                    joins = [self._q.popleft() for _ in range(n)]
+                    # mirror the about-to-be-seated work NOW, not after
+                    # the step: between this pop and the post-step
+                    # update the loop may wedge inside a device call,
+                    # and a wedged replica with an empty queue would
+                    # otherwise report pending=0 — invisible to the
+                    # fleet sweep's eject condition (ISSUE 19 satellite)
+                    self._seated.extend(joins)
+                    self._active_ct = len(self._seated)
+                    return joins
                 if busy:
                     return []
                 self.hb_ts = time.monotonic()   # idle loop still beats
@@ -1001,13 +1187,14 @@ class DecodeRouter:
             joins = self._take_joins()
             if joins is None:
                 with self._cv:
-                    killed = self._killed
-                if killed:
-                    # fail-stop: seated sequences die with the replica
-                    # (their KV state is gone); the QUEUE stays intact
-                    # for the front door's rescue
-                    self.engine.abort(
-                        ServeRejected("draining", "replica killed"))
+                    if self._killed:
+                        # fail-stop WITHOUT failing seated streams:
+                        # their emitted-token journals live host-side,
+                        # so the front door resurrects them on a
+                        # survivor (detach_inflight); close() still
+                        # fails whatever nobody detached.  Leave the
+                        # seated mirror as-is for that rescue.
+                        self._cv.notify_all()
                 return
             now = time.monotonic()
             for req in joins:
@@ -1017,29 +1204,48 @@ class DecodeRouter:
                     record_decode("decode_deadline_evictions")
                     req.stream._fail(ServeRejected(
                         "deadline",
-                        "decode deadline passed waiting for a slot"))
+                        "decode deadline passed waiting for a slot"),
+                        req.epoch)
                     continue
                 self.engine.join(req)
             if _race.ACTIVE is not None:   # the join/step boundary
                 _race.point("decode.step")
+            emitted = 0
             if not self.engine.idle:
                 try:
                     self.engine.evict_expired()
-                    self.engine.step()
+                    emitted = self.engine.step()
                 except Exception as e:    # noqa: BLE001 — every in-flight
                     self.engine.abort(e)  # stream must learn its fate; the
                                           # router keeps serving new work
             with self._cv:
-                active = self.engine.active
+                seated = [s.req for s in self.engine.slots
+                          if s is not None]
+                active = len(seated)
                 # a completed step with seated rows IS progress (tokens
-                # moved); a truly wedged step never reaches this line
-                progressed = bool(joins) or active != self._active_ct
+                # moved); a truly wedged step never reaches this line.
+                # NOTE: if the door detached the in-flight batch while
+                # this (formerly wedged) step was running, the engine's
+                # stale seats re-enter the mirror here — their emissions
+                # are epoch-fenced, and the seats free themselves at
+                # their next emit, so the inflation is transient.
+                progressed = bool(joins) or bool(emitted) \
+                    or active != self._active_ct
+                self._seated = seated
                 self._active_ct = active
                 now = time.monotonic()
                 self.hb_ts = now
                 if progressed or active:
                     self.progress_ts = now
                 self._cv.notify_all()   # drain() waits on this
+            if emitted:
+                # the chaos token clock: cumulative tokens THIS engine
+                # emitted — deterministic, unlike the door's admission
+                # clock, for mid-generation kill:replica@<idx>:tok<n>
+                self._tokens_total += emitted
+                inj = _chaos.active()
+                if inj is not None and self.chaos_idx is not None:
+                    inj.on_token(self.chaos_idx, self._tokens_total)
 
 
 __all__ = ["DecodeEngine", "DecodeRouter", "DecodeStream"]

@@ -19,10 +19,25 @@ restarts.
   (``hb_ts`` every loop visit, ``progress_ts`` every completed batch);
   the sweep — time-gated, riding admissions and ``poll()`` calls, no
   extra thread — EJECTS a replica that is killed or *wedged* (pending
-  work but a stale heartbeat: a stuck device call), rescues its queued
-  requests onto a survivor (``detach_queue`` → ``adopt``; admitted work
-  is handed over, never failed), and RE-ADMITS a replica whose
-  heartbeat returns.
+  work — queued OR seated in-flight — but a stale heartbeat: a stuck
+  device call), rescues its queued requests onto a survivor
+  (``detach_queue`` → ``adopt``; admitted work is handed over, never
+  failed), and RE-ADMITS a replica whose heartbeat returns.
+
+* **Exactly-once stream recovery (ISSUE 19).**  Ejecting a DECODE
+  replica also detaches its seated in-flight generations as
+  continuation requests (``detach_inflight`` — each stream's host-side
+  emitted-token journal replayed as the prompt suffix, its replay epoch
+  bumped so the dead replica cannot double-deliver) and re-seats them
+  on the least-loaded survivor through chunked prefill, prefix store
+  consulted first: the continuation appends from the next token index
+  and the full stream is bitwise-equal to an unkilled run.
+  Resurrection is GATED — per-stream retry budget
+  (``recovery_budget``), the door's deadline estimator pricing the
+  re-prefill (``pending_steps``), and survivor existence; a doomed
+  stream fails FAST with ``ServeRejected('recovery_exhausted')``
+  carrying ``DecodeStream.partial()`` instead of occupying a survivor
+  slot.
 
 * **Admission control by request class.**  Requests carry a class from
   :data:`CLASSES` (``interactive | batch | best_effort``); overload —
@@ -70,8 +85,8 @@ import time
 import numpy as np
 
 from .. import chaos as chaos_mod
-from ..metrics import (record_fleet, record_serve_latency,
-                       serve_latency_stats)
+from ..metrics import (record_decode_recovery, record_fleet,
+                       record_serve_latency, serve_latency_stats)
 from ..obs.lock_witness import make_lock
 from ..parallel.elastic import FlapDamper
 from .router import ServeRejected
@@ -89,8 +104,10 @@ DEFAULT_SHED_AT = {"interactive": None, "batch": 0.85, "best_effort": 0.5}
 class _Replica:
     """One replica's record inside the front door: the router plus the
     door-side health state.  Registered as the chaos kill target for
-    ``kill:replica@<idx>:req<n>`` — ``stop()`` fail-stops the router at
-    its next batch boundary (queue left intact for rescue)."""
+    ``kill:replica@<idx>:req<n>`` (admission clock) and
+    ``kill:replica@<idx>:tok<n>`` (the decode engine's own token clock)
+    — ``stop()`` fail-stops the router at its next batch boundary
+    (queue and in-flight streams left intact for rescue)."""
 
     __slots__ = ("idx", "router", "ejected", "draining", "cost_ms")
 
@@ -132,13 +149,15 @@ class FrontDoor:
     ``forward_deadline_ms=True`` forwards the per-request deadline into
     ``replica.submit(..., deadline_ms=...)`` (decode replicas evict
     mid-generation); one-shot routers don't take the kwarg, so it
-    defaults off.
+    defaults off.  ``recovery_budget``: how many times one in-flight
+    decode stream may be resurrected across replica deaths before the
+    door fails it with ``recovery_exhausted`` (ISSUE 19).
     """
 
     def __init__(self, make_replica, n_replicas=1, *, shed_at=None,
                  class_deadline_ms=None, wedge_timeout_ms=1000.0,
                  health_every_ms=5.0, window=512, register_chaos=True,
-                 forward_deadline_ms=False):
+                 forward_deadline_ms=False, recovery_budget=2):
         self.make_replica = make_replica
         self.shed_at = dict(DEFAULT_SHED_AT)
         self.shed_at.update(shed_at or {})
@@ -148,6 +167,7 @@ class FrontDoor:
         self.health_every_ms = float(health_every_ms)
         self.register_chaos = bool(register_chaos)
         self.forward_deadline_ms = bool(forward_deadline_ms)
+        self.recovery_budget = max(0, int(recovery_budget))
         self._lock = make_lock("FrontDoor._lock")
         self._replicas = []
         self._next_idx = 0
@@ -246,7 +266,15 @@ class FrontDoor:
                     record_fleet("fleet_replica_readmitted")
                 continue
             hb_age_ms = (now - snap["hb_ts"]) * 1e3
-            wedged = snap["pending"] > 0 and hb_age_ms > self.wedge_timeout_ms
+            # pending covers queued + seated; pending_steps (decode)
+            # additionally prices prompt backlogs — EITHER nonzero with
+            # a stale heartbeat means live work behind a stuck loop.
+            # Before ISSUE 19 a replica wedged mid-device-call with an
+            # empty queue (its whole batch seated) reported pending=0
+            # and was never ejected.
+            stuck_work = snap["pending"] > 0 \
+                or snap.get("pending_steps", 0) > 0
+            wedged = stuck_work and hb_age_ms > self.wedge_timeout_ms
             if snap["killed"] or snap["stopped"] or wedged:
                 rep.ejected = True
                 record_fleet("fleet_replica_ejected")
@@ -263,35 +291,101 @@ class FrontDoor:
                     rep.cost_ms = max(1e-3, float(st["p99"]) / 1e3)
 
     def _rescue_locked(self, dead):
-        """Hand a dead/draining replica's QUEUED requests to the least-
-        loaded survivor; admitted work is rescued, not failed.  With no
-        survivor the orphans' futures fail loudly (counted)."""
+        """Hand a dead/draining replica's QUEUED requests — and, for an
+        EJECTED decode replica, its seated in-flight streams as
+        continuation requests (ISSUE 19) — to the least-loaded
+        survivor; admitted work is rescued, not failed.  Continuations
+        go through the recovery gate first (retry budget, deadline
+        estimator, survivor existence): a doomed stream fails FAST with
+        ``recovery_exhausted`` + partial tokens.  With no survivor the
+        queued orphans' futures fail loudly (counted)."""
         orphans = dead.router.detach_queue()
-        if not orphans:
+        conts = []
+        detach = getattr(dead.router, "detach_inflight", None)
+        if detach is not None and dead.ejected:
+            # draining replicas (scale_in) finish their own seated work;
+            # only a DEAD replica's in-flight batch needs resurrection
+            conts = detach()
+        if not orphans and not conts:
             return 0
+        now = time.monotonic()
         survivors = [r for r in self._replicas if r.live() and r is not dead]
-        if survivors:
-            best = min(survivors,
-                       key=lambda r: (r.router.pending, r.cost_ms, r.idx))
+        best = min(survivors,
+                   key=lambda r: (r.router.pending, r.cost_ms, r.idx)) \
+            if survivors else None
+        ready = []
+        for req in conts:
+            why = self._recovery_gate_locked(req, best, now)
+            if why is None:
+                ready.append(req)
+            else:
+                self._fail_recovery_locked(req, why)
+        if best is not None:
             try:
-                n = best.router.adopt(orphans)
+                # continuations ride AHEAD of the queued orphans: they
+                # hold original arrival timestamps and already-delivered
+                # tokens, so they reseat first
+                n = best.router.adopt(ready + orphans)
                 record_fleet("fleet_rescued", n)
                 return n
             except ServeRejected:
                 pass    # survivor raced into shutdown: fall through
-        self._failures += len(orphans)
-        record_fleet("fleet_request_failures", len(orphans))
-        exc = ServeRejected("draining",
-                            "replica died with no survivor to adopt its "
-                            "queue")
-        for req in orphans:
-            fail = getattr(req, "future", None)
-            if fail is not None:
-                if fail.set_running_or_notify_cancel():
-                    fail.set_exception(exc)
-            else:
-                req.stream._fail(exc)
+        for req in ready:
+            self._fail_recovery_locked(
+                req, "no survivor to adopt the in-flight stream")
+        if orphans:
+            self._failures += len(orphans)
+            record_fleet("fleet_request_failures", len(orphans))
+            exc = ServeRejected("draining",
+                                "replica died with no survivor to adopt "
+                                "its queue")
+            for req in orphans:
+                fail = getattr(req, "future", None)
+                if fail is not None:
+                    if fail.set_running_or_notify_cancel():
+                        fail.set_exception(exc)
+                else:
+                    req.stream._fail(exc)
         return 0
+
+    def _recovery_gate_locked(self, req, best, now):
+        """None = resurrect on ``best``; else the reason string the
+        stream fails fast with.  The deadline leg reuses the door's
+        admission estimator: steps already pending on the survivor,
+        plus the continuation's own re-prefill (``ceil(P/chunk)``) and
+        remaining tokens, at the survivor's recent per-batch cost."""
+        if best is None:
+            return "no survivor to adopt the in-flight stream"
+        if req.retries > self.recovery_budget:
+            return (f"retry budget exhausted "
+                    f"({req.retries - 1} recoveries already spent, "
+                    f"budget {self.recovery_budget})")
+        if req.deadline is not None:
+            steps = getattr(best.router, "pending_steps", None)
+            ahead = int(steps) if steps is not None \
+                else int(best.router.pending)
+            ct = max(1, int(getattr(
+                getattr(best.router, "engine", None), "chunk_top", 1)))
+            replay = (len(req.prompt) + ct - 1) // ct
+            eta_ms = (ahead + replay + int(req.max_new)) * best.cost_ms
+            if now + eta_ms / 1e3 > req.deadline:
+                return (f"re-prefill + {req.max_new} remaining tokens "
+                        f"(~{eta_ms:.1f}ms) cannot meet the deadline")
+        return None
+
+    def _fail_recovery_locked(self, req, why):
+        """Fail one unrecoverable stream loudly: ``recovery_exhausted``
+        with the partial tokens attached (ISSUE 19 satellite — work
+        already delivered is surfaced, never silently discarded)."""
+        record_decode_recovery("decode_recovery_exhausted")
+        self._failures += 1
+        record_fleet("fleet_request_failures")
+        partial = req.stream.partial()
+        req.stream._fail(ServeRejected(
+            "recovery_exhausted",
+            f"in-flight stream not recoverable: {why} "
+            f"({len(partial)} tokens already delivered ride exc.partial)",
+            partial=partial))
 
     # -- admission + dispatch ----------------------------------------------
 
@@ -427,6 +521,11 @@ class FrontDoor:
             self._next_idx += 1
         router = self.make_replica(idx)    # may build executors: no lock
         rep = _Replica(idx, router)
+        if hasattr(router, "chaos_idx"):
+            # decode replicas report their own emitted-token clock to
+            # the injector (kill:replica@<idx>:tok<n> — deterministic
+            # mid-generation kills, ISSUE 19)
+            router.chaos_idx = idx
         inj = chaos_mod.active()
         if inj is not None and self.register_chaos:
             inj.register_replica(idx, rep)

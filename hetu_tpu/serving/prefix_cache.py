@@ -23,6 +23,17 @@ re-proven: the engine's masked cache writes make KV bytes independent
 of ingestion mode, so a hit's token stream is bitwise-equal to the
 cold path (gated in tests and the decode bench).
 
+The same determinism makes the store a RECOVERY accelerator (ISSUE
+19): a migrated in-flight stream replays ``original prompt + emitted
+tokens`` as its continuation prompt on a survivor, and because stores
+are shared across a fleet's engines, the dead replica's snapshot of
+the original prompt (inserted at the stream's first generated token)
+seats the continuation with those rows pre-filled — the lookup's
+partial-overlap walk needs no recovery-specific code, and only the
+journal suffix is re-prefilled
+(``decode_recovery_prefix_assisted`` / ``decode_recovery_replayed_rows``
+partition the continuation prompt).
+
 Threading: ``_lock`` (witnessed, leaf-level — nothing nests under it)
 guards the trie/entry maps so a store may be shared across engines;
 row slicing — a device call — happens strictly OUTSIDE the lock, per

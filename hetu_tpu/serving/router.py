@@ -73,10 +73,15 @@ class ServeRejected(RuntimeError):
     """
 
     #: the closed reason taxonomy; ``shed:<class>`` is the one
-    #: parameterized form (class-based admission shedding)
-    REASONS = ("queue_full", "over_max_len", "deadline", "draining")
+    #: parameterized form (class-based admission shedding).
+    #: ``recovery_exhausted`` (ISSUE 19) marks an in-flight decode
+    #: stream the fleet could NOT resurrect after its replica died
+    #: (retry budget, deadline estimator, or zero survivors) — the
+    #: instance's ``partial`` carries the tokens generated so far.
+    REASONS = ("queue_full", "over_max_len", "deadline", "draining",
+               "recovery_exhausted")
 
-    def __init__(self, reason, detail="", klass=None):
+    def __init__(self, reason, detail="", klass=None, partial=None):
         reason = str(reason)
         if reason not in self.REASONS and not reason.startswith("shed:"):
             raise ValueError(
@@ -84,6 +89,10 @@ class ServeRejected(RuntimeError):
                 f"{list(self.REASONS)} or 'shed:<class>'")
         self.reason = reason
         self.klass = klass
+        #: tokens already delivered before recovery gave up (a list for
+        #: ``recovery_exhausted`` failures, else None) — partial work is
+        #: surfaced, never silently discarded
+        self.partial = partial
         record_serve_rejection(reason)
         super().__init__(f"{reason}: {detail}" if detail else reason)
 
@@ -423,7 +432,15 @@ class ServingRouter:
             # gets its k per-sample rows of a row-scaled fetch, the
             # whole value of a batch-invariant (or exact-fit aggregate)
             # one; no runtime shape guessing to mis-scatter
-            outs, rows_per_req = self.iex.infer_rows(stacked)
+            try:
+                outs, rows_per_req = self.iex.infer_rows(stacked)
+            except Exception:     # noqa: BLE001 — one COUNTED retry
+                # (ISSUE 19): a transient dispatch fault (a PS failover
+                # racing the pull, a replica mid-promotion) should not
+                # fail an admitted batch; a second failure is real and
+                # falls through to fail the futures
+                record_serve("serve_batch_retries")
+                outs, rows_per_req = self.iex.infer_rows(stacked)
             t_done = time.perf_counter_ns()
             record_serve_latency(self._lat_batch, (t_done - t_call) / 1e3)
             if tr is not None:

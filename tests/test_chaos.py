@@ -252,6 +252,68 @@ def test_chaos_replica_kill_missing_handle_is_loud():
     assert fault_counts().get("chaos_kill_target_missing", 0) == 0
 
 
+def test_chaos_replica_kill_token_clock_spec_parses():
+    """``kill:replica@<idx>:tok<n>`` — the DECODE ENGINE's own emitted-
+    token clock (ISSUE 19), for deterministic mid-generation kills; the
+    rank-level ``:step<n>`` form stays invalid for replicas."""
+    _, faults = chaos.parse_spec("7:kill:replica@0:tok16")
+    assert faults == [{"kind": "kill_replica", "idx": 0, "tok": 16}]
+    _, faults = chaos.parse_spec("7:kill:replica@1:req3,kill:replica@0:tok5")
+    assert faults == [{"kind": "kill_replica", "idx": 1, "req": 3},
+                      {"kind": "kill_replica", "idx": 0, "tok": 5}]
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec("7:kill:replica@1:step40")    # req/tok clocks only
+
+
+def test_chaos_replica_kill_fires_once_on_token_clock():
+    """The token-clock kill fires its handle exactly once, at the first
+    report where the replica's cumulative emitted tokens reach n, only
+    for ITS replica index — and draws nothing from the RNG."""
+    reset_faults()
+    spec = "11:drop=0.2,kill:replica@1:tok5"
+    inj = chaos.ChaosInjector.from_spec(spec)
+    reps = {i: _FakeProc() for i in range(2)}
+    for i, h in reps.items():
+        inj.register_replica(i, h)
+    assert inj.on_token(1, 4) == []
+    assert inj.on_token(0, 5) == []     # replica 0's clock: not the target
+    assert reps[0].stopped == 0 and reps[1].stopped == 0
+    assert inj.on_token(1, 5) == [1]
+    assert reps[1].stopped == 1 and reps[0].stopped == 0
+    assert inj.on_token(1, 6) == []     # one-shot
+    assert reps[1].stopped == 1
+    assert fault_counts().get("chaos_kill_replica") == 1
+    # determinism: the kill perturbs no transport fault decision
+    a = chaos.ChaosInjector.from_spec(spec)
+    b = chaos.ChaosInjector.from_spec("11:drop=0.2")
+    a.register_replica(1, _FakeProc())
+    seq_a = []
+    for i in range(100):
+        if i == 50:
+            a.on_token(1, 7)
+        seq_a.append(a.on_send(i % 3, 1))
+    assert seq_a == [b.on_send(i % 3, 1) for i in range(100)]
+
+
+def test_chaos_replica_kill_token_clock_missing_handle_is_loud():
+    """Same quiet/loud split as the admission clock: no registered
+    replicas at fire time warns + counts; other replicas registered
+    means the target lives behind a different door — quiet no-op."""
+    reset_faults()
+    inj = chaos.ChaosInjector.from_spec("7:kill:replica@1:tok2")
+    with pytest.warns(RuntimeWarning, match="kill:replica@1:tok2"):
+        assert inj.on_token(1, 2) == []
+    assert fault_counts().get("chaos_kill_target_missing") == 1
+    reset_faults()
+    inj2 = chaos.ChaosInjector.from_spec("7:kill:replica@1:tok2")
+    inj2.register_replica(0, _FakeProc())
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert inj2.on_token(1, 2) == []
+    assert fault_counts().get("chaos_kill_target_missing", 0) == 0
+
+
 def test_partition_spec_parses():
     _, faults = chaos.parse_spec("7:partition:rank0|rank1@step3:heal7")
     assert faults == [{"kind": "partition", "a": frozenset({0}),

@@ -416,5 +416,15 @@ def test_decode_bench_smoke():
     # one ttft histogram observation per stream
     assert extra["ttft_counted_per_stream"] is True
     assert extra["continuous"]["counters"].get("decode_rejections", 0) == 0
+    # ISSUE 19: the mid-generation replica kill recovered every
+    # in-flight stream bitwise-equal with zero failures and zero
+    # restarts; the zero-survivor kill failed loudly with partials
+    rec = extra["recovery"]
+    assert rec["holds"] is True
+    assert rec["failed_streams"] == 0 and rec["restarts"] == 0
+    assert rec["streams_bitwise_equal_to_unkilled"] is True
+    assert rec["counters"]["decode_recovery_reseated"] >= 1
+    assert rec["zero_survivor"]["holds"] is True
+    assert rec["zero_survivor"]["recovery_exhausted"] >= 1
     assert extra["total_tokens"] > 0
     assert res["vs_baseline"] > 0, res

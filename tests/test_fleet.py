@@ -14,7 +14,11 @@ Coverage map (the ISSUE's acceptance):
   rescued onto a survivor — every admitted request answered, zero
   restarts; a chaos ``kill:replica@<idx>:req<n>`` drives the same path
   on the door's admission clock
-- a wedge-ejected replica whose heartbeat returns is re-admitted
+- a killed DECODE replica's seated in-flight streams are detached as
+  continuation requests and resurrected on a survivor (ISSUE 19 —
+  bitwise parity + gating live in tests/test_decode_recovery.py)
+- a wedge-ejected replica whose heartbeat returns is re-admitted; the
+  wedge condition sees seated-but-unqueued work, not just the queue
 - scale-out builds no new executable: the new replica's bucket resolves
   through the serve arm of the step cache (``step_cache_serve_hit``)
 - scale-in / close drain gracefully: queued work handed to a survivor,
@@ -443,8 +447,10 @@ def test_serve_rejected_reason_taxonomy_is_validated_and_counted():
 
 def test_decode_fleet_kill_rescues_queued_streams():
     """The same replica contract over DecodeRouter: a killed decode
-    replica's QUEUED streams are rescued onto the survivor and complete;
-    its SEATED state dies with it (KV cache is replica-local)."""
+    replica's QUEUED streams are rescued onto the survivor and complete.
+    (SEATED streams are resurrected too since ISSUE 19 — exactly-once
+    migration is covered in tests/test_decode_recovery.py; this replica
+    here never started, so everything is queued.)"""
     from hetu_tpu.models import GPT2Config, gpt2_decode_graph
     from hetu_tpu.serving import DecodeEngine, DecodeRouter
     cfg = GPT2Config.tiny(n_positions=32, batch_size=1)

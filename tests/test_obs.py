@@ -203,6 +203,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_fleet("fleet_replicas_hw", 3)
     metrics.record_prefix_cache("prefix_cache_hits", 2)
     metrics.record_prefix_cache("prefix_cache_bytes_hw", 512)
+    metrics.record_decode_recovery("decode_recovery_reseated", 2)
     metrics.record_rpc("OP_PULL", 100.0, 2048)
     dump = obs.metrics_dump()
     legacy = {
@@ -222,6 +223,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "serve_rejection_reason": metrics.serve_rejection_counts(),
         "fleet": metrics.fleet_counts(),
         "prefix_cache": metrics.prefix_cache_counts(),
+        "decode_recovery": metrics.decode_recovery_counts(),
     }
     for fam, want in legacy.items():
         assert dump["counters"][fam] == want, fam

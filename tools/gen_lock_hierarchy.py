@@ -21,6 +21,10 @@ acquisition graph:
   condition variables, done-callbacks taking the door lock from
   replica loop threads, a chaos replica kill with detach/adopt queue
   rescue, an autoscaler poll and the graceful drain;
+* **recovery** — a decode FrontDoor under a token-clock replica kill
+  (ISSUE 19): in-flight detach (door lock -> dead replica cv -> stream
+  journal snapshot), survivor adopt, and the zero-survivor fail-fast
+  (``recovery_exhausted`` under the door lock);
 * **elastic** — an ``ElasticController`` over a dp=4 CPU mesh driving
   a chaos-scheduled shrink and the grow-back (``resize_world``,
   step-clock kills through the chaos injector's lock).
@@ -201,6 +205,55 @@ def fleet_plane():
         chaos.install(prev)
 
 
+def recovery_plane():
+    """Exactly-once stream recovery (ISSUE 19): a decode FrontDoor
+    under ``kill:replica@0:tok2`` on the engine's token clock — the
+    sweep's detach (door lock -> dead replica's DecodeRouter._cv, then
+    the journal snapshot under DecodeStream._lock), the survivor adopt,
+    and the no-survivor fail-fast path (door lock -> stream lock via
+    the recovery gate)."""
+    from hetu_tpu.models import gpt2_decode_graph, GPT2Config
+    from hetu_tpu.serving import DecodeEngine, DecodeRouter, FrontDoor
+    dcfg = GPT2Config.tiny(n_positions=32, batch_size=1)
+
+    def mk(idx):
+        feeds, logits, caches, _ = gpt2_decode_graph(dcfg, max_len=16)
+        eng = DecodeEngine(feeds, logits, caches, max_slots=2,
+                           max_len=16)
+        return DecodeRouter(eng, queue_limit=8, name=f"rc{idx}")
+
+    inj = chaos.ChaosInjector.from_spec("7:kill:replica@0:tok2")
+    prev = chaos.install(inj)
+    try:
+        door = FrontDoor(mk, 2, health_every_ms=1e9,
+                         wedge_timeout_ms=1e9)
+        streams = [door.submit([3 + i, 5, 7], max_new_tokens=4)
+                   for i in range(3)]
+        deadline = time.monotonic() + 60
+        while not all(s.done for s in streams) \
+                and time.monotonic() < deadline:
+            door.poll()
+            time.sleep(0.005)
+        door.close()
+    finally:
+        chaos.install(prev)
+
+    # zero-survivor fail-fast: recovery_exhausted under the door lock
+    inj = chaos.ChaosInjector.from_spec("7:kill:replica@0:tok1")
+    prev = chaos.install(inj)
+    try:
+        door = FrontDoor(mk, 1, health_every_ms=1e9,
+                         wedge_timeout_ms=1e9)
+        s = door.submit([3, 5, 7], max_new_tokens=4)
+        deadline = time.monotonic() + 60
+        while not s.done and time.monotonic() < deadline:
+            door.poll()
+            time.sleep(0.005)
+        door.close()
+    finally:
+        chaos.install(prev)
+
+
 def elastic_plane():
     """Chaos-scheduled shrink at step 2, rejoin, grow-back."""
     from hetu_tpu.parallel.elastic import (ElasticController, LogicalRank,
@@ -240,6 +293,7 @@ def main(out=None):
     training_plane()
     serving_plane()
     fleet_plane()
+    recovery_plane()
     elastic_plane()
     cycles = WITNESS.check()
     rep = WITNESS.export(out)
