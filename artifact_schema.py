@@ -96,7 +96,23 @@ Chaos/robustness artifacts (``chaos``, ``failover``, ``serve``,
 ``restarts``/``resumes`` (must be 0 for the transparent-recovery
 configs), ``fault_counters`` (the chaos run's evidence),
 ``clean_run_counters`` (must be ``{}``), and loss/response parity flags
-against the clean run.  ``--config partition``
+against the clean run.
+
+``artifacts/protocol_verify.json`` (``tools/verify_protocols.py
+--deep --out ...``, ISSUE 20) is the protocol model checker's verdict:
+per-model ``models.<name>`` blocks (``states``/``transitions``/
+``depth`` of the exhaustive BFS, ``complete`` — False means the budget
+truncated exploration and the verdict is NOT exhaustive — and
+``violations`` with rendered shortest counterexample traces, empty at
+HEAD), ``mutations.<name>`` (each seeded historical bug class with the
+``expected`` vs ``violated`` invariant name and counterexample length —
+all must be CAUGHT), and ``conformance_selftest`` (the trace monitors
+accept a canned well-formed run and flag each canned bad trace by
+rule).  The chaos artifacts above additionally carry
+``protocol_conformance`` blocks in ``extra``: the recorded kill-run
+event trace replayed against the same models' transition relations
+(``ok`` gates the leg; a non-empty ``divergences`` list names the
+violated rule per event).  ``--config partition``
 (``artifacts/partition_smoke.json``) adds the fencing-epoch evidence:
 ``fsck_serving_ranks``/``fsck_epochs`` (exactly one serving epoch per
 shard post-heal), ``noheal_lineage_violations`` (the unhealed split

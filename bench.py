@@ -2614,6 +2614,7 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     import jax
     import hetu_tpu as ht
     from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.analysis.protocol import PROTO, check_conformance
     from hetu_tpu.metrics import fault_counts, reset_faults
     from hetu_tpu.ps.dist_store import DistributedStore
     from tools.ps_fsck import fsck
@@ -2692,6 +2693,10 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     step_ms = [0.0] * steps
     failover_steps, fsck_report = [], None
     t_run0 = time.monotonic()
+    # the chaos run is also a RECORDED protocol trace: every promote /
+    # fence / apply transition is replayed against the replication
+    # model's transition relation (ISSUE 20) and conformance gates ok
+    PROTO.start()
     try:
         ex, ids, y_ = build(stores[0], tid)
         for step in range(steps):
@@ -2719,6 +2724,7 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
         parity = losses == base
         counters = fault_counts()
     finally:
+        proto_events = PROTO.stop()   # before teardown closes fire
         chaos_mod.install(prev)
         if env_chaos is not None:
             os.environ["HETU_CHAOS"] = env_chaos
@@ -2733,8 +2739,10 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
     total_ms = (time.monotonic() - t_run0) * 1e3
     recovery_ms = sum(step_ms[s] for s in failover_steps)
     bound_ms = rpc_timeout * 1e3 + hb_deadline_ms
+    proto_conf = check_conformance(proto_events)
     ok = (parity and len(failover_steps) == 2 and recovery_ms < bound_ms
           and bool(fsck_report and fsck_report["ok"])
+          and proto_conf["ok"]
           and not clean_counters)
     return {
         "metric": "failover_recovery_ms",
@@ -2748,8 +2756,10 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
                             "were absorbed by failover (restarts=0, no "
                             "resume), recovery stayed under one "
                             "rpc_timeout + heartbeat deadline, fsck "
-                            "verified the re-replicated backup, and the "
-                            "clean run recorded zero fault counters",
+                            "verified the re-replicated backup, the "
+                            "recorded protocol trace conformed to the "
+                            "replication model, and the clean run "
+                            "recorded zero fault counters",
             **_provenance({"steps": steps, "kill_step": kill_step,
                            "second_kill_step": second_kill,
                            "world": world, "replication": 2,
@@ -2764,6 +2774,7 @@ def bench_failover(steps=10, kill_step=3, smoke=True):
             "redundancy_restored": bool(fsck_report
                                         and fsck_report["ok"]),
             "fsck_mismatches": (fsck_report or {}).get("mismatches"),
+            "protocol_conformance": proto_conf,
             "fault_counters": counters,
             "clean_run_counters": clean_counters,
             "backend": jax.default_backend(),
@@ -3527,11 +3538,17 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
             time.sleep(0.005)
         return False
 
+    from hetu_tpu.analysis.protocol import PROTO, check_conformance
+
     ht_metrics.reset_all()
     rec_store = PrefixKVStore()
     inj = chaos_mod.ChaosInjector.from_spec(
         f"{seed}:kill:replica@0:tok{kill_tok}")
     prev_inj = chaos_mod.install(inj)
+    # the kill run doubles as a recorded protocol trace: seat / emit /
+    # detach / adopt / fence transitions replay against the decode-
+    # recovery model (ISSUE 20) and conformance gates the leg
+    PROTO.start()
     try:
         # wedge_timeout pushed out of the way: a first-touch bucket
         # compile inside a step would otherwise read as a wedge on CPU
@@ -3556,7 +3573,9 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
         finally:
             door.close()
     finally:
+        rec_proto = PROTO.stop()
         chaos_mod.install(prev_inj)
+    rec_conf = check_conformance(rec_proto)
     rec_c = ht_metrics.decode_recovery_counts()
     rec_fleet = ht_metrics.fleet_counts()
     rec_lat = HetuProfiler.latency_stats().get(
@@ -3573,6 +3592,7 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
               and rec_c.get("decode_recovery_exhausted", 0) == 0
               and int(rec_lat.get("count", 0))
               == rec_c.get("decode_recovery_reseated", 0)
+              and rec_conf["ok"]
               and ht_metrics.fault_counts().get(
                   "chaos_kill_replica", 0) == 1)
 
@@ -3581,6 +3601,7 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
         f"{seed}:kill:replica@0:tok3")
     prev_inj = chaos_mod.install(inj0)
     exhausted, zs_partials_ok = 0, True
+    PROTO.start()
     try:
         door = FrontDoor(
             lambda idx: DecodeRouter(mk_engine(True), queue_limit=16,
@@ -3603,8 +3624,11 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
         finally:
             door.close()
     finally:
+        zs_proto = PROTO.stop()
         chaos_mod.install(prev_inj)
+    zs_conf = check_conformance(zs_proto)
     exhaust_ok = (exhausted >= 1 and zs_partials_ok
+                  and zs_conf["ok"]
                   and ht_metrics.decode_recovery_counts().get(
                       "decode_recovery_exhausted", 0) == exhausted)
 
@@ -3859,11 +3883,13 @@ def bench_decode(smoke=True, n_requests=None, seed=0, write_artifact=None):
                 "fleet": {k: int(v) for k, v in rec_fleet.items()},
                 "reseat_latency_us": rec_lat,
                 "wall_s": round(rec_wall, 2),
+                "protocol_conformance": rec_conf,
                 "holds": bool(rec_ok),
                 "zero_survivor": {
                     "streams": 3,
                     "recovery_exhausted": int(exhausted),
                     "partials_attached": bool(zs_partials_ok),
+                    "protocol_conformance": zs_conf,
                     "holds": bool(exhaust_ok),
                 },
             },
@@ -4149,6 +4175,7 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
     import jax
     import hetu_tpu as ht
     from hetu_tpu import chaos as chaos_mod
+    from hetu_tpu.analysis.protocol import PROTO, check_conformance
     from hetu_tpu.metrics import fault_counts, reset_faults
     from hetu_tpu.ps.dist_store import DistributedStore
     from tools.ps_fsck import fsck
@@ -4201,7 +4228,9 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
 
     def run_variant(schedule, heal):
         """One full training run; returns (losses, per-step ms, events,
-        fault counters, fsck report)."""
+        fault counters, fsck report, protocol-conformance report) — the
+        run is also a RECORDED protocol trace replayed against the
+        replication model (ISSUE 20)."""
         reset_faults()
         ports = _free_ports(world)
         stores, tid = make_cluster(ports)
@@ -4211,6 +4240,7 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
         prev = chaos_mod.install(
             chaos_mod.ChaosInjector.from_spec(schedule)) if schedule \
             else chaos_mod.uninstall()
+        PROTO.start()
         try:
             ex, ids, y_ = build(stores[0], tid)
             for step in range(steps):
@@ -4246,18 +4276,20 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
                         (time.monotonic() - t1) * 1e3
             report = fsck([("127.0.0.1", p) for p in ports], n_tables=1,
                           replication=2, retries=2, retry_wait=0.2)
-            return losses, step_ms, events, fault_counts(), report
+            out = (losses, step_ms, events, fault_counts(), report)
         finally:
+            proto_events = PROTO.stop()  # before teardown closes fire
             chaos_mod.install(prev) if schedule else None
             for s in stores:
                 try:
                     s.close()
                 except Exception:
                     pass
+        return out + (check_conformance(proto_events),)
 
     two_cell = None
     try:
-        base, base_ms, base_ev, clean_counters, base_fsck = \
+        base, base_ms, base_ev, clean_counters, base_fsck, base_conf = \
             run_variant(None, heal=False)
         noheal = run_variant(
             f"13:partition:rank0|rank1@step{cut_step}", heal=False)
@@ -4272,8 +4304,8 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
         if env_tick is not None:
             os.environ["HETU_PS_REREPLICATE_EVERY"] = env_tick
 
-    h_losses, h_ms, h_ev, h_counters, h_fsck = heal
-    n_losses, _, n_ev, n_counters, n_fsck = noheal
+    h_losses, h_ms, h_ev, h_counters, h_fsck, h_conf = heal
+    n_losses, _, n_ev, n_counters, n_fsck, n_conf = noheal
     heal_parity = h_losses == base
     noheal_parity = n_losses == base
     one_lineage = all(len(r) == 1
@@ -4293,6 +4325,7 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
           and not n_fsck["ok"]          # unhealed split brain is VISIBLE
           and bool(n_fsck["lineage_violations"])
           and base_fsck["ok"] and not clean_counters
+          and base_conf["ok"] and n_conf["ok"] and h_conf["ok"]
           and bool(two_cell) and two_cell["ok"])
     return {
         "metric": "partition_recovery_ms",
@@ -4310,7 +4343,9 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
                             "divergence and exactly one serving epoch "
                             "per shard, the UNHEALED run's split brain "
                             "stayed fsck-visible, the clean run recorded "
-                            "zero fault counters, and the 2-cell "
+                            "zero fault counters, every variant's "
+                            "recorded protocol trace conformed to the "
+                            "replication model, and the 2-cell "
                             "scenario served local reads through the "
                             "cut (rejections=0) and converged after "
                             "heal",
@@ -4339,6 +4374,8 @@ def bench_partition(steps=10, cut_step=3, heal_step=7, smoke=True):
             "noheal_split_brain_detected":
                 bool(n_fsck["lineage_violations"]) or not n_fsck["ok"],
             "noheal_lineage_violations": n_fsck["lineage_violations"],
+            "protocol_conformance": h_conf,
+            "noheal_protocol_conformance": n_conf,
             "two_cell": two_cell,
             "backend": jax.default_backend(),
         },

@@ -13,13 +13,17 @@ placeholder shapes on every ``run()``.
 The framework's own static analysis lives in ``tools/hetu_lint.py`` — an
 AST pass gated by ``tests/test_lint.py`` — whose concurrency engine
 (repo-wide lock-order + shared-state + blocking-under-lock detectors,
-ISSUE 14) is this package's :mod:`~hetu_tpu.analysis.concurrency`.
+ISSUE 14) is this package's :mod:`~hetu_tpu.analysis.concurrency`, and
+whose protocol model checker (exhaustive BFS verification of the PS
+replication / decode recovery / elastic resize protocols plus the
+trace-conformance layer, ISSUE 20) is :mod:`~hetu_tpu.analysis.protocol`.
 """
 from .shapes import GraphShapes, abstract_infer_shape, infer_graph
 from .lint import (RULES, Diagnostic, GraphInfo, GraphValidationError,
                    LintReport, lint, rule)
 from . import concurrency  # noqa: F401  (stdlib-only; ISSUE 14 verifier)
+from . import protocol  # noqa: F401  (stdlib-only; ISSUE 20 checker)
 
 __all__ = ["GraphShapes", "abstract_infer_shape", "infer_graph",
            "RULES", "Diagnostic", "GraphInfo", "GraphValidationError",
-           "LintReport", "lint", "rule", "concurrency"]
+           "LintReport", "lint", "rule", "concurrency", "protocol"]

@@ -759,6 +759,51 @@ def reset_fleet_counts():
     _fleet.reset()
 
 
+# ------------------------------------------------ protocol verification
+# The ISSUE 20 model checker and its trace-conformance layer
+# (``analysis/protocol.py``) record their activity here:
+# ``protocol_events`` counts transition events the :data:`PROTO`
+# recorder captured at the real protocol sites (dist_store / decode /
+# fleet / elastic — zero unless ``HETU_PROTO_TRACE`` or a chaos bench
+# flips the recorder on) and ``protocol_events_dropped`` events the
+# buffer cap discarded; ``protocol_conformance_checks`` counts events
+# replayed against the models' transition relations and
+# ``protocol_divergences`` the replays a monitor rejected (the chaos
+# benches gate on ZERO of these — an allowlisted divergence counts
+# under ``protocol_divergences_allowlisted`` instead);
+# ``protocol_states_explored`` counts canonical states the BFS checker
+# visited and ``protocol_violations`` the invariant violations it found
+# (nonzero only under a seeded mutation — HEAD models verify clean).
+# Surfaced by ``HetuProfiler.protocol_counters()`` and
+# ``tools/verify_protocols.py``; a process that never checks or records
+# a protocol reports an empty dict.
+
+_protocol = REGISTRY.counter_family(
+    "protocol",
+    "protocol model-checking and trace-conformance events (empty in a "
+    "process that never verifies a protocol)")
+
+
+def record_protocol(kind, n=1):
+    """Count ``n`` protocol-verification events of ``kind``; kinds
+    ending in ``_hw`` are high-water gauges (the stored value is the
+    max seen)."""
+    kind = str(kind)
+    if kind.endswith("_hw"):
+        _protocol.max_gauge(kind, int(n))
+    elif n:
+        _protocol.inc(kind, int(n))
+
+
+def protocol_counts():
+    """{kind: count} snapshot of protocol-verification counters."""
+    return _protocol.counts()
+
+
+def reset_protocol_counts():
+    _protocol.reset()
+
+
 # --------------------------------------------------- latency histograms
 # Log-bucketed distributions (``obs.registry.Histogram``: 8 buckets per
 # octave, p50/p90/p99 accessors) — the mean-only counters above cannot
@@ -941,6 +986,7 @@ _FAMILIES = {
     "decode_recovery": _decode_recovery,
     "serve_rejection_reason": _serve_reject,
     "fleet": _fleet,
+    "protocol": _protocol,
     "ps_rpc_bytes": _rpc_bytes,
 }
 

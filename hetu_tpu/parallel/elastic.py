@@ -57,6 +57,7 @@ import time
 
 import numpy as np
 
+from ..analysis.protocol import PROTO as _PROTO
 from ..metrics import record_elastic
 from .. import obs
 from .. import race as _race
@@ -273,13 +274,23 @@ class ElasticController:
             record_elastic("elastic_unreachable_held", len(held))
             obs.event("elastic:unreachable_held", cat="elastic",
                       ranks=list(held), step=step)
+            if _PROTO.on:
+                for r in held:
+                    _PROTO.emit("elastic", "hold", rank=r, step=step)
             dead = [r for r in dead if r not in held]
         if dead:
+            if _PROTO.on:
+                for r in dead:
+                    _PROTO.emit("elastic", "dead", rank=r, step=step)
             survivors = [r for r in self.active if r not in dead]
             if len(survivors) < self.min_dp:
                 record_elastic("elastic_shrink_refused")
                 obs.event("elastic:shrink_refused", cat="elastic",
                           step=step, survivors=len(survivors))
+                if _PROTO.on:
+                    _PROTO.emit("elastic", "refused", step=step,
+                                survivors=len(survivors),
+                                min_dp=self.min_dp)
             else:
                 record_elastic("elastic_dead_rank", len(dead))
                 return self._resize("shrink", survivors, dead, step, t0)
@@ -332,6 +343,11 @@ class ElasticController:
               "to_dp": to_dp, "ranks": list(changed),
               "recovery_ms": round(ms, 3)}
         self.events.append(ev)
+        if _PROTO.on:
+            _PROTO.emit("elastic", "resize", way=kind, step=step,
+                        removed=list(changed) if kind == "shrink" else [],
+                        added=list(changed) if kind == "grow" else [],
+                        active=list(self.active), min_dp=self.min_dp)
         return ev
 
 

@@ -240,7 +240,7 @@ class HetuProfiler:
         ``all_counts``): flash_fallbacks, emb_pallas_fallbacks, faults,
         elastic, autoparallel, cache, zero, step_cache, run_plan, serve,
         decode, prefix_cache, decode_recovery, serve_rejection_reason,
-        fleet, ps_rpc_bytes.  The per-family
+        fleet, protocol, ps_rpc_bytes.  The per-family
         accessors below are thin slices of this — same registry, same
         numbers; ``obs.metrics_dump()`` adds the histogram/gauge half."""
         from .metrics import all_counts
@@ -513,6 +513,26 @@ class HetuProfiler:
         empty dict."""
         from .metrics import fleet_counts
         return fleet_counts()
+
+    @staticmethod
+    def protocol_counters():
+        """{kind: count} of protocol model-checking and trace-
+        conformance events (``hetu_tpu.metrics`` registry, ISSUE 20):
+        transition events the ``analysis.protocol.PROTO`` recorder
+        captured at the live protocol sites and buffer-cap drops
+        (``protocol_events`` / ``protocol_events_dropped``), recorded
+        events replayed against the models' transition relations
+        (``protocol_conformance_checks``) with the replays a monitor
+        rejected (``protocol_divergences`` — the chaos benches gate on
+        zero) or accepted under a documented allowlist entry
+        (``protocol_divergences_allowlisted``), plus checker activity:
+        canonical states the BFS explored
+        (``protocol_states_explored``) and invariant violations found
+        (``protocol_violations`` — nonzero only under a seeded
+        mutation).  A process that never verifies a protocol reports an
+        empty dict."""
+        from .metrics import protocol_counts
+        return protocol_counts()
 
     @staticmethod
     def fault_counters():

@@ -87,6 +87,7 @@ import numpy as np
 from .store import EmbeddingStore, _OPT_IDS, _OPT_NAMES, _V3_CHUNK
 from .. import chaos as _chaos
 from .. import race as _race
+from ..analysis.protocol import PROTO as _PROTO
 from ..metrics import record_cache, record_fault, record_rpc
 from ..obs.lock_witness import make_condition, make_lock, make_rlock
 from ..obs.trace import TRACER as _TR
@@ -393,8 +394,12 @@ class StoreServer:
         lineage's re-replication) must not let the LOWER epoch win, or
         the losing lineage's remaining frames would pass the fence."""
         with self._epoch_lock:
-            if epoch > self._epochs.get(shard, 0):
+            adopted = epoch > self._epochs.get(shard, 0)
+            if adopted:
                 self._epochs[shard] = epoch
+        if adopted and _PROTO.on:
+            _PROTO.emit("ps", "adopt", rank=self.rank, shard=shard,
+                        new=epoch)
 
     def _fence_or_adopt(self, shard, epoch, refuse_equal_if_serving=False):
         """The replica-plane epoch gate (OP_REPLICATE / OP_INIT /
@@ -412,6 +417,10 @@ class StoreServer:
             if epoch < cur or (refuse_equal_if_serving and epoch == cur
                                and shard in self._serving):
                 record_fault("ps_epoch_refused")
+                if _PROTO.on:
+                    _PROTO.emit("ps", "fence_refused", gate="repl",
+                                rank=self.rank, shard=shard, cur=cur,
+                                got=epoch)
                 raise EpochFenced(shard, cur,
                                   serving=shard in self._serving)
         if epoch > cur:
@@ -436,6 +445,9 @@ class StoreServer:
             self._promotable.discard(shard)
             self._fwd_ok[shard] = False
             record_fault("ps_demotions")
+            if _PROTO.on:
+                _PROTO.emit("ps", "demote", rank=self.rank, shard=shard,
+                            epoch=self._epochs.get(shard, 0))
 
     def _fence(self, shard, frame_epoch):
         """Fencing gate for a replication-relevant frame against a shard
@@ -450,6 +462,10 @@ class StoreServer:
         if frame_epoch == cur:
             return
         record_fault("ps_epoch_refused")
+        if _PROTO.on:
+            _PROTO.emit("ps", "fence_refused", gate="serve",
+                        rank=self.rank, shard=shard, cur=cur,
+                        got=frame_epoch)
         if frame_epoch > cur:
             self._demote(shard, frame_epoch)
             raise EpochFenced(shard, frame_epoch, serving=False)
@@ -725,6 +741,9 @@ class StoreServer:
                 grads = np.frombuffer(inner, np.float32, inkeys * iwidth,
                                       ioff).reshape(inkeys, iwidth)
                 store.push(itable, ikeys // self.world, grads, ilr)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "apply_replica", rank=self.rank,
+                                shard=shard, client=iclient, seq=iseq)
         elif iop == OP_PUSH_PULL:
             npush = int(ikeys[0])
             if npush and not self._seen(iclient, iseq):
@@ -732,6 +751,9 @@ class StoreServer:
                                       ioff).reshape(npush, iwidth)
                 store.push(itable, ikeys[1:1 + npush] // self.world,
                            grads, ilr)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "apply_replica", rank=self.rank,
+                                shard=shard, client=iclient, seq=iseq)
         elif iop == OP_SET_DATA:
             n = (len(inner) - ioff) // 4
             store.set_data(itable, np.frombuffer(
@@ -820,6 +842,9 @@ class StoreServer:
             self._fwd_ok[shard] = False
             record_fault("ps_promoted")
             record_fault("ps_epoch_bumps")
+            if _PROTO.on:
+                _PROTO.emit("ps", "promote", rank=self.rank, shard=shard,
+                            old=cur, new=new_epoch, want=want_epoch)
             return new_epoch
 
     def _sync_to(self, shard, target):
@@ -958,6 +983,10 @@ class StoreServer:
             if len(done) >= ntabs:
                 del self._sync_parts[("loaded", shard)]
                 self._promotable.add(shard)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "sync_done", rank=self.rank,
+                                shard=shard,
+                                epoch=self._epochs.get(shard, 0))
 
     def _handle(self, conn, body):
         op, table, nkeys, lr, width, client, seq, shard, epoch = \
@@ -982,6 +1011,12 @@ class StoreServer:
                 grads = np.frombuffer(body, np.float32, nkeys * width,
                                       off).reshape(nkeys, width)
                 self._apply_push(shard, store, table, keys, grads, lr, body)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "apply", rank=self.rank, shard=shard,
+                                client=client, seq=seq, epoch=epoch)
+            elif _PROTO.on:
+                _PROTO.emit("ps", "dedup_hit", rank=self.rank, shard=shard,
+                            client=client, seq=seq)
             _send_frame(conn, b"\x00\x01")
         elif op == OP_PUSH_PULL:
             # fused SDPushPull: apply the push shard, answer the pull shard,
@@ -997,6 +1032,9 @@ class StoreServer:
                                       off).reshape(npush, width)
                 self._apply_push(shard, store, table, push_keys, grads, lr,
                                  body)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "apply", rank=self.rank, shard=shard,
+                                client=client, seq=seq, epoch=epoch)
             out = store.pull(table, pull_keys // self.world)
             _send_frame(conn, b"\x00",
                         np.ascontiguousarray(out, np.float32).tobytes())
@@ -1417,6 +1455,10 @@ class DistributedStore:
                 self._route[shard] = (shard + 1) % self.world \
                     if dead == shard else shard
                 self._failed_over.add(shard)
+                if _PROTO.on:
+                    _PROTO.emit("ps", "route_flip", rank=self.rank,
+                                shard=shard, epoch=cur,
+                                to=self._route[shard])
 
     def _rpc_shard(self, shard, op, table, keys, payload=b"", lr=-1.0,
                    width=0, op_timeout=None):
@@ -1505,6 +1547,9 @@ class DistributedStore:
             self._flip_epoch[shard] = self._epoch[shard]
             self._failed_over.add(shard)
         record_fault("ps_failover_promoted")
+        if _PROTO.on:
+            _PROTO.emit("ps", "client_failover", rank=self.rank,
+                        shard=shard, to=alt, epoch=self._epoch[shard])
         return alt
 
     def _fanout(self, jobs):

@@ -93,6 +93,7 @@ while acquiring the other, so the PR 14 witness hierarchy stays acyclic.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 import warnings
@@ -102,6 +103,7 @@ import numpy as np
 
 from .. import chaos as _chaos
 from .. import race as _race
+from ..analysis.protocol import PROTO as _PROTO
 from ..graph.run_plan import KeyedPlanCache
 from ..graph import step_cache
 from ..metrics import (record_decode, record_decode_latency,
@@ -133,9 +135,13 @@ class DecodeStream:
     device call when the door gave up on it, then waking later — cannot
     re-fire an already-resolved future or double-deliver a token."""
 
+    #: process-wide stream ids — stable names for protocol-event traces
+    _IDS = itertools.count()
+
     def __init__(self, prompt_len, max_new_tokens):
         self.prompt_len = int(prompt_len)
         self.max_new_tokens = int(max_new_tokens)
+        self.sid = next(DecodeStream._IDS)
         self._lock = make_lock("DecodeStream._lock")
         self._futs = []
         self._tokens = []
@@ -209,7 +215,11 @@ class DecodeStream:
         delivered, then appends.  Returns ``(new_epoch, journal)``."""
         with self._lock:
             self._epoch += 1
-            return self._epoch, list(self._tokens)
+            epoch, journal = self._epoch, list(self._tokens)
+        if _PROTO.on:
+            _PROTO.emit("decode", "detach", sid=self.sid, old=epoch - 1,
+                        new=epoch, n=len(journal))
+        return epoch, journal
 
     def _emit(self, tok, epoch=None):
         """Deliver one token.  ``epoch`` is the replay epoch of the
@@ -219,12 +229,18 @@ class DecodeStream:
         ttft observation), regardless of which replica delivered it."""
         with self._lock:
             if epoch is not None and epoch != self._epoch:
+                if _PROTO.on:
+                    _PROTO.emit("decode", "fenced", sid=self.sid,
+                                got=epoch, cur=self._epoch)
                 return False
             while len(self._futs) <= len(self._tokens):
                 self._futs.append(Future())
             fut = self._futs[len(self._tokens)]
             self._tokens.append(int(tok))
             count = len(self._tokens)
+            if _PROTO.on:
+                _PROTO.emit("decode", "emit", sid=self.sid,
+                            epoch=self._epoch, idx=count - 1)
         # resolve OUTSIDE the stream lock: a done-callback attached by
         # the consumer runs in this thread and must not run under (or
         # re-acquire) our lock
@@ -238,6 +254,8 @@ class DecodeStream:
                 return False
             tokens = list(self._tokens)
             extra = self._futs[len(tokens):]
+        if _PROTO.on:
+            _PROTO.emit("decode", "finish", sid=self.sid, n=len(tokens))
         for f in extra:
             if f.set_running_or_notify_cancel():
                 f.set_exception(IndexError(
@@ -252,6 +270,8 @@ class DecodeStream:
                 return False
             done = len(self._tokens)
             pending = self._futs[done:]
+        if _PROTO.on:
+            _PROTO.emit("decode", "fail", sid=self.sid, n=done)
         for f in pending:
             if f.set_running_or_notify_cancel():
                 f.set_exception(exc)
@@ -529,6 +549,9 @@ class DecodeEngine:
             record_decode("decode_slot_recycles")
         self._used[slot] = True
         record_decode("decode_joins")
+        if _PROTO.on:
+            _PROTO.emit("decode", "seat", sid=req.stream.sid,
+                        epoch=req.epoch, n=req.stream.n_tokens)
         if req.detached_ts is not None:
             # a migrated continuation reseats here: the journal replay is
             # the prompt suffix, minus whatever the prefix store seated

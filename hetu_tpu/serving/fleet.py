@@ -85,6 +85,7 @@ import time
 import numpy as np
 
 from .. import chaos as chaos_mod
+from ..analysis.protocol import PROTO as _PROTO
 from ..metrics import (record_decode_recovery, record_fleet,
                        record_serve_latency, serve_latency_stats)
 from ..obs.lock_witness import make_lock
@@ -327,6 +328,9 @@ class FrontDoor:
                 # tokens, so they reseat first
                 n = best.router.adopt(ready + orphans)
                 record_fleet("fleet_rescued", n)
+                if _PROTO.on:
+                    _PROTO.emit("decode", "adopt", replica=best.idx,
+                                n=n, continuations=len(ready))
                 return n
             except ServeRejected:
                 pass    # survivor raced into shutdown: fall through
@@ -380,6 +384,10 @@ class FrontDoor:
         record_decode_recovery("decode_recovery_exhausted")
         self._failures += 1
         record_fleet("fleet_request_failures")
+        if _PROTO.on:
+            _PROTO.emit("decode", "exhausted", sid=req.stream.sid,
+                        retries=req.retries, budget=self.recovery_budget,
+                        why=why)
         partial = req.stream.partial()
         req.stream._fail(ServeRejected(
             "recovery_exhausted",

@@ -314,3 +314,44 @@ def test_style_string_constants_do_not_mask_unused_imports():
     assert any("unused import 'os'" in f for f in findings), findings
     exported = 'import os\n__all__ = ["os"]\n'
     assert hetu_lint.check_style(exported, "synthetic.py") == []
+
+
+def test_protocol_alphabet_detects_unmodeled_opcode():
+    """ISSUE 20 drift gate: a new OP_* in ps/ that is in neither the
+    model's message alphabet nor the allowlist is a finding — a new
+    replication opcode cannot silently bypass the model."""
+    src = ("OP_A = 1\nOP_NEW = 2\n"
+           "def f(x):\n    send(OP_A); send(OP_NEW)\n"
+           "def g(op):\n    return op == OP_A or op == OP_NEW\n")
+    findings = hetu_lint.check_protocol_alphabet(
+        {"synthetic.py": src}, alphabet={"OP_A": "modeled"},
+        allowlist={})
+    assert any("OP_NEW" in f and "neither" in f for f in findings), \
+        findings
+    assert not any("OP_A is" in f for f in findings)
+
+
+def test_protocol_alphabet_detects_double_listing_and_stale_entry():
+    src = ("OP_A = 1\n"
+           "def f(x):\n    send(OP_A)\n"
+           "def g(op):\n    return op == OP_A\n")
+    findings = hetu_lint.check_protocol_alphabet(
+        {"synthetic.py": src},
+        alphabet={"OP_A": "modeled", "OP_GONE": "removed long ago"},
+        allowlist={"OP_A": "also exempt?"})
+    assert any("OP_A" in f and "BOTH" in f for f in findings), findings
+    assert any("OP_GONE" in f and "stale" in f for f in findings), \
+        findings
+
+
+def test_protocol_alphabet_requires_reasons():
+    src = ("OP_A = 1\n"
+           "def f(x):\n    send(OP_A)\n"
+           "def g(op):\n    return op == OP_A\n")
+    findings = hetu_lint.check_protocol_alphabet(
+        {"synthetic.py": src}, alphabet={}, allowlist={"OP_A": "  "})
+    assert any("empty reason" in f for f in findings), findings
+    clean = hetu_lint.check_protocol_alphabet(
+        {"synthetic.py": src}, alphabet={"OP_A": "modeled"},
+        allowlist={})
+    assert clean == [], clean

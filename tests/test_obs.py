@@ -204,6 +204,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
     metrics.record_prefix_cache("prefix_cache_hits", 2)
     metrics.record_prefix_cache("prefix_cache_bytes_hw", 512)
     metrics.record_decode_recovery("decode_recovery_reseated", 2)
+    metrics.record_protocol("protocol_states_explored", 1224)
+    metrics.record_protocol("protocol_events", 3)
     metrics.record_rpc("OP_PULL", 100.0, 2048)
     dump = obs.metrics_dump()
     legacy = {
@@ -224,6 +226,7 @@ def test_metrics_dump_roundtrips_every_counter_family():
         "fleet": metrics.fleet_counts(),
         "prefix_cache": metrics.prefix_cache_counts(),
         "decode_recovery": metrics.decode_recovery_counts(),
+        "protocol": metrics.protocol_counts(),
     }
     for fam, want in legacy.items():
         assert dump["counters"][fam] == want, fam
@@ -235,6 +238,8 @@ def test_metrics_dump_roundtrips_every_counter_family():
     assert legacy["fleet"] == {"fleet_admitted": 6, "fleet_replicas_hw": 3}
     assert legacy["prefix_cache"] == {"prefix_cache_hits": 2,
                                       "prefix_cache_bytes_hw": 512}
+    assert legacy["protocol"] == {"protocol_states_explored": 1224,
+                                  "protocol_events": 3}
     assert dump["counters"]["ps_rpc_bytes"] == {"OP_PULL": 2048}
     assert dump["histograms"]["ps_rpc_us"]["OP_PULL"]["count"] == 1
     # the one-call profiler view is the same registry

@@ -1,0 +1,369 @@
+"""Protocol model checker + trace conformance (ISSUE 20).
+
+The three executable models (PS replication/failover, decode recovery,
+elastic resize) must explore EXHAUSTIVELY at their small configs with
+zero invariant violations at HEAD; each seeded historical mutation
+(PR 4 promote-without-synced-gate, PR 8 promote-without-epoch-bump,
+PR 19 zombie-emission-unfenced) must yield a shortest counterexample
+NAMING its invariant; the conformance monitors must accept a recorded
+LIVE failover run and flag every canned bad-trace bug class; the PROTO
+recorder defaults off (the ISSUE 10 one-attribute-load discipline).
+The wide exhaustive sweep is ``slow`` per the ROADMAP CI rule.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)                  # repo root: tools import
+
+from hetu_tpu.analysis import protocol as P
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    yield
+    P.PROTO.on = False
+    P.PROTO.drain()
+
+
+# ------------------------------------------------ exhaustive check @ HEAD
+
+@pytest.mark.parametrize("name", P.MODELS)
+def test_model_explores_clean_at_head(name):
+    res = P.check(P.build_model(name))
+    assert res.complete, f"{name}: exploration truncated"
+    assert res.ok, res.violations[0].render() if res.violations else None
+    assert res.states > 300 and res.transitions > res.states
+    d = res.to_dict()
+    json.dumps(d)                          # artifact-serializable
+    assert d["ok"] and d["model"] == name
+
+
+def test_verify_all_clean_and_fast():
+    rep = P.verify_all()
+    assert rep["ok"]
+    for name, m in rep["models"].items():
+        assert m["complete"] and m["ok"], (name, m)
+    assert set(rep["mutations"]) == set(P.SEEDED_MUTATIONS)
+
+
+@pytest.mark.slow
+def test_deep_exhaustive_sweep():
+    rep = P.verify_all(deep=True, max_states=1_000_000)
+    assert rep["ok"]
+    for name, m in rep["models"].items():
+        assert m["complete"], (name, m["states"])
+        # deep configs must actually widen the space beyond shallow
+        assert m["states"] > P.check(P.build_model(name)).states
+
+
+# --------------------------------------------------- seeded mutations
+
+@pytest.mark.parametrize("mname", sorted(P.SEEDED_MUTATIONS))
+def test_seeded_mutation_yields_named_counterexample(mname):
+    spec = P.SEEDED_MUTATIONS[mname]
+    res = P.check(P.build_model(spec["model"], mutation=mname))
+    assert res.violations, f"{mname}: checker missed the seeded bug"
+    v = res.violations[0]
+    assert v.invariant == spec["invariant"], (v.invariant, v.message)
+    assert v.trace and v.depth >= len(v.trace) - 1
+    rendered = v.render()
+    assert spec["invariant"] in rendered
+    for i in range(len(v.trace)):
+        assert f"{i + 1:2d}. " in rendered
+
+
+def test_mutation_counterexamples_are_short():
+    """BFS order ⇒ minimal counterexamples: the seeded bugs are a few
+    steps, not budget-deep wanders (the readability claim)."""
+    for mname, spec in P.SEEDED_MUTATIONS.items():
+        res = P.check(P.build_model(spec["model"], mutation=mname))
+        assert len(res.violations[0].trace) <= 16, mname
+
+
+# ---------------------------------------------------------- recorder
+
+def test_recorder_defaults_off_and_roundtrips():
+    assert P.PROTO.on is False             # env default in the suite
+    P.protocol_event("ps", "noop")         # gated: must not record
+    assert P.PROTO.drain() == []
+    P.PROTO.start()
+    P.PROTO.emit("ps", "promote", rank=1, shard=0, old=1, new=2, want=2)
+    P.protocol_event("decode", "seat", sid=0, epoch=0, n=0)
+    ev = P.PROTO.stop()
+    assert P.PROTO.on is False
+    assert [e["kind"] for e in ev] == ["promote", "seat"]
+    assert [e["i"] for e in ev] == [0, 1]
+    assert ev[0]["plane"] == "ps" and ev[1]["plane"] == "decode"
+    assert P.PROTO.drain() == []           # stop drained the buffer
+
+
+def test_hot_sites_share_the_singleton():
+    """Every instrumented plane guards on THE module singleton, so one
+    flag controls all hooks (and off = one attribute load per site)."""
+    from hetu_tpu.parallel import elastic
+    from hetu_tpu.ps import dist_store
+    from hetu_tpu.serving import decode, fleet
+    for mod in (dist_store, decode, fleet, elastic):
+        assert mod._PROTO is P.PROTO, mod.__name__
+
+
+# ----------------------------------------------- conformance monitors
+
+def _diverged(events, rule, allowlist=None):
+    rep = P.check_conformance(events, allowlist=allowlist)
+    found = [d["rule"] for plane in ("ps", "decode", "elastic")
+             for d in rep[plane]["divergences"]]
+    return rep, rule in found
+
+
+BAD_TRACES = {
+    "epoch-monotonicity": [
+        {"plane": "ps", "kind": "apply", "rank": 0, "shard": 0,
+         "client": 0, "seq": 0, "epoch": 2},
+        {"plane": "ps", "kind": "apply", "rank": 0, "shard": 0,
+         "client": 0, "seq": 1, "epoch": 1},
+    ],
+    "promote-bumps-epoch": [
+        {"plane": "ps", "kind": "promote", "rank": 2, "shard": 1,
+         "old": 3, "new": 3, "want": 3},
+    ],
+    "demoted-copy-served": [
+        {"plane": "ps", "kind": "demote", "rank": 0, "shard": 0,
+         "epoch": 1},
+        {"plane": "ps", "kind": "apply", "rank": 0, "shard": 0,
+         "client": 1, "seq": 0, "epoch": 1},
+    ],
+    "exactly-once-apply": [
+        {"plane": "ps", "kind": "apply", "rank": 0, "shard": 0,
+         "client": 0, "seq": 7, "epoch": 1},
+        {"plane": "ps", "kind": "apply", "rank": 0, "shard": 0,
+         "client": 0, "seq": 7, "epoch": 1},
+    ],
+    "fence-refuses-stale-only": [
+        {"plane": "ps", "kind": "fence_refused", "rank": 1, "shard": 0,
+         "gate": "repl", "cur": 1, "got": 2},
+    ],
+    "fenced-zombie-never-mutates": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 1,
+         "n": 0},
+        {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0,
+         "idx": 0},
+    ],
+    "exactly-once-token": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 0,
+         "n": 0},
+        {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0,
+         "idx": 0},
+        {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0,
+         "idx": 2},                         # gap: 1 never emitted
+    ],
+    "no-journal-gaps": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 0,
+         "n": 0},
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 0,
+         "n": 5},                           # reseat invented 5 tokens
+    ],
+    "fence-only-stale": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 1,
+         "n": 0},
+        {"plane": "decode", "kind": "fenced", "sid": 0, "got": 1,
+         "cur": 1},
+    ],
+    "stream-epoch-monotone": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 0,
+         "n": 0},
+        {"plane": "decode", "kind": "detach", "sid": 0, "old": 1,
+         "new": 2, "n": 0},                 # detached from wrong epoch
+    ],
+    "retry-budget": [
+        {"plane": "decode", "kind": "detach", "sid": 0, "old": 0,
+         "new": 1, "n": 0, "retries": 2, "budget": 1},
+    ],
+    "shrink-only-dead": [
+        {"plane": "elastic", "kind": "resize", "way": "shrink",
+         "step": 1, "removed": [1], "added": [], "active": [0, 2],
+         "min_dp": 2},
+    ],
+    "held-unreachable-never-shrunk": [
+        {"plane": "elastic", "kind": "hold", "rank": 1, "step": 1},
+        {"plane": "elastic", "kind": "resize", "way": "shrink",
+         "step": 2, "removed": [1], "added": [], "active": [0, 2],
+         "min_dp": 2},
+    ],
+    "min-dp-floor": [
+        {"plane": "elastic", "kind": "dead", "rank": 1, "step": 1},
+        {"plane": "elastic", "kind": "resize", "way": "shrink",
+         "step": 1, "removed": [1], "added": [], "active": [0],
+         "min_dp": 2},
+    ],
+    "refuse-only-below-floor": [
+        {"plane": "elastic", "kind": "refused", "step": 1,
+         "survivors": 3, "min_dp": 2},
+    ],
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_TRACES))
+def test_conformance_flags_each_bad_trace(rule):
+    rep, hit = _diverged(BAD_TRACES[rule], rule)
+    assert hit, (rule, rep)
+    assert not rep["ok"]
+
+
+def test_conformance_accepts_well_formed_run():
+    good = [
+        {"plane": "ps", "kind": "promote", "rank": 1, "shard": 0,
+         "old": 1, "new": 2, "want": 2},
+        {"plane": "ps", "kind": "apply", "rank": 1, "shard": 0,
+         "client": 0, "seq": 0, "epoch": 2},
+        {"plane": "ps", "kind": "dedup_hit", "rank": 1, "shard": 0,
+         "client": 0, "seq": 0},
+        {"plane": "ps", "kind": "fence_refused", "rank": 1, "shard": 0,
+         "gate": "serve", "cur": 2, "got": 1},
+        {"plane": "decode", "kind": "seat", "sid": 3, "epoch": 0,
+         "n": 0},
+        {"plane": "decode", "kind": "emit", "sid": 3, "epoch": 0,
+         "idx": 0},
+        {"plane": "decode", "kind": "detach", "sid": 3, "old": 0,
+         "new": 1, "n": 1},
+        {"plane": "decode", "kind": "seat", "sid": 3, "epoch": 1,
+         "n": 1},
+        {"plane": "decode", "kind": "fenced", "sid": 3, "got": 0,
+         "cur": 1},
+        {"plane": "decode", "kind": "emit", "sid": 3, "epoch": 1,
+         "idx": 1},
+        {"plane": "elastic", "kind": "dead", "rank": 2, "step": 5},
+        {"plane": "elastic", "kind": "resize", "way": "shrink",
+         "step": 5, "removed": [2], "added": [], "active": [0, 1],
+         "min_dp": 2},
+    ]
+    rep = P.check_conformance(good)
+    assert rep["ok"], rep
+    assert rep["events"] == len(good)
+    assert rep["ps"]["checked"] == 4 and rep["decode"]["checked"] == 6
+
+
+def test_conformance_allowlist_downgrades_named_rule():
+    events = BAD_TRACES["exactly-once-apply"]
+    rep = P.check_conformance(
+        events, allowlist={"exactly-once-apply": "synthetic test"})
+    assert rep["ok"]
+    assert rep["ps"]["allowlisted"] and \
+        rep["ps"]["allowlisted"][0]["reason"] == "synthetic test"
+
+
+# ------------------------------------------- live-run conformance (PS)
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_live_failover_run_conforms():
+    """A real 3-rank replicated cluster under a primary kill: the
+    recorded transition trace must replay cleanly against the model —
+    the model-vs-code gap the conformance layer exists to close."""
+    from hetu_tpu.ps.dist_store import DistributedStore
+
+    world, rows, width = 3, 24, 4
+    ports = _free_ports(world)
+    endpoints = [("127.0.0.1", p) for p in ports]
+    stores = [DistributedStore(r, world, endpoints, port=ports[r],
+                               rpc_timeout=5.0, rpc_retries=2,
+                               connect_timeout=2.0, replication=2)
+              for r in range(world)]
+    try:
+        tid = None
+        for s in stores:
+            tid = s.init_table(rows, width, opt="sgd", lr=0.1,
+                               init_scale=0.0)
+        stores[0].set_data(tid, np.zeros((rows, width), np.float32))
+        P.PROTO.start()
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            ids = rng.randint(0, rows, 8)
+            stores[0].push(tid, ids,
+                           np.ones((8, width), np.float32) * 0.1)
+        stores[1].server.stop()            # kill shard 1's primary
+        shard1 = np.asarray([1, 4, 7], np.int64)   # keys % 3 == 1
+        stores[0].push(tid, shard1, np.ones((3, width), np.float32))
+        events = P.PROTO.stop()
+    finally:
+        P.PROTO.on = False
+        for s in stores:
+            try:
+                s.close()
+            except Exception:
+                pass
+    kinds = {e["kind"] for e in events}
+    assert "apply" in kinds and "promote" in kinds, kinds
+    rep = P.check_conformance(events)
+    assert rep["ok"], rep
+    assert rep["ps"]["checked"] >= 5
+
+
+# ------------------------------------------------------- CLI + artifact
+
+def test_verify_protocols_cli_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "verify_protocols.py"), "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["ok"] and not rep["deep"]
+    assert set(rep["models"]) == set(P.MODELS)
+    assert rep["conformance_selftest"]["ok"]
+
+
+def test_verify_protocols_mutation_and_trace_modes(tmp_path, capsys):
+    from tools import verify_protocols as vp
+    assert vp.main(["--mutation", "zombie_emit_unfenced"]) == 0
+    text = capsys.readouterr().out
+    assert "fenced-zombie-never-mutates" in text
+    assert "counterexample" in text
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(BAD_TRACES["promote-bumps-epoch"][0])
+                   + "\n")
+    assert vp.main(["--trace", str(bad)]) == 1
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(vp.GOOD_TRACE))
+    assert vp.main(["--trace", str(good)]) == 0
+
+
+def test_committed_artifact_is_green():
+    path = os.path.join(ROOT, "artifacts", "protocol_verify.json")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["ok"] and art["deep"]
+    for name, m in art["models"].items():
+        assert m["complete"] and m["ok"], name
+    for mname, m in art["mutations"].items():
+        assert m["ok"] and m["violated"] == \
+            P.SEEDED_MUTATIONS[mname]["invariant"]
+    assert art["provenance"]["workload"]["tool"] == "verify_protocols"
+
+
+# ----------------------------------------------------- metrics bridge
+
+def test_check_records_protocol_counters():
+    from hetu_tpu import metrics
+    metrics.reset_protocol_counts()
+    res = P.check(P.build_model("elastic_resize"))
+    counts = metrics.protocol_counts()
+    assert counts.get("protocol_states_explored", 0) == res.states
+    metrics.reset_protocol_counts()

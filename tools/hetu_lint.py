@@ -41,6 +41,16 @@ Checks
    CLI never imports jax); ``--concurrency`` runs it alone, and it is
    part of the default ``run_all`` gate.
 
+6. **protocol drift** (ISSUE 20): every ``OP_*`` opcode the ps/ layer
+   defines must appear in the protocol model checker's message
+   alphabet (``hetu_tpu/analysis/protocol.py``
+   ``PS_MESSAGE_ALPHABET`` — the model gives it transition semantics)
+   or in its allowlist (``PS_OPCODE_ALLOWLIST`` — an explicit reason
+   why it carries no replicated-state mutation), so a new
+   replication-relevant opcode cannot silently bypass the model.
+   Stale alphabet entries (opcodes that no longer exist) and
+   reason-less entries are findings too.
+
 Usage: ``python tools/hetu_lint.py [--concurrency] [root]`` — prints
 findings, exits non-zero if any.  Every check also takes raw source
 strings so the test suite can prove each detector fires on a synthetic
@@ -180,6 +190,83 @@ def check_opcodes(sources):
                 f"opcode {name} has no server dispatch arm (never "
                 f"compared with ==) — a client can send a frame the "
                 f"server cannot handle")
+    return findings
+
+
+# ----------------------------------------------------------- protocol drift
+
+_protocol_mods = {}      # resolved checker path -> loaded module
+
+
+def protocol_checker(root=REPO):
+    """The ISSUE 20 protocol model checker
+    (``hetu_tpu/analysis/protocol.py``), loaded by FILE PATH with the
+    same per-resolved-path cache discipline as
+    :func:`concurrency_engine` — the module is stdlib-only, so the lint
+    CLI stays independent of the package's jax imports."""
+    path = os.path.abspath(
+        os.path.join(root, "hetu_tpu", "analysis", "protocol.py"))
+    mod = _protocol_mods.get(path)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            f"_hetu_lint_protocol_{len(_protocol_mods)}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _protocol_mods[path] = mod
+    return mod
+
+
+def check_protocol_alphabet(sources, alphabet=None, allowlist=None,
+                            root=REPO):
+    """``{filename: source}`` (the ps/ tree) -> findings: every ``OP_*``
+    opcode defined there must appear in the protocol model's message
+    alphabet (``PS_MESSAGE_ALPHABET`` — the checker gives it transition
+    semantics) or in the allowlist (``PS_OPCODE_ALLOWLIST`` — an
+    explicit reason it carries no replicated-state mutation), never in
+    both; and neither map may name an opcode that no longer exists or
+    carry an empty reason.  ``alphabet``/``allowlist`` overrides let the
+    synthetic-violation tests exercise each finding."""
+    findings = []
+    if alphabet is None or allowlist is None:
+        mod = protocol_checker(root)
+        if alphabet is None:
+            alphabet = mod.PS_MESSAGE_ALPHABET
+        if allowlist is None:
+            allowlist = mod.PS_OPCODE_ALLOWLIST
+    defs = {}
+    for fname, src in sources.items():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(f"{fname}: syntax error: {e}")
+            continue
+        defs.update(_opcode_defs(tree, fname, findings))
+    for name in sorted(defs):
+        in_alpha, in_allow = name in alphabet, name in allowlist
+        if not in_alpha and not in_allow:
+            findings.append(
+                f"opcode {name} is in neither the protocol model's "
+                f"message alphabet (analysis/protocol.py "
+                f"PS_MESSAGE_ALPHABET) nor its allowlist "
+                f"(PS_OPCODE_ALLOWLIST) — give it model semantics or an "
+                f"explicit out-of-model reason")
+        elif in_alpha and in_allow:
+            findings.append(
+                f"opcode {name} appears in BOTH the protocol message "
+                f"alphabet and the allowlist — modeled or exempt, pick "
+                f"one")
+    for name in sorted(set(alphabet) | set(allowlist)):
+        if name not in defs:
+            findings.append(
+                f"protocol alphabet/allowlist names opcode {name} that "
+                f"no ps/ source defines — stale model vocabulary")
+    for map_name, mapping in (("PS_MESSAGE_ALPHABET", alphabet),
+                              ("PS_OPCODE_ALLOWLIST", allowlist)):
+        for name, reason in sorted(mapping.items()):
+            if not str(reason).strip():
+                findings.append(
+                    f"{map_name}[{name!r}] carries an empty reason — the "
+                    f"drift gate's whole point is the documented why")
     return findings
 
 
@@ -434,6 +521,7 @@ def run_all(root=REPO, style_dirs=("hetu_tpu", "tools")):
     # the same {relpath: source} map scan_package would rebuild
     findings += run_concurrency(root, sources=pkg)
     findings += check_opcodes(ps)
+    findings += check_protocol_alphabet(ps, root=root)
     metrics_key = os.path.join("hetu_tpu", "metrics.py")
     profiler_key = os.path.join("hetu_tpu", "profiler.py")
     findings += check_metrics(pkg[metrics_key], pkg[profiler_key],

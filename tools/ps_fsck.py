@@ -20,7 +20,12 @@ Usage::
 
 ``--verify`` exits nonzero on any STABLE divergence, missing replica, or
 multi-/zero-lineage shard, so a CI job or an operator cron can gate on
-it.  A holder that is unreachable or answers "holds no copy" is reported
+it.  Every failure names the protocol-model invariant it falsifies
+(``exactly-once-apply``, ``single-serving-lineage``,
+``epoch-monotonicity`` — the same names
+``hetu_tpu.analysis.protocol.PSReplicationModel`` checks exhaustively),
+so an fsck report and a model-checker counterexample speak one
+vocabulary.  A holder that is unreachable or answers "holds no copy" is reported
 per shard; with ``--verify`` that is a failure too (redundancy is the
 thing being checked).
 
@@ -137,9 +142,13 @@ def fsck(endpoints, n_tables, replication=2, timeout=10.0, retries=0,
                     if v["status"] == "ok"}) > 1
 
     def probe_lineage(shard):
-        """Every holder's (epoch, serving) + the sorted serving ranks —
-        exactly one holder may serve a shard (0 is an outage, 2+ a
-        split brain)."""
+        """Every holder's (epoch, serving) + the sorted serving ranks.
+        Returns the name of the violated model invariant (matching
+        ``hetu_tpu.analysis.protocol.PSReplicationModel``) or None:
+        ``single-serving-lineage`` when not exactly one holder serves
+        (0 is an outage, 2+ a split brain), ``epoch-monotonicity`` when
+        the one serving holder's fencing epoch is BELOW another copy's —
+        a stale lineage serving past a promotion it never saw."""
         eps = {}
         for rank in holders_of(shard):
             status, val = shard_epoch(endpoints[rank], shard,
@@ -152,10 +161,16 @@ def fsck(endpoints, n_tables, replication=2, timeout=10.0, retries=0,
                          if v["status"] == "ok" and v["serving"])
         report["epochs"][shard] = eps
         report["serving_ranks"][shard] = serving
-        return len(serving) != 1
+        if len(serving) != 1:
+            return "single-serving-lineage"
+        ok_eps = [v["epoch"] for v in eps.values() if v["status"] == "ok"]
+        if ok_eps and eps[serving[0]]["epoch"] < max(ok_eps):
+            return "epoch-monotonicity"
+        return None
 
     pending = []                       # (shard, table) pairs to re-check
     pending_lineage = []               # shards whose lineage looked split
+    lineage_kind = {}                  # shard -> violated invariant name
     for shard in range(world):
         per_shard = {}
         for table in range(n_tables):
@@ -164,8 +179,10 @@ def fsck(endpoints, n_tables, replication=2, timeout=10.0, retries=0,
                 pending.append((shard, table))
             per_shard[table] = digests
         report["shards"][shard] = per_shard
-        if probe_lineage(shard):
+        kind = probe_lineage(shard)
+        if kind:
             pending_lineage.append(shard)
+            lineage_kind[shard] = kind
 
     # stabilisation passes: only the diverging pairs / split-looking
     # shards are re-probed, so an in-flight op-log frame or a probe that
@@ -188,22 +205,31 @@ def fsck(endpoints, n_tables, replication=2, timeout=10.0, retries=0,
         pending = still
         still_split = []
         for shard in pending_lineage:
-            if probe_lineage(shard):
+            kind = probe_lineage(shard)
+            if kind:
                 still_split.append(shard)
+                lineage_kind[shard] = kind
             else:
                 report["transient_cleared"] += 1
         pending_lineage = still_split
 
+    # each finding names the protocol-model invariant it falsifies (the
+    # names match hetu_tpu.analysis.protocol.PSReplicationModel, so a
+    # live-cluster fsck failure points at the same property the model
+    # checker proves on the abstract protocol)
     for shard, table in pending:
         digests = report["shards"][shard][table]
         report["mismatches"].append(
             {"shard": shard, "table": table,
+             "invariant": "exactly-once-apply",
              "digests": {r: v["value"] for r, v in digests.items()
                          if v["status"] == "ok"}})
     for shard in pending_lineage:
         eps = report["epochs"][shard]
         report["lineage_violations"].append(
             {"shard": shard,
+             "invariant": lineage_kind.get(shard,
+                                           "single-serving-lineage"),
              "serving_ranks": report["serving_ranks"][shard],
              "epochs": {r: v["epoch"] for r, v in eps.items()
                         if v["status"] == "ok"}})
@@ -266,10 +292,13 @@ def main(argv=None):
         print(json.dumps(report, indent=2))
     else:
         for m in report["mismatches"]:
-            print(f"MISMATCH shard {m['shard']} table {m['table']}: "
+            print(f"MISMATCH shard {m['shard']} table {m['table']} "
+                  f"[invariant: {m['invariant']} — replicas replaying "
+                  f"one op-log must be bitwise identical]: "
                   f"{m['digests']}")
         for v in report["lineage_violations"]:
-            print(f"LINEAGE shard {v['shard']}: serving ranks "
+            print(f"LINEAGE shard {v['shard']} [invariant: "
+                  f"{v['invariant']}]: serving ranks "
                   f"{v['serving_ranks']} (want exactly 1), epochs "
                   f"{v['epochs']}")
         for e in report["errors"]:

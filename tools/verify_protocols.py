@@ -1,0 +1,273 @@
+"""verify_protocols — CLI for the ISSUE 20 protocol model checker.
+
+Front-end over ``hetu_tpu/analysis/protocol.py``: exhaustively explores
+the PS-replication, decode-recovery and elastic-resize models (BFS over
+the full reachable state space at the configured bounds), proves every
+seeded historical mutation still yields a counterexample naming its
+invariant, self-tests the trace-conformance monitors on canned
+good/bad event streams, and (``--out``) writes
+``artifacts/protocol_verify.json`` with provenance.
+
+The checker module is loaded by FILE PATH (same discipline as
+``tools/hetu_lint.py``): it is stdlib-only, so this CLI never imports
+jax and runs anywhere in seconds.
+
+Usage::
+
+    python tools/verify_protocols.py                 # shallow sweep
+    python tools/verify_protocols.py --deep          # exhaustive (slow)
+    python tools/verify_protocols.py --json
+    python tools/verify_protocols.py --out artifacts/protocol_verify.json
+    python tools/verify_protocols.py --mutation promote_no_epoch_bump
+    python tools/verify_protocols.py --trace run_events.json
+
+``--mutation NAME`` renders the FULL shortest counterexample trace for
+one seeded mutation (the summary report only carries its length) — the
+operator's view of "what interleaving breaks if this gate is removed".
+``--trace FILE`` replays a recorded run (a JSON list or JSONL of
+``PROTO`` events, e.g. dumped by a bench leg) against the models'
+transition relations and reports per-plane conformance verdicts.
+
+Exit status is nonzero on any invariant violation at HEAD, any seeded
+mutation the checker FAILS to catch, any conformance divergence, or a
+truncated (incomplete) exploration — so CI can gate on it directly.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import artifact_schema  # noqa: E402  (repo root; stdlib-only)
+
+
+def load_checker():
+    """Load ``hetu_tpu/analysis/protocol.py`` by file path — stdlib-only,
+    no package (and hence no jax) import."""
+    path = os.path.join(ROOT, "hetu_tpu", "analysis", "protocol.py")
+    spec = importlib.util.spec_from_file_location(
+        "_verify_protocols_checker", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------- conformance self-test
+
+#: One well-formed run touching every monitored plane: a PS promotion
+#: with an epoch bump followed by applies and a stale-frame refusal, a
+#: decode stream that detaches once and reseats with a contiguous
+#: journal, and an elastic shrink that removes only a dead rank.
+GOOD_TRACE = [
+    {"plane": "ps", "kind": "adopt", "rank": 1, "shard": 0, "new": 1},
+    {"plane": "ps", "kind": "promote", "rank": 1, "shard": 0,
+     "old": 1, "new": 2, "want": 2},
+    {"plane": "ps", "kind": "apply", "rank": 1, "shard": 0,
+     "client": 0, "seq": 0, "epoch": 2},
+    {"plane": "ps", "kind": "dedup_hit", "rank": 1, "shard": 0,
+     "client": 0, "seq": 0},
+    {"plane": "ps", "kind": "apply_replica", "rank": 2, "shard": 0,
+     "client": 0, "seq": 0},
+    {"plane": "ps", "kind": "fence_refused", "rank": 1, "shard": 0,
+     "gate": "serve", "cur": 2, "got": 1},
+    {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 0, "n": 0},
+    {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0, "idx": 0},
+    {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0, "idx": 1},
+    {"plane": "decode", "kind": "detach", "sid": 0, "old": 0, "new": 1,
+     "n": 2},
+    {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 1, "n": 2},
+    {"plane": "decode", "kind": "fenced", "sid": 0, "got": 0, "cur": 1},
+    {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 1, "idx": 2},
+    {"plane": "decode", "kind": "finish", "sid": 0, "n": 3},
+    {"plane": "elastic", "kind": "dead", "rank": 2, "step": 4},
+    {"plane": "elastic", "kind": "resize", "way": "shrink", "step": 4,
+     "removed": [2], "added": [], "active": [0, 1], "min_dp": 2},
+]
+
+#: Minimal bad runs, one per historical bug class the monitors exist to
+#: catch — each must be flagged under exactly the named rule.
+BAD_TRACES = {
+    "promote-bumps-epoch": [
+        {"plane": "ps", "kind": "promote", "rank": 0, "shard": 0,
+         "old": 2, "new": 2, "want": 2},
+    ],
+    "fenced-zombie-never-mutates": [
+        {"plane": "decode", "kind": "seat", "sid": 0, "epoch": 1,
+         "n": 0},
+        {"plane": "decode", "kind": "emit", "sid": 0, "epoch": 0,
+         "idx": 0},
+    ],
+    "shrink-only-dead": [
+        {"plane": "elastic", "kind": "resize", "step": 1,
+         "removed": [1], "added": [], "active": [0, 2], "min_dp": 2},
+    ],
+}
+
+
+def conformance_selftest(proto):
+    """Prove the monitors accept a well-formed run and flag each canned
+    bug class under its named rule."""
+    good = proto.check_conformance(GOOD_TRACE)
+    seeded = {}
+    for rule, events in BAD_TRACES.items():
+        rep = proto.check_conformance(events)
+        flagged = any(d["rule"] == rule
+                      for r in ("ps", "decode", "elastic")
+                      for d in rep[r]["divergences"])
+        seeded[rule] = flagged
+    return {"good_trace_ok": good["ok"],
+            "good_trace_events": good["events"],
+            "seeded_bad_flagged": seeded,
+            "ok": good["ok"] and all(seeded.values())}
+
+
+# --------------------------------------------------------- rendering
+
+def _render_violation(v):
+    lines = [f"  invariant violated: {v['invariant']}",
+             f"    {v['message']}",
+             f"    counterexample ({len(v['trace'])} steps):"]
+    lines += [f"      {i + 1:2d}. {lab}" for i, lab in
+              enumerate(v["trace"])]
+    lines.append(f"    state: {v['state']}")
+    return "\n".join(lines)
+
+
+def render(report):
+    out = [f"protocol verification "
+           f"({'deep' if report['deep'] else 'shallow'} configs, "
+           f"{report['elapsed_s']:.2f}s)"]
+    for name, m in report["models"].items():
+        flag = "OK" if m["ok"] and m["complete"] else \
+            ("INCOMPLETE" if m["ok"] else "VIOLATED")
+        out.append(f"  model {name:<16} {m['states']:>7} states  "
+                   f"{m['transitions']:>7} transitions  "
+                   f"depth {m['depth']:>3}  {flag}")
+        for v in m["violations"]:
+            out.append(_render_violation(v))
+    for name, m in report["mutations"].items():
+        flag = "CAUGHT" if m["ok"] else "MISSED"
+        out.append(f"  mutation {name:<24} -> "
+                   f"{m['violated'] or 'no violation'} "
+                   f"({m['trace_len']} steps)  {flag}")
+    st = report["conformance_selftest"]
+    n_ok = sum(st["seeded_bad_flagged"].values())
+    out.append(f"  conformance self-test: good trace "
+               f"{'accepted' if st['good_trace_ok'] else 'REJECTED'}; "
+               f"{n_ok}/{len(st['seeded_bad_flagged'])} seeded bad "
+               f"traces flagged")
+    out.append(f"verdict: {'OK' if report['ok'] else 'FAIL'}")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------- modes
+
+def run_verify(proto, deep, max_states):
+    t0 = time.perf_counter()
+    report = proto.verify_all(deep=deep, max_states=max_states)
+    report["conformance_selftest"] = conformance_selftest(proto)
+    report["ok"] = bool(report["ok"]
+                        and report["conformance_selftest"]["ok"])
+    report["deep"] = deep
+    report["max_states"] = max_states
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
+def run_mutation(proto, name, out):
+    spec = proto.SEEDED_MUTATIONS[name]
+    res = proto.check(proto.build_model(spec["model"], mutation=name))
+    out(f"mutation {name} ({spec['model']}): {spec['history']}")
+    out(f"  expected invariant: {spec['invariant']}")
+    if not res.violations:
+        out("  NO VIOLATION FOUND — the checker missed this mutation")
+        return 1
+    v = res.violations[0]
+    out(v.render())
+    return 0 if v.invariant == spec["invariant"] else 1
+
+
+def load_events(path):
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if text.startswith("["):
+        return json.loads(text)
+    return [json.loads(line) for line in text.splitlines() if line]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="verify_protocols",
+        description="Exhaustive model check of the PS replication, "
+                    "decode recovery and elastic resize protocols")
+    p.add_argument("--deep", action="store_true",
+                   help="exhaustive sweep at the wide configs (slow; "
+                        "tier-1 uses the shallow bounds)")
+    p.add_argument("--max-states", type=int, default=1_000_000,
+                   help="state-count budget per model (exploration is "
+                        "flagged incomplete when hit)")
+    p.add_argument("--mutation", choices=None,
+                   help="render the full counterexample for ONE seeded "
+                        "mutation instead of the sweep")
+    p.add_argument("--trace", metavar="FILE",
+                   help="replay a recorded PROTO event dump (JSON list "
+                        "or JSONL) through the conformance monitors")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON")
+    p.add_argument("--out", metavar="PATH",
+                   help="also write the report (with provenance) to "
+                        "PATH — the artifacts/protocol_verify.json "
+                        "writer")
+    args = p.parse_args(argv)
+    proto = load_checker()
+
+    if args.mutation:
+        if args.mutation not in proto.SEEDED_MUTATIONS:
+            p.error(f"unknown mutation {args.mutation!r}; have "
+                    f"{sorted(proto.SEEDED_MUTATIONS)}")
+        return run_mutation(proto, args.mutation, print)
+
+    if args.trace:
+        events = load_events(args.trace)
+        rep = proto.check_conformance(events)
+        if args.json:
+            print(json.dumps(rep, indent=1))
+        else:
+            for plane in ("ps", "decode", "elastic"):
+                r = rep[plane]
+                print(f"  plane {plane:<8} {r['checked']:>6} events  "
+                      f"{len(r['divergences'])} divergence(s)  "
+                      f"{len(r['allowlisted'])} allowlisted")
+                for d in r["divergences"]:
+                    print(f"    DIVERGED [{d['rule']}] event "
+                          f"{d['event']}: {d['detail']}")
+            print("conformance:", "OK" if rep["ok"] else "FAIL")
+        return 0 if rep["ok"] else 1
+
+    report = run_verify(proto, args.deep, args.max_states)
+    if args.out:
+        workload = {"tool": "verify_protocols", "deep": args.deep,
+                    "max_states": args.max_states,
+                    "models": list(proto.MODELS),
+                    "mutations": sorted(proto.SEEDED_MUTATIONS)}
+        report["provenance"] = artifact_schema.provenance(
+            workload, embed_workload=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
